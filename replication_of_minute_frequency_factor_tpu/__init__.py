@@ -1,0 +1,21 @@
+"""TPU-native minute-frequency factor framework.
+
+A ground-up JAX/XLA re-design of the capabilities of
+``C-X-Lu/Replication-of-Minute-Frequency-Factor`` (the CICC high-frequency
+factor handbook replication): 58 minute-bar factor kernels, a batch/incremental
+computation pipeline, and the factor-evaluation stack (coverage, IC/rank-IC,
+decile group backtests), executed as fused XLA graphs over dense
+``[tickers, 240, fields]`` day tensors sharded across a TPU mesh.
+
+Layering (mirrors reference layer map, SURVEY.md §1):
+  L0 data plane   -> :mod:`.data`       (parquet day files -> dense day tensors)
+  L1 kernels      -> :mod:`.models`     (58 factors as fused jit graphs)
+                     :mod:`.oracle`     (numpy/pandas polars-semantics oracle)
+  L2 pipeline     -> :mod:`.pipeline`   (incremental compute driver + cache)
+  L3 evaluation   -> :mod:`.factor`, :mod:`.evaluation`
+  L4 scale-out    -> :mod:`.parallel`   (mesh/sharding/collectives)
+"""
+
+__version__ = "0.1.0"
+
+from .config import Config, get_config, set_config  # noqa: F401

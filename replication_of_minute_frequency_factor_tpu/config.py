@@ -1,0 +1,74 @@
+"""Typed configuration.
+
+The reference hardcodes Windows paths and magic constants
+(``Factor.py:49,70``, ``MinuteFrequentFactorCICC.py:64,68``); here they are a
+small dataclass with environment-variable overrides so the same code runs in
+tests, on a dev box, and on a TPU pod.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass
+class Config:
+    # --- data roots (reference: hardcoded D:\QuantData\... paths) ---
+    #: directory of per-trading-day minute-bar parquet files (YYYYMMDD*.parquet)
+    minute_dir: str = "data/kline"
+    #: single parquet of daily price/volume data (CSMAR column names)
+    daily_pv_path: str = "data/price_volume.parquet"
+    #: directory where factor exposures are cached
+    factor_dir: str = "data/factors"
+
+    # --- execution ---
+    #: 'jax' (TPU/XLA fused kernels) or 'numpy' (polars-semantics CPU oracle)
+    backend: str = "jax"
+    #: dtype for on-device compute ('float32' is the TPU-native choice;
+    #: 'bfloat16' trades accuracy for HBM bandwidth on the bar tensor)
+    dtype: str = "float32"
+    #: how many trading days to batch into one device step
+    days_per_batch: int = 8
+    #: logical device mesh (batch_days, tickers); None = single device
+    mesh_shape: Optional[Tuple[int, int]] = None
+    #: replicate reference quirks Q1-Q4 bit-for-bit (SURVEY.md §2.5).
+    #: False switches to the mathematically intended definitions.
+    replicate_quirks: bool = True
+
+    @classmethod
+    def from_env(cls) -> "Config":
+        cfg = cls()
+        mapping = {
+            "MFF_MINUTE_DIR": "minute_dir",
+            "MFF_DAILY_PV_PATH": "daily_pv_path",
+            "MFF_FACTOR_DIR": "factor_dir",
+            "MFF_BACKEND": "backend",
+            "MFF_DTYPE": "dtype",
+        }
+        for env, field in mapping.items():
+            if env in os.environ:
+                setattr(cfg, field, os.environ[env])
+        if "MFF_DAYS_PER_BATCH" in os.environ:
+            cfg.days_per_batch = int(os.environ["MFF_DAYS_PER_BATCH"])
+        if "MFF_REPLICATE_QUIRKS" in os.environ:
+            cfg.replicate_quirks = os.environ["MFF_REPLICATE_QUIRKS"] not in (
+                "0", "false", "False")
+        return cfg
+
+
+_config: Optional[Config] = None
+
+
+def get_config() -> Config:
+    global _config
+    if _config is None:
+        _config = Config.from_env()
+    return _config
+
+
+def set_config(cfg: Config) -> Config:
+    global _config
+    _config = cfg
+    return cfg

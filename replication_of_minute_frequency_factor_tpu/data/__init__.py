@@ -1,0 +1,4 @@
+"""L0 data plane: parquet/long-format minute bars -> dense day tensors."""
+
+from .minute import DayGrid, FIELDS, grid_day, F_OPEN, F_HIGH, F_LOW, F_CLOSE, F_VOLUME  # noqa: F401
+from .synthetic import synth_day  # noqa: F401

@@ -1,0 +1,87 @@
+"""Minute-bar gridding: long rows -> dense ``[tickers, 240, fields]`` tensor.
+
+The reference consumes one parquet per trading day with long-format rows
+``(code, date, time, open, high, low, close, volume)``
+(SURVEY.md §2.3; MinuteFrequentFactorCICC.py:68-77). The TPU-native layout is
+a dense f32 day tensor over the 240-slot trade-minute grid plus a validity
+mask — missing bars (halts, late opens) become cleared mask lanes instead of
+absent rows, which is what lets all 58 kernels run as one fused XLA graph
+with static shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .. import sessions
+
+FIELDS = ("open", "high", "low", "close", "volume")
+F_OPEN, F_HIGH, F_LOW, F_CLOSE, F_VOLUME = range(5)
+
+
+@dataclasses.dataclass
+class DayGrid:
+    """One trading day, densely gridded.
+
+    bars:  f32[T, 240, 5]  (open, high, low, close, volume); 0 where invalid
+    mask:  bool[T, 240]    bar present at (ticker, slot)
+    codes: [T] ticker identifiers, sorted ascending
+    date:  the trading date (numpy datetime64[D] scalar or None)
+    """
+
+    bars: np.ndarray
+    mask: np.ndarray
+    codes: np.ndarray
+    date: Optional[np.datetime64] = None
+
+    @property
+    def n_tickers(self) -> int:
+        return self.bars.shape[0]
+
+
+def grid_day(
+    code: np.ndarray,
+    time: np.ndarray,
+    open_: np.ndarray,
+    high: np.ndarray,
+    low: np.ndarray,
+    close: np.ndarray,
+    volume: np.ndarray,
+    date: Optional[np.datetime64] = None,
+    codes: Optional[Sequence] = None,
+    dtype=np.float32,
+) -> DayGrid:
+    """Scatter long-format rows of one day onto the dense minute grid.
+
+    * off-grid timestamps (anything but whole minutes in 09:30-11:29 /
+      13:00-14:59) are dropped — the reference's formula would alias 11:30
+      onto 13:00 (sessions.py);
+    * duplicate (code, slot) rows keep the last occurrence;
+    * ``codes`` pins the ticker axis (for cross-day batching); defaults to
+      the sorted unique codes present.
+    """
+    code = np.asarray(code)
+    slots = sessions.time_to_slot(np.asarray(time))
+    ok = slots >= 0
+
+    if codes is None:
+        codes = np.unique(code)
+    else:
+        # the ticker axis is always sorted ascending (np.searchsorted below
+        # requires it; callers must read the axis order back off .codes)
+        codes = np.sort(np.asarray(codes))
+    tidx = np.searchsorted(codes, code)
+    known = (tidx < len(codes)) & (np.take(codes, np.minimum(tidx, len(codes) - 1)) == code)
+    ok &= known
+
+    T = len(codes)
+    bars = np.zeros((T, sessions.N_SLOTS, len(FIELDS)), dtype=dtype)
+    mask = np.zeros((T, sessions.N_SLOTS), dtype=bool)
+    ti, si = tidx[ok], slots[ok]
+    for f, col in zip(range(5), (open_, high, low, close, volume)):
+        bars[ti, si, f] = np.asarray(col)[ok]
+    mask[ti, si] = True
+    return DayGrid(bars=bars, mask=mask, codes=codes, date=date)

@@ -1,0 +1,36 @@
+"""Masked array ops with polars-compatible reduction semantics.
+
+The dense ``[..., 240]`` day grid carries a boolean validity mask; a cleared
+lane is polars *null* (skipped by reductions), while a set lane holding NaN is
+polars *NaN* (propagates through means/stds). This null-vs-NaN split is the
+load-bearing semantic the whole kernel library builds on (SURVEY.md §7
+"hard parts" #1).
+"""
+
+from .masked import (  # noqa: F401
+    count,
+    masked_corr,
+    masked_first,
+    masked_kurtosis,
+    masked_last,
+    masked_max,
+    masked_mean,
+    masked_min,
+    masked_product,
+    masked_skew,
+    masked_std,
+    masked_sum,
+    masked_var,
+    ffill,
+    pct_change_valid,
+    shift_valid,
+)
+from .ranking import (  # noqa: F401
+    bottomk_threshold,
+    masked_order,
+    rank_average,
+    topk_sum,
+    topk_threshold,
+)
+from .rolling import rolling_window_stats  # noqa: F401
+from .segments import segment_stats_by_value, pdf_quantile_rank  # noqa: F401

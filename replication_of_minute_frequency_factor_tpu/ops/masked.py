@@ -1,0 +1,183 @@
+"""Masked reductions along the last axis, matching polars defaults.
+
+Conventions (SURVEY.md §2.5 Q11):
+  * null == masked-out lane: skipped by sum/mean/std/skew/kurtosis/corr;
+  * NaN inside a valid lane propagates (polars treats NaN as a float value);
+  * ``std``/``var`` default ``ddof=1``; result is null (NaN here) when
+    ``n <= ddof``;
+  * ``skew`` is the biased Fisher-Pearson g1 = m3 / m2^1.5;
+  * ``kurtosis`` is biased Fisher excess = m4 / m2^2 - 3;
+  * ``corr`` is Pearson over pairwise-valid lanes.
+
+All functions broadcast over leading dims and reduce the trailing axis, so the
+same code serves ``[240]``, ``[T, 240]`` and ``[D, T, 240]`` tensors — the
+XLA-friendly formulation of the reference's ``group_by(['code','date'])``
+aggregations. Central moments use the two-pass (subtract-mean) form for f32
+stability on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_NAN = jnp.nan
+
+
+def count(mask):
+    return jnp.sum(mask, axis=-1)
+
+
+def masked_sum(x, mask):
+    return jnp.sum(jnp.where(mask, x, 0.0), axis=-1)
+
+
+def masked_mean(x, mask):
+    n = count(mask)
+    s = masked_sum(x, mask)
+    return jnp.where(n > 0, s / jnp.maximum(n, 1), _NAN)
+
+
+def _central_moment(x, mask, mu, k):
+    d = jnp.where(mask, x - mu[..., None], 0.0)
+    return jnp.sum(d**k, axis=-1)
+
+
+def masked_var(x, mask, ddof: int = 1):
+    n = count(mask)
+    mu = masked_mean(x, mask)
+    m2 = _central_moment(x, mask, mu, 2)
+    denom = jnp.maximum(n - ddof, 1)
+    return jnp.where(n > ddof, m2 / denom, _NAN)
+
+
+def masked_std(x, mask, ddof: int = 1):
+    return jnp.sqrt(masked_var(x, mask, ddof=ddof))
+
+
+def masked_skew(x, mask):
+    """Biased Fisher-Pearson g1 (polars ``Expr.skew(bias=True)`` default)."""
+    n = count(mask)
+    mu = masked_mean(x, mask)
+    nn = jnp.maximum(n, 1)
+    m2 = _central_moment(x, mask, mu, 2) / nn
+    m3 = _central_moment(x, mask, mu, 3) / nn
+    g1 = m3 / jnp.power(m2, 1.5)  # m2 == 0 -> NaN/inf, as polars
+    return jnp.where(n > 0, g1, _NAN)
+
+
+def masked_kurtosis(x, mask):
+    """Biased Fisher excess kurtosis (polars ``Expr.kurtosis()`` default)."""
+    n = count(mask)
+    mu = masked_mean(x, mask)
+    nn = jnp.maximum(n, 1)
+    m2 = _central_moment(x, mask, mu, 2) / nn
+    m4 = _central_moment(x, mask, mu, 4) / nn
+    g2 = m4 / (m2 * m2) - 3.0
+    return jnp.where(n > 0, g2, _NAN)
+
+
+def masked_corr(x, y, mask):
+    """Pearson correlation over pairwise-valid lanes (polars ``pl.corr``)."""
+    n = count(mask)
+    mx = masked_mean(x, mask)
+    my = masked_mean(y, mask)
+    dx = jnp.where(mask, x - mx[..., None], 0.0)
+    dy = jnp.where(mask, y - my[..., None], 0.0)
+    cov = jnp.sum(dx * dy, axis=-1)
+    vx = jnp.sum(dx * dx, axis=-1)
+    vy = jnp.sum(dy * dy, axis=-1)
+    r = cov / jnp.sqrt(vx * vy)  # zero variance -> NaN, as polars
+    return jnp.where(n > 1, r, _NAN)
+
+
+def masked_product(x, mask):
+    return jnp.prod(jnp.where(mask, x, 1.0), axis=-1)
+
+
+def masked_min(x, mask):
+    n = count(mask)
+    m = jnp.min(jnp.where(mask, x, jnp.inf), axis=-1)
+    return jnp.where(n > 0, m, _NAN)
+
+
+def masked_max(x, mask):
+    n = count(mask)
+    m = jnp.max(jnp.where(mask, x, -jnp.inf), axis=-1)
+    return jnp.where(n > 0, m, _NAN)
+
+
+def _first_valid_index(mask):
+    return jnp.argmax(mask, axis=-1)
+
+
+def _last_valid_index(mask):
+    L = mask.shape[-1]
+    return L - 1 - jnp.argmax(mask[..., ::-1], axis=-1)
+
+
+def masked_first(x, mask):
+    """Value at the first valid lane (polars ``.first()`` on the group)."""
+    idx = _first_valid_index(mask)
+    v = jnp.take_along_axis(x, idx[..., None], axis=-1)[..., 0]
+    return jnp.where(count(mask) > 0, v, _NAN)
+
+
+def masked_last(x, mask):
+    idx = _last_valid_index(mask)
+    v = jnp.take_along_axis(x, idx[..., None], axis=-1)[..., 0]
+    return jnp.where(count(mask) > 0, v, _NAN)
+
+
+def ffill(x, mask):
+    """Forward-fill values over invalid lanes (last valid value so far).
+
+    Lanes before the first valid lane are left as NaN. Returns
+    ``(filled, has_prev)`` where ``has_prev[..., i]`` says lane i has seen at
+    least one valid lane at or before i.
+    """
+    L = x.shape[-1]
+    idx = jnp.arange(L)
+    last_valid = jnp.maximum.accumulate(jnp.where(mask, idx, -1), axis=-1)
+    has_prev = last_valid >= 0
+    filled = jnp.take_along_axis(x, jnp.maximum(last_valid, 0), axis=-1)
+    return jnp.where(has_prev, filled, _NAN), has_prev
+
+
+def shift_valid(x, mask, periods: int = 1):
+    """Shift over the *valid* lanes only — the dense-grid analogue of polars
+    ``shift(periods)`` on a group whose rows are the present bars in slot
+    order. Returns ``(values, out_mask)``: for ``periods=1`` each valid lane
+    receives the previous valid lane's value (null at the first valid lane).
+
+    Only |periods| == 1 is needed by the reference kernels
+    (``corr_pvd``/``corr_pvl``, MinuteFrequentFactorCalculateMethodsCICC.py:899,913).
+    """
+    if periods == 0:
+        return x, mask
+    L = x.shape[-1]
+    idx = jnp.arange(L)
+    if periods > 0:
+        if periods != 1:
+            raise NotImplementedError("only |periods| <= 1 supported")
+        last_valid = jnp.maximum.accumulate(jnp.where(mask, idx, -1), axis=-1)
+        # previous valid index *strictly before* lane i
+        prev = jnp.concatenate(
+            [jnp.full(last_valid.shape[:-1] + (1,), -1, last_valid.dtype),
+             last_valid[..., :-1]], axis=-1)
+        ok = mask & (prev >= 0)
+        vals = jnp.take_along_axis(x, jnp.maximum(prev, 0), axis=-1)
+        return jnp.where(ok, vals, _NAN), ok
+    else:
+        if periods != -1:
+            raise NotImplementedError("only |periods| <= 1 supported")
+        rx, rm = shift_valid(x[..., ::-1], mask[..., ::-1], 1)
+        return rx[..., ::-1], rm[..., ::-1]
+
+
+def pct_change_valid(x, mask):
+    """Percent change over consecutive *valid* lanes (polars
+    ``pct_change()`` within a group of present bars). Null at the first
+    valid lane. Returns ``(values, out_mask)``."""
+    prev, ok = shift_valid(x, mask, 1)
+    vals = x / prev - 1.0
+    return jnp.where(ok, vals, _NAN), ok

@@ -1,0 +1,85 @@
+"""Rolling-window regression statistics over the 240-slot minute grid.
+
+The ``mmt_ols_*`` family (reference
+MinuteFrequentFactorCalculateMethodsCICC.py:93-376) runs polars
+``.rolling(index_column='minute_in_trade', period='50i')``: the window at
+trade-minute m covers *index values* (m-50, m] — i.e. slots [m-49, m] on our
+dense grid — and windows with fewer than 50 present bars are dropped
+(``.filter(pl.len() >= 50)``, :129). Because the interval spans exactly 50
+integer slots, a window is kept iff every slot in it holds a bar, which makes
+the dense formulation exact: compute stats at every slot via cumulative sums
+and mark a window valid when its masked count equals ``window``.
+
+Numerical note: cov/var are shift-invariant, so second-moment cumsums run on
+*day-mean-centred* prices, keeping f32 cumulative sums small on TPU (raw
+CNY-price squares summed over 240 slots would eat the f32 mantissa). Raw
+windowed means (needed for the reference's beta fallback ``mean_y/mean_x``,
+:130-134) come from separate raw cumsums, which are benign.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from .masked import masked_mean
+
+
+def _windowed_sum(a, window: int):
+    """Inclusive trailing-window sums: out[..., m] = sum(a[..., m-W+1 : m+1])."""
+    c = jnp.cumsum(a, axis=-1)
+    shifted = jnp.concatenate(
+        [jnp.zeros(a.shape[:-1] + (window,), a.dtype), c[..., :-window]],
+        axis=-1)
+    return c - shifted
+
+
+def rolling_window_stats(x, y, mask, window: int = 50) -> Dict[str, jnp.ndarray]:
+    """Per-slot trailing-window moments of (x, y) over valid bars.
+
+    Returns dict of ``[..., L]`` arrays:
+      ``valid``   — window complete (all ``window`` slots hold bars)
+      ``mean_x``/``mean_y`` — raw windowed means
+      ``cov``     — windowed covariance, ddof=0
+      ``var_x``/``var_y`` — windowed variances, ddof=0
+
+    Stats are only meaningful where ``valid``; other lanes are garbage and
+    must be masked by the caller.
+    """
+    m = mask.astype(x.dtype)
+    xm = jnp.where(mask, x, 0.0)
+    ym = jnp.where(mask, y, 0.0)
+
+    n_w = _windowed_sum(m, window)
+    valid = n_w == window
+
+    sum_x = _windowed_sum(xm, window)
+    sum_y = _windowed_sum(ym, window)
+    mean_x = sum_x / window
+    mean_y = sum_y / window
+
+    # centred second moments for f32 stability
+    cx = masked_mean(x, mask)
+    cy = masked_mean(y, mask)
+    xc = jnp.where(mask, x - cx[..., None], 0.0)
+    yc = jnp.where(mask, y - cy[..., None], 0.0)
+    s_xx = _windowed_sum(xc * xc, window)
+    s_yy = _windowed_sum(yc * yc, window)
+    s_xy = _windowed_sum(xc * yc, window)
+    s_x = _windowed_sum(xc, window)
+    s_y = _windowed_sum(yc, window)
+
+    inv_w = 1.0 / window
+    cov = s_xy * inv_w - (s_x * inv_w) * (s_y * inv_w)
+    var_x = s_xx * inv_w - (s_x * inv_w) ** 2
+    var_y = s_yy * inv_w - (s_y * inv_w) ** 2
+
+    return {
+        "valid": valid,
+        "mean_x": mean_x,
+        "mean_y": mean_y,
+        "cov": cov,
+        "var_x": jnp.maximum(var_x, 0.0),
+        "var_y": jnp.maximum(var_y, 0.0),
+    }

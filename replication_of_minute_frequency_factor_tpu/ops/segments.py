@@ -1,0 +1,90 @@
+"""Group-by-exact-value segment ops for the chip (volume-at-price) factors.
+
+The ``doc_*`` family (reference
+MinuteFrequentFactorCalculateMethodsCICC.py:937-1201) groups each stock's
+volume shares by exact end-of-day-relative return value, then takes moments
+of the per-group sums, or walks the cumulative distribution to a quantile.
+
+On the dense grid this becomes: sort the 240 lanes by value, detect tie-group
+boundaries, and read per-segment sums off a cumulative-weight array at the
+segment *end* positions. Moments over segments then reuse the ordinary masked
+reductions with "is a segment end" as the mask — no scatter/segment_sum
+needed, which keeps everything a fused sort+cumsum on TPU.
+
+Ordering note (SURVEY.md §2.5 Q7): the reference's ``cum_sum`` runs in
+polars' non-deterministic group-output order; we fix the order to ascending
+value (= ascending rank), the intended semantics, and the numpy oracle
+matches this choice.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from .masked import masked_kurtosis, masked_skew
+
+_NAN = jnp.nan
+
+
+def _sorted_segments(values, weights, mask):
+    """Sort lanes by value; return per-lane segment-end flags and segment sums.
+
+    Returns ``(sv, seg_sum, is_end, cumw)`` where lanes are in
+    ascending-value order (invalid lanes strictly last via two-key sort, so
+    valid ``+inf`` values keep their own segment), ``is_end`` marks the last
+    lane of each valid tie-group, ``seg_sum`` holds (at end lanes) the summed
+    weight of that group, and ``cumw`` is the running weight cumsum.
+    """
+    from .ranking import masked_order
+
+    order = masked_order(values, mask)
+    sv = jnp.take_along_axis(jnp.where(mask, values, 0.0), order, axis=-1)
+    sw = jnp.take_along_axis(jnp.where(mask, weights, 0.0), order, axis=-1)
+    smask = jnp.take_along_axis(mask, order, axis=-1)
+
+    L = values.shape[-1]
+    new_group = jnp.concatenate(
+        [jnp.ones(values.shape[:-1] + (1,), bool),
+         (sv[..., 1:] != sv[..., :-1]) | (smask[..., 1:] != smask[..., :-1])],
+        axis=-1)
+    is_end = jnp.concatenate(
+        [new_group[..., 1:], jnp.ones(values.shape[:-1] + (1,), bool)],
+        axis=-1) & smask
+
+    cumw = jnp.cumsum(sw, axis=-1)
+    idx = jnp.arange(L)
+    start = jnp.maximum.accumulate(jnp.where(new_group, idx, -1), axis=-1)
+    prev_cum = jnp.where(
+        start > 0,
+        jnp.take_along_axis(cumw, jnp.maximum(start - 1, 0), axis=-1),
+        0.0)
+    seg_sum = cumw - prev_cum
+    return sv, seg_sum, is_end, cumw
+
+
+def segment_stats_by_value(values, weights, mask) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(skew, kurtosis) of per-unique-value weight sums — ``doc_skew`` /
+    ``doc_kurt`` / ``doc_std``-as-coded (reference :948-1001)."""
+    _, seg_sum, is_end, _ = _sorted_segments(values, weights, mask)
+    return masked_skew(seg_sum, is_end), masked_kurtosis(seg_sum, is_end)
+
+
+def pdf_quantile_rank(values, weights, mask, threshold: float):
+    """First (lowest-value) segment whose cumulative weight exceeds
+    ``threshold``; returns that segment's ``values`` entry.
+
+    Matches ``doc_pdf*`` (reference :1022-1027) under the ascending-order
+    resolution of quirk Q7: with non-negative weights the end-of-segment
+    cumulative sums are non-decreasing in value order, so "min rank among
+    qualifying" equals "first segment whose cumulative share crosses the
+    threshold". NaN when nothing qualifies (e.g. NaN shares from a
+    zero-volume day).
+    """
+    sv, _, is_end, cumw = _sorted_segments(values, weights, mask)
+    qualify = is_end & (cumw > threshold)
+    any_q = jnp.any(qualify, axis=-1)
+    first = jnp.argmax(qualify, axis=-1)
+    val = jnp.take_along_axis(sv, first[..., None], axis=-1)[..., 0]
+    return jnp.where(any_q, val, _NAN)

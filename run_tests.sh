@@ -1,0 +1,6 @@
+#!/bin/sh
+# Hermetic CPU-only test run: unsetting PALLAS_AXON_POOL_IPS stops the
+# container's sitecustomize from dialing the TPU tunnel at interpreter
+# start (a wedged tunnel otherwise hangs every python process).
+exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q "$@"

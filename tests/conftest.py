@@ -1,0 +1,36 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+This is the standard hardware-free multi-device trick
+(``xla_force_host_platform_device_count``) — SURVEY.md §4 item 4.
+
+Note: in this container a ``sitecustomize`` hook may have imported jax and
+registered the experimental TPU platform before pytest starts, so setting
+env vars alone is not enough — we also flip ``jax_platforms`` via
+``jax.config`` (effective as long as no backend has been used yet). If the
+TPU tunnel is wedged the *interpreter itself* can hang at startup; use
+``./run_tests.sh`` (which unsets ``PALLAS_AXON_POOL_IPS``) for a
+hermetic CPU-only run.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,203 @@
+"""Property tests: masked ops vs straight numpy/scipy on the valid subset."""
+
+import numpy as np
+import pytest
+import scipy.stats
+
+from replication_of_minute_frequency_factor_tpu.ops import (
+    bottomk_threshold,
+    ffill,
+    masked_corr,
+    masked_first,
+    masked_kurtosis,
+    masked_last,
+    masked_mean,
+    masked_product,
+    masked_skew,
+    masked_std,
+    pct_change_valid,
+    rank_average,
+    rolling_window_stats,
+    shift_valid,
+    topk_sum,
+    topk_threshold,
+)
+
+
+def _data(rng, rows=6, L=40, p_valid=0.7):
+    x = rng.normal(0, 1, (rows, L))
+    mask = rng.random((rows, L)) < p_valid
+    mask[0] = True          # fully valid row
+    mask[1] = False         # empty row
+    mask[2, :2] = True      # nearly-empty row
+    mask[2, 2:] = False
+    return x, mask
+
+
+def test_moments_match_numpy(rng):
+    x, mask = _data(rng)
+    mean = np.asarray(masked_mean(x, mask))
+    std = np.asarray(masked_std(x, mask))
+    skew = np.asarray(masked_skew(x, mask))
+    kurt = np.asarray(masked_kurtosis(x, mask))
+    for i in range(x.shape[0]):
+        v = x[i, mask[i]]
+        if len(v) == 0:
+            assert np.isnan(mean[i]) and np.isnan(std[i])
+            continue
+        np.testing.assert_allclose(mean[i], v.mean(), rtol=1e-5)
+        if len(v) >= 2:
+            np.testing.assert_allclose(std[i], v.std(ddof=1), rtol=1e-4)
+            np.testing.assert_allclose(
+                skew[i], scipy.stats.skew(v, bias=True), rtol=1e-3, atol=1e-5)
+            np.testing.assert_allclose(
+                kurt[i], scipy.stats.kurtosis(v, bias=True, fisher=True),
+                rtol=1e-3, atol=1e-5)
+        else:
+            assert np.isnan(std[i])
+
+
+def test_corr_matches_numpy(rng):
+    x, mask = _data(rng)
+    y = np.asarray(rng.normal(0, 1, x.shape))
+    r = np.asarray(masked_corr(x, y, mask))
+    for i in range(x.shape[0]):
+        v, w = x[i, mask[i]], y[i, mask[i]]
+        if len(v) < 2:
+            assert np.isnan(r[i])
+        else:
+            np.testing.assert_allclose(r[i], np.corrcoef(v, w)[0, 1],
+                                       rtol=1e-4, atol=1e-6)
+
+
+def test_first_last_product(rng):
+    x, mask = _data(rng)
+    first = np.asarray(masked_first(x, mask))
+    last = np.asarray(masked_last(x, mask))
+    prod = np.asarray(masked_product(1.0 + 0.01 * x, mask))
+    for i in range(x.shape[0]):
+        v = x[i, mask[i]]
+        if len(v) == 0:
+            assert np.isnan(first[i]) and np.isnan(last[i])
+            continue
+        assert first[i] == pytest.approx(v[0], rel=1e-6)
+        assert last[i] == pytest.approx(v[-1], rel=1e-6)
+        np.testing.assert_allclose(prod[i], np.prod(1.0 + 0.01 * v), rtol=1e-5)
+
+
+def test_rank_average_matches_scipy(rng):
+    x, mask = _data(rng)
+    x = np.round(x, 1)  # force ties
+    r = np.asarray(rank_average(x, mask))
+    for i in range(x.shape[0]):
+        v = x[i, mask[i]]
+        if len(v) == 0:
+            assert np.all(np.isnan(r[i]))
+            continue
+        np.testing.assert_allclose(r[i, mask[i]],
+                                   scipy.stats.rankdata(v, method="average"),
+                                   rtol=1e-6)
+        assert np.all(np.isnan(r[i, ~mask[i]]))
+
+
+def test_topk_threshold(rng):
+    x, mask = _data(rng)
+    for k in (1, 5, 100):
+        thr = np.asarray(topk_threshold(x, mask, k))
+        thb = np.asarray(bottomk_threshold(x, mask, k))
+        ts = np.asarray(topk_sum(x, mask, k))
+        for i in range(x.shape[0]):
+            v = np.sort(x[i, mask[i]])
+            if len(v) == 0:
+                assert np.isnan(thr[i]) and np.isnan(thb[i]) and np.isnan(ts[i])
+                continue
+            top = v[-k:] if k <= len(v) else v
+            assert thr[i] == pytest.approx(top.min(), rel=1e-6)
+            bot = v[:k] if k <= len(v) else v
+            assert thb[i] == pytest.approx(bot.max(), rel=1e-6)
+            np.testing.assert_allclose(ts[i], top.sum(), rtol=1e-5)
+
+
+def test_shift_and_pct_change(rng):
+    x, mask = _data(rng)
+    x = np.abs(x) + 1.0
+    sv, sm = shift_valid(x, mask, 1)
+    sv, sm = np.asarray(sv), np.asarray(sm)
+    pv, pm = pct_change_valid(x, mask)
+    pv, pm = np.asarray(pv), np.asarray(pm)
+    lv, lm = shift_valid(x, mask, -1)
+    lv, lm = np.asarray(lv), np.asarray(lm)
+    for i in range(x.shape[0]):
+        idx = np.flatnonzero(mask[i])
+        v = x[i, idx]
+        # forward shift: first valid lane has no predecessor
+        if len(idx):
+            assert not sm[i, idx[0]]
+            assert not pm[i, idx[0]]
+            assert not lm[i, idx[-1]]
+        for j in range(1, len(idx)):
+            assert sm[i, idx[j]]
+            assert sv[i, idx[j]] == pytest.approx(v[j - 1], rel=1e-6)
+            assert pv[i, idx[j]] == pytest.approx(v[j] / v[j - 1] - 1, rel=1e-4, abs=1e-6)
+            assert lv[i, idx[j - 1]] == pytest.approx(v[j], rel=1e-6)
+
+
+def test_ffill(rng):
+    x, mask = _data(rng)
+    f, has = ffill(x, mask)
+    f, has = np.asarray(f), np.asarray(has)
+    for i in range(x.shape[0]):
+        last = None
+        for j in range(x.shape[1]):
+            if mask[i, j]:
+                last = x[i, j]
+            if last is None:
+                assert not has[i, j]
+            else:
+                assert has[i, j]
+                assert f[i, j] == pytest.approx(last, rel=1e-6)
+
+
+def test_rolling_window_stats_vs_naive(rng):
+    L, W = 60, 10
+    x = rng.normal(10, 1, (3, L))
+    y = x + rng.normal(0, 0.3, (3, L))
+    mask = rng.random((3, L)) < 0.9
+    mask[0] = True
+    st = {k: np.asarray(v) for k, v in
+          rolling_window_stats(x, y, mask, window=W).items()}
+    for i in range(3):
+        for m in range(L):
+            lo = m - W + 1
+            if lo < 0:
+                continue
+            sel = mask[i, lo:m + 1]
+            expected_valid = sel.all()
+            assert bool(st["valid"][i, m]) == expected_valid
+            if not expected_valid:
+                continue
+            xs, ys = x[i, lo:m + 1], y[i, lo:m + 1]
+            np.testing.assert_allclose(st["mean_x"][i, m], xs.mean(), rtol=1e-5)
+            np.testing.assert_allclose(st["mean_y"][i, m], ys.mean(), rtol=1e-5)
+            np.testing.assert_allclose(st["cov"][i, m],
+                                       np.cov(xs, ys, ddof=0)[0, 1],
+                                       rtol=1e-3, atol=1e-6)
+            np.testing.assert_allclose(st["var_x"][i, m], xs.var(ddof=0),
+                                       rtol=1e-3, atol=1e-6)
+
+
+def test_inf_values_do_not_collide_with_invalid_sentinel():
+    """Regression: valid +inf lanes must not tie-group with invalid lanes."""
+    import jax.numpy as jnp
+    from replication_of_minute_frequency_factor_tpu.ops import pdf_quantile_rank
+
+    x = np.array([[1.0, np.inf, 2.0, 123.0]])
+    mask = np.array([[True, True, True, False]])
+    r = np.asarray(rank_average(jnp.asarray(x), jnp.asarray(mask)))
+    np.testing.assert_allclose(r[0, :3], [1.0, 3.0, 2.0])
+
+    vals = np.array([[0.0, np.inf, 1.0, 9.0]])
+    w = np.array([[0.2, 0.5, 0.3, 9.0]])
+    out = np.asarray(pdf_quantile_rank(jnp.asarray(vals), jnp.asarray(w),
+                                       jnp.asarray(mask), 0.6))
+    assert np.isinf(out[0])

@@ -1,0 +1,69 @@
+"""Session grid and gridding round-trips."""
+
+import numpy as np
+
+from replication_of_minute_frequency_factor_tpu import sessions
+from replication_of_minute_frequency_factor_tpu.data import grid_day, synth_day
+from replication_of_minute_frequency_factor_tpu.data.minute import (
+    F_CLOSE, F_VOLUME)
+
+
+def test_grid_times_shape_and_sentinels():
+    t = sessions.GRID_TIMES
+    assert len(t) == 240
+    assert t[0] == 93000000
+    assert t[119] == 112900000
+    assert t[120] == 130000000
+    assert t[239] == 145900000
+    assert t[237] == 145700000
+
+
+def test_time_to_slot_roundtrip():
+    slots = np.arange(240)
+    assert np.array_equal(sessions.time_to_slot(sessions.GRID_TIMES), slots)
+
+
+def test_time_to_slot_rejects_offgrid():
+    # 11:30 must NOT alias onto 13:00 (reference formula would collide)
+    bad = np.array([113000000, 92900000, 150000000, 125900000, 93000500])
+    assert np.all(sessions.time_to_slot(bad) == -1)
+
+
+def test_grid_day_scatter(rng):
+    day = synth_day(rng, n_codes=5, missing_prob=0.2)
+    g = grid_day(day["code"], day["time"], day["open"], day["high"],
+                 day["low"], day["close"], day["volume"])
+    assert g.bars.shape == (5, 240, 5)
+    assert g.mask.sum() == len(day["code"])
+    # spot-check one row round-trips
+    i = 7
+    code, t = day["code"][i], day["time"][i]
+    ti = list(g.codes).index(code)
+    si = int(sessions.time_to_slot(np.array([t]))[0])
+    assert g.mask[ti, si]
+    np.testing.assert_allclose(g.bars[ti, si, F_CLOSE], day["close"][i],
+                               rtol=1e-6)
+    np.testing.assert_allclose(g.bars[ti, si, F_VOLUME], day["volume"][i],
+                               rtol=1e-6)
+
+
+def test_grid_day_short_and_constant(rng):
+    day = synth_day(rng, n_codes=6, constant_price_codes=2, short_day_codes=2)
+    g = grid_day(day["code"], day["time"], day["open"], day["high"],
+                 day["low"], day["close"], day["volume"])
+    # short-day codes only hold the last 30 slots
+    assert g.mask[-1].sum() == 30
+    assert g.mask[-1, -30:].all()
+    # constant-price codes are flat
+    const_close = g.bars[0, :, F_CLOSE]
+    assert np.allclose(const_close, const_close[0])
+
+
+def test_grid_day_unsorted_pinned_codes(rng):
+    """Regression: caller-supplied unsorted universe must not drop rows."""
+    day = synth_day(rng, n_codes=3)
+    g = grid_day(day["code"], day["time"], day["open"], day["high"],
+                 day["low"], day["close"], day["volume"],
+                 codes=["600002", "600000", "600001"])
+    assert g.mask.sum() == len(day["code"])
+    assert list(g.codes) == sorted(g.codes)
