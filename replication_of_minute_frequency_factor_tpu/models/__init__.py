@@ -1,0 +1,17 @@
+"""L1 factor kernel library: 58 CICC minute-frequency factors as fused JAX.
+
+Each factor is a pure function ``f(ctx: DayContext) -> [..., T]`` over the
+dense day tensor; ``compute_factors`` fuses any subset into a single jitted
+XLA graph with shared intermediates (returns, volume shares, rolling
+regression stats, global ranks) computed once — eliminating the reference's
+one-full-data-pass-per-factor design (SURVEY.md §6).
+"""
+
+from .context import DayContext  # noqa: F401
+from .registry import (  # noqa: F401
+    FACTOR_NAMES,
+    FACTORS,
+    compute_factors,
+    compute_factors_jit,
+    factor_names,
+)

@@ -1,0 +1,66 @@
+"""Factor registry and the fused compute entry point."""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+
+from .context import DayContext
+
+#: name -> kernel(ctx) -> [..., T]
+FACTORS: Dict[str, Callable] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        FACTORS[name] = fn
+        return fn
+    return deco
+
+
+def _load_all():
+    # import for registration side effects (ordered as the reference file)
+    from . import momentum, volatility, shape, liquidity, pv_corr, chip, trade_flow  # noqa: F401
+
+
+def factor_names() -> Tuple[str, ...]:
+    _load_all()
+    return tuple(FACTORS)
+
+
+class _Lazy:
+    def __iter__(self):
+        return iter(factor_names())
+
+    def __len__(self):
+        return len(factor_names())
+
+    def __contains__(self, x):
+        return x in factor_names()
+
+
+FACTOR_NAMES = _Lazy()
+
+
+def compute_factors(bars, mask, names: Optional[Sequence[str]] = None,
+                    replicate_quirks: bool = True):
+    """Compute the named factors (default: all 58) over a day tensor.
+
+    Pure function of ``(bars [..., T, 240, 5], mask [..., T, 240])``;
+    returns ``{name: [..., T]}``. Trace it under jit via
+    :func:`compute_factors_jit`.
+    """
+    _load_all()
+    if names is None:
+        names = tuple(FACTORS)
+    ctx = DayContext(bars, mask, replicate_quirks=replicate_quirks)
+    return {n: FACTORS[n](ctx) for n in names}
+
+
+@functools.partial(jax.jit, static_argnames=("names", "replicate_quirks"))
+def compute_factors_jit(bars, mask, names: Optional[Tuple[str, ...]] = None,
+                        replicate_quirks: bool = True):
+    """One fused XLA graph computing every requested factor."""
+    return compute_factors(bars, mask, names, replicate_quirks)

@@ -77,8 +77,18 @@ def masked_kurtosis(x, mask):
 
 
 def masked_corr(x, y, mask):
-    """Pearson correlation over pairwise-valid lanes (polars ``pl.corr``)."""
+    """Pearson correlation over pairwise-valid lanes (polars ``pl.corr``).
+
+    Both series are anchored to their first valid value before the moment
+    pass: correlation is shift-invariant, and the anchoring makes a
+    constant series yield *exactly* zero variance in f32 (hence NaN, as the
+    f64 oracle) instead of rounding noise posing as signal. (An all-invalid
+    row anchors to NaN, but the final ``n > 1`` gate forces NaN there
+    anyway.)
+    """
     n = count(mask)
+    x = x - masked_first(x, mask)[..., None]
+    y = y - masked_first(y, mask)[..., None]
     mx = masked_mean(x, mask)
     my = masked_mean(y, mask)
     dx = jnp.where(mask, x - mx[..., None], 0.0)
@@ -177,7 +187,9 @@ def shift_valid(x, mask, periods: int = 1):
 def pct_change_valid(x, mask):
     """Percent change over consecutive *valid* lanes (polars
     ``pct_change()`` within a group of present bars). Null at the first
-    valid lane. Returns ``(values, out_mask)``."""
+    valid lane. Returns ``(values, out_mask)``.
+
+    Uses (x - prev)/prev for f32 accuracy (see ``DayContext.ret_co``)."""
     prev, ok = shift_valid(x, mask, 1)
-    vals = x / prev - 1.0
+    vals = (x - prev) / prev
     return jnp.where(ok, vals, _NAN), ok
