@@ -1,0 +1,43 @@
+"""Distributed execution: device meshes, shardings, and collectives.
+
+The reference's only parallelism is a joblib process pool over trading-day
+files (MinuteFrequentFactorCICC.py:85-94) with the filesystem as its
+"communication backend". Here the equivalent is first-class (SURVEY.md §5):
+
+* a ``jax.sharding.Mesh`` over ``(days, tickers)`` logical axes;
+* ``NamedSharding`` placement of the day-batch tensor so per-stock kernels
+  run with zero communication (tickers axis is embarrassingly parallel);
+* explicit XLA collectives (``psum`` / ``all_gather`` over ICI) via
+  ``shard_map`` for the only genuinely cross-ticker ops: per-date
+  cross-sectional moments, ranks and quantile cuts used by evaluation.
+"""
+
+from .mesh import (
+    DAYS_AXIS,
+    TICKERS_AXIS,
+    day_batch_spec,
+    make_mesh,
+    mask_spec,
+    shard_day_batch,
+)
+from .collectives import (
+    sharded_compute_factors,
+    xs_masked_mean,
+    xs_masked_std,
+    xs_pearson,
+    xs_rank,
+)
+
+__all__ = [
+    "DAYS_AXIS",
+    "TICKERS_AXIS",
+    "make_mesh",
+    "day_batch_spec",
+    "mask_spec",
+    "shard_day_batch",
+    "sharded_compute_factors",
+    "xs_masked_mean",
+    "xs_masked_std",
+    "xs_pearson",
+    "xs_rank",
+]

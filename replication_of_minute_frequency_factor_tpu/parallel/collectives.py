@@ -1,0 +1,178 @@
+"""Cross-sectional collectives over a sharded ticker axis.
+
+The only operations in the whole framework that need inter-device
+communication are the per-date cross-sectional statistics of evaluation
+(Factor.py:172-182 Pearson/Spearman IC; :284-292 quantile cuts). Everything
+else — all 58 kernels — is per-(ticker, day) pure and runs with zero
+collectives.
+
+Two usage styles:
+
+* moment-style stats (mean/std/corr) as ``psum`` of local partial sums —
+  O(1) words over ICI per date;
+* order statistics (rank, quantile cut) by ``all_gather`` of the ``[T]``
+  cross-section (tiny: 5000 f32 = 20 KB/date) followed by a local sort,
+  slicing this shard's lanes back out (SURVEY.md §7 hard-part 5).
+
+Functions suffixed ``_local`` are the per-shard bodies (usable inside any
+``shard_map``); the unsuffixed wrappers apply ``shard_map`` over a mesh for
+``[dates, tickers]`` matrices sharded ``P(None, 'tickers')``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.registry import compute_factors
+from ..ops import rank_average
+from .mesh import TICKERS_AXIS, day_batch_spec, mask_spec
+
+
+# --------------------------------------------------------------------------
+# psum-based masked moments (inside shard_map)
+# --------------------------------------------------------------------------
+
+def _moments(x, mask, axis_name):
+    """Global (count, sum, sum-of-squares) over the sharded last axis."""
+    xm = jnp.where(mask, x, 0.0)
+    n = jax.lax.psum(jnp.sum(mask, axis=-1), axis_name)
+    s = jax.lax.psum(jnp.sum(xm, axis=-1), axis_name)
+    ss = jax.lax.psum(jnp.sum(xm * xm, axis=-1), axis_name)
+    return n, s, ss
+
+
+def xs_masked_mean_local(x, mask, axis_name=TICKERS_AXIS):
+    n, s, _ = _moments(x, mask, axis_name)
+    return s / n
+
+
+def xs_masked_std_local(x, mask, axis_name=TICKERS_AXIS, ddof: int = 1):
+    """Cross-device masked std, polars default ddof=1 (SURVEY.md Q11)."""
+    n, s, ss = _moments(x, mask, axis_name)
+    mean = s / n
+    var = (ss - n * mean * mean) / (n - ddof)
+    return jnp.sqrt(jnp.maximum(var, 0.0))
+
+
+def xs_pearson_local(x, y, mask, axis_name=TICKERS_AXIS):
+    """Masked Pearson correlation across the sharded axis (per leading row).
+
+    The per-date IC of Factor.py:172-177 under ticker sharding: five psums.
+    """
+    xm = jnp.where(mask, x, 0.0)
+    ym = jnp.where(mask, y, 0.0)
+    n = jax.lax.psum(jnp.sum(mask, axis=-1), axis_name)
+    sx = jax.lax.psum(jnp.sum(xm, axis=-1), axis_name)
+    sy = jax.lax.psum(jnp.sum(ym, axis=-1), axis_name)
+    sxx = jax.lax.psum(jnp.sum(xm * xm, axis=-1), axis_name)
+    syy = jax.lax.psum(jnp.sum(ym * ym, axis=-1), axis_name)
+    sxy = jax.lax.psum(jnp.sum(xm * ym, axis=-1), axis_name)
+    cov = sxy - sx * sy / n
+    vx = sxx - sx * sx / n
+    vy = syy - sy * sy / n
+    return cov / jnp.sqrt(vx * vy)
+
+
+def xs_rank_local(x, mask, axis_name=TICKERS_AXIS):
+    """Average-tie rank among valid lanes of the full cross-section.
+
+    all_gather the [rows, T_local] block from every shard, rank the global
+    [rows, T] matrix locally (identical on all shards), then slice this
+    shard's columns back out.
+    """
+    full_x = jax.lax.all_gather(x, axis_name, axis=-1, tiled=True)
+    full_m = jax.lax.all_gather(mask, axis_name, axis=-1, tiled=True)
+    r = rank_average(full_x, full_m)
+    idx = jax.lax.axis_index(axis_name)
+    return jax.lax.dynamic_slice_in_dim(
+        r, idx * x.shape[-1], x.shape[-1], axis=-1)
+
+
+# --------------------------------------------------------------------------
+# shard_map wrappers for [dates, tickers] matrices
+# --------------------------------------------------------------------------
+
+def _xs_wrap(body):
+    """Wrap a local body into a jitted shard_map over P(None, 'tickers')."""
+
+    @functools.partial(jax.jit, static_argnames=("mesh",))
+    def run(mesh: Mesh, *arrays):
+        spec = P(None, TICKERS_AXIS)
+        fn = shard_map(
+            body, mesh=mesh,
+            in_specs=(spec,) * len(arrays),
+            out_specs=body.out_spec,
+        )
+        return fn(*arrays)
+
+    return run
+
+
+def _mean_body(x, m):
+    return xs_masked_mean_local(x, m)
+
+
+_mean_body.out_spec = P(None)
+
+
+def _std_body(x, m):
+    return xs_masked_std_local(x, m)
+
+
+_std_body.out_spec = P(None)
+
+
+def _pearson_body(x, y, m):
+    return xs_pearson_local(x, y, m)
+
+
+_pearson_body.out_spec = P(None)
+
+
+def _rank_body(x, m):
+    return xs_rank_local(x, m)
+
+
+_rank_body.out_spec = P(None, TICKERS_AXIS)
+
+
+xs_masked_mean = _xs_wrap(_mean_body)
+xs_masked_std = _xs_wrap(_std_body)
+xs_pearson = _xs_wrap(_pearson_body)
+xs_rank = _xs_wrap(_rank_body)
+
+
+# --------------------------------------------------------------------------
+# sharded factor computation
+# --------------------------------------------------------------------------
+
+def sharded_compute_factors(
+    bars, mask, mesh: Mesh,
+    names: Optional[Tuple[str, ...]] = None,
+    replicate_quirks: bool = True,
+):
+    """All 58 kernels over a mesh-sharded day batch.
+
+    Inputs follow :func:`..parallel.mesh.shard_day_batch` placement; outputs
+    are ``{name: [D, T]}`` sharded ``P('days', 'tickers')``. The graph
+    contains no collectives — XLA compiles one fully data-parallel module.
+    """
+    batched = bars.ndim == 4
+    out_spec = P(*day_batch_spec(batched)[:2]) if batched else P(TICKERS_AXIS)
+    fn = jax.jit(
+        functools.partial(
+            compute_factors, names=names, replicate_quirks=replicate_quirks),
+        in_shardings=(NamedSharding(mesh, day_batch_spec(batched)),
+                      NamedSharding(mesh, mask_spec(batched))),
+        out_shardings=NamedSharding(mesh, out_spec),
+    )
+    return fn(bars, mask)

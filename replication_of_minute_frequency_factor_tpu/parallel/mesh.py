@@ -1,0 +1,92 @@
+"""Device mesh and sharding layout for the day-batch tensor.
+
+Layout: ``bars [D, T, 240, 5]`` and ``mask [D, T, 240]`` shard over a 2-D
+logical mesh ``(days, tickers)``. Factor kernels are pure per-(day, ticker)
+maps, so both axes are data-parallel for L1; the per-date cross-sectional
+stage (L3) keeps the days axis data-parallel and turns the tickers axis into
+a collective axis (see collectives.py).
+
+Replaces reference joblib fan-out (MinuteFrequentFactorCICC.py:85-94): one
+process per day-file becomes one mesh coordinate per (day-shard,
+ticker-shard), with ICI collectives instead of filesystem round-trips.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DAYS_AXIS = "days"
+TICKERS_AXIS = "tickers"
+
+
+def make_mesh(
+    shape: Optional[Tuple[int, int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a ``(days, tickers)`` mesh over the available devices.
+
+    Default shape ``(1, n_devices)``: the ticker axis is the wide one
+    (~5000 tickers vs. a handful of days per batch) and per-stock kernels
+    need zero communication, so all ICI bandwidth is reserved for the small
+    cross-sectional collectives.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    if shape is None:
+        shape = (1, devices.size)
+    if shape[0] * shape[1] != devices.size:
+        raise ValueError(
+            f"mesh shape {shape} does not match {devices.size} devices")
+    return Mesh(devices.reshape(shape), (DAYS_AXIS, TICKERS_AXIS))
+
+
+def day_batch_spec(batched: bool = True) -> P:
+    """PartitionSpec for ``bars [D, T, 240, 5]`` (or ``[T, 240, 5]``)."""
+    if batched:
+        return P(DAYS_AXIS, TICKERS_AXIS, None, None)
+    return P(TICKERS_AXIS, None, None)
+
+
+def mask_spec(batched: bool = True) -> P:
+    if batched:
+        return P(DAYS_AXIS, TICKERS_AXIS, None)
+    return P(TICKERS_AXIS, None)
+
+
+def _pad_to_multiple(a: np.ndarray, mult: int, axis: int) -> np.ndarray:
+    rem = a.shape[axis] % mult
+    if rem == 0:
+        return a
+    pad = [(0, 0)] * a.ndim
+    pad[axis] = (0, mult - rem)
+    return np.pad(a, pad)
+
+
+def shard_day_batch(bars, mask, mesh: Mesh):
+    """Place a host day-batch onto the mesh, zero-padding the tickers axis
+    to a shard multiple (padding lanes have mask=False so every masked
+    reduction ignores them).
+
+    Returns ``(bars, mask, n_tickers)`` — callers slice results back to
+    ``n_tickers``.
+    """
+    bars = np.asarray(bars)
+    mask = np.asarray(mask)
+    batched = bars.ndim == 4
+    t_axis = 1 if batched else 0
+    n_tickers = bars.shape[t_axis]
+    t_shards = mesh.shape[TICKERS_AXIS]
+    bars = _pad_to_multiple(bars, t_shards, t_axis)
+    mask = _pad_to_multiple(mask, t_shards, t_axis)
+    if batched:
+        d_shards = mesh.shape[DAYS_AXIS]
+        bars = _pad_to_multiple(bars, d_shards, 0)
+        mask = _pad_to_multiple(mask, d_shards, 0)
+    bars_s = jax.device_put(bars, NamedSharding(mesh, day_batch_spec(batched)))
+    mask_s = jax.device_put(mask, NamedSharding(mesh, mask_spec(batched)))
+    return bars_s, mask_s, n_tickers

@@ -1,0 +1,99 @@
+"""Sharded == single-device, on the virtual 8-device CPU mesh.
+
+SURVEY.md §4 item 4: the TPU-world analogue of a fake distributed backend.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from replication_of_minute_frequency_factor_tpu import ops
+from replication_of_minute_frequency_factor_tpu.data.minute import grid_day
+from replication_of_minute_frequency_factor_tpu.data.synthetic import synth_day
+from replication_of_minute_frequency_factor_tpu.models.registry import (
+    compute_factors_jit, factor_names)
+from replication_of_minute_frequency_factor_tpu.parallel import (
+    make_mesh, shard_day_batch, sharded_compute_factors,
+    xs_masked_mean, xs_masked_std, xs_pearson, xs_rank)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+    return make_mesh((2, 4))
+
+
+@pytest.fixture(scope="module")
+def xs_data():
+    rng = np.random.default_rng(7)
+    dates, tickers = 6, 40
+    x = rng.normal(size=(dates, tickers)).astype(np.float32)
+    y = rng.normal(size=(dates, tickers)).astype(np.float32)
+    m = rng.random((dates, tickers)) > 0.2
+    m[3] = False  # an all-masked date must not poison collectives
+    m[3, :2] = True
+    # exact ties across shard boundaries exercise the gathered rank
+    x[1, ::5] = 0.25
+    return x, y, m
+
+
+def test_xs_moment_collectives_match_local(mesh, xs_data):
+    x, y, m = xs_data
+    tick_mesh = make_mesh((1, 8))
+    mean = xs_masked_mean(tick_mesh, x, m)
+    std = xs_masked_std(tick_mesh, x, m)
+    ic = xs_pearson(tick_mesh, x, y, m)
+
+    ref_mean = ops.masked_mean(x, m)
+    ref_std = ops.masked_std(x, m)
+    ref_ic = ops.masked_corr(x, y, m)
+    np.testing.assert_allclose(np.asarray(mean), ref_mean, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(std), ref_std, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ic), ref_ic, rtol=1e-5, atol=1e-6)
+
+
+def test_xs_rank_matches_local(xs_data):
+    x, _, m = xs_data
+    tick_mesh = make_mesh((1, 8))
+    r = np.asarray(xs_rank(tick_mesh, x, m))
+    ref = np.asarray(ops.rank_average(x, m))
+    np.testing.assert_allclose(r[m], ref[m], rtol=1e-6)
+    assert np.isnan(r[~m]).all()
+
+
+def test_sharded_factors_match_single_device(mesh):
+    rng = np.random.default_rng(3)
+    days = []
+    for _ in range(2):
+        cols = synth_day(rng, n_codes=12, missing_prob=0.05,
+                         zero_volume_prob=0.05)
+        g = grid_day(cols["code"], cols["time"], cols["open"], cols["high"],
+                     cols["low"], cols["close"], cols["volume"])
+        days.append((g.bars, g.mask))
+    bars = np.stack([b for b, _ in days])
+    mask = np.stack([m for _, m in days])
+
+    single = {k: np.asarray(v)
+              for k, v in compute_factors_jit(bars, mask).items()}
+
+    bars_s, mask_s, n_tickers = shard_day_batch(bars, mask, mesh)
+    sharded = sharded_compute_factors(bars_s, mask_s, mesh)
+    assert set(sharded) == set(factor_names())
+    for name, v in sharded.items():
+        got = np.asarray(v)[:bars.shape[0], :n_tickers]
+        np.testing.assert_allclose(
+            got, single[name], rtol=2e-5, atol=1e-6,
+            err_msg=f"factor {name} diverged under sharding")
+
+
+def test_shard_day_batch_pads_and_masks(mesh):
+    rng = np.random.default_rng(4)
+    cols = synth_day(rng, n_codes=10)  # 10 % 4 != 0 -> padding
+    g = grid_day(cols["code"], cols["time"], cols["open"], cols["high"],
+                 cols["low"], cols["close"], cols["volume"])
+    bars = np.stack([g.bars])
+    mask = np.stack([g.mask])
+    bars_s, mask_s, n = shard_day_batch(bars, mask, mesh)
+    assert n == 10
+    assert bars_s.shape[1] % 4 == 0
+    assert not np.asarray(mask_s)[:, n:].any()
