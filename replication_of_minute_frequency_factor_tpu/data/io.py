@@ -1,0 +1,136 @@
+"""Parquet IO: day-file discovery, column loading, atomic writes.
+
+Reproduces the reference's on-disk contracts (SURVEY.md §2.3):
+
+* one minute-bar parquet per trading day, date = first 8 filename chars
+  parsed ``%Y%m%d`` (MinuteFrequentFactorCICC.py:69-77);
+* exposure parquet written atomically via tempfile-then-rename
+  (Factor.py:74-90) so a crash mid-write never corrupts the cache;
+* daily price/volume parquet with CSMAR column names renamed on load
+  (Factor.py:32-47).
+
+pyarrow replaces polars as the host-side columnar engine; everything
+numeric leaves here as numpy, bound for the device.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+_DATE_RE = re.compile(r"^(\d{8})")
+
+#: CSMAR -> canonical column renames (reference Factor.py:32-47)
+DAILY_PV_RENAME = {
+    "Trddt": "date",
+    "Stkcd": "code",
+    "Opnprc": "open",
+    "Hiprc": "high",
+    "Loprc": "low",
+    "Clsprc": "close",
+    "Dnshrtrd": "volume",
+    "Dnvaltrd": "amount",
+    "ChangeRatio": "pct_change",
+    "Dsmvosd": "cmc",
+    "Dsmvtll": "tmc",
+    "Adjprcwd": "close_adjust",
+    "LimitDown": "limit_down",
+    "LimitUp": "limit_up",
+}
+
+
+def parse_day_filename(name: str) -> Optional[np.datetime64]:
+    """``'20240102_clean.parquet'`` -> 2024-01-02; None if no date prefix."""
+    m = _DATE_RE.match(os.path.basename(name))
+    if not m:
+        return None
+    s = m.group(1)
+    try:
+        return np.datetime64(f"{s[:4]}-{s[4:6]}-{s[6:8]}", "D")
+    except ValueError:
+        return None
+
+
+def list_day_files(minute_dir: str) -> List[Tuple[np.datetime64, str]]:
+    """Date-sorted ``(date, path)`` for every parquet day file in a dir."""
+    out = []
+    for name in os.listdir(minute_dir):
+        if not name.endswith(".parquet"):
+            continue
+        date = parse_day_filename(name)
+        if date is not None:
+            out.append((date, os.path.join(minute_dir, name)))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def read_columns(path: str,
+                 columns: Sequence[str]) -> Dict[str, np.ndarray]:
+    """Read selected parquet columns as a dict of numpy arrays."""
+    table = pq.read_table(path, columns=list(columns))
+    out = {}
+    for name in columns:
+        col = table.column(name)
+        if pa.types.is_string(col.type) or pa.types.is_large_string(col.type):
+            out[name] = np.asarray(col.to_pylist())
+        else:
+            out[name] = col.to_numpy(zero_copy_only=False)
+    return out
+
+MINUTE_COLUMNS = ("code", "time", "open", "high", "low", "close", "volume")
+
+
+def read_minute_day(path: str) -> Dict[str, np.ndarray]:
+    return read_columns(path, MINUTE_COLUMNS)
+
+
+def write_parquet_atomic(table: pa.Table, path: str) -> None:
+    """tempfile-in-target-dir -> fsync-free rename; temp removed on failure
+    (the reference's crash-safety mechanism, Factor.py:74-90)."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(suffix=".parquet.tmp", dir=d)
+    os.close(fd)
+    try:
+        pq.write_table(table, tmp)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
+
+
+def read_daily_pv(
+    path: str,
+    columns: Optional[Sequence[str]] = None,
+) -> Dict[str, np.ndarray]:
+    """Daily price/volume loader with the CSMAR rename table applied.
+
+    ``columns`` selects *canonical* names (post-rename), mirroring the
+    reference's projection kwarg (Factor.py:21-31). Dates parse to
+    datetime64[D]; ``code`` normalises to zero-padded 6-char strings.
+    """
+    schema_names = pq.read_schema(path).names
+    rename = {k: v for k, v in DAILY_PV_RENAME.items() if k in schema_names}
+    inv = {v: k for k, v in rename.items()}
+    if columns is None:
+        read = schema_names
+    else:
+        read = [inv.get(c, c) for c in columns]
+    raw = read_columns(path, read)
+    out = {}
+    for k, v in raw.items():
+        out[rename.get(k, k)] = v
+    if "date" in out and not np.issubdtype(out["date"].dtype, np.datetime64):
+        out["date"] = np.asarray(out["date"], dtype="datetime64[D]")
+    elif "date" in out:
+        out["date"] = out["date"].astype("datetime64[D]")
+    if "code" in out and out["code"].dtype.kind in "iu":
+        out["code"] = np.char.zfill(out["code"].astype(str), 6)
+    return out

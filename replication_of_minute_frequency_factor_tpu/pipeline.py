@@ -1,0 +1,249 @@
+"""L2 batch/incremental compute driver — the TPU replacement for the
+reference's joblib fan-out (MinuteFrequentFactorCICC.py:50-112).
+
+Shape of the change: instead of one OS process per day-file each running one
+polars pass per factor, days batch along a leading axis of a dense
+``[D, T, 240, 5]`` tensor and ALL requested factors compute in one fused XLA
+graph per batch. Incremental resume (only days newer than the cache,
+:79-81), per-day failure isolation (skip-and-log, :17-25) and the atomic
+parquet cache (Factor.py:64-90) keep the reference's operational contract.
+
+The cache is *multi-factor columnar*: one wide table ``(code, date,
+factor...)`` — the reference's 58 separate passes collapse into one.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from .config import Config, get_config
+from .data import io as dio
+from .data.minute import grid_day
+from .models.registry import compute_factors_jit, factor_names
+from .utils.logging import get_logger, FailureReport
+
+logger = get_logger(__name__)
+
+#: ticker-axis bucket size — T pads up to a multiple so XLA recompiles at
+#: most a handful of distinct shapes across a year of day files
+TICKER_BUCKET = 256
+
+
+class ExposureTable:
+    """Long-format exposure rows ``(code, date, factor...)`` sorted by
+    (date, code) — the reference's exposure contract (SURVEY.md §2.3) widened
+    to many factor columns."""
+
+    def __init__(self, columns: Dict[str, np.ndarray]):
+        assert "code" in columns and "date" in columns
+        self.columns = columns
+
+    # --- construction ---------------------------------------------------
+    @classmethod
+    def empty(cls, names: Sequence[str]) -> "ExposureTable":
+        cols = {"code": np.array([], dtype=object),
+                "date": np.array([], dtype="datetime64[D]")}
+        for n in names:
+            cols[n] = np.array([], dtype=np.float32)
+        return cls(cols)
+
+    @classmethod
+    def concat(cls, parts: Sequence["ExposureTable"]) -> "ExposureTable":
+        keys = list(parts[0].columns)
+        cols = {k: np.concatenate([np.asarray(p.columns[k]) for p in parts])
+                for k in keys}
+        return cls(cols)
+
+    # --- views ----------------------------------------------------------
+    @property
+    def factor_names(self) -> Tuple[str, ...]:
+        return tuple(k for k in self.columns if k not in ("code", "date"))
+
+    def __len__(self) -> int:
+        return len(self.columns["code"])
+
+    @property
+    def max_date(self) -> Optional[np.datetime64]:
+        d = self.columns["date"]
+        return d.max() if len(d) else None
+
+    def sort(self) -> "ExposureTable":
+        order = np.lexsort((self.columns["code"], self.columns["date"]))
+        self.columns = {k: np.asarray(v)[order]
+                        for k, v in self.columns.items()}
+        return self
+
+    def single(self, name: str) -> Dict[str, np.ndarray]:
+        """Reference-shaped single-factor view ``(code, date, <name>)``."""
+        return {"code": self.columns["code"], "date": self.columns["date"],
+                name: self.columns[name]}
+
+    # --- parquet --------------------------------------------------------
+    def to_arrow(self) -> pa.Table:
+        arrays, fields = [], []
+        for k, v in self.columns.items():
+            if k == "code":
+                arrays.append(pa.array([str(c) for c in v], pa.string()))
+                fields.append(pa.field(k, pa.string()))
+            elif k == "date":
+                arrays.append(pa.array(v.astype("datetime64[D]")))
+                fields.append(pa.field(k, pa.date32()))
+            else:
+                arrays.append(pa.array(np.asarray(v, np.float32)))
+                fields.append(pa.field(k, pa.float32()))
+        return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+
+    @classmethod
+    def from_arrow(cls, table: pa.Table) -> "ExposureTable":
+        cols = {}
+        for name in table.schema.names:
+            col = table.column(name)
+            if name == "code":
+                cols[name] = np.asarray(col.to_pylist(), dtype=object)
+            elif name == "date":
+                cols[name] = col.to_numpy(
+                    zero_copy_only=False).astype("datetime64[D]")
+            else:
+                cols[name] = col.to_numpy(zero_copy_only=False)
+        return cls(cols)
+
+    def save(self, path: str) -> None:
+        dio.write_parquet_atomic(self.to_arrow(), path)
+
+    @classmethod
+    def load(cls, path: str) -> "ExposureTable":
+        import pyarrow.parquet as pq
+        return cls.from_arrow(pq.read_table(path))
+
+
+def _pad_bucket(n: int, bucket: int = TICKER_BUCKET) -> int:
+    return max(bucket, -(-n // bucket) * bucket)
+
+
+def _grid_batch(day_data: List[Tuple[np.datetime64, Dict[str, np.ndarray]]]):
+    """Union-code, bucket-padded dense batch for a list of day columns.
+
+    Returns ``(bars [D,Tp,240,5], mask [D,Tp,240], codes [Tp],
+    present [D,Tp])`` where ``present`` marks codes that had rows in that
+    day's file (they get an output row even if every bar was off-grid,
+    matching the reference's per-group row).
+    """
+    all_codes = np.unique(np.concatenate(
+        [d["code"] for _, d in day_data])).astype(object)
+    t_pad = _pad_bucket(len(all_codes))
+    pads = np.array([f"__pad{i}__" for i in range(t_pad - len(all_codes))],
+                    dtype=object)
+    codes = np.sort(np.concatenate([all_codes, pads]))
+    bars_l, mask_l, present_l = [], [], []
+    for _, d in day_data:
+        g = grid_day(d["code"], d["time"], d["open"], d["high"], d["low"],
+                     d["close"], d["volume"], codes=codes)
+        bars_l.append(g.bars)
+        mask_l.append(g.mask)
+        present_l.append(np.isin(g.codes, np.unique(d["code"])))
+    return (np.stack(bars_l), np.stack(mask_l), codes, np.stack(present_l))
+
+
+def compute_exposures(
+    minute_dir: Optional[str] = None,
+    names: Optional[Sequence[str]] = None,
+    cache_path: Optional[str] = None,
+    cfg: Optional[Config] = None,
+    progress: bool = True,
+    fault_hook: Optional[Callable[[np.datetime64], None]] = None,
+) -> ExposureTable:
+    """Compute factor exposures for every day file, incrementally.
+
+    * resumes past ``cache_path``'s max cached date (reference :79-81);
+    * a failing day is logged into the returned table's
+      ``.failures`` report and skipped (reference :17-25);
+    * ``fault_hook(date)`` is the fault-injection test hook (SURVEY.md §5).
+    """
+    cfg = cfg or get_config()
+    minute_dir = minute_dir or cfg.minute_dir
+    names = tuple(names) if names is not None else factor_names()
+
+    cached = None
+    if cache_path is not None:
+        import os
+        if os.path.exists(cache_path):
+            cached = ExposureTable.load(cache_path)
+            missing = [n for n in names if n not in cached.factor_names]
+            if missing:
+                logger.warning(
+                    "cache %s lacks factors %s; recomputing all days",
+                    cache_path, missing)
+                cached = None
+
+    files = dio.list_day_files(minute_dir)
+    if cached is not None and cached.max_date is not None:
+        files = [(d, p) for d, p in files if d > cached.max_date]
+
+    failures = FailureReport()
+    parts: List[ExposureTable] = []
+    iterator: Sequence = files
+    if progress and files:
+        try:
+            from tqdm import tqdm
+            iterator = tqdm(files, desc="day files", unit="day")
+        except ImportError:
+            pass
+
+    batch: List[Tuple[np.datetime64, Dict[str, np.ndarray]]] = []
+    t0 = time.perf_counter()
+
+    def flush():
+        if not batch:
+            return
+        bars, mask, codes, present = _grid_batch(batch)
+        out = compute_factors_jit(bars, mask, names=names,
+                                  replicate_quirks=cfg.replicate_quirks)
+        out = {k: np.asarray(v) for k, v in out.items()}
+        for i, (date, _) in enumerate(batch):
+            sel = present[i]
+            cols = {"code": codes[sel].astype(object),
+                    "date": np.full(int(sel.sum()), date, "datetime64[D]")}
+            for n in names:
+                cols[n] = out[n][i, sel].astype(np.float32)
+            parts.append(ExposureTable(cols))
+        batch.clear()
+
+    for date, path in iterator:
+        try:
+            if fault_hook is not None:
+                fault_hook(date)
+            day = dio.read_minute_day(path)
+            if len(day["code"]) == 0:
+                raise ValueError("empty day file")
+            batch.append((date, day))
+        except Exception as e:  # noqa: BLE001 — per-day isolation
+            failures.record(str(date), path, e)
+            logger.warning("skipping day %s (%s): %s", date, path, e)
+            continue
+        if len(batch) >= cfg.days_per_batch:
+            flush()
+    flush()
+
+    if parts:
+        new = ExposureTable.concat(parts).sort()
+    else:
+        new = ExposureTable.empty(names)
+    if cached is not None and len(cached):
+        keep = ["code", "date", *names]
+        cached.columns = {k: cached.columns[k] for k in keep}
+        result = ExposureTable.concat([cached, new]).sort()
+    else:
+        result = new
+    result.failures = failures
+    elapsed = time.perf_counter() - t0
+    if files:
+        logger.info("computed %d factors x %d new days in %.2fs "
+                    "(%d rows, %d failed days)", len(names), len(files),
+                    elapsed, len(new), len(failures))
+    if cache_path is not None and len(result):
+        result.save(cache_path)
+    return result

@@ -1,0 +1,67 @@
+"""Structured logging + failure reporting.
+
+The reference's observability is a bare ``print`` on worker error and a tqdm
+bar (MinuteFrequentFactorCICC.py:24,93). Here failures aggregate into a
+structured report attached to pipeline results so a batch run can be audited
+after the fact (SURVEY.md §5 failure detection).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import traceback
+from typing import List
+
+_CONFIGURED = False
+
+
+def get_logger(name: str) -> logging.Logger:
+    global _CONFIGURED
+    if not _CONFIGURED:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+        root = logging.getLogger("replication_of_minute_frequency_factor_tpu")
+        if not root.handlers:
+            root.addHandler(h)
+            root.setLevel(logging.INFO)
+        _CONFIGURED = True
+    return logging.getLogger(name)
+
+
+@dataclasses.dataclass
+class Failure:
+    key: str          # e.g. the trading date
+    source: str       # e.g. the file path
+    error: str
+    trace: str
+
+
+class FailureReport:
+    """Per-task failure isolation ledger (reference: caught-and-printed
+    exceptions silently dropped the day, MinuteFrequentFactorCICC.py:20-25)."""
+
+    def __init__(self):
+        self.failures: List[Failure] = []
+
+    def record(self, key: str, source: str, exc: BaseException) -> None:
+        self.failures.append(Failure(
+            key=key, source=source, error=f"{type(exc).__name__}: {exc}",
+            trace=traceback.format_exc()))
+
+    def __len__(self) -> int:
+        return len(self.failures)
+
+    def __bool__(self) -> bool:
+        return bool(self.failures)
+
+    def keys(self) -> List[str]:
+        return [f.key for f in self.failures]
+
+    def summary(self) -> str:
+        if not self.failures:
+            return "no failures"
+        lines = [f"{len(self.failures)} failed:"]
+        lines += [f"  {f.key} ({f.source}): {f.error}" for f in self.failures]
+        return "\n".join(lines)

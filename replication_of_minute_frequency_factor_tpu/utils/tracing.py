@@ -1,0 +1,53 @@
+"""Timing and XLA-level tracing hooks (SURVEY.md §5 tracing/profiling).
+
+``Timer`` wraps host-side stages (IO, gridding, device step);
+``trace_annotation`` tags regions so they show up named in a
+``jax.profiler`` trace when one is being captured.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, List
+
+
+class Timer:
+    """Accumulating named stage timer.
+
+    >>> t = Timer()
+    >>> with t("io"): ...
+    >>> t.totals()["io"]
+    """
+
+    def __init__(self):
+        self._totals: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def __call__(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._totals[name] = self._totals.get(name, 0.0) + dt
+            self._counts[name] = self._counts.get(name, 0) + 1
+
+    def totals(self) -> Dict[str, float]:
+        return dict(self._totals)
+
+    def report(self) -> str:
+        rows: List[str] = []
+        for k in sorted(self._totals, key=self._totals.get, reverse=True):
+            rows.append(f"{k}: {self._totals[k]:.3f}s x{self._counts[k]}")
+        return "; ".join(rows) or "no timings"
+
+
+@contextlib.contextmanager
+def trace_annotation(name: str):
+    """Named region in the XLA profiler timeline (no-op overhead outside a
+    capture)."""
+    import jax
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -1,0 +1,120 @@
+"""L2 pipeline: IO contracts, incremental resume, failure isolation,
+atomic cache (SURVEY.md §4 item 3)."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from replication_of_minute_frequency_factor_tpu.data import io as dio
+from replication_of_minute_frequency_factor_tpu.data.synthetic import synth_day
+from replication_of_minute_frequency_factor_tpu.config import Config
+from replication_of_minute_frequency_factor_tpu.pipeline import (
+    ExposureTable, compute_exposures)
+
+NAMES = ("vol_return1min", "mmt_am", "liq_openvol")
+
+
+def _write_day(dirpath, rng, date_str, n_codes=6, **kw):
+    cols = synth_day(rng, n_codes=n_codes, date=date_str, **kw)
+    arrays = {
+        "code": pa.array([str(c) for c in cols["code"]]),
+        "time": pa.array(cols["time"]),
+    }
+    for k in ("open", "high", "low", "close", "volume"):
+        arrays[k] = pa.array(cols[k])
+    table = pa.table(arrays)
+    name = date_str.replace("-", "") + "_cleaned.parquet"
+    pq.write_table(table, os.path.join(dirpath, name))
+
+
+@pytest.fixture
+def minute_dir(tmp_path, rng):
+    d = tmp_path / "kline"
+    d.mkdir()
+    for ds in ("2024-01-02", "2024-01-03", "2024-01-04"):
+        _write_day(str(d), rng, ds, missing_prob=0.05)
+    return str(d)
+
+
+def _cfg():
+    return Config(days_per_batch=2)
+
+
+def test_day_file_listing_and_date_parse(minute_dir):
+    files = dio.list_day_files(minute_dir)
+    assert [str(d) for d, _ in files] == [
+        "2024-01-02", "2024-01-03", "2024-01-04"]
+    assert dio.parse_day_filename("foo.parquet") is None
+    assert dio.parse_day_filename("20240102.parquet") == np.datetime64(
+        "2024-01-02")
+
+
+def test_compute_exposures_end_to_end(minute_dir, tmp_path):
+    cache = str(tmp_path / "factors.parquet")
+    t = compute_exposures(minute_dir, NAMES, cache_path=cache, cfg=_cfg(),
+                          progress=False)
+    assert t.factor_names == NAMES
+    assert len(np.unique(t.columns["date"])) == 3
+    # sorted by (date, code)
+    order = np.lexsort((t.columns["code"], t.columns["date"]))
+    assert (order == np.arange(len(t))).all()
+    # cache written and loadable
+    t2 = ExposureTable.load(cache)
+    assert len(t2) == len(t)
+    np.testing.assert_allclose(
+        t2.columns["vol_return1min"], t.columns["vol_return1min"])
+
+
+def test_incremental_resume_only_computes_new_days(minute_dir, tmp_path, rng):
+    cache = str(tmp_path / "factors.parquet")
+    compute_exposures(minute_dir, NAMES, cache_path=cache, cfg=_cfg(),
+                      progress=False)
+    base = ExposureTable.load(cache)
+    # add a new day; only it should be computed, and old rows must survive
+    _write_day(minute_dir, rng, "2024-01-05")
+    seen = []
+    t = compute_exposures(minute_dir, NAMES, cache_path=cache, cfg=_cfg(),
+                          progress=False, fault_hook=lambda d: seen.append(d))
+    assert seen == [np.datetime64("2024-01-05")]
+    assert t.max_date == np.datetime64("2024-01-05")
+    old = t.columns["date"] < np.datetime64("2024-01-05")
+    assert old.sum() == len(base)
+
+
+def test_failed_day_is_skipped_and_reported(minute_dir, tmp_path):
+    bad = np.datetime64("2024-01-03")
+
+    def hook(date):
+        if date == bad:
+            raise RuntimeError("injected fault")
+
+    t = compute_exposures(minute_dir, NAMES, cfg=_cfg(), progress=False,
+                          fault_hook=hook)
+    assert len(t.failures) == 1
+    assert t.failures.keys() == [str(bad)]
+    assert "injected fault" in t.failures.summary()
+    assert bad not in t.columns["date"]
+    assert len(np.unique(t.columns["date"])) == 2
+
+
+def test_atomic_write_leaves_no_temp_on_failure(tmp_path):
+    import pyarrow as pa
+    path = str(tmp_path / "out.parquet")
+
+    class Boom:
+        pass
+
+    with pytest.raises(Exception):
+        dio.write_parquet_atomic(Boom(), path)  # not a table -> raises
+    assert not os.path.exists(path)
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+
+
+def test_single_factor_view_matches_reference_shape(minute_dir):
+    t = compute_exposures(minute_dir, NAMES, cfg=_cfg(), progress=False)
+    one = t.single("mmt_am")
+    assert set(one) == {"code", "date", "mmt_am"}
+    assert len(one["mmt_am"]) == len(t)
