@@ -1,0 +1,70 @@
+"""Device-side evaluation kernels: per-date cross-sectional statistics.
+
+The hot loop of evaluation (reference Factor.py:172-182, :284-292) is a
+reduction *across tickers for every date* — here one ``vmap`` over the date
+axis of dense ``[dates, tickers]`` matrices (SURVEY.md §3.2). Under a
+sharded ticker axis the same math runs through
+:mod:`.parallel.collectives`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .ops import masked_corr, rank_average
+
+
+@jax.jit
+def ic_series(exposure, fwd_ret, valid):
+    """Per-date Pearson IC and Spearman rank-IC.
+
+    exposure, fwd_ret: ``[dates, tickers]``; valid: both present and non-NaN
+    (reference drops NaN exposures before correlating, Factor.py:167-169).
+    Returns ``(ic [dates], rank_ic [dates])`` — NaN where a date has <2
+    valid tickers or zero variance.
+    """
+    ic = masked_corr(exposure, fwd_ret, valid)
+    rx = rank_average(exposure, valid)
+    ry = rank_average(fwd_ret, valid)
+    # rank_average leaves NaN outside ``valid``; neutralise before corr
+    rank_ic = masked_corr(jnp.where(valid, rx, 0.0),
+                          jnp.where(valid, ry, 0.0), valid)
+    return ic, rank_ic
+
+
+@functools.partial(jax.jit, static_argnames=("group_num",))
+def qcut_labels(exposure, valid, group_num: int):
+    """Per-date quantile-bucket labels 0..group_num-1 (NaN-safe).
+
+    Matches polars ``qcut(group_num, allow_duplicates=True)`` over each date
+    (Factor.py:284-292): bucket edges are the linear-interpolated quantiles
+    of that date's valid exposures; duplicate edges collapse (a value never
+    lands in an empty duplicate bucket because ``searchsorted`` on the
+    sorted edge list is right-continuous). Invalid lanes get -1.
+    """
+    qs = jnp.linspace(0.0, 1.0, group_num + 1)[1:-1]
+
+    def one_date(x, m):
+        n = jnp.sum(m)
+        # quantiles over valid lanes via sorted gather at fractional index
+        order = jnp.argsort(jnp.where(m, x, jnp.inf))
+        sx = jnp.where(m, x, 0.0)[order]
+        pos = qs * jnp.maximum(n - 1, 0)
+        lo = jnp.floor(pos).astype(jnp.int32)
+        hi = jnp.ceil(pos).astype(jnp.int32)
+        frac = pos - lo
+        edges = sx[lo] * (1 - frac) + sx[hi] * frac
+        # right-closed buckets like polars/pandas qcut: x <= edge_i -> bucket i
+        lab = jnp.sum(x[:, None] > edges[None, :], axis=-1)
+        return jnp.where(m & (n > 0), lab, -1)
+
+    return jax.vmap(one_date)(exposure, valid)
+
+
+@jax.jit
+def coverage_counts(valid):
+    """Per-date count of usable exposures (Factor.py:92-105)."""
+    return jnp.sum(valid, axis=-1)

@@ -1,0 +1,258 @@
+"""L3 evaluation & persistence — the ``Factor`` base class.
+
+API mirrors the reference's ``Factor`` (Factor.py:7-350): exposure holder +
+``coverage`` / ``ic_test`` / ``group_test`` / ``to_parquet``, with the same
+summary attributes (``IC``, ``ICIR``, ``rank_IC``, ``rank_ICIR``,
+Factor.py:16-19,187-190). The per-date cross-sectional statistics run on
+device through :mod:`.eval_ops` (vmap over the date axis); joins and
+calendar group-bys are host-side numpy (:mod:`.frames`).
+
+Join semantics note (quirk Q10): the reference aligns exposure to daily
+returns with ``pl.concat(how='align_left')`` on (code, date); here exposure
+axes define the grid and daily data is gathered onto it — the same left
+semantics without the string-keyed join.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from . import eval_ops, frames, plotting
+from .config import get_config
+from .data import io as dio
+
+
+class Factor:
+    """Holds one factor's long-format exposure and evaluates it."""
+
+    def __init__(self, factor_name: str):
+        self.factor_name = factor_name
+        #: dict(code=[N] str, date=[N] datetime64[D], <factor_name>=[N] f32)
+        self.factor_exposure: Optional[Dict[str, np.ndarray]] = None
+        self.IC: Optional[float] = None
+        self.ICIR: Optional[float] = None
+        self.rank_IC: Optional[float] = None
+        self.rank_ICIR: Optional[float] = None
+
+    # ------------------------------------------------------------------
+    # data access
+    # ------------------------------------------------------------------
+    def set_exposure(self, code, date, value) -> "Factor":
+        self.factor_exposure = {
+            "code": np.asarray(code, dtype=object),
+            "date": np.asarray(date, dtype="datetime64[D]"),
+            self.factor_name: np.asarray(value, dtype=np.float32),
+        }
+        return self
+
+    def _require_exposure(self) -> Dict[str, np.ndarray]:
+        if self.factor_exposure is None:
+            raise RuntimeError(
+                f"factor {self.factor_name!r} has no exposure loaded")
+        return self.factor_exposure
+
+    def _read_daily_pv_data(self, columns=None,
+                            path: Optional[str] = None) -> Dict[str, np.ndarray]:
+        """Daily PV loader (reference Factor.py:21-62) — CSMAR renames +
+        date parsing + column projection, path from config instead of the
+        hardcoded ``D:\\QuantData`` root."""
+        path = path or get_config().daily_pv_path
+        return dio.read_daily_pv(path, columns)
+
+    # ------------------------------------------------------------------
+    # persistence (reference Factor.py:64-90)
+    # ------------------------------------------------------------------
+    def _resolve_path(self, path: Optional[str]) -> str:
+        path = path or get_config().factor_dir
+        if os.path.isdir(path) or not path.endswith(".parquet"):
+            path = os.path.join(path, f"{self.factor_name}.parquet")
+        return path
+
+    def to_parquet(self, path: Optional[str] = None) -> str:
+        exp = self._require_exposure()
+        table = pa.table({
+            "code": pa.array([str(c) for c in exp["code"]], pa.string()),
+            "date": pa.array(exp["date"]),
+            self.factor_name: pa.array(
+                np.asarray(exp[self.factor_name], np.float32)),
+        })
+        path = self._resolve_path(path)
+        dio.write_parquet_atomic(table, path)
+        return path
+
+    def read_parquet(self, path: Optional[str] = None) -> "Factor":
+        import pyarrow.parquet as pq
+        t = pq.read_table(self._resolve_path(path))
+        self.set_exposure(
+            np.asarray(t.column("code").to_pylist(), dtype=object),
+            t.column("date").to_numpy(zero_copy_only=False),
+            t.column(self.factor_name).to_numpy(zero_copy_only=False))
+        return self
+
+    # ------------------------------------------------------------------
+    # evaluation
+    # ------------------------------------------------------------------
+    def _exposure_matrix(self):
+        exp = self._require_exposure()
+        mat, present, dates, codes = frames.long_to_matrix(
+            exp["code"], exp["date"], exp[self.factor_name])
+        valid = present & np.isfinite(mat)
+        return mat, valid, dates, codes
+
+    def coverage(self, plot: bool = True, return_df: bool = False,
+                 save_path: Optional[str] = None):
+        """Per-date usable-exposure counts (reference Factor.py:92-125)."""
+        _, valid, dates, _ = self._exposure_matrix()
+        counts = np.asarray(eval_ops.coverage_counts(valid))
+        fig = None
+        if plot:
+            fig = plotting.plot_coverage(dates, counts, self.factor_name,
+                                         save_path)
+        if return_df:
+            return {"date": dates, "coverage": counts}
+        return fig
+
+    def ic_test(self, future_days: int = 5, plot: bool = True,
+                return_df: bool = False, save_path: Optional[str] = None,
+                daily_pv_path: Optional[str] = None):
+        """Pearson/Spearman IC vs. the future ``future_days``-day return
+        (reference Factor.py:127-229).
+
+        Sets ``IC/ICIR/rank_IC/rank_ICIR``; ICIR uses sample std (ddof=1)
+        of the per-date IC series.
+        """
+        mat, valid, dates, codes = self._exposure_matrix()
+        pv = self._read_daily_pv_data(["code", "date", "pct_change"],
+                                      path=daily_pv_path)
+        fwd = frames.forward_returns(pv["code"], pv["date"],
+                                     pv["pct_change"], future_days)
+        fwd_mat, fwd_present, _, _ = frames.long_to_matrix(
+            pv["code"], pv["date"], fwd, codes=codes, dates=dates)
+        both = valid & fwd_present & np.isfinite(fwd_mat)
+        ic, rank_ic = eval_ops.ic_series(
+            np.nan_to_num(mat), np.nan_to_num(fwd_mat), both)
+        ic = np.asarray(ic)
+        rank_ic = np.asarray(rank_ic)
+        keep = np.isfinite(ic)  # drop dates with no usable cross-section
+        ic_k, rank_k, dates_k = ic[keep], rank_ic[keep], dates[keep]
+        if len(ic_k):
+            self.IC = float(np.mean(ic_k))
+            self.ICIR = float(np.mean(ic_k) / np.std(ic_k, ddof=1))
+            self.rank_IC = float(np.nanmean(rank_k))
+            self.rank_ICIR = float(
+                np.nanmean(rank_k) / np.nanstd(rank_k, ddof=1))
+        stats = {"IC": self.IC, "ICIR": self.ICIR,
+                 "rank_IC": self.rank_IC, "rank_ICIR": self.rank_ICIR}
+        fig = None
+        if plot and len(ic_k):
+            fig = plotting.plot_ic(dates_k, ic_k, self.factor_name,
+                                   stats={"IC": self.IC, "ICIR": self.ICIR},
+                                   save_path=save_path)
+        if return_df:
+            return {"date": dates_k, "IC": ic_k, "rank_IC": rank_k}
+        return stats if fig is None else fig
+
+    def group_test(self, frequency: str = "month",
+                   weight_param: Optional[str] = None, group_num: int = 5,
+                   plot: bool = True, return_df: bool = False,
+                   save_path: Optional[str] = None,
+                   daily_pv_path: Optional[str] = None):
+        """Decile backtest (reference Factor.py:231-350).
+
+        Per-date quantile buckets -> calendar resample (week/month/quarter/
+        year) of compounded returns per stock -> one-period lag of group
+        label and market caps (the lookahead guard, Factor.py:305-314) ->
+        equal/'tmc'/'cmc'-weighted group returns per period.
+
+        Bad ``frequency``/``weight_param`` raise ``ValueError`` (the
+        reference crashed with ``NameError`` — quirk Q8, fixed).
+        """
+        if weight_param not in (None, "tmc", "cmc"):
+            raise ValueError(
+                f"weight_param must be None/'tmc'/'cmc', got {weight_param!r}")
+        mat, valid, dates, codes = self._exposure_matrix()
+        labels = np.asarray(
+            eval_ops.qcut_labels(np.nan_to_num(mat), valid, group_num))
+
+        pv = self._read_daily_pv_data(
+            ["code", "date", "pct_change", "tmc", "cmc"], path=daily_pv_path)
+        # date-sort rows so stable group-bys below keep date order within
+        # every (code, period) segment ('last' = latest trading day)
+        dorder = np.argsort(pv["date"], kind="stable")
+        pv = {k: np.asarray(v)[dorder] for k, v in pv.items()}
+        # gather each pv row's same-day group label (align-left on the
+        # exposure grid; rows without exposure get -1)
+        lab_mat = labels.astype(np.float32)
+        ci = np.searchsorted(codes, pv["code"])
+        di = np.searchsorted(dates, pv["date"])
+        ok = (ci < len(codes)) & (di < len(dates))
+        ok &= np.take(codes, np.minimum(ci, len(codes) - 1)) == pv["code"]
+        ok &= np.take(dates, np.minimum(di, len(dates) - 1)) == pv["date"]
+        row_group = np.full(len(pv["code"]), -1.0, np.float32)
+        row_group[ok] = lab_mat[di[ok], ci[ok]]
+
+        period = frames.period_start(pv["date"], frequency)
+        order, seg, n_segs = frames.group_segments(pv["code"], period)
+        per_ret = frames.segment_compound(pv["pct_change"][order], seg, n_segs)
+        last_group = frames.segment_last(row_group[order], seg, n_segs)
+        last_tmc = frames.segment_last(
+            np.asarray(pv.get("tmc", np.ones(len(period))), np.float64)[order],
+            seg, n_segs)
+        last_cmc = frames.segment_last(
+            np.asarray(pv.get("cmc", np.ones(len(period))), np.float64)[order],
+            seg, n_segs)
+        seg_code = frames.segment_last(pv["code"][order], seg, n_segs)
+        seg_period = frames.segment_last(period[order], seg, n_segs)
+
+        # one-period lag per code (lookahead guard, Factor.py:305-314)
+        so = np.lexsort((seg_period, seg_code))
+        starts = np.r_[True, seg_code[so][1:] != seg_code[so][:-1]]
+
+        def lag(a):
+            s = np.asarray(a)[so]
+            out = np.r_[s[:1], s[:-1]]
+            out = out.astype(np.float64)
+            out[starts] = np.nan
+            return out
+
+        g_lag = lag(last_group)
+        tmc_lag = lag(last_tmc)
+        cmc_lag = lag(last_cmc)
+        p_sorted = seg_period[so]
+        r_sorted = np.asarray(per_ret)[so]
+
+        usable = np.isfinite(g_lag) & (g_lag >= 0)
+        if weight_param == "tmc":
+            w = tmc_lag
+        elif weight_param == "cmc":
+            w = cmc_lag
+        else:
+            w = np.ones_like(g_lag)
+        key_p = p_sorted[usable]
+        key_g = g_lag[usable].astype(np.int64)
+        o2, seg2, n2 = frames.group_segments(key_p, key_g)
+        gret = frames.segment_weighted_mean(
+            r_sorted[usable][o2], w[usable][o2], seg2, n2)
+        out_p = frames.segment_last(key_p[o2], seg2, n2)
+        out_g = frames.segment_last(key_g[o2], seg2, n2)
+
+        periods = np.unique(out_p)
+        ret_mat = np.full((len(periods), group_num), np.nan)
+        pi = np.searchsorted(periods, out_p)
+        ret_mat[pi, out_g] = gret
+        cum = np.cumprod(np.nan_to_num(ret_mat) + 1.0, axis=0) - 1.0
+
+        fig = None
+        if plot and len(periods):
+            fig = plotting.plot_group_returns(
+                periods, cum, self.factor_name,
+                labels=[f"G{j}" for j in range(group_num)],
+                save_path=save_path)
+        if return_df:
+            return {"period": periods, "group_return": ret_mat,
+                    "cum_return": cum}
+        return fig

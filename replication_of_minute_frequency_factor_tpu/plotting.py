@@ -1,0 +1,71 @@
+"""The three reference chart types, headless-safe matplotlib.
+
+Coverage bar (Factor.py:106-122), IC bar + cumulative line on twin axes
+(:191-226), decile cumulative-return lines with percent formatting
+(:322-347). Each renderer returns the Figure; pass ``save_path`` to write a
+PNG without needing a display.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import matplotlib
+import numpy as np
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt  # noqa: E402
+from matplotlib.ticker import PercentFormatter  # noqa: E402
+
+
+def _finish(fig, save_path: Optional[str]):
+    fig.tight_layout()
+    if save_path:
+        fig.savefig(save_path, dpi=120)
+    return fig
+
+
+def plot_coverage(dates, counts, factor_name: str,
+                  save_path: Optional[str] = None):
+    fig, ax = plt.subplots(figsize=(12, 4))
+    ax.bar(np.asarray(dates, "datetime64[D]").astype("datetime64[ns]"),
+           counts, width=1.0, color="#4C72B0")
+    ax.set_title(f"{factor_name} coverage")
+    ax.set_ylabel("# non-NaN exposures")
+    return _finish(fig, save_path)
+
+
+def plot_ic(dates, ic, factor_name: str, stats: Optional[dict] = None,
+            save_path: Optional[str] = None):
+    """Per-date IC bars (left axis) + cumulative IC line (right axis)."""
+    d = np.asarray(dates, "datetime64[D]").astype("datetime64[ns]")
+    fig, ax = plt.subplots(figsize=(12, 4))
+    ax.bar(d, ic, width=1.0, color="#4C72B0", label="IC")
+    ax.set_ylabel("IC")
+    ax2 = ax.twinx()
+    ax2.plot(d, np.cumsum(np.nan_to_num(ic)), color="#C44E52",
+             label="cumulative IC")
+    ax2.set_ylabel("cumulative IC")
+    title = f"{factor_name} IC"
+    if stats:
+        title += "  " + "  ".join(f"{k}={v:.4f}" for k, v in stats.items())
+    ax.set_title(title)
+    return _finish(fig, save_path)
+
+
+def plot_group_returns(period_dates, cum_returns: np.ndarray,
+                       factor_name: str,
+                       labels: Optional[Sequence[str]] = None,
+                       save_path: Optional[str] = None):
+    """cum_returns: [periods, groups] cumulative return per decile."""
+    d = np.asarray(period_dates, "datetime64[D]").astype("datetime64[ns]")
+    fig, ax = plt.subplots(figsize=(12, 5))
+    g = cum_returns.shape[1]
+    for j in range(g):
+        ax.plot(d, cum_returns[:, j],
+                label=labels[j] if labels else f"group {j}")
+    ax.yaxis.set_major_formatter(PercentFormatter(xmax=1.0))
+    ax.legend(loc="upper left", ncols=min(g, 5), fontsize=8)
+    ax.set_title(f"{factor_name} group cumulative return")
+    return _finish(fig, save_path)
