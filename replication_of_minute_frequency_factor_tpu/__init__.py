@@ -19,3 +19,6 @@ Layering (mirrors reference layer map, SURVEY.md §1):
 __version__ = "0.1.0"
 
 from .config import Config, get_config, set_config  # noqa: F401
+from .factor import Factor  # noqa: F401
+from .minfreq import MinFreqFactor  # noqa: F401
+from .pipeline import ExposureTable, compute_exposures  # noqa: F401
