@@ -19,7 +19,7 @@ import numpy as np
 from . import frames
 from .config import Config, get_config
 from .factor import Factor
-from .models.registry import FACTORS, factor_names
+from .models.registry import factor_names, register_alias
 from .pipeline import compute_exposures
 
 AGG_METHODS = ("o", "m", "z", "std")
@@ -67,16 +67,15 @@ class MinFreqFactor(Factor):
         """
         cfg = cfg or get_config()
         name = self.factor_name
-        if callable(calculate_method):
-            FACTORS[name] = calculate_method  # ad-hoc kernel under our name
-        elif isinstance(calculate_method, str):
-            if calculate_method not in factor_names():
+        if calculate_method is not None:
+            if isinstance(calculate_method, str) \
+                    and calculate_method not in factor_names():
                 raise KeyError(
                     f"unknown factor kernel {calculate_method!r}")
-            # alias the kernel under this factor's name so the cache column
+            # expose the kernel under this factor's name so the cache column
             # carries factor_name (reference cached <factor_name>.parquet
             # whatever cal_* method produced it)
-            FACTORS[name] = FACTORS[calculate_method]
+            register_alias(name, calculate_method)
         elif name not in factor_names():
             raise KeyError(
                 f"{name!r} is not a registered kernel; pass "

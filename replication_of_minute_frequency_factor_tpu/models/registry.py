@@ -9,8 +9,12 @@ import jax
 
 from .context import DayContext
 
-#: name -> kernel(ctx) -> [..., T]
+#: name -> kernel(ctx) -> [..., T]  (the canonical 58)
 FACTORS: Dict[str, Callable] = {}
+
+#: user-defined names -> kernel; consulted after FACTORS, never reported by
+#: :func:`factor_names` (keeps the canonical set closed for parity suites)
+ALIASES: Dict[str, Callable] = {}
 
 
 def register(name: str):
@@ -18,6 +22,23 @@ def register(name: str):
         FACTORS[name] = fn
         return fn
     return deco
+
+
+def register_alias(name: str, kernel) -> None:
+    """Expose a kernel (an existing name or an ad-hoc ``fn(ctx)``) under a
+    user-chosen factor name (MinFreqFactor's ``calculate_method=``)."""
+    if isinstance(kernel, str):
+        _load_all()
+        kernel = FACTORS[kernel]
+    ALIASES[name] = kernel
+
+
+def resolve(name: str) -> Callable:
+    _load_all()
+    try:
+        return FACTORS[name]
+    except KeyError:
+        return ALIASES[name]
 
 
 def _load_all():
@@ -56,7 +77,7 @@ def compute_factors(bars, mask, names: Optional[Sequence[str]] = None,
     if names is None:
         names = tuple(FACTORS)
     ctx = DayContext(bars, mask, replicate_quirks=replicate_quirks)
-    return {n: FACTORS[n](ctx) for n in names}
+    return {n: resolve(n)(ctx) for n in names}
 
 
 @functools.partial(jax.jit, static_argnames=("names", "replicate_quirks"))
