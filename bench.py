@@ -1,0 +1,81 @@
+"""Headline benchmark: full CICC handbook (58 kernels) over 5000 tickers x
+one trading year of minute bars, on the attached TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "s", "vs_baseline": N}
+
+Baseline is the north-star target of BASELINE.json:5 — the full set in
+< 60 s. ``vs_baseline`` = 60 / measured (>1 means faster than target).
+
+The reference publishes no numbers (BASELINE.md); its implied workload is
+one polars pass per factor per day-file on all CPU cores.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+from replication_of_minute_frequency_factor_tpu.models.registry import (
+    compute_factors_jit, factor_names)
+
+N_TICKERS = 5000
+DAYS_PER_BATCH = 8
+TRADING_DAYS_PER_YEAR = 244
+WARMUP = 1
+ITERS = 5
+
+
+def make_batch(rng, n_days=DAYS_PER_BATCH, n_tickers=N_TICKERS):
+    shape = (n_days, n_tickers, 240)
+    close = (10.0 * np.exp(np.cumsum(
+        rng.normal(0, 1e-3, shape).astype(np.float32), axis=-1)))
+    open_ = close * (1 + rng.normal(0, 1e-4, shape).astype(np.float32))
+    high = np.maximum(open_, close) * 1.0002
+    low = np.minimum(open_, close) * 0.9998
+    volume = rng.integers(0, 100_000, shape).astype(np.float32)
+    bars = np.stack([open_, high, low, close, volume], axis=-1)
+    mask = rng.random(shape) > 0.02  # sparse missing bars
+    return bars.astype(np.float32), mask
+
+
+def main():
+    rng = np.random.default_rng(0)
+    names = factor_names()
+    bars, mask = make_batch(rng)
+
+    def step(b, m):
+        out = compute_factors_jit(b, m, names=names)
+        jax.block_until_ready(out)
+        return out
+
+    # warmup: host->device + compile
+    db, dm = jax.device_put(bars), jax.device_put(mask)
+    for _ in range(WARMUP):
+        step(db, dm)
+
+    # steady state: include the host->device copy each batch (the pipeline
+    # streams day files through; transfer is part of the real step)
+    times = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        db, dm = jax.device_put(bars), jax.device_put(mask)
+        step(db, dm)
+        times.append(time.perf_counter() - t0)
+
+    per_batch = float(np.median(times))
+    full_year = per_batch * (TRADING_DAYS_PER_YEAR / DAYS_PER_BATCH)
+    target = 60.0
+    print(json.dumps({
+        "metric": "cicc58_5000tickers_1yr_wall",
+        "value": round(full_year, 3),
+        "unit": "s",
+        "vs_baseline": round(target / full_year, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
