@@ -199,6 +199,24 @@ def compute_exposures(
     def flush():
         if not batch:
             return
+        if cfg.backend == "numpy":
+            # CPU oracle path: reference (polars) semantics in f64
+            # (SURVEY.md §7 backend dispatch; container has no polars)
+            import pandas as pd
+            from .oracle import compute_oracle
+            for date, d in batch:
+                df = pd.DataFrame(
+                    {k: d[k] for k in ("code", "time", "open", "high",
+                                       "low", "close", "volume")})
+                df["date"] = date
+                wide = compute_oracle(df, names)
+                cols = {"code": wide["code"].to_numpy(dtype=object),
+                        "date": np.full(len(wide), date, "datetime64[D]")}
+                for n in names:
+                    cols[n] = wide[n].to_numpy(np.float32)
+                parts.append(ExposureTable(cols))
+            batch.clear()
+            return
         bars, mask, codes, present = _grid_batch(batch)
         out = compute_factors_jit(bars, mask, names=names,
                                   replicate_quirks=cfg.replicate_quirks)

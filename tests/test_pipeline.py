@@ -113,6 +113,23 @@ def test_atomic_write_leaves_no_temp_on_failure(tmp_path):
     assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
 
 
+def test_numpy_backend_matches_jax_backend(minute_dir):
+    t_jax = compute_exposures(minute_dir, NAMES, cfg=_cfg(), progress=False)
+    t_np = compute_exposures(
+        minute_dir, NAMES, cfg=Config(days_per_batch=2, backend="numpy"),
+        progress=False)
+    assert len(t_np) == len(t_jax)
+    np.testing.assert_array_equal(t_np.columns["code"],
+                                  t_jax.columns["code"])
+    for n in NAMES:
+        a, b = t_np.columns[n], t_jax.columns[n]
+        both = np.isfinite(a) & np.isfinite(b)
+        np.testing.assert_array_equal(np.isfinite(a), np.isfinite(b),
+                                      err_msg=f"{n}: NaN pattern differs")
+        np.testing.assert_allclose(a[both], b[both], rtol=2e-4, atol=1e-6,
+                                   err_msg=f"{n}: values differ")
+
+
 def test_single_factor_view_matches_reference_shape(minute_dir):
     t = compute_exposures(minute_dir, NAMES, cfg=_cfg(), progress=False)
     one = t.single("mmt_am")
