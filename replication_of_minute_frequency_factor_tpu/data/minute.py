@@ -53,6 +53,7 @@ def grid_day(
     date: Optional[np.datetime64] = None,
     codes: Optional[Sequence] = None,
     dtype=np.float32,
+    use_native: Optional[bool] = None,
 ) -> DayGrid:
     """Scatter long-format rows of one day onto the dense minute grid.
 
@@ -61,11 +62,12 @@ def grid_day(
       onto 13:00 (sessions.py);
     * duplicate (code, slot) rows keep the last occurrence;
     * ``codes`` pins the ticker axis (for cross-day batching); defaults to
-      the sorted unique codes present.
+      the sorted unique codes present;
+    * ``use_native`` selects the C++ one-pass packer (:mod:`..native`);
+      default: native when built, numpy otherwise (identical results —
+      tests/test_native.py).
     """
     code = np.asarray(code)
-    slots = sessions.time_to_slot(np.asarray(time))
-    ok = slots >= 0
 
     if codes is None:
         codes = np.unique(code)
@@ -75,9 +77,20 @@ def grid_day(
         codes = np.sort(np.asarray(codes))
     tidx = np.searchsorted(codes, code)
     known = (tidx < len(codes)) & (np.take(codes, np.minimum(tidx, len(codes) - 1)) == code)
-    ok &= known
 
     T = len(codes)
+    if use_native is None or use_native:
+        from .. import native
+        if native.available() and dtype == np.float32:
+            bars, mask = native.grid_pack_native(
+                np.where(known, tidx, -1), time,
+                open_, high, low, close, volume, T)
+            return DayGrid(bars=bars, mask=mask, codes=codes, date=date)
+        if use_native:
+            raise RuntimeError("native gridpack requested but unavailable")
+
+    slots = sessions.time_to_slot(np.asarray(time))
+    ok = (slots >= 0) & known
     bars = np.zeros((T, sessions.N_SLOTS, len(FIELDS)), dtype=dtype)
     mask = np.zeros((T, sessions.N_SLOTS), dtype=bool)
     ti, si = tidx[ok], slots[ok]

@@ -1,0 +1,96 @@
+"""ctypes loader for the native grid packer (``gridpack.cpp``).
+
+Builds lazily with g++ on first use if the shared library is missing;
+falls back to pure numpy (``data/minute.py``) when no toolchain exists.
+The native path is the default host-side packer once loaded — the numpy
+implementation remains the parity oracle (see tests/test_native.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libgridpack.so")
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB_PATH,
+             os.path.join(_DIR, "gridpack.cpp")],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first call; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB_PATH) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.grid_pack_abi_version.restype = ctypes.c_int64
+    if lib.grid_pack_abi_version() != 1:
+        return None
+    lib.grid_pack.restype = ctypes.c_int64
+    lib.grid_pack.argtypes = [
+        ctypes.POINTER(ctypes.c_int64),   # tidx
+        ctypes.POINTER(ctypes.c_int64),   # time
+        ctypes.POINTER(ctypes.c_double),  # open
+        ctypes.POINTER(ctypes.c_double),  # high
+        ctypes.POINTER(ctypes.c_double),  # low
+        ctypes.POINTER(ctypes.c_double),  # close
+        ctypes.POINTER(ctypes.c_double),  # volume
+        ctypes.c_int64,                   # n_rows
+        ctypes.c_int64,                   # n_tickers
+        ctypes.POINTER(ctypes.c_float),   # bars out
+        ctypes.POINTER(ctypes.c_uint8),   # mask out
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def grid_pack_native(tidx: np.ndarray, time: np.ndarray, open_: np.ndarray,
+                     high: np.ndarray, low: np.ndarray, close: np.ndarray,
+                     volume: np.ndarray, n_tickers: int):
+    """One-pass native scatter; returns ``(bars [T,240,5] f32,
+    mask [T,240] bool)``. Caller guarantees ``tidx`` is -1 for unknown
+    codes."""
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native gridpack unavailable")
+    n = len(tidx)
+    tidx = np.ascontiguousarray(tidx, np.int64)
+    time = np.ascontiguousarray(time, np.int64)
+    f64 = [np.ascontiguousarray(a, np.float64)
+           for a in (open_, high, low, close, volume)]
+    bars = np.zeros((n_tickers, 240, 5), np.float32)
+    mask = np.zeros((n_tickers, 240), np.uint8)
+
+    def p(a, t):
+        return a.ctypes.data_as(ctypes.POINTER(t))
+
+    lib.grid_pack(p(tidx, ctypes.c_int64), p(time, ctypes.c_int64),
+                  *[p(a, ctypes.c_double) for a in f64],
+                  n, n_tickers,
+                  p(bars, ctypes.c_float), p(mask, ctypes.c_uint8))
+    return bars, mask.astype(bool)

@@ -1,0 +1,6 @@
+#!/bin/sh
+# Build the native grid packer shared library next to this script.
+set -e
+cd "$(dirname "$0")"
+g++ -O3 -march=native -shared -fPIC -o libgridpack.so gridpack.cpp
+echo "built $(pwd)/libgridpack.so"

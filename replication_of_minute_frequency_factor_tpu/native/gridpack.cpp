@@ -1,0 +1,71 @@
+// Native day-grid packer: long minute-bar rows -> dense [T, 240, 5] tensor.
+//
+// This is the host-side hot loop of the data plane (the role polars' Rust
+// engine plays in the reference, SURVEY.md §2.1): one cache-friendly pass
+// over the day's ~1.2M rows doing timestamp->slot conversion and a
+// last-write-wins scatter, instead of five numpy fancy-indexing passes.
+// Loaded from Python via ctypes (replication_of_minute_frequency_factor_tpu/native/__init__.py); the numpy
+// implementation in data/minute.py stays as the portable fallback and the
+// parity oracle for this code.
+//
+// Build: native/build.sh  (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+
+namespace {
+
+constexpr int64_t kAmOpenMsm = 9 * 60 + 30;  // 570
+constexpr int64_t kPmOpenMsm = 13 * 60;      // 780
+constexpr int64_t kAmSlots = 120;
+constexpr int64_t kPmSlots = 120;
+constexpr int64_t kNSlots = 240;
+constexpr int64_t kNFields = 5;
+
+// HHMMSSmmm -> slot index, -1 off-grid (mirrors sessions.time_to_slot:
+// whole minutes inside [09:30,11:30) U [13:00,15:00) only).
+inline int64_t TimeToSlot(int64_t t) {
+  if (t % 100000 != 0) return -1;  // sub-minute component
+  const int64_t hm = t / 10000000 * 60 + (t % 10000000) / 100000;
+  if (hm >= kAmOpenMsm && hm < kAmOpenMsm + kAmSlots) return hm - kAmOpenMsm;
+  if (hm >= kPmOpenMsm && hm < kPmOpenMsm + kPmSlots)
+    return hm - kPmOpenMsm + kAmSlots;
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Scatter n_rows long-format rows onto the dense grid.
+//   tidx:   [n_rows] ticker index per row, -1 = unknown code (dropped)
+//   time:   [n_rows] HHMMSSmmm
+//   o/h/l/c/v: [n_rows] f64 field columns (parquet native width)
+//   bars:   [n_tickers * 240 * 5] f32, caller-zeroed
+//   mask:   [n_tickers * 240] u8, caller-zeroed
+// Returns number of rows placed.
+int64_t grid_pack(const int64_t* tidx, const int64_t* time,
+                  const double* open, const double* high, const double* low,
+                  const double* close, const double* volume, int64_t n_rows,
+                  int64_t n_tickers, float* bars, uint8_t* mask) {
+  int64_t placed = 0;
+  for (int64_t i = 0; i < n_rows; ++i) {
+    const int64_t t = tidx[i];
+    if (t < 0 || t >= n_tickers) continue;
+    const int64_t s = TimeToSlot(time[i]);
+    if (s < 0) continue;
+    float* cell = bars + (t * kNSlots + s) * kNFields;
+    cell[0] = static_cast<float>(open[i]);
+    cell[1] = static_cast<float>(high[i]);
+    cell[2] = static_cast<float>(low[i]);
+    cell[3] = static_cast<float>(close[i]);
+    cell[4] = static_cast<float>(volume[i]);
+    mask[t * kNSlots + s] = 1;
+    ++placed;
+  }
+  return placed;
+}
+
+// Exported so Python can assert ABI compatibility at load time.
+int64_t grid_pack_abi_version() { return 1; }
+
+}  // extern "C"
