@@ -36,6 +36,9 @@ class Config:
     #: replicate reference quirks Q1-Q4 bit-for-bit (SURVEY.md §2.5).
     #: False switches to the mathematically intended definitions.
     replicate_quirks: bool = True
+    #: debug sanitizer: validate day tensors (finite prices, high>=low,
+    #: volume>=0 on valid lanes) before compute; raises DayDataError
+    debug_validate: bool = False
 
     @classmethod
     def from_env(cls) -> "Config":

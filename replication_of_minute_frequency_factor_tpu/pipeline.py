@@ -25,6 +25,7 @@ from .data import io as dio
 from .data.minute import grid_day
 from .models.registry import compute_factors_jit, factor_names
 from .utils.logging import get_logger, FailureReport
+from .utils.tracing import Timer, trace_annotation
 
 logger = get_logger(__name__)
 
@@ -184,6 +185,7 @@ def compute_exposures(
         files = [(d, p) for d, p in files if d > cached.max_date]
 
     failures = FailureReport()
+    timer = Timer()
     parts: List[ExposureTable] = []
     iterator: Sequence = files
     if progress and files:
@@ -217,10 +219,15 @@ def compute_exposures(
                 parts.append(ExposureTable(cols))
             batch.clear()
             return
-        bars, mask, codes, present = _grid_batch(batch)
-        out = compute_factors_jit(bars, mask, names=names,
-                                  replicate_quirks=cfg.replicate_quirks)
-        out = {k: np.asarray(v) for k, v in out.items()}
+        with timer("grid"):
+            bars, mask, codes, present = _grid_batch(batch)
+        if cfg.debug_validate:
+            from .utils.debug import validate_batch
+            validate_batch(bars, mask)
+        with timer("device"), trace_annotation("factor_batch"):
+            out = compute_factors_jit(bars, mask, names=names,
+                                      replicate_quirks=cfg.replicate_quirks)
+            out = {k: np.asarray(v) for k, v in out.items()}
         for i, (date, _) in enumerate(batch):
             sel = present[i]
             cols = {"code": codes[sel].astype(object),
@@ -234,7 +241,8 @@ def compute_exposures(
         try:
             if fault_hook is not None:
                 fault_hook(date)
-            day = dio.read_minute_day(path)
+            with timer("io"):
+                day = dio.read_minute_day(path)
             if len(day["code"]) == 0:
                 raise ValueError("empty day file")
             batch.append((date, day))
@@ -260,8 +268,9 @@ def compute_exposures(
     elapsed = time.perf_counter() - t0
     if files:
         logger.info("computed %d factors x %d new days in %.2fs "
-                    "(%d rows, %d failed days)", len(names), len(files),
-                    elapsed, len(new), len(failures))
+                    "(%d rows, %d failed days) [%s]", len(names), len(files),
+                    elapsed, len(new), len(failures), timer.report())
+    result.timings = timer.totals()
     if cache_path is not None and len(result):
         result.save(cache_path)
     return result

@@ -1,0 +1,69 @@
+"""Aux subsystems: timers, failure report, data sanitizer
+(SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+from replication_of_minute_frequency_factor_tpu.config import Config
+from replication_of_minute_frequency_factor_tpu.pipeline import (
+    compute_exposures)
+from replication_of_minute_frequency_factor_tpu.utils import (
+    FailureReport, Timer)
+from replication_of_minute_frequency_factor_tpu.utils.debug import (
+    DayDataError, validate_batch)
+
+from test_pipeline import _write_day
+
+
+def test_timer_accumulates():
+    t = Timer()
+    with t("a"):
+        pass
+    with t("a"):
+        pass
+    with t("b"):
+        pass
+    totals = t.totals()
+    assert set(totals) == {"a", "b"}
+    assert "a:" in t.report() and "x2" in t.report()
+
+
+def test_failure_report():
+    r = FailureReport()
+    assert not r and "no failures" in r.summary()
+    try:
+        raise ValueError("boom")
+    except ValueError as e:
+        r.record("2024-01-02", "f.parquet", e)
+    assert len(r) == 1
+    assert "boom" in r.summary()
+    assert r.keys() == ["2024-01-02"]
+
+
+def test_validate_batch_catches_corruption():
+    bars = np.ones((2, 4, 240, 5), np.float32)
+    mask = np.ones((2, 4, 240), bool)
+    assert validate_batch(bars, mask, raise_=False) == []
+    bars[0, 0, 5, 3] = np.nan          # NaN close on a valid lane
+    bars[1, 2, 7, 4] = -1.0            # negative volume
+    bars[0, 1, 9, 1] = 0.5             # high < low (low is 1.0)
+    probs = validate_batch(bars, mask, raise_=False)
+    assert len(probs) == 3
+    with pytest.raises(DayDataError):
+        validate_batch(bars, mask)
+    # corruption on a masked lane is fine
+    bars2 = np.ones((1, 2, 240, 5), np.float32)
+    mask2 = np.ones((1, 2, 240), bool)
+    bars2[0, 0, 0, 0] = np.nan
+    mask2[0, 0, 0] = False
+    assert validate_batch(bars2, mask2, raise_=False) == []
+
+
+def test_pipeline_debug_validate_and_timings(tmp_path, rng):
+    d = tmp_path / "kline"
+    d.mkdir()
+    _write_day(str(d), rng, "2024-01-02")
+    cfg = Config(days_per_batch=1, debug_validate=True)
+    t = compute_exposures(str(d), ("mmt_am",), cfg=cfg, progress=False)
+    assert len(t) > 0
+    assert {"io", "grid", "device"} <= set(t.timings)
