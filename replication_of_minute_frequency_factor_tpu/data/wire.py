@@ -1,0 +1,146 @@
+"""Compact host->device wire format for day batches.
+
+The tunnel/PCIe link, not the MXU, bounds pipeline throughput (the fused
+58-factor graph runs in ~2 ms per 8-day x 5000-ticker batch; the raw f32
+tensor for it is ~200 MB). A-share prices are tick-aligned (0.01 CNY), so
+the batch ships as:
+
+  base    [D, T]         f32   first valid close (ticks*0.01)
+  deltas  [D, T, 240, 4] int16 close tick-delta vs previous valid close;
+                               open/high/low tick-delta vs same-bar close
+  volume  [D, T, 240]    int32 shares
+  mask    [D, T, 240]    bool
+
+12 bytes/bar instead of 20 — a 1.67x cut in wire bytes — reconstructed by
+a fused on-device decode: one int32 cumsum over the 240-slot axis + a
+scale. Decoded prices match the direct f32 cast to within 1 ulp (~1e-7
+relative): XLA strength-reduces the constant tick division to a
+reciprocal multiply, which is not correctly rounded. The wobble is
+semantically safe — equal tick counts decode to identical floats, so every
+sign/threshold comparison in the kernels (ret>0, time masks, top-k cuts on
+integer volume) is unaffected. ``encode`` returns None whenever the data
+doesn't fit the format (off-tick prices, >int16 deltas, non-integer or
+>int31 volume) and callers fall back to shipping raw f32, so the format is
+an opt-in transfer optimisation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TICK = 0.01
+_I16 = 32767
+
+
+@dataclasses.dataclass
+class WireBatch:
+    base: np.ndarray     # [..., T] f32
+    deltas: np.ndarray   # [..., T, 240, 4] int16
+    volume: np.ndarray   # [..., T, 240] int32
+    mask: np.ndarray     # [..., T, 240] bool
+
+    @property
+    def nbytes(self) -> int:
+        return (self.base.nbytes + self.deltas.nbytes + self.volume.nbytes
+                + self.mask.nbytes)
+
+
+def encode(bars: np.ndarray, mask: np.ndarray, tick: float = TICK,
+           use_native: Optional[bool] = None) -> Optional[WireBatch]:
+    """Host-side packing; None when the batch can't be represented.
+
+    Dispatches to the C++ single-pass encoder (:mod:`..native`) when built
+    (~100x the numpy path below, which remains the portable fallback and
+    parity oracle)."""
+    bars = np.asarray(bars)
+    mask = np.asarray(mask)
+    if use_native is None or use_native:
+        from .. import native
+        if native.available():
+            out = native.wire_encode_native(bars, mask, round(1.0 / tick))
+            if out is not None:
+                base, deltas, volume = out
+                return WireBatch(base=base, deltas=deltas, volume=volume,
+                                 mask=mask.astype(bool))
+            return None  # native says unrepresentable; semantics match numpy
+        if use_native:
+            raise RuntimeError("native wire encoder unavailable")
+    o, h, l, c, v = (bars[..., i] for i in range(5))
+
+    ct = np.rint(c / tick)
+    # tick alignment of every price field on valid lanes
+    for p in (o, h, l, c):
+        pt = p / tick
+        if not np.allclose(pt[mask], np.rint(pt[mask]), atol=1e-3):
+            return None
+    if np.abs(ct[mask]).max(initial=0) > 2**22:  # f32-exact tick range
+        return None
+    vv = v[mask]
+    if len(vv) and (not np.allclose(vv, np.rint(vv), atol=1e-3)
+                    or vv.max(initial=0) >= 2**31 or vv.min(initial=0) < 0):
+        return None
+
+    ctm = np.where(mask, ct, 0.0)
+    # previous valid close ticks per slot (base before the first valid bar)
+    idx = np.where(mask, np.arange(mask.shape[-1]), -1)
+    last_valid = np.maximum.accumulate(idx, axis=-1)
+    prev_valid = np.concatenate(
+        [np.full(last_valid.shape[:-1] + (1,), -1), last_valid[..., :-1]],
+        axis=-1)
+    first_idx = np.argmax(mask, axis=-1)
+    base_ct = np.take_along_axis(ctm, first_idx[..., None], axis=-1)[..., 0]
+    prev_ct = np.where(
+        prev_valid >= 0,
+        np.take_along_axis(ctm, np.maximum(prev_valid, 0), axis=-1),
+        base_ct[..., None])
+    dclose = np.where(mask, ct - prev_ct, 0.0)
+    dopen = np.where(mask, np.rint(o / tick) - ct, 0.0)
+    dhigh = np.where(mask, np.rint(h / tick) - ct, 0.0)
+    dlow = np.where(mask, np.rint(l / tick) - ct, 0.0)
+    deltas = np.stack([dclose, dopen, dhigh, dlow], axis=-1)
+    if np.abs(deltas).max(initial=0) > _I16:
+        return None
+    return WireBatch(
+        base=(base_ct / round(1.0 / tick)).astype(np.float32),
+        deltas=deltas.astype(np.int16),
+        volume=np.where(mask, v, 0).astype(np.int32),
+        mask=mask.astype(bool),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("tick",))
+def decode(base, deltas, volume, mask, tick: float = TICK):
+    """On-device unpacking -> ``(bars [..., T, 240, 5] f32, mask)``.
+
+    Fuses into the factor graph: XLA keeps the int16->f32 expansion in
+    HBM-local registers instead of shipping wide floats over the wire.
+    """
+    d = deltas.astype(jnp.int32)
+    inv = jnp.float32(round(1.0 / tick))
+    ct = jnp.round(base * inv).astype(jnp.int32)[..., None] \
+        + jnp.cumsum(d[..., 0], axis=-1)
+    close = ct.astype(jnp.float32) / inv
+    open_ = (ct + d[..., 1]).astype(jnp.float32) / inv
+    high = (ct + d[..., 2]).astype(jnp.float32) / inv
+    low = (ct + d[..., 3]).astype(jnp.float32) / inv
+    vol = volume.astype(jnp.float32)
+    zero = jnp.zeros_like(close)
+    m = mask
+    bars = jnp.stack(
+        [jnp.where(m, f, zero) for f in (open_, high, low, close, vol)],
+        axis=-1)
+    return bars, m
+
+
+def put(wire: WireBatch, shardings=None):
+    """device_put the packed representation (decode happens device-side)."""
+    arrs = (wire.base, wire.deltas, wire.volume, wire.mask)
+    if shardings is None:
+        return tuple(jax.device_put(a) for a in arrs)
+    return tuple(jax.device_put(a, s) for a, s in zip(arrs, shardings))

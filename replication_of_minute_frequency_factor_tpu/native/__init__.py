@@ -45,8 +45,14 @@ def load() -> Optional[ctypes.CDLL]:
     except OSError:
         return None
     lib.grid_pack_abi_version.restype = ctypes.c_int64
-    if lib.grid_pack_abi_version() != 1:
-        return None
+    if lib.grid_pack_abi_version() != 2:
+        # stale build from an older source tree: rebuild once
+        if not _build():
+            return None
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.grid_pack_abi_version.restype = ctypes.c_int64
+        if lib.grid_pack_abi_version() != 2:
+            return None
     lib.grid_pack.restype = ctypes.c_int64
     lib.grid_pack.argtypes = [
         ctypes.POINTER(ctypes.c_int64),   # tidx
@@ -60,6 +66,16 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.c_int64,                   # n_tickers
         ctypes.POINTER(ctypes.c_float),   # bars out
         ctypes.POINTER(ctypes.c_uint8),   # mask out
+    ]
+    lib.wire_encode.restype = ctypes.c_int64
+    lib.wire_encode.argtypes = [
+        ctypes.POINTER(ctypes.c_float),   # bars [n,240,5]
+        ctypes.POINTER(ctypes.c_uint8),   # mask [n,240]
+        ctypes.c_int64,                   # n_tickers (flattened)
+        ctypes.c_double,                  # inv_tick
+        ctypes.POINTER(ctypes.c_float),   # base out
+        ctypes.POINTER(ctypes.c_int16),   # deltas out
+        ctypes.POINTER(ctypes.c_int32),   # volume out
     ]
     _lib = lib
     return _lib
@@ -94,3 +110,34 @@ def grid_pack_native(tidx: np.ndarray, time: np.ndarray, open_: np.ndarray,
                   n, n_tickers,
                   p(bars, ctypes.c_float), p(mask, ctypes.c_uint8))
     return bars, mask.astype(bool)
+
+
+def wire_encode_native(bars: np.ndarray, mask: np.ndarray,
+                       inv_tick: float = 100.0):
+    """One-pass native wire pack of ``bars [..., T, 240, 5] f32``.
+
+    Returns ``(base, deltas, volume)`` with the leading batch shape
+    preserved, or None when the batch is unrepresentable (caller falls
+    back to shipping raw f32 — data/wire.py).
+    """
+    lib = load()
+    if lib is None:
+        return None
+    bars = np.ascontiguousarray(bars, np.float32)
+    lead = bars.shape[:-2]  # [..., T]
+    n = int(np.prod(lead)) if lead else 1
+    m8 = np.ascontiguousarray(mask, np.uint8)
+    base = np.empty(lead, np.float32)
+    deltas = np.empty(lead + (240, 4), np.int16)
+    volume = np.empty(lead + (240,), np.int32)
+
+    def p(a, t):
+        return a.ctypes.data_as(ctypes.POINTER(t))
+
+    rc = lib.wire_encode(p(bars, ctypes.c_float), p(m8, ctypes.c_uint8),
+                         n, float(inv_tick), p(base, ctypes.c_float),
+                         p(deltas, ctypes.c_int16),
+                         p(volume, ctypes.c_int32))
+    if rc != 0:
+        return None
+    return base, deltas, volume

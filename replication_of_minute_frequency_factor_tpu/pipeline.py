@@ -20,10 +20,23 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 import pyarrow as pa
 
+import functools
+
+import jax
+
 from .config import Config, get_config
 from .data import io as dio
+from .data import wire
 from .data.minute import grid_day
-from .models.registry import compute_factors_jit, factor_names
+from .models.registry import compute_factors, compute_factors_jit, factor_names
+
+
+@functools.partial(jax.jit, static_argnames=("names", "replicate_quirks"))
+def _compute_from_wire(base, deltas, volume, mask, names, replicate_quirks):
+    """Fused on-device wire-decode + all-factor graph (one XLA module)."""
+    bars, m = wire.decode(base, deltas, volume, mask)
+    return compute_factors(bars, m, names=names,
+                           replicate_quirks=replicate_quirks)
 from .utils.logging import get_logger, FailureReport
 from .utils.tracing import Timer, trace_annotation
 
@@ -224,9 +237,19 @@ def compute_exposures(
         if cfg.debug_validate:
             from .utils.debug import validate_batch
             validate_batch(bars, mask)
+        w = None
+        if cfg.wire_transfer:
+            with timer("wire_encode"):
+                w = wire.encode(bars, mask)
         with timer("device"), trace_annotation("factor_batch"):
-            out = compute_factors_jit(bars, mask, names=names,
-                                      replicate_quirks=cfg.replicate_quirks)
+            if w is not None:
+                out = _compute_from_wire(
+                    w.base, w.deltas, w.volume, w.mask, names=names,
+                    replicate_quirks=cfg.replicate_quirks)
+            else:
+                out = compute_factors_jit(
+                    bars, mask, names=names,
+                    replicate_quirks=cfg.replicate_quirks)
             out = {k: np.asarray(v) for k, v in out.items()}
         for i, (date, _) in enumerate(batch):
             sel = present[i]

@@ -48,6 +48,27 @@ def test_native_last_write_wins():
     assert g.bars[0, 0, 0] == 2.0
 
 
+def test_native_wire_encode_matches_numpy(rng):
+    from replication_of_minute_frequency_factor_tpu.data import wire
+    cols = synth_day(rng, n_codes=12, missing_prob=0.1, zero_volume_prob=0.1,
+                     short_day_codes=2)
+    g = grid_day(cols["code"], cols["time"], cols["open"], cols["high"],
+                 cols["low"], cols["close"], cols["volume"])
+    bars, mask = g.bars[None], g.mask[None]
+    a = wire.encode(bars, mask, use_native=True)
+    b = wire.encode(bars, mask, use_native=False)
+    assert a is not None and b is not None
+    np.testing.assert_array_equal(a.base, b.base)
+    np.testing.assert_array_equal(a.deltas, b.deltas)
+    np.testing.assert_array_equal(a.volume, b.volume)
+    # unrepresentable input rejected by both
+    bad = bars.copy()
+    i = tuple(np.argwhere(mask)[0])
+    bad[i][3] += 0.005
+    assert wire.encode(bad, mask, use_native=True) is None
+    assert wire.encode(bad, mask, use_native=False) is None
+
+
 def test_abi_and_slot_formula_parity(rng):
     times = np.concatenate([sessions.GRID_TIMES,
                             np.array([92900000, 113000000, 120000000,

@@ -1,0 +1,81 @@
+"""Wire format: exact round-trip, fallback detection, factor equality."""
+
+import numpy as np
+import pytest
+
+from replication_of_minute_frequency_factor_tpu.data import wire
+from replication_of_minute_frequency_factor_tpu.data.minute import grid_day
+from replication_of_minute_frequency_factor_tpu.data.synthetic import synth_day
+from replication_of_minute_frequency_factor_tpu.models.registry import (
+    compute_factors_jit)
+
+
+@pytest.fixture
+def batch(rng):
+    days = []
+    for _ in range(2):
+        cols = synth_day(rng, n_codes=10, missing_prob=0.1,
+                         zero_volume_prob=0.1, short_day_codes=2)
+        g = grid_day(cols["code"], cols["time"], cols["open"], cols["high"],
+                     cols["low"], cols["close"], cols["volume"])
+        days.append(g)
+    bars = np.stack([g.bars for g in days])
+    mask = np.stack([g.mask for g in days])
+    return bars, mask
+
+
+def test_roundtrip_exact(batch):
+    bars, mask = batch
+    w = wire.encode(bars, mask)
+    assert w is not None
+    assert w.nbytes < 0.65 * (bars.nbytes + mask.nbytes)
+    out_bars, out_mask = wire.decode(w.base, w.deltas, w.volume, w.mask)
+    out_bars = np.asarray(out_bars)
+    np.testing.assert_array_equal(np.asarray(out_mask), mask)
+    # prices within 1 ulp (XLA reciprocal-multiply, see wire.py docstring);
+    # volumes exact
+    np.testing.assert_allclose(out_bars[mask][:, :4],
+                               bars[mask][:, :4].astype(np.float32),
+                               rtol=2.5e-7)
+    np.testing.assert_array_equal(out_bars[mask][:, 4], bars[mask][:, 4])
+    # equal tick counts decode identically: a flat bar stays exactly flat
+    flat = bars[mask][:, 0] == bars[mask][:, 3]  # open == close
+    np.testing.assert_array_equal(out_bars[mask][flat, 0],
+                                  out_bars[mask][flat, 3])
+
+
+def test_factors_identical_through_wire(batch):
+    bars, mask = batch
+    w = wire.encode(bars, mask)
+    direct = compute_factors_jit(bars, mask)
+    via = compute_factors_jit(*wire.decode(w.base, w.deltas, w.volume, w.mask))
+    for k in direct:
+        a, b = np.asarray(direct[k]), np.asarray(via[k])
+        np.testing.assert_array_equal(np.isfinite(a), np.isfinite(b),
+                                      err_msg=f"{k} NaN pattern differs")
+        ok = np.isfinite(a)
+        # higher-moment factors amplify the 1-ulp price wobble (returns are
+        # ~3e-4, so a 1e-7 price shift is ~3e-4 relative on a return)
+        np.testing.assert_allclose(
+            a[ok], b[ok], rtol=2e-3, atol=5e-4,
+            err_msg=f"{k} differs through wire decode")
+
+
+def test_encode_rejects_unrepresentable(batch):
+    bars, mask = batch
+    b = bars.copy()
+    b[0, 0, 0, 3] = 1.005  # off-tick price on a valid lane
+    mask2 = mask.copy()
+    mask2[0, 0, 0] = True
+    assert wire.encode(b, mask2) is None
+
+    b = bars.copy()
+    b[mask][:, 4]  # volumes are ints; make one fractional
+    b2 = bars.copy()
+    i = tuple(np.argwhere(mask)[0])
+    b2[i][4] = 10.5
+    assert wire.encode(b2, mask) is None
+
+    b3 = bars.copy()
+    b3[i][3] = b3[i][3] + 400.0  # 40k-tick jump overflows int16
+    assert wire.encode(b3, mask) is None
