@@ -1,0 +1,228 @@
+"""Vmapped symbolic factor search (BASELINE.json config 5).
+
+Searches the space of factor expressions over the minute-bar day tensor by
+evaluating an entire *population* of candidate expression programs in one
+jit/vmap graph — the TPU-native form of genetic factor mining: the genome
+is data, not Python code, so 10k candidates batch onto the MXU instead of
+10k interpreter passes.
+
+Representation: every candidate shares a fixed postfix *skeleton* (a static
+sequence of PUSH/UNARY/BINARY slots, so stack discipline is valid by
+construction and the interpreter is a trace-time Python loop — no
+data-dependent control flow). A genome assigns each slot a choice:
+
+  PUSH   -> which per-bar feature series to push (open/.../volume, intrabar
+            return, volume share, hl-range, tod ramp)
+  UNARY  -> identity / neg / abs / log1p|x| / zscore over valid bars /
+            lag-1 / cumsum
+  BINARY -> + / - / * / protected divide / min / max
+
+The factor value per (candidate, day, ticker) is the masked mean of the
+final series; fitness is |mean per-date cross-sectional Pearson IC| against
+caller-supplied forward returns. Selection/mutation/crossover run host-side
+on the int genome matrix (cheap); only evaluation touches the device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .data.minute import F_CLOSE, F_HIGH, F_LOW, F_OPEN, F_VOLUME
+from .ops import masked_corr, masked_mean, masked_std
+
+# slot kinds
+PUSH, UNARY, BINARY = 0, 1, 2
+
+#: default skeleton: (((f u) (f u) b u) ((f) (f) b) b u) — depth-3 tree,
+#: 6 feature leaves worth of mixing, 14 slots
+DEFAULT_SKELETON: Tuple[int, ...] = (
+    PUSH, UNARY, PUSH, UNARY, BINARY, UNARY,
+    PUSH, PUSH, BINARY,
+    BINARY,
+    PUSH, PUSH, BINARY,
+    BINARY, UNARY,
+)
+
+N_FEATURES = 9
+N_UNARY = 7
+N_BINARY = 6
+
+
+def _features(bars, mask):
+    """Feature bank ``[F, ..., 240]`` of per-bar series."""
+    o = bars[..., F_OPEN]
+    h = bars[..., F_HIGH]
+    l = bars[..., F_LOW]
+    c = bars[..., F_CLOSE]
+    v = bars[..., F_VOLUME]
+    eps = 1e-12
+    ret = (c - o) / jnp.where(jnp.abs(o) > eps, o, 1.0)
+    vshare = v / jnp.maximum(
+        jnp.sum(jnp.where(mask, v, 0.0), axis=-1, keepdims=True), 1.0)
+    hlr = (h - l) / jnp.where(jnp.abs(l) > eps, l, 1.0)
+    tod = jnp.broadcast_to(jnp.linspace(-1.0, 1.0, bars.shape[-2]),
+                           mask.shape)
+    return jnp.stack([o, h, l, c, v, ret, vshare, hlr, tod])
+
+
+def _apply_unary(k, x, mask):
+    z_mu = masked_mean(x, mask)
+    z_sd = masked_std(x, mask)
+    z = (x - z_mu[..., None]) / jnp.where(z_sd[..., None] > 0,
+                                          z_sd[..., None], 1.0)
+    lag = jnp.concatenate([x[..., :1], x[..., :-1]], axis=-1)
+    branches = [
+        x,
+        -x,
+        jnp.abs(x),
+        jnp.log1p(jnp.abs(x)),
+        z,
+        lag,
+        jnp.cumsum(jnp.where(mask, x, 0.0), axis=-1),
+    ]
+    return jnp.select([k == i for i in range(N_UNARY)], branches, x)
+
+
+def _apply_binary(k, a, b):
+    eps = 1e-6
+    branches = [
+        a + b,
+        a - b,
+        a * b,
+        a / jnp.where(jnp.abs(b) > eps, b, jnp.where(b >= 0, eps, -eps)),
+        jnp.minimum(a, b),
+        jnp.maximum(a, b),
+    ]
+    return jnp.select([k == i for i in range(N_BINARY)], branches, a)
+
+
+def eval_programs(genomes, bars, mask,
+                  skeleton: Tuple[int, ...] = DEFAULT_SKELETON):
+    """Evaluate a genome population over a day batch.
+
+    genomes: int32 ``[P, L]``; bars ``[D, T, 240, 5]``; mask ``[D, T, 240]``.
+    Returns factor values ``[P, D, T]`` (masked mean of each candidate's
+    final series; NaN where a ticker has no bars).
+    """
+    feats = _features(bars, mask)  # [F, D, T, 240]
+
+    def one(genome):
+        stack = []
+        for slot, kind in enumerate(skeleton):
+            g = genome[slot]
+            if kind == PUSH:
+                stack.append(jnp.take(feats, g, axis=0))
+            elif kind == UNARY:
+                stack.append(_apply_unary(g, stack.pop(), mask))
+            else:
+                b = stack.pop()
+                a = stack.pop()
+                stack.append(_apply_binary(g, a, b))
+        assert len(stack) == 1, "malformed skeleton"
+        return masked_mean(stack[0], mask)  # [D, T]
+
+    return jax.vmap(one)(genomes)
+
+
+@functools.partial(jax.jit, static_argnames=("skeleton",))
+def fitness(genomes, bars, mask, fwd_ret, fwd_valid,
+            skeleton: Tuple[int, ...] = DEFAULT_SKELETON):
+    """|mean per-date cross-sectional IC| per candidate -> ``[P]``."""
+    vals = eval_programs(genomes, bars, mask, skeleton)  # [P, D, T]
+    valid = jnp.isfinite(vals) & fwd_valid[None]
+    ic = masked_corr(jnp.where(valid, vals, 0.0),
+                     jnp.broadcast_to(jnp.where(valid, fwd_ret[None], 0.0),
+                                      vals.shape),
+                     valid)  # [P, D]
+    return jnp.abs(jnp.nanmean(ic, axis=-1))
+
+
+def _gene_bounds(skeleton):
+    return np.array([
+        {PUSH: N_FEATURES, UNARY: N_UNARY, BINARY: N_BINARY}[k]
+        for k in skeleton], np.int32)
+
+
+@dataclasses.dataclass
+class SearchResult:
+    genome: np.ndarray
+    fitness: float
+    history: np.ndarray  # best fitness per generation
+
+
+def random_population(rng: np.random.Generator, pop: int,
+                      skeleton=DEFAULT_SKELETON) -> np.ndarray:
+    bounds = _gene_bounds(skeleton)
+    return (rng.random((pop, len(skeleton))) * bounds).astype(np.int32)
+
+
+def evolve(bars, mask, fwd_ret, fwd_valid,
+           pop: int = 1024, generations: int = 10,
+           elite_frac: float = 0.1, mutate_p: float = 0.15,
+           skeleton=DEFAULT_SKELETON, seed: int = 0,
+           device_batch: int = 1024) -> SearchResult:
+    """Host-side GA around the device fitness kernel.
+
+    Tournament-free truncation GA: keep the elite, refill with uniform
+    crossover of elite pairs + per-gene mutation. Every candidate in a
+    generation evaluates in ``ceil(pop/device_batch)`` fused device calls.
+    """
+    rng = np.random.default_rng(seed)
+    bounds = _gene_bounds(skeleton)
+    genomes = random_population(rng, pop, skeleton)
+    n_elite = max(2, int(pop * elite_frac))
+    history = []
+    best_g, best_f = genomes[0], -1.0
+
+    for _ in range(generations):
+        fits = np.concatenate([
+            np.asarray(fitness(jnp.asarray(genomes[i:i + device_batch]),
+                               bars, mask, fwd_ret, fwd_valid,
+                               skeleton=skeleton))
+            for i in range(0, pop, device_batch)])
+        fits = np.nan_to_num(fits, nan=-1.0)
+        order = np.argsort(-fits)
+        if fits[order[0]] > best_f:
+            best_f = float(fits[order[0]])
+            best_g = genomes[order[0]].copy()
+        history.append(fits[order[0]])
+        elite = genomes[order[:n_elite]]
+        # refill: uniform crossover of random elite pairs + mutation
+        pa = elite[rng.integers(0, n_elite, pop - n_elite)]
+        pb = elite[rng.integers(0, n_elite, pop - n_elite)]
+        take = rng.random(pa.shape) < 0.5
+        children = np.where(take, pa, pb)
+        mut = rng.random(children.shape) < mutate_p
+        children = np.where(
+            mut, (rng.random(children.shape) * bounds).astype(np.int32),
+            children)
+        genomes = np.concatenate([elite, children])
+
+    return SearchResult(genome=best_g, fitness=best_f,
+                        history=np.asarray(history))
+
+
+def describe(genome, skeleton=DEFAULT_SKELETON) -> str:
+    """Human-readable postfix rendering of a genome."""
+    feats = ["open", "high", "low", "close", "vol", "ret", "vshare",
+             "hlr", "tod"]
+    una = ["id", "neg", "abs", "log1p", "z", "lag1", "cumsum"]
+    bina = ["+", "-", "*", "/", "min", "max"]
+    stack = []
+    for slot, kind in enumerate(skeleton):
+        g = int(genome[slot])
+        if kind == PUSH:
+            stack.append(feats[g])
+        elif kind == UNARY:
+            stack.append(f"{una[g]}({stack.pop()})")
+        else:
+            b = stack.pop()
+            a = stack.pop()
+            stack.append(f"({a} {bina[g]} {b})")
+    return f"mean({stack[0]})"
