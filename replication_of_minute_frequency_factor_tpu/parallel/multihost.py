@@ -1,0 +1,64 @@
+"""Multi-host (DCN) scaffolding.
+
+The reference has no multi-node story (its "communication backend" is the
+filesystem, SURVEY.md §5); here scale-out past one host is the standard JAX
+recipe: ``jax.distributed.initialize`` on every process, one global
+``(days, tickers)`` mesh spanning all hosts' devices, and
+``make_array_from_process_local_data`` so each host feeds only its own
+shard of the day batch — factor compute stays collective-free, the small
+evaluation collectives ride ICI within a host and DCN across.
+
+On a single process these helpers degrade to the local mesh path (tested);
+on a pod slice, launch one process per host with the usual coordinator
+environment and call :func:`initialize` first.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+
+from .mesh import day_batch_spec, mask_spec, make_mesh
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """``jax.distributed.initialize`` with explicit or env-provided
+    topology. No-op when the runtime is already initialised or when
+    running single-process with no coordinator configured."""
+    if jax.process_count() > 1:
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+    except (ValueError, RuntimeError):
+        # single-process run without a coordinator: local devices only
+        pass
+
+
+def global_mesh(shape: Optional[Tuple[int, int]] = None):
+    """Mesh over every device of every process (days x tickers)."""
+    return make_mesh(shape, devices=jax.devices())
+
+
+def shard_from_host_local(bars: np.ndarray, mask: np.ndarray, mesh):
+    """Build global device arrays from *this host's* slice of the batch.
+
+    Each process passes the rows of the tickers axis it owns (the global
+    tickers axis is the concatenation over processes in process order);
+    returns globally-sharded ``(bars, mask)`` without any host ever
+    materialising the full batch — the multi-host equivalent of
+    :func:`..parallel.mesh.shard_day_batch`.
+    """
+    batched = bars.ndim == 4
+    return (
+        jax.make_array_from_process_local_data(
+            NamedSharding(mesh, day_batch_spec(batched)), bars),
+        jax.make_array_from_process_local_data(
+            NamedSharding(mesh, mask_spec(batched)), mask),
+    )

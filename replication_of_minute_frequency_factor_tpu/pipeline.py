@@ -162,6 +162,79 @@ def _grid_batch(day_data: List[Tuple[np.datetime64, Dict[str, np.ndarray]]]):
     return (np.stack(bars_l), np.stack(mask_l), codes, np.stack(present_l))
 
 
+def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
+                         parts: List["ExposureTable"]) -> None:
+    """Double-buffered device pipeline (replaces the reference's joblib
+    fan-out, SURVEY.md §7 L2): a reader thread prepares batch i+1
+    (grid + validate + wire-encode) while the device computes batch i;
+    JAX's async dispatch keeps the chip busy while batch i-1's results
+    materialise on host."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=2)
+
+    def produce():
+        try:
+            for batch in batches:
+                with timer("grid"):
+                    bars, mask, codes, present = _grid_batch(batch)
+                if cfg.debug_validate:
+                    from .utils.debug import validate_batch
+                    validate_batch(bars, mask)
+                w = None
+                if cfg.wire_transfer:
+                    with timer("wire_encode"):
+                        w = wire.encode(bars, mask)
+                dates = [d for d, _ in batch]
+                q.put(("batch", (dates, codes, present, w, bars, mask)))
+        except BaseException as e:  # surface in the consumer thread
+            q.put(("error", e))
+            return
+        q.put(("done", None))
+
+    threading.Thread(target=produce, daemon=True).start()
+
+    def launch(item):
+        dates, codes, present, w, bars, mask = item
+        with trace_annotation("factor_batch"):
+            if w is not None:
+                out = _compute_from_wire(
+                    w.base, w.deltas, w.volume, w.mask, names=names,
+                    replicate_quirks=cfg.replicate_quirks)
+            else:
+                out = compute_factors_jit(
+                    bars, mask, names=names,
+                    replicate_quirks=cfg.replicate_quirks)
+        return dates, codes, present, out
+
+    def materialize(pending):
+        dates, codes, present, out = pending
+        with timer("device"):
+            out = {k: np.asarray(v) for k, v in out.items()}
+        for i, date in enumerate(dates):
+            sel = present[i]
+            cols = {"code": codes[sel].astype(object),
+                    "date": np.full(int(sel.sum()), date, "datetime64[D]")}
+            for n in names:
+                cols[n] = out[n][i, sel].astype(np.float32)
+            parts.append(ExposureTable(cols))
+
+    pending = None
+    while True:
+        kind, payload = q.get()
+        if kind == "error":
+            raise payload
+        if kind == "done":
+            break
+        launched = launch(payload)
+        if pending is not None:
+            materialize(pending)
+        pending = launched
+    if pending is not None:
+        materialize(pending)
+
+
 def compute_exposures(
     minute_dir: Optional[str] = None,
     names: Optional[Sequence[str]] = None,
@@ -208,17 +281,37 @@ def compute_exposures(
         except ImportError:
             pass
 
-    batch: List[Tuple[np.datetime64, Dict[str, np.ndarray]]] = []
     t0 = time.perf_counter()
 
-    def flush():
-        if not batch:
-            return
-        if cfg.backend == "numpy":
-            # CPU oracle path: reference (polars) semantics in f64
-            # (SURVEY.md §7 backend dispatch; container has no polars)
-            import pandas as pd
-            from .oracle import compute_oracle
+    def read_batches():
+        """Yield lists of (date, day-columns), one list per device batch,
+        with per-day failure isolation (reference :17-25)."""
+        batch: List[Tuple[np.datetime64, Dict[str, np.ndarray]]] = []
+        for date, path in iterator:
+            try:
+                if fault_hook is not None:
+                    fault_hook(date)
+                with timer("io"):
+                    day = dio.read_minute_day(path)
+                if len(day["code"]) == 0:
+                    raise ValueError("empty day file")
+                batch.append((date, day))
+            except Exception as e:  # noqa: BLE001 — per-day isolation
+                failures.record(str(date), path, e)
+                logger.warning("skipping day %s (%s): %s", date, path, e)
+                continue
+            if len(batch) >= cfg.days_per_batch:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+    if cfg.backend == "numpy":
+        # CPU oracle path: reference (polars) semantics in f64
+        # (SURVEY.md §7 backend dispatch; container has no polars)
+        import pandas as pd
+        from .oracle import compute_oracle
+        for batch in read_batches():
             for date, d in batch:
                 df = pd.DataFrame(
                     {k: d[k] for k in ("code", "time", "open", "high",
@@ -230,52 +323,8 @@ def compute_exposures(
                 for n in names:
                     cols[n] = wide[n].to_numpy(np.float32)
                 parts.append(ExposureTable(cols))
-            batch.clear()
-            return
-        with timer("grid"):
-            bars, mask, codes, present = _grid_batch(batch)
-        if cfg.debug_validate:
-            from .utils.debug import validate_batch
-            validate_batch(bars, mask)
-        w = None
-        if cfg.wire_transfer:
-            with timer("wire_encode"):
-                w = wire.encode(bars, mask)
-        with timer("device"), trace_annotation("factor_batch"):
-            if w is not None:
-                out = _compute_from_wire(
-                    w.base, w.deltas, w.volume, w.mask, names=names,
-                    replicate_quirks=cfg.replicate_quirks)
-            else:
-                out = compute_factors_jit(
-                    bars, mask, names=names,
-                    replicate_quirks=cfg.replicate_quirks)
-            out = {k: np.asarray(v) for k, v in out.items()}
-        for i, (date, _) in enumerate(batch):
-            sel = present[i]
-            cols = {"code": codes[sel].astype(object),
-                    "date": np.full(int(sel.sum()), date, "datetime64[D]")}
-            for n in names:
-                cols[n] = out[n][i, sel].astype(np.float32)
-            parts.append(ExposureTable(cols))
-        batch.clear()
-
-    for date, path in iterator:
-        try:
-            if fault_hook is not None:
-                fault_hook(date)
-            with timer("io"):
-                day = dio.read_minute_day(path)
-            if len(day["code"]) == 0:
-                raise ValueError("empty day file")
-            batch.append((date, day))
-        except Exception as e:  # noqa: BLE001 — per-day isolation
-            failures.record(str(date), path, e)
-            logger.warning("skipping day %s (%s): %s", date, path, e)
-            continue
-        if len(batch) >= cfg.days_per_batch:
-            flush()
-    flush()
+    else:
+        _run_device_pipeline(read_batches(), names, cfg, timer, parts)
 
     if parts:
         new = ExposureTable.concat(parts).sort()
