@@ -24,7 +24,7 @@ _tried = False
 def _build() -> bool:
     try:
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB_PATH,
+            ["g++", "-O3", "-fno-math-errno", "-shared", "-fPIC", "-o", _LIB_PATH,
              os.path.join(_DIR, "gridpack.cpp")],
             check=True, capture_output=True, timeout=120)
         return True
@@ -45,13 +45,13 @@ def load() -> Optional[ctypes.CDLL]:
     except OSError:
         return None
     lib.grid_pack_abi_version.restype = ctypes.c_int64
-    if lib.grid_pack_abi_version() != 2:
+    if lib.grid_pack_abi_version() != 3:
         # stale build from an older source tree: rebuild once
         if not _build():
             return None
         lib = ctypes.CDLL(_LIB_PATH)
         lib.grid_pack_abi_version.restype = ctypes.c_int64
-        if lib.grid_pack_abi_version() != 2:
+        if lib.grid_pack_abi_version() != 3:
             return None
     lib.grid_pack.restype = ctypes.c_int64
     lib.grid_pack.argtypes = [

@@ -2,5 +2,5 @@
 # Build the native grid packer shared library next to this script.
 set -e
 cd "$(dirname "$0")"
-g++ -O3 -march=native -shared -fPIC -o libgridpack.so gridpack.cpp
+g++ -O3 -march=native -fno-math-errno -shared -fPIC -o libgridpack.so gridpack.cpp
 echo "built $(pwd)/libgridpack.so"

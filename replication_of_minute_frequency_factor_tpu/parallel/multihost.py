@@ -29,14 +29,22 @@ def initialize(coordinator_address: Optional[str] = None,
                process_id: Optional[int] = None) -> None:
     """``jax.distributed.initialize`` with explicit or env-provided
     topology. No-op when the runtime is already initialised or when
-    running single-process with no coordinator configured."""
-    if jax.process_count() > 1:
+    running single-process with no coordinator configured.
+
+    Must run before anything touches the XLA backend —
+    ``jax.process_count()`` would itself initialise it, so the
+    already-initialised check uses ``jax.distributed.is_initialized``.
+    Errors are only swallowed on the implicit (env-discovery) path; a
+    caller who names a coordinator gets the failure raised."""
+    if jax.distributed.is_initialized():
         return
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)
     except (ValueError, RuntimeError):
+        if coordinator_address is not None:
+            raise
         # single-process run without a coordinator: local devices only
         pass
 

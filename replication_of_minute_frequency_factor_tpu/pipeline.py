@@ -186,6 +186,11 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
                 if cfg.wire_transfer:
                     with timer("wire_encode"):
                         w = wire.encode(bars, mask)
+                if w is not None:
+                    # the raw grid is only a fallback for unrepresentable
+                    # batches; don't keep ~4 uncompressed copies alive in
+                    # the queue + in-flight slots
+                    bars = mask = None
                 dates = [d for d, _ in batch]
                 q.put(("batch", (dates, codes, present, w, bars, mask)))
         except BaseException as e:  # surface in the consumer thread

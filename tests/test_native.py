@@ -67,6 +67,20 @@ def test_native_wire_encode_matches_numpy(rng):
     bad[i][3] += 0.005
     assert wire.encode(bad, mask, use_native=True) is None
     assert wire.encode(bad, mask, use_native=False) is None
+    # a NaN lane after a genuine violation must not launder the batch
+    # (ordered-comparison maxima would reset on NaN); NaN alone rejects too
+    vi = np.argwhere(mask[0])
+    for fields in ((3,), (4,), (3, 4)):
+        bad = bars.copy()
+        bad[0][tuple(vi[0])][3] += 0.3          # off-tick close early
+        for f in fields:
+            bad[0][tuple(vi[-1])][f] = np.nan   # NaN in a later lane
+        assert wire.encode(bad, mask, use_native=True) is None, fields
+        assert wire.encode(bad, mask, use_native=False) is None, fields
+    nan_only = bars.copy()
+    nan_only[0][tuple(vi[0])][4] = np.nan
+    assert wire.encode(nan_only, mask, use_native=True) is None
+    assert wire.encode(nan_only, mask, use_native=False) is None
 
 
 def test_abi_and_slot_formula_parity(rng):
