@@ -39,7 +39,8 @@ def make_batch(rng, n_days=DAYS_PER_BATCH, n_tickers=N_TICKERS):
     open_ = close * (1 + rng.normal(0, 1e-4, shape).astype(np.float32))
     high = np.maximum(open_, close) * 1.0002
     low = np.minimum(open_, close) * 0.9998
-    volume = rng.integers(0, 100_000, shape).astype(np.float32)
+    # board lots of 100 shares, like real A-share minute volume
+    volume = (rng.integers(0, 1000, shape) * 100).astype(np.float32)
     bars = np.stack([open_, high, low, close, volume], axis=-1)
     bars[..., :4] = np.round(bars[..., :4], 2)  # tick-aligned (0.01 CNY)
     mask = rng.random(shape) > 0.02  # sparse missing bars
@@ -49,37 +50,38 @@ def make_batch(rng, n_days=DAYS_PER_BATCH, n_tickers=N_TICKERS):
 def main():
     rng = np.random.default_rng(0)
     names = factor_names()
-    bars, mask = make_batch(rng)
+    batches = [make_batch(rng) for _ in range(2)]
+    bars, mask = batches[0]
 
     use_wire = wire.encode(bars[:1], mask[:1]) is not None
 
-    def step(b, m):
-        """One full pipeline step: host pack -> wire transfer -> fused
-        on-device decode + 58-factor graph (falls back to raw f32 when the
-        wire format can't represent the batch)."""
+    def dispatch(b, m):
+        """One pipeline step, dispatched asynchronously: host pack -> wire
+        transfer -> fused on-device decode + 58-factor graph (falls back to
+        raw f32 when the wire format can't represent the batch)."""
         if use_wire:
             w = wire.encode(b, m)
             arrs = wire.put(w)
-            out = _compute_from_wire(*arrs, names=names,
-                                     replicate_quirks=True)
-        else:
-            out = compute_factors_jit(jax.device_put(b), jax.device_put(m),
-                                      names=names)
-        jax.block_until_ready(out)
-        return out
+            return _compute_from_wire(*arrs, names=names,
+                                      replicate_quirks=True)
+        return compute_factors_jit(jax.device_put(b), jax.device_put(m),
+                                   names=names)
 
     for _ in range(WARMUP):
-        step(bars, mask)
+        jax.block_until_ready(dispatch(bars, mask))
 
-    # steady state: host encode + host->device copy included each batch
-    # (the pipeline streams day files through; ingest is part of the step)
-    times = []
-    for _ in range(ITERS):
-        t0 = time.perf_counter()
-        step(bars, mask)
-        times.append(time.perf_counter() - t0)
-
-    per_batch = float(np.median(times))
+    # steady state, double-buffered like the real driver
+    # (pipeline._run_device_pipeline): batch i+1's host encode and
+    # host->device copy overlap batch i's device compute; at most two
+    # batches in flight. Ingest is part of the measured step.
+    t0 = time.perf_counter()
+    outs = []
+    for i in range(ITERS):
+        outs.append(dispatch(*batches[i % 2]))
+        if i >= 2:
+            jax.block_until_ready(outs[i - 2])
+    jax.block_until_ready(outs)
+    per_batch = (time.perf_counter() - t0) / ITERS
     full_year = per_batch * (TRADING_DAYS_PER_YEAR / DAYS_PER_BATCH)
     target = 60.0
     print(json.dumps({

@@ -39,8 +39,10 @@ class Config:
     #: debug sanitizer: validate day tensors (finite prices, high>=low,
     #: volume>=0 on valid lanes) before compute; raises DayDataError
     debug_validate: bool = False
-    #: ship day batches as int16 tick-deltas + int32 volume (data/wire.py,
-    #: 1.67x fewer wire bytes; auto-falls back to f32 when unrepresentable)
+    #: ship day batches as tick-deltas (int8/int16), lot volume
+    #: (uint16/int32) and a bit-packed mask (data/wire.py, ~3.4x fewer
+    #: wire bytes on typical data; auto-falls back to f32 when
+    #: unrepresentable)
     wire_transfer: bool = True
 
     @classmethod

@@ -106,6 +106,38 @@ def write_parquet_atomic(table: pa.Table, path: str) -> None:
         raise
 
 
+def coerce_dates(dates: np.ndarray) -> np.ndarray:
+    """To datetime64[D], accepting ISO strings and compact ``YYYYMMDD``
+    (CSMAR exports use both). Raises on out-of-range results instead of
+    letting numpy's year-only fallback turn ``"20240102"`` into the year
+    20240102 — a silent empty join downstream otherwise."""
+    dates = np.asarray(dates)
+    if np.issubdtype(dates.dtype, np.datetime64):
+        return dates.astype("datetime64[D]")
+    if dates.dtype.kind in "iu":  # integer YYYYMMDD
+        dates = dates.astype(str)
+    if dates.dtype.kind == "S":  # bytes -> str (str(b'x') would mangle)
+        dates = np.char.decode(dates, "utf-8")
+    if dates.dtype.kind in "UO" and len(dates):
+        stripped = np.char.strip(dates.astype(str))
+        nonempty = stripped[stripped != ""]
+        if len(nonempty) and len(nonempty[0]) == 8 and nonempty[0].isdigit():
+            dates = np.array(
+                [f"{x[:4]}-{x[4:6]}-{x[6:8]}"
+                 if len(x) == 8 and x.isdigit() else "NaT"
+                 for x in stripped])
+    out = np.asarray(dates, dtype="datetime64[D]")
+    ok = ~np.isnat(out)  # missing dates stay NaT (they drop from joins)
+    if ok.any():
+        years = out[ok].astype("datetime64[Y]").astype(int) + 1970
+        if years.min() < 1900 or years.max() > 2200:
+            raise ValueError(
+                f"unparseable trading dates (years {years.min()}-"
+                f"{years.max()}): expected ISO YYYY-MM-DD or compact "
+                "YYYYMMDD strings")
+    return out
+
+
 def read_daily_pv(
     path: str,
     columns: Optional[Sequence[str]] = None,
@@ -127,10 +159,8 @@ def read_daily_pv(
     out = {}
     for k, v in raw.items():
         out[rename.get(k, k)] = v
-    if "date" in out and not np.issubdtype(out["date"].dtype, np.datetime64):
-        out["date"] = np.asarray(out["date"], dtype="datetime64[D]")
-    elif "date" in out:
-        out["date"] = out["date"].astype("datetime64[D]")
+    if "date" in out:
+        out["date"] = coerce_dates(out["date"])
     if "code" in out and out["code"].dtype.kind in "iu":
         out["code"] = np.char.zfill(out["code"].astype(str), 6)
     return out

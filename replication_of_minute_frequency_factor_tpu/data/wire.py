@@ -2,26 +2,32 @@
 
 The tunnel/PCIe link, not the MXU, bounds pipeline throughput (the fused
 58-factor graph runs in ~2 ms per 8-day x 5000-ticker batch; the raw f32
-tensor for it is ~200 MB). A-share prices are tick-aligned (0.01 CNY), so
-the batch ships as:
+tensor for it is ~200 MB). A-share prices are tick-aligned (0.01 CNY) and
+volumes trade in board lots, so the batch ships as:
 
-  base    [D, T]         f32   first valid close (ticks*0.01)
-  deltas  [D, T, 240, 4] int16 close tick-delta vs previous valid close;
-                               open/high/low tick-delta vs same-bar close
-  volume  [D, T, 240]    int32 shares
-  mask    [D, T, 240]    bool
+  base     [D, T]         f32    first valid close (ticks*0.01)
+  dclose   [D, T, 240]    int8   close tick-delta vs previous valid close
+                                 (int16 when any delta exceeds 127 ticks)
+  dohl     [D, T, 240, 3] int8   open/high/low tick-delta vs same-bar
+                                 close (int16 fallback likewise)
+  volume   [D, T, 240]    uint16 shares / vol_scale (1 or 100-share lots;
+                                 int32 fallback when neither fits)
+  maskbits [D, T, 30]     uint8  validity mask, bit-packed little-endian
 
-12 bytes/bar instead of 20 — a 1.67x cut in wire bytes — reconstructed by
-a fused on-device decode: one int32 cumsum over the 240-slot axis + a
-scale. Decoded prices match the direct f32 cast to within 1 ulp (~1e-7
+Down to ~6.1 bytes/bar from 21 (f32 bars + bool mask) on typical data —
+a 3.4x cut in wire bytes — reconstructed by a fused on-device decode: one
+int32 cumsum over the 240-slot axis, a bit-unpack, and two scales. Every
+narrowing is per-batch with a widening fallback, so one expensive ticker
+or heavy-volume day widens its field instead of rejecting the batch.
+Decoded prices match the direct f32 cast to within 1 ulp (~1e-7
 relative): XLA strength-reduces the constant tick division to a
 reciprocal multiply, which is not correctly rounded. The wobble is
-semantically safe — equal tick counts decode to identical floats, so every
-sign/threshold comparison in the kernels (ret>0, time masks, top-k cuts on
-integer volume) is unaffected. ``encode`` returns None whenever the data
-doesn't fit the format (off-tick prices, >int16 deltas, non-integer or
->int31 volume) and callers fall back to shipping raw f32, so the format is
-an opt-in transfer optimisation.
+semantically safe — equal tick counts decode to identical floats, so
+every sign/threshold comparison in the kernels (ret>0, time masks, top-k
+cuts on integer volume) is unaffected. ``encode`` returns None whenever
+the data doesn't fit the format at all (off-tick prices, >int16 deltas,
+non-integer or >int31 volume) and callers fall back to shipping raw f32,
+so the format is an opt-in transfer optimisation.
 """
 
 from __future__ import annotations
@@ -34,30 +40,48 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..native import narrow_wire
+
 TICK = 0.01
 _I16 = 32767
+N_SLOTS = 240
+MASK_BYTES = N_SLOTS // 8
 
 
 @dataclasses.dataclass
 class WireBatch:
-    base: np.ndarray     # [..., T] f32
-    deltas: np.ndarray   # [..., T, 240, 4] int16
-    volume: np.ndarray   # [..., T, 240] int32
-    mask: np.ndarray     # [..., T, 240] bool
+    base: np.ndarray      # [..., T] f32
+    dclose: np.ndarray    # [..., T, 240] int8/int16
+    dohl: np.ndarray      # [..., T, 240, 3] int8/int16
+    volume: np.ndarray    # [..., T, 240] uint16/int32
+    maskbits: np.ndarray  # [..., T, 30] uint8 (little-endian bit order)
+    vol_scale: float      # shares per volume unit (1 or 100)
+
+    @property
+    def arrays(self):
+        return (self.base, self.dclose, self.dohl, self.volume,
+                self.maskbits,
+                np.float32(self.vol_scale))
 
     @property
     def nbytes(self) -> int:
-        return (self.base.nbytes + self.deltas.nbytes + self.volume.nbytes
-                + self.mask.nbytes)
+        return sum(np.asarray(a).nbytes for a in self.arrays)
+
+
+def pack_mask(mask: np.ndarray) -> np.ndarray:
+    """[..., 240] bool -> [..., 30] uint8, little-endian bit order."""
+    return np.packbits(np.asarray(mask, bool), axis=-1, bitorder="little")
 
 
 def encode(bars: np.ndarray, mask: np.ndarray, tick: float = TICK,
-           use_native: Optional[bool] = None) -> Optional[WireBatch]:
+           use_native: Optional[bool] = None,
+           floor: Optional[dict] = None) -> Optional[WireBatch]:
     """Host-side packing; None when the batch can't be represented.
 
     Dispatches to the C++ single-pass encoder (:mod:`..native`) when built
     (~100x the numpy path below, which remains the portable fallback and
-    parity oracle)."""
+    parity oracle). ``floor`` is the widen-only dtype state a pipeline run
+    threads through successive batches (see ``native.narrow_wire``)."""
     bars = np.asarray(bars)
     mask = np.asarray(mask)
     if use_native is None or use_native:
@@ -65,9 +89,11 @@ def encode(bars: np.ndarray, mask: np.ndarray, tick: float = TICK,
         if native.available():
             out = native.wire_encode_native(bars, mask, round(1.0 / tick))
             if out is not None:
-                base, deltas, volume = out
-                return WireBatch(base=base, deltas=deltas, volume=volume,
-                                 mask=mask.astype(bool))
+                base, dclose, dohl, volume, vol_scale = narrow_wire(
+                    *out, floor=floor)
+                return WireBatch(base=base, dclose=dclose, dohl=dohl,
+                                 volume=volume, maskbits=pack_mask(mask),
+                                 vol_scale=vol_scale)
             return None  # native says unrepresentable; semantics match numpy
         if use_native:
             raise RuntimeError("native wire encoder unavailable")
@@ -103,35 +129,42 @@ def encode(bars: np.ndarray, mask: np.ndarray, tick: float = TICK,
     dopen = np.where(mask, np.rint(o / tick) - ct, 0.0)
     dhigh = np.where(mask, np.rint(h / tick) - ct, 0.0)
     dlow = np.where(mask, np.rint(l / tick) - ct, 0.0)
-    deltas = np.stack([dclose, dopen, dhigh, dlow], axis=-1)
-    if np.abs(deltas).max(initial=0) > _I16:
+    dohl = np.stack([dopen, dhigh, dlow], axis=-1)
+    dohl_max = int(np.abs(dohl).max(initial=0))
+    dclose_max = int(np.abs(dclose).max(initial=0))
+    if dclose_max > _I16 or dohl_max > _I16:
         return None
-    return WireBatch(
-        base=(base_ct / round(1.0 / tick)).astype(np.float32),
-        deltas=deltas.astype(np.int16),
-        volume=np.where(mask, v, 0).astype(np.int32),
-        mask=mask.astype(bool),
-    )
+    vol_i = np.where(mask, np.rint(v), 0).astype(np.int64)
+    stats = (dohl_max, dclose_max,
+             int((vol_i % 100 == 0).all()), int(vol_i.max(initial=0)))
+    base, dclose, dohl, volume, vol_scale = narrow_wire(
+        (base_ct / round(1.0 / tick)).astype(np.float32),
+        dclose.astype(np.int16), dohl.astype(np.int16),
+        vol_i.astype(np.int32), stats, floor=floor)
+    return WireBatch(base=base, dclose=dclose, dohl=dohl, volume=volume,
+                     maskbits=pack_mask(mask), vol_scale=vol_scale)
 
 
 @functools.partial(jax.jit, static_argnames=("tick",))
-def decode(base, deltas, volume, mask, tick: float = TICK):
+def decode(base, dclose, dohl, volume, maskbits, vol_scale,
+           tick: float = TICK):
     """On-device unpacking -> ``(bars [..., T, 240, 5] f32, mask)``.
 
-    Fuses into the factor graph: XLA keeps the int16->f32 expansion in
+    Fuses into the factor graph: XLA keeps the int->f32 expansion in
     HBM-local registers instead of shipping wide floats over the wire.
     """
-    d = deltas.astype(jnp.int32)
+    bits = (maskbits[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+    m = bits.reshape(maskbits.shape[:-1] + (N_SLOTS,)).astype(bool)
     inv = jnp.float32(round(1.0 / tick))
     ct = jnp.round(base * inv).astype(jnp.int32)[..., None] \
-        + jnp.cumsum(d[..., 0], axis=-1)
+        + jnp.cumsum(dclose.astype(jnp.int32), axis=-1)
+    d = dohl.astype(jnp.int32)
     close = ct.astype(jnp.float32) / inv
-    open_ = (ct + d[..., 1]).astype(jnp.float32) / inv
-    high = (ct + d[..., 2]).astype(jnp.float32) / inv
-    low = (ct + d[..., 3]).astype(jnp.float32) / inv
-    vol = volume.astype(jnp.float32)
+    open_ = (ct + d[..., 0]).astype(jnp.float32) / inv
+    high = (ct + d[..., 1]).astype(jnp.float32) / inv
+    low = (ct + d[..., 2]).astype(jnp.float32) / inv
+    vol = volume.astype(jnp.float32) * vol_scale.astype(jnp.float32)
     zero = jnp.zeros_like(close)
-    m = mask
     bars = jnp.stack(
         [jnp.where(m, f, zero) for f in (open_, high, low, close, vol)],
         axis=-1)
@@ -140,7 +173,6 @@ def decode(base, deltas, volume, mask, tick: float = TICK):
 
 def put(wire: WireBatch, shardings=None):
     """device_put the packed representation (decode happens device-side)."""
-    arrs = (wire.base, wire.deltas, wire.volume, wire.mask)
     if shardings is None:
-        return tuple(jax.device_put(a) for a in arrs)
-    return tuple(jax.device_put(a, s) for a, s in zip(arrs, shardings))
+        return tuple(jax.device_put(a) for a in wire.arrays)
+    return tuple(jax.device_put(a, s) for a, s in zip(wire.arrays, shardings))

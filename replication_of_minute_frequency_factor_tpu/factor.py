@@ -24,6 +24,9 @@ import pyarrow as pa
 from . import eval_ops, frames, plotting
 from .config import get_config
 from .data import io as dio
+from .utils.logging import get_logger
+
+logger = get_logger(__name__)
 
 
 class Factor:
@@ -145,6 +148,12 @@ class Factor:
             self.rank_IC = float(np.nanmean(rank_k))
             self.rank_ICIR = float(
                 np.nanmean(rank_k) / np.nanstd(rank_k, ddof=1))
+        else:
+            logger.warning(
+                "ic_test: no date with a usable cross-section — exposure "
+                "and daily PV data share no (code, date) pairs with finite "
+                "forward returns; IC stats left as None. Check that both "
+                "sources cover the same dates and code format.")
         stats = {"IC": self.IC, "ICIR": self.ICIR,
                  "rank_IC": self.rank_IC, "rank_ICIR": self.rank_ICIR}
         fig = None

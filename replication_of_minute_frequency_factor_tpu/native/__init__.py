@@ -24,7 +24,8 @@ _tried = False
 def _build() -> bool:
     try:
         subprocess.run(
-            ["g++", "-O3", "-fno-math-errno", "-shared", "-fPIC", "-o", _LIB_PATH,
+            ["g++", "-O3", "-mtune=native", "-fno-math-errno", "-shared",
+             "-fPIC", "-o", _LIB_PATH,
              os.path.join(_DIR, "gridpack.cpp")],
             check=True, capture_output=True, timeout=120)
         return True
@@ -45,13 +46,13 @@ def load() -> Optional[ctypes.CDLL]:
     except OSError:
         return None
     lib.grid_pack_abi_version.restype = ctypes.c_int64
-    if lib.grid_pack_abi_version() != 3:
+    if lib.grid_pack_abi_version() != 5:
         # stale build from an older source tree: rebuild once
         if not _build():
             return None
         lib = ctypes.CDLL(_LIB_PATH)
         lib.grid_pack_abi_version.restype = ctypes.c_int64
-        if lib.grid_pack_abi_version() != 3:
+        if lib.grid_pack_abi_version() != 5:
             return None
     lib.grid_pack.restype = ctypes.c_int64
     lib.grid_pack.argtypes = [
@@ -74,8 +75,10 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.c_int64,                   # n_tickers (flattened)
         ctypes.c_double,                  # inv_tick
         ctypes.POINTER(ctypes.c_float),   # base out
-        ctypes.POINTER(ctypes.c_int16),   # deltas out
+        ctypes.POINTER(ctypes.c_int16),   # dclose out
+        ctypes.POINTER(ctypes.c_int16),   # dohl out
         ctypes.POINTER(ctypes.c_int32),   # volume out
+        ctypes.POINTER(ctypes.c_int64),   # stats out [4]
     ]
     _lib = lib
     return _lib
@@ -116,9 +119,11 @@ def wire_encode_native(bars: np.ndarray, mask: np.ndarray,
                        inv_tick: float = 100.0):
     """One-pass native wire pack of ``bars [..., T, 240, 5] f32``.
 
-    Returns ``(base, deltas, volume)`` with the leading batch shape
-    preserved, or None when the batch is unrepresentable (caller falls
-    back to shipping raw f32 — data/wire.py).
+    Returns ``(base, dclose, dohl, volume, vol_scale)`` with the leading
+    batch shape preserved — ``dclose``/``dohl`` narrowed to int8 and
+    ``volume`` to uint16 board lots when the batch's stats allow — or None
+    when the batch is unrepresentable (caller falls back to shipping raw
+    f32 — data/wire.py).
     """
     lib = load()
     if lib is None:
@@ -128,16 +133,53 @@ def wire_encode_native(bars: np.ndarray, mask: np.ndarray,
     n = int(np.prod(lead)) if lead else 1
     m8 = np.ascontiguousarray(mask, np.uint8)
     base = np.empty(lead, np.float32)
-    deltas = np.empty(lead + (240, 4), np.int16)
+    dclose = np.empty(lead + (240,), np.int16)
+    dohl = np.empty(lead + (240, 3), np.int16)
     volume = np.empty(lead + (240,), np.int32)
+    stats = np.zeros(4, np.int64)
 
     def p(a, t):
         return a.ctypes.data_as(ctypes.POINTER(t))
 
     rc = lib.wire_encode(p(bars, ctypes.c_float), p(m8, ctypes.c_uint8),
                          n, float(inv_tick), p(base, ctypes.c_float),
-                         p(deltas, ctypes.c_int16),
-                         p(volume, ctypes.c_int32))
-    if rc != 0:
+                         p(dclose, ctypes.c_int16),
+                         p(dohl, ctypes.c_int16),
+                         p(volume, ctypes.c_int32),
+                         p(stats, ctypes.c_int64))
+    if rc < 0:
         return None
-    return base, deltas, volume
+    return base, dclose, dohl, volume, stats
+
+
+def narrow_wire(base, dclose, dohl, volume, stats, floor=None):
+    """Shared narrowing policy for both encode paths (native + numpy):
+    int8 deltas and uint16 lot-volume whenever the batch stats fit.
+
+    ``floor`` (a mutable dict, threaded through a pipeline run) makes the
+    choice widen-only across batches: once one batch needs a wide dtype,
+    later batches keep it, so the jit cache sees a bounded set of
+    signatures (at most one widening per field per run) instead of
+    data-dependent flip-flopping that would recompile the fused factor
+    graph."""
+    floor = floor if floor is not None else {}
+    dmax_ohl, dmax_c, v_lots, vmax = (int(s) for s in stats)
+    if dmax_ohl <= 127 and not floor.get("dohl_wide"):
+        dohl = dohl.astype(np.int8)
+    else:
+        floor["dohl_wide"] = True
+    if dmax_c <= 127 and not floor.get("dclose_wide"):
+        dclose = dclose.astype(np.int8)
+    else:
+        floor["dclose_wide"] = True
+    vol_scale = 1.0
+    vol_fit = floor.get("vol_fit", "u16")
+    if vmax <= 0xFFFF and vol_fit == "u16":
+        volume = volume.astype(np.uint16)
+    elif v_lots and vmax // 100 <= 0xFFFF and vol_fit in ("u16", "lots"):
+        volume = (volume // 100).astype(np.uint16)
+        vol_scale = 100.0
+        floor["vol_fit"] = "lots"
+    else:
+        floor["vol_fit"] = "i32"
+    return base, dclose, dohl, volume, vol_scale

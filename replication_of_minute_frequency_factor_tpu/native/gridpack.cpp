@@ -67,8 +67,9 @@ int64_t grid_pack(const int64_t* tidx, const int64_t* time,
 }
 
 // Pack a dense [n_tickers, 240, 5] f32 grid into the compact wire format
-// (data/wire.py): per-ticker first-valid close as f32 base, int16 tick
-// deltas (close vs previous valid close; open/high/low vs same-bar close),
+// (data/wire.py): per-ticker first-valid close as f32 base, int16 close
+// tick-delta vs previous valid close, int16 open/high/low tick-delta vs
+// same-bar close (the caller narrows to int8 when the returned max fits),
 // int32 volume. Two passes per ticker, both L1-resident: a branch-light
 // tick-conversion/validation sweep the compiler can keep in vector
 // registers (rint inlines to a rounding instruction; llround would be a
@@ -76,19 +77,26 @@ int64_t grid_pack(const int64_t* tidx, const int64_t* time,
 // mode (nearest-even vs half-away) cannot change accept/reject semantics:
 // any value ~0.5 ticks off-grid already fails the 1e-3 alignment check.
 //   bars [n*240*5] f32, mask [n*240] u8  ->
-//   base [n] f32, deltas [n*240*4] i16, volume [n*240] i32 (caller-zeroed
-//   deltas/volume not required; every lane is written)
-// Returns 0 on success, 1 if the batch is unrepresentable (off-tick price,
-// delta overflow, fractional/negative/overflowing volume); outputs are
-// garbage on failure (caller discards and ships raw f32 instead).
+//   base [n] f32, dclose [n*240] i16, dohl [n*240*3] i16,
+//   volume [n*240] i32 (caller-zeroing not required; every lane is written)
+//   stats[4]: max |open/high/low delta|, max |close delta|, all-volumes-
+//   divisible-by-100 flag, max volume — callers use these to narrow dohl /
+//   dclose to int8 and volume to uint16 lots when they fit.
+// Returns -1 if the batch is unrepresentable (off-tick price, delta
+// overflow, fractional/negative/overflowing volume) — outputs are garbage
+// and the caller ships raw f32 instead; 0 on success.
 int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
-                    double inv_tick, float* base, int16_t* deltas,
-                    int32_t* volume) {
+                    double inv_tick, float* base, int16_t* dclose,
+                    int16_t* dohl, int32_t* volume, int64_t* stats) {
   const double kAlignTol = 1e-3;
+  int32_t dmax_ohl_all = 0, dmax_c_all = 0;
+  int64_t vmax_all = 0;
+  bool v_lots = true;  // every volume divisible by 100 (A-share board lot)
   for (int64_t t = 0; t < n_tickers; ++t) {
     const float* tb = bars + t * kNSlots * kNFields;
     const uint8_t* tm = mask + t * kNSlots;
-    int16_t* td = deltas + t * kNSlots * 4;
+    int16_t* tdc = dclose + t * kNSlots;
+    int16_t* tdo = dohl + t * kNSlots * 3;
     int32_t* tv = volume + t * kNSlots;
 
     // pass 1: prices -> integer ticks with masked-lane zeroing. Per-lane
@@ -133,17 +141,18 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
       ct[s] = lane_bad ? 0 : static_cast<int32_t>(rc);
       vt[s] = lane_bad ? 0 : static_cast<int64_t>(rv);
     }
-    if (bad) return 1;
+    if (bad) return -1;
 
     // pass 2: sequential previous-valid-close deltas + output writes.
     int32_t prev = 0;
     bool have_base = false;
     double base_val = 0.0;
-    int32_t dmax = 0;
+    int32_t dmax_c = 0, dmax_ohl = 0;
     for (int64_t s = 0; s < kNSlots; ++s) {
-      int16_t* d = td + s * 4;
+      int16_t* d = tdo + s * 3;
       if (!tm[s]) {
-        d[0] = d[1] = d[2] = d[3] = 0;
+        tdc[s] = 0;
+        d[0] = d[1] = d[2] = 0;
         tv[s] = 0;
         continue;
       }
@@ -155,27 +164,36 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
       }
       const int32_t dc = c - prev, dop = ot[s] - c, dh = ht[s] - c,
                     dl = lt[s] - c;
-      int32_t a = dc < 0 ? -dc : dc;
+      const int32_t ac = dc < 0 ? -dc : dc;
       const int32_t ao = dop < 0 ? -dop : dop, ah = dh < 0 ? -dh : dh,
                     al = dl < 0 ? -dl : dl;
-      a = a > ao ? a : ao;
-      a = a > ah ? a : ah;
+      int32_t a = ao > ah ? ao : ah;
       a = a > al ? a : al;
-      dmax = dmax > a ? dmax : a;
-      d[0] = static_cast<int16_t>(dc);
-      d[1] = static_cast<int16_t>(dop);
-      d[2] = static_cast<int16_t>(dh);
-      d[3] = static_cast<int16_t>(dl);
-      tv[s] = static_cast<int32_t>(vt[s]);
+      dmax_c = dmax_c > ac ? dmax_c : ac;
+      dmax_ohl = dmax_ohl > a ? dmax_ohl : a;
+      tdc[s] = static_cast<int16_t>(dc);
+      d[0] = static_cast<int16_t>(dop);
+      d[1] = static_cast<int16_t>(dh);
+      d[2] = static_cast<int16_t>(dl);
+      const int64_t v = vt[s];
+      tv[s] = static_cast<int32_t>(v);
+      v_lots &= (v % 100) == 0;
+      vmax_all = vmax_all > v ? vmax_all : v;
       prev = c;
     }
-    if (dmax > 32767) return 1;
+    if (dmax_c > 32767 || dmax_ohl > 32767) return -1;
+    dmax_ohl_all = dmax_ohl_all > dmax_ohl ? dmax_ohl_all : dmax_ohl;
+    dmax_c_all = dmax_c_all > dmax_c ? dmax_c_all : dmax_c;
     base[t] = static_cast<float>(base_val);
   }
+  stats[0] = dmax_ohl_all;
+  stats[1] = dmax_c_all;
+  stats[2] = v_lots ? 1 : 0;
+  stats[3] = vmax_all;
   return 0;
 }
 
 // Exported so Python can assert ABI compatibility at load time.
-int64_t grid_pack_abi_version() { return 3; }
+int64_t grid_pack_abi_version() { return 5; }
 
 }  // extern "C"

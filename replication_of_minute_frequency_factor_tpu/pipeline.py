@@ -32,9 +32,10 @@ from .models.registry import compute_factors, compute_factors_jit, factor_names
 
 
 @functools.partial(jax.jit, static_argnames=("names", "replicate_quirks"))
-def _compute_from_wire(base, deltas, volume, mask, names, replicate_quirks):
+def _compute_from_wire(base, dclose, dohl, volume, maskbits, vol_scale,
+                       names, replicate_quirks):
     """Fused on-device wire-decode + all-factor graph (one XLA module)."""
-    bars, m = wire.decode(base, deltas, volume, mask)
+    bars, m = wire.decode(base, dclose, dohl, volume, maskbits, vol_scale)
     return compute_factors(bars, m, names=names,
                            replicate_quirks=replicate_quirks)
 from .utils.logging import get_logger, FailureReport
@@ -173,6 +174,7 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
     import threading
 
     q: "queue.Queue" = queue.Queue(maxsize=2)
+    wire_floor: dict = {}  # widen-only dtype state across this run's batches
 
     def produce():
         try:
@@ -185,7 +187,7 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
                 w = None
                 if cfg.wire_transfer:
                     with timer("wire_encode"):
-                        w = wire.encode(bars, mask)
+                        w = wire.encode(bars, mask, floor=wire_floor)
                 if w is not None:
                     # the raw grid is only a fallback for unrepresentable
                     # batches; don't keep ~4 uncompressed copies alive in
@@ -205,7 +207,7 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
         with trace_annotation("factor_batch"):
             if w is not None:
                 out = _compute_from_wire(
-                    w.base, w.deltas, w.volume, w.mask, names=names,
+                    *w.arrays, names=names,
                     replicate_quirks=cfg.replicate_quirks)
             else:
                 out = compute_factors_jit(

@@ -59,8 +59,15 @@ def test_native_wire_encode_matches_numpy(rng):
     b = wire.encode(bars, mask, use_native=False)
     assert a is not None and b is not None
     np.testing.assert_array_equal(a.base, b.base)
-    np.testing.assert_array_equal(a.deltas, b.deltas)
+    # identical narrowing choices (int8 deltas / uint16 lot volume)
+    assert a.dclose.dtype == b.dclose.dtype
+    assert a.dohl.dtype == b.dohl.dtype
+    assert a.volume.dtype == b.volume.dtype
+    assert a.vol_scale == b.vol_scale
+    np.testing.assert_array_equal(a.dclose, b.dclose)
+    np.testing.assert_array_equal(a.dohl, b.dohl)
     np.testing.assert_array_equal(a.volume, b.volume)
+    np.testing.assert_array_equal(a.maskbits, b.maskbits)
     # unrepresentable input rejected by both
     bad = bars.copy()
     i = tuple(np.argwhere(mask)[0])

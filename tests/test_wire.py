@@ -28,8 +28,9 @@ def test_roundtrip_exact(batch):
     bars, mask = batch
     w = wire.encode(bars, mask)
     assert w is not None
-    assert w.nbytes < 0.65 * (bars.nbytes + mask.nbytes)
-    out_bars, out_mask = wire.decode(w.base, w.deltas, w.volume, w.mask)
+    assert w.dohl.dtype == np.int8  # synthetic intra-bar ranges are narrow
+    assert w.nbytes < 0.4 * (bars.nbytes + mask.nbytes)
+    out_bars, out_mask = wire.decode(*w.arrays)
     out_bars = np.asarray(out_bars)
     np.testing.assert_array_equal(np.asarray(out_mask), mask)
     # prices within 1 ulp (XLA reciprocal-multiply, see wire.py docstring);
@@ -48,7 +49,7 @@ def test_factors_identical_through_wire(batch):
     bars, mask = batch
     w = wire.encode(bars, mask)
     direct = compute_factors_jit(bars, mask)
-    via = compute_factors_jit(*wire.decode(w.base, w.deltas, w.volume, w.mask))
+    via = compute_factors_jit(*wire.decode(*w.arrays))
     for k in direct:
         a, b = np.asarray(direct[k]), np.asarray(via[k])
         np.testing.assert_array_equal(np.isfinite(a), np.isfinite(b),
@@ -79,3 +80,61 @@ def test_encode_rejects_unrepresentable(batch):
     b3 = bars.copy()
     b3[i][3] = b3[i][3] + 400.0  # 40k-tick jump overflows int16
     assert wire.encode(b3, mask) is None
+
+
+def test_widen_only_floor_is_sticky(batch):
+    """A pipeline-run floor keeps later batches at the widest dtype seen,
+    so the jit cache sees a bounded set of signatures."""
+    bars, mask = batch
+    wide = bars.copy()
+    i = tuple(np.argwhere(mask)[0])
+    wide[i][1] = wide[i][3] + 3.0  # 300-tick intra-bar range
+    floor = {}
+    a = wire.encode(bars, mask, floor=floor)
+    assert a.dohl.dtype == np.int8
+    b = wire.encode(wide, mask, floor=floor)
+    assert b.dohl.dtype == np.int16
+    c = wire.encode(bars, mask, floor=floor)  # narrow again -> stays wide
+    assert c.dohl.dtype == np.int16
+    # and decode of the widened batch still round-trips
+    out_bars, _ = wire.decode(*c.arrays)
+    np.testing.assert_allclose(np.asarray(out_bars)[mask][:, 3],
+                               bars[mask][:, 3], rtol=2.5e-7)
+
+
+def test_coerce_dates_formats():
+    from replication_of_minute_frequency_factor_tpu.data.io import coerce_dates
+    want = np.array(["2024-01-02"], "datetime64[D]")
+    for raw in (["2024-01-02"], ["20240102"], [b"20240102"], [20240102],
+                np.array(["2024-01-02"], "datetime64[s]")):
+        np.testing.assert_array_equal(coerce_dates(np.array(raw)), want,
+                                      err_msg=str(raw))
+    # missing entries stay NaT instead of failing the whole read, in both
+    # ISO and compact columns, even when the first element is the empty one
+    for col in (["2024-01-02", ""], ["20240102", ""], ["", "20240102"],
+                [" ", "20240102"]):
+        out = coerce_dates(np.array(col))
+        good = [i for i, x in enumerate(col) if x.strip()]
+        assert not np.isnat(out[good[0]]), col
+        assert np.isnat(out[1 - good[0]]), col
+    # garbage that numpy would parse as a year raises loudly
+    with pytest.raises(ValueError):
+        coerce_dates(np.array(["2024010"]))
+
+
+def test_wide_intrabar_range_widens_dohl_not_fallback(batch):
+    """A bar whose high-close spread exceeds 127 ticks widens dohl to
+    int16 (e.g. a 1000+ CNY ticker) instead of rejecting the batch."""
+    bars, mask = batch
+    b = bars.copy()
+    i = tuple(np.argwhere(mask)[0])
+    b[i][1] = b[i][3] + 3.0  # high 300 ticks above close
+    for use_native in (True, False):
+        try:
+            w = wire.encode(b, mask, use_native=use_native)
+        except RuntimeError:
+            continue  # no C++ toolchain
+        assert w is not None and w.dohl.dtype == np.int16
+        out_bars, out_mask = wire.decode(*w.arrays)
+        np.testing.assert_allclose(
+            np.asarray(out_bars)[i][1], b[i][1], rtol=2.5e-7)
