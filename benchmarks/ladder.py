@@ -1,0 +1,166 @@
+"""Benchmark ladder — the five configs of BASELINE.json:6-12, one JSON
+line each (the headline config 3 lives in the repo-root ``bench.py``,
+which the driver runs; this script reports the full ladder).
+
+  1. realized-volatility factor (vol_return1min), 50 tickers x 1 day —
+     reference-semantics CPU oracle path vs the jit path
+  2. full CICC handbook (58 kernels), 500 tickers x 1 month
+  3. full A-share universe, 5000 tickers x 1 year (delegates to bench.py
+     sizing; same measurement loop)
+  4. 5-year cross-sectional rank-IC + decile backtest on device
+  5. symbolic factor search: vmapped population of candidate expression
+     trees over one day's minute-bar tensor
+
+Run:  python benchmarks/ladder.py [--configs 1,2,4,5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+sys.path.insert(0, ".")  # repo root (for bench.py when run from checkout)
+
+
+def _bars(rng, n_days, n_tickers):
+    import bench
+    return bench.make_batch(rng, n_days=n_days, n_tickers=n_tickers)
+
+
+def _emit(name, seconds, unit="s", **extra):
+    print(json.dumps({"metric": name, "value": round(seconds, 4),
+                      "unit": unit, **extra}), flush=True)
+
+
+def config1(rng):
+    """50 tickers x 1 day, vol_return1min: oracle (reference CPU
+    semantics) vs fused jit path."""
+    import pandas as pd
+
+    from replication_of_minute_frequency_factor_tpu.data.synthetic import synth_day
+    from replication_of_minute_frequency_factor_tpu.data.minute import grid_day
+    from replication_of_minute_frequency_factor_tpu.models.registry import compute_factors_jit
+    from replication_of_minute_frequency_factor_tpu.oracle import compute_oracle
+
+    cols = synth_day(rng, n_codes=50)
+    df = pd.DataFrame({k: cols[k] for k in
+                       ("code", "time", "open", "high", "low", "close",
+                        "volume")})
+    df["date"] = np.datetime64("2024-01-02")
+    t0 = time.perf_counter()
+    compute_oracle(df, ("vol_return1min",))
+    _emit("cfg1_vol_return1min_50tkr_1day_oracle_cpu",
+          time.perf_counter() - t0)
+
+    g = grid_day(cols["code"], cols["time"], cols["open"], cols["high"],
+                 cols["low"], cols["close"], cols["volume"])
+    bars, mask = g.bars[None], g.mask[None]
+    out = compute_factors_jit(bars, mask, names=("vol_return1min",))
+    jax.block_until_ready(out)  # compile
+    t0 = time.perf_counter()
+    for _ in range(10):
+        jax.block_until_ready(
+            compute_factors_jit(bars, mask, names=("vol_return1min",)))
+    _emit("cfg1_vol_return1min_50tkr_1day_jit",
+          (time.perf_counter() - t0) / 10)
+
+
+def config2(rng):
+    """Full 58-kernel handbook, 500 tickers x 1 month (21 days)."""
+    from replication_of_minute_frequency_factor_tpu.data import wire
+    from replication_of_minute_frequency_factor_tpu.models.registry import factor_names
+    from replication_of_minute_frequency_factor_tpu.pipeline import _compute_from_wire
+
+    names = factor_names()
+    bars, mask = _bars(rng, n_days=21, n_tickers=500)
+    w = wire.encode(bars, mask)
+
+    def step():
+        arrs = wire.put(w)
+        return _compute_from_wire(*arrs, names=names, replicate_quirks=True)
+
+    jax.block_until_ready(step())  # compile
+    t0 = time.perf_counter()
+    for _ in range(3):
+        jax.block_until_ready(step())
+    _emit("cfg2_cicc58_500tkr_1mo", (time.perf_counter() - t0) / 3,
+          factors=len(names))
+
+
+def config4(rng):
+    """5-year rank-IC + decile backtest fully on device: 1220 dates x
+    5000 tickers exposure vs forward returns."""
+    from replication_of_minute_frequency_factor_tpu import eval_ops
+
+    n_dates, n_tickers = 1220, 5000
+    expo = rng.normal(0, 1, (n_dates, n_tickers)).astype(np.float32)
+    fwd = rng.normal(0, 0.02, (n_dates, n_tickers)).astype(np.float32)
+    valid = rng.random((n_dates, n_tickers)) > 0.05
+
+    def step():
+        ic, ric = eval_ops.ic_series(expo, fwd, valid)
+        labels = eval_ops.qcut_labels(expo, valid, 10)
+        return ic, ric, labels
+
+    jax.block_until_ready(step())
+    t0 = time.perf_counter()
+    for _ in range(3):
+        jax.block_until_ready(step())
+    _emit("cfg4_5yr_rankic_decile_5000tkr", (time.perf_counter() - t0) / 3,
+          dates=n_dates)
+
+
+def config5(rng, scale=1.0):
+    """Symbolic search: one vmapped fitness evaluation of a 10k-candidate
+    population over a day tensor (the hot loop of search.evolve)."""
+    from replication_of_minute_frequency_factor_tpu import search
+
+    pop_n = max(64, int(10_000 * scale))
+    bars, mask = _bars(rng, n_days=1, n_tickers=max(50, int(1000 * scale)))
+    fwd = rng.normal(0, 0.02, bars.shape[:2]).astype(np.float32)  # [D, T]
+    fwd_valid = np.ones_like(fwd, bool)
+    pop = search.random_population(rng, pop_n)
+
+    jax.block_until_ready(search.fitness(pop, bars, mask, fwd, fwd_valid))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        jax.block_until_ready(
+            search.fitness(pop, bars, mask, fwd, fwd_valid))
+    _emit("cfg5_symbolic_search_candidates",
+          (time.perf_counter() - t0) / 3, population=pop_n)
+
+
+def config3():
+    """Headline config — same code path as bench.py."""
+    import bench
+    bench.main()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", default="1,2,3,4,5")
+    ap.add_argument("--scale", type=float, default=1.0,
+                    help="shrink config 5's population/universe "
+                         "(e.g. 0.05 for a CPU smoke test)")
+    args = ap.parse_args()
+    wanted = {int(c) for c in args.configs.split(",")}
+    rng = np.random.default_rng(0)
+    if 1 in wanted:
+        config1(rng)
+    if 2 in wanted:
+        config2(rng)
+    if 3 in wanted:
+        config3()
+    if 4 in wanted:
+        config4(rng)
+    if 5 in wanted:
+        config5(rng, scale=args.scale)
+
+
+if __name__ == "__main__":
+    main()

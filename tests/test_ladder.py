@@ -1,0 +1,21 @@
+"""Benchmark ladder smoke: the light configs run and emit valid JSON."""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_ladder_smoke():
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}  # never dial the TPU tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "benchmarks/ladder.py", "--configs", "1,5",
+         "--scale", "0.02"],
+        capture_output=True, text=True, timeout=500, check=True, env=env)
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 3  # cfg1 oracle, cfg1 jit, cfg5
+    for line in lines:
+        rec = json.loads(line)
+        assert rec["value"] > 0 and rec["unit"] == "s"
