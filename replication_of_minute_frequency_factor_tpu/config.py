@@ -39,6 +39,9 @@ class Config:
     #: debug sanitizer: validate day tensors (finite prices, high>=low,
     #: volume>=0 on valid lanes) before compute; raises DayDataError
     debug_validate: bool = False
+    #: rolling-moment backend for the mmt_ols_* family: 'conv' (XLA) or
+    #: 'pallas' (fused VMEM-resident kernel, ops/pallas_rolling.py)
+    rolling_impl: str = "conv"
     #: ship day batches as tick-deltas (int8/int16), lot volume
     #: (uint16/int32) and a bit-packed mask (data/wire.py, ~3.4x fewer
     #: wire bytes on typical data; auto-falls back to f32 when

@@ -34,10 +34,12 @@ class DayContext:
     have shape ``[..., T]``.
     """
 
-    def __init__(self, bars, mask, replicate_quirks: bool = True):
+    def __init__(self, bars, mask, replicate_quirks: bool = True,
+                 rolling_impl: str = None):
         self.bars = bars
         self.mask = mask
         self.replicate_quirks = replicate_quirks
+        self.rolling_impl = rolling_impl  # None -> Config.rolling_impl
         self._memo = {}
         #: HHMMSSmmm per slot, broadcastable against [..., T, 240]
         self.times = jnp.asarray(np.asarray(sessions.GRID_TIMES))
@@ -142,7 +144,8 @@ class DayContext:
         """Windowed (low, high) regression stats, window=50 trade minutes."""
         return self._get(
             "rolling50",
-            lambda: rolling_window_stats(self.low, self.high, self.mask, 50))
+            lambda: rolling_window_stats(self.low, self.high, self.mask, 50,
+                                         impl=self.rolling_impl))
 
     @property
     def rolling_beta(self):

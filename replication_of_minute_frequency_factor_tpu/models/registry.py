@@ -66,22 +66,29 @@ FACTOR_NAMES = _Lazy()
 
 
 def compute_factors(bars, mask, names: Optional[Sequence[str]] = None,
-                    replicate_quirks: bool = True):
+                    replicate_quirks: bool = True,
+                    rolling_impl: Optional[str] = None):
     """Compute the named factors (default: all 58) over a day tensor.
 
     Pure function of ``(bars [..., T, 240, 5], mask [..., T, 240])``;
     returns ``{name: [..., T]}``. Trace it under jit via
-    :func:`compute_factors_jit`.
+    :func:`compute_factors_jit`. ``rolling_impl`` picks the mmt_ols_*
+    backend ('conv'/'pallas'); keep it explicit under jit — a None falls
+    back to the config value *at trace time*, which the jit cache key
+    cannot see.
     """
     _load_all()
     if names is None:
         names = tuple(FACTORS)
-    ctx = DayContext(bars, mask, replicate_quirks=replicate_quirks)
+    ctx = DayContext(bars, mask, replicate_quirks=replicate_quirks,
+                     rolling_impl=rolling_impl)
     return {n: resolve(n)(ctx) for n in names}
 
 
-@functools.partial(jax.jit, static_argnames=("names", "replicate_quirks"))
+@functools.partial(jax.jit, static_argnames=("names", "replicate_quirks",
+                                             "rolling_impl"))
 def compute_factors_jit(bars, mask, names: Optional[Tuple[str, ...]] = None,
-                        replicate_quirks: bool = True):
+                        replicate_quirks: bool = True,
+                        rolling_impl: Optional[str] = None):
     """One fused XLA graph computing every requested factor."""
-    return compute_factors(bars, mask, names, replicate_quirks)
+    return compute_factors(bars, mask, names, replicate_quirks, rolling_impl)

@@ -45,7 +45,8 @@ def _windowed_sum(a, window: int):
     return out.reshape(lead + (L,))
 
 
-def rolling_window_stats(x, y, mask, window: int = 50) -> Dict[str, jnp.ndarray]:
+def rolling_window_stats(x, y, mask, window: int = 50,
+                         impl: str = None) -> Dict[str, jnp.ndarray]:
     """Per-slot trailing-window moments of (x, y) over valid bars.
 
     Returns dict of ``[..., L]`` arrays:
@@ -56,7 +57,16 @@ def rolling_window_stats(x, y, mask, window: int = 50) -> Dict[str, jnp.ndarray]
 
     Stats are only meaningful where ``valid``; other lanes are garbage and
     must be masked by the caller.
+
+    ``impl``: ``'conv'`` (XLA, default) or ``'pallas'`` (the VMEM-resident
+    fused kernel, ops/pallas_rolling.py); None reads ``Config.rolling_impl``.
     """
+    if impl is None:
+        from ..config import get_config
+        impl = get_config().rolling_impl
+    if impl == "pallas":
+        from .pallas_rolling import rolling_window_stats_pallas
+        return rolling_window_stats_pallas(x, y, mask, window)
     m = mask.astype(x.dtype)
     xm = jnp.where(mask, x, 0.0)
     ym = jnp.where(mask, y, 0.0)

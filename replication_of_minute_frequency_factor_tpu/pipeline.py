@@ -31,13 +31,15 @@ from .data.minute import grid_day
 from .models.registry import compute_factors, compute_factors_jit, factor_names
 
 
-@functools.partial(jax.jit, static_argnames=("names", "replicate_quirks"))
+@functools.partial(jax.jit, static_argnames=("names", "replicate_quirks",
+                                             "rolling_impl"))
 def _compute_from_wire(base, dclose, dohl, volume, maskbits, vol_scale,
-                       names, replicate_quirks):
+                       names, replicate_quirks, rolling_impl=None):
     """Fused on-device wire-decode + all-factor graph (one XLA module)."""
     bars, m = wire.decode(base, dclose, dohl, volume, maskbits, vol_scale)
     return compute_factors(bars, m, names=names,
-                           replicate_quirks=replicate_quirks)
+                           replicate_quirks=replicate_quirks,
+                           rolling_impl=rolling_impl)
 from .utils.logging import get_logger, FailureReport
 from .utils.tracing import Timer, trace_annotation
 
@@ -208,11 +210,13 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
             if w is not None:
                 out = _compute_from_wire(
                     *w.arrays, names=names,
-                    replicate_quirks=cfg.replicate_quirks)
+                    replicate_quirks=cfg.replicate_quirks,
+                    rolling_impl=cfg.rolling_impl)
             else:
                 out = compute_factors_jit(
                     bars, mask, names=names,
-                    replicate_quirks=cfg.replicate_quirks)
+                    replicate_quirks=cfg.replicate_quirks,
+                    rolling_impl=cfg.rolling_impl)
         return dates, codes, present, out
 
     def materialize(pending):
