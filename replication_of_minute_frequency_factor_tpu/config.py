@@ -42,6 +42,10 @@ class Config:
     #: rolling-moment backend for the mmt_ols_* family: 'conv' (XLA) or
     #: 'pallas' (fused VMEM-resident kernel, ops/pallas_rolling.py)
     rolling_impl: str = "conv"
+    #: index-pool membership parquet enabling cal_final_exposure's
+    #: stock_pool= (data/io.py read_stock_pool); None keeps the
+    #: reference's only-'full' behaviour (quirk Q9)
+    stock_pool_path: Optional[str] = None
     #: ship day batches as tick-deltas (int8/int16), lot volume
     #: (uint16/int32) and a bit-packed mask (data/wire.py, ~3.4x fewer
     #: wire bytes on typical data; auto-falls back to f32 when
@@ -57,6 +61,8 @@ class Config:
             "MFF_FACTOR_DIR": "factor_dir",
             "MFF_BACKEND": "backend",
             "MFF_DTYPE": "dtype",
+            "MFF_ROLLING_IMPL": "rolling_impl",
+            "MFF_STOCK_POOL_PATH": "stock_pool_path",
         }
         for env, field in mapping.items():
             if env in os.environ:

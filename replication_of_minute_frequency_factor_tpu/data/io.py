@@ -138,6 +138,74 @@ def coerce_dates(dates: np.ndarray) -> np.ndarray:
     return out
 
 
+def read_stock_pool(path: str, pool: str,
+                    dates: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Membership ``(codes, dates)`` pairs of an index stock pool.
+
+    The reference only *advertises* index pools (hs300/zz500/zz1000 in the
+    ``cal_final_exposure`` docstring) and raises for them (quirk Q9,
+    MinuteFrequentFactorCICC.py:137-140); this is the working
+    implementation behind ``Config.stock_pool_path``. Two schemas:
+
+    * exact rows: columns ``code, date, pool`` — one row per member-day;
+    * intervals (CSMAR constituent files): columns ``code, in_date,
+      out_date, pool`` — member while ``in_date <= d < out_date``
+      (null/NaT ``out_date`` = still a member), expanded onto the given
+      trading ``dates``.
+
+    ``pool`` selects rows by the ``pool`` column (absent column = the file
+    is a single pool). Codes normalise to zero-padded 6-char strings.
+    """
+    names = pq.read_schema(path).names
+    interval = "in_date" in names
+    cols = ["code"] + (["in_date", "out_date"] if interval else ["date"])
+    if "pool" in names:
+        cols.append("pool")
+    raw = read_columns(path, cols)
+    code = np.asarray(raw["code"])
+    if code.dtype.kind in "iu":
+        code = np.char.zfill(code.astype(str), 6)
+    code = code.astype(object)
+    keep = np.ones(len(code), bool)
+    if "pool" in raw:
+        keep = np.asarray(raw["pool"]).astype(str) == pool
+    dates = np.sort(np.asarray(dates, "datetime64[D]"))
+    if not interval:
+        d = coerce_dates(raw["date"])[keep]
+        return code[keep], d
+    in_d = coerce_dates(raw["in_date"])[keep]
+    out_d = coerce_dates(raw["out_date"])[keep]
+    code = code[keep]
+    far = np.datetime64("2200-01-01", "D")
+    out_d = np.where(np.isnat(out_d), far, out_d)
+    mcodes, mdates = [], []
+    for c, lo, hi in zip(code, in_d, out_d):
+        a = np.searchsorted(dates, lo, side="left")
+        b = np.searchsorted(dates, hi, side="left")
+        if b > a:
+            mcodes.append(np.full(b - a, c, object))
+            mdates.append(dates[a:b])
+    if not mcodes:
+        return (np.array([], object), np.array([], "datetime64[D]"))
+    return np.concatenate(mcodes), np.concatenate(mdates)
+
+
+def membership_filter(code: np.ndarray, date: np.ndarray,
+                      pool_code: np.ndarray,
+                      pool_date: np.ndarray) -> np.ndarray:
+    """Boolean mask of rows whose ``(code, date)`` is in the membership."""
+    if len(pool_code) == 0:
+        return np.zeros(len(code), bool)
+    key = np.char.add(np.asarray(code, str),
+                      np.asarray(date, "datetime64[D]").astype(str))
+    pkey = np.unique(np.char.add(np.asarray(pool_code, str),
+                                 np.asarray(pool_date,
+                                            "datetime64[D]").astype(str)))
+    idx = np.searchsorted(pkey, key)
+    idx = np.minimum(idx, len(pkey) - 1)
+    return pkey[idx] == key
+
+
 def read_daily_pv(
     path: str,
     columns: Optional[Sequence[str]] = None,

@@ -112,19 +112,34 @@ class MinFreqFactor(Factor):
         population std (ddof=0, reference :222,234); output named
         ``{name}_{t}_{method}`` (:189).
 
-        Only ``stock_pool='full'`` exists (reference raises for the index
-        pools its docstring advertises — quirk Q9, kept).
+        ``stock_pool``: the reference advertises index pools (hs300/
+        zz500/zz1000) but raises for anything except ``'full'`` (quirk
+        Q9, MinuteFrequentFactorCICC.py:137-140). Here a non-'full' pool
+        works when ``Config.stock_pool_path`` names a membership parquet
+        (exact member-days or CSMAR in/out-date intervals — see
+        ``data.io.read_stock_pool``): exposure rows outside the pool are
+        dropped before resampling. Without a configured membership file
+        the reference's error is kept.
         """
-        if stock_pool != "full":
-            raise ValueError(
-                "only stock_pool='full' is supported (reference quirk Q9: "
-                "index pools are advertised but unimplemented, "
-                "MinuteFrequentFactorCICC.py:137-140)")
         if method not in AGG_METHODS:
             raise ValueError(f"method must be one of {AGG_METHODS}")
         exp = self._require_exposure()
         code, date = exp["code"], exp["date"]
         val = np.asarray(exp[self.factor_name], np.float64)
+
+        if stock_pool != "full":
+            pool_path = get_config().stock_pool_path
+            if pool_path is None:
+                raise ValueError(
+                    "stock_pool={!r} needs Config.stock_pool_path (a "
+                    "membership parquet); without one only 'full' exists "
+                    "— the reference itself raises here (quirk Q9, "
+                    "MinuteFrequentFactorCICC.py:137-140)".format(stock_pool))
+            from .data import io as dio
+            pc, pd_ = dio.read_stock_pool(pool_path, stock_pool,
+                                          np.unique(date))
+            sel = dio.membership_filter(code, date, pc, pd_)
+            code, date, val = code[sel], date[sel], val[sel]
 
         if mode == "calendar":
             period = frames.period_start(date, frequency)

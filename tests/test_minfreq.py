@@ -167,3 +167,54 @@ def test_stock_pool_quirk_q9():
                    np.array([1.0]))
     with pytest.raises(ValueError):
         f.cal_final_exposure("week", stock_pool="hs300")
+
+
+def test_stock_pool_membership(tmp_path):
+    """With Config.stock_pool_path set, index pools actually filter —
+    both exact member-day rows and CSMAR-style in/out intervals."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from replication_of_minute_frequency_factor_tpu.config import (
+        Config, set_config, get_config)
+
+    dates = np.array(["2024-01-02", "2024-01-03", "2024-01-04"],
+                     "datetime64[D]")
+    codes = ["600000", "600001", "600002"]
+    code_col = np.repeat(codes, len(dates))
+    date_col = np.tile(dates, len(codes))
+    vals = np.arange(9, dtype=np.float32)
+
+    exact = pa.table({
+        "code": ["600000", "600000", "600001"],
+        "date": ["2024-01-02", "2024-01-03", "2024-01-03"],
+        "pool": ["hs300", "hs300", "zz500"],
+    })
+    p_exact = str(tmp_path / "pool_exact.parquet")
+    pq.write_table(exact, p_exact)
+
+    interval = pa.table({
+        "code": ["600000", "600002"],
+        "in_date": ["2024-01-03", "2023-06-01"],
+        "out_date": [None, "2024-01-04"],
+        "pool": ["hs300", "hs300"],
+    })
+    p_int = str(tmp_path / "pool_interval.parquet")
+    pq.write_table(interval, p_int)
+
+    old = get_config()
+    try:
+        for path, want in (
+            (p_exact, {("600000", "2024-01-02"), ("600000", "2024-01-03")}),
+            (p_int, {("600000", "2024-01-03"), ("600000", "2024-01-04"),
+                     ("600002", "2024-01-02"), ("600002", "2024-01-03")}),
+        ):
+            set_config(Config(stock_pool_path=path))
+            f = MinFreqFactor("x")
+            f.set_exposure(code_col, date_col, vals)
+            out = f.cal_final_exposure(1, method="o", mode="days",
+                                       stock_pool="hs300").factor_exposure
+            got = {(c, str(d)) for c, d in zip(out["code"], out["date"])}
+            assert got == want, path
+    finally:
+        set_config(old)
