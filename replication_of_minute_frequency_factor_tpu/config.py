@@ -26,9 +26,10 @@ class Config:
     # --- execution ---
     #: 'jax' (TPU/XLA fused kernels) or 'numpy' (polars-semantics CPU oracle)
     backend: str = "jax"
-    #: dtype for on-device compute ('float32' is the TPU-native choice;
-    #: 'bfloat16' trades accuracy for HBM bandwidth on the bar tensor)
-    dtype: str = "float32"
+    # NOTE deliberately no bf16 knob: bar tensors stay f32 on device. The
+    # wire format (int tick-deltas + lot volume) already beats bf16 on
+    # bytes without losing a bit, and masked second-moment kernels need
+    # the f32 mantissa (ops/rolling.py numerical note).
     #: how many trading days to batch into one device step
     days_per_batch: int = 8
     #: logical device mesh (batch_days, tickers); None = single device
@@ -60,7 +61,6 @@ class Config:
             "MFF_DAILY_PV_PATH": "daily_pv_path",
             "MFF_FACTOR_DIR": "factor_dir",
             "MFF_BACKEND": "backend",
-            "MFF_DTYPE": "dtype",
             "MFF_ROLLING_IMPL": "rolling_impl",
             "MFF_STOCK_POOL_PATH": "stock_pool_path",
         }
