@@ -176,3 +176,21 @@ def put(wire: WireBatch, shardings=None):
     if shardings is None:
         return tuple(jax.device_put(a) for a in wire.arrays)
     return tuple(jax.device_put(a, s) for a, s in zip(wire.arrays, shardings))
+
+
+def mesh_shardings(mesh):
+    """NamedShardings placing a wire batch on a ``(days, tickers)`` mesh:
+    every per-ticker array shards along the tickers axis (the wide,
+    communication-free one), the vol_scale scalar replicates. The caller
+    must pad the ticker axis to a multiple of the tickers mesh dim."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import TICKERS_AXIS
+
+    t = TICKERS_AXIS
+    return (NamedSharding(mesh, P(None, t)),              # base [D, T]
+            NamedSharding(mesh, P(None, t, None)),        # dclose
+            NamedSharding(mesh, P(None, t, None, None)),  # dohl
+            NamedSharding(mesh, P(None, t, None)),        # volume
+            NamedSharding(mesh, P(None, t, None)),        # maskbits
+            NamedSharding(mesh, P()))                     # vol_scale

@@ -141,17 +141,20 @@ def _pad_bucket(n: int, bucket: int = TICKER_BUCKET) -> int:
     return max(bucket, -(-n // bucket) * bucket)
 
 
-def _grid_batch(day_data: List[Tuple[np.datetime64, Dict[str, np.ndarray]]]):
+def _grid_batch(day_data: List[Tuple[np.datetime64, Dict[str, np.ndarray]]],
+                shard_mult: int = 1):
     """Union-code, bucket-padded dense batch for a list of day columns.
 
     Returns ``(bars [D,Tp,240,5], mask [D,Tp,240], codes [Tp],
     present [D,Tp])`` where ``present`` marks codes that had rows in that
     day's file (they get an output row even if every bar was off-grid,
-    matching the reference's per-group row).
+    matching the reference's per-group row). ``Tp`` pads to a multiple of
+    both TICKER_BUCKET and ``shard_mult`` (the mesh tickers dim).
     """
     all_codes = np.unique(np.concatenate(
         [d["code"] for _, d in day_data])).astype(object)
-    t_pad = _pad_bucket(len(all_codes))
+    bucket = TICKER_BUCKET * shard_mult // np.gcd(TICKER_BUCKET, shard_mult)
+    t_pad = _pad_bucket(len(all_codes), bucket)
     pads = np.array([f"__pad{i}__" for i in range(t_pad - len(all_codes))],
                     dtype=object)
     codes = np.sort(np.concatenate([all_codes, pads]))
@@ -171,9 +174,26 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
     fan-out, SURVEY.md §7 L2): a reader thread prepares batch i+1
     (grid + validate + wire-encode) while the device computes batch i;
     JAX's async dispatch keeps the chip busy while batch i-1's results
-    materialise on host."""
+    materialise on host.
+
+    With ``cfg.mesh_shape`` set, batches shard along the tickers axis of
+    a ``(days, tickers)`` mesh over all local devices — factor compute is
+    collective-free, so this is pure data parallelism; XLA keeps the
+    per-factor outputs sharded until the host gather."""
     import queue
     import threading
+
+    mesh = shardings = bars_sharding = None
+    n_shards = 1
+    if cfg.mesh_shape is not None:
+        from jax.sharding import NamedSharding
+        from .parallel.mesh import day_batch_spec, make_mesh, mask_spec
+        n_dev = len(jax.devices())
+        mesh = make_mesh((1, n_dev))  # tickers-wide (mesh.py rationale)
+        n_shards = n_dev
+        shardings = wire.mesh_shardings(mesh)
+        bars_sharding = (NamedSharding(mesh, day_batch_spec()),
+                         NamedSharding(mesh, mask_spec()))
 
     q: "queue.Queue" = queue.Queue(maxsize=2)
     wire_floor: dict = {}  # widen-only dtype state across this run's batches
@@ -182,7 +202,8 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
         try:
             for batch in batches:
                 with timer("grid"):
-                    bars, mask, codes, present = _grid_batch(batch)
+                    bars, mask, codes, present = _grid_batch(
+                        batch, shard_mult=n_shards)
                 if cfg.debug_validate:
                     from .utils.debug import validate_batch
                     validate_batch(bars, mask)
@@ -208,11 +229,15 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
         dates, codes, present, w, bars, mask = item
         with trace_annotation("factor_batch"):
             if w is not None:
+                arrs = wire.put(w, shardings)
                 out = _compute_from_wire(
-                    *w.arrays, names=names,
+                    *arrs, names=names,
                     replicate_quirks=cfg.replicate_quirks,
                     rolling_impl=cfg.rolling_impl)
             else:
+                if bars_sharding is not None:
+                    bars = jax.device_put(bars, bars_sharding[0])
+                    mask = jax.device_put(mask, bars_sharding[1])
                 out = compute_factors_jit(
                     bars, mask, names=names,
                     replicate_quirks=cfg.replicate_quirks,
