@@ -116,14 +116,17 @@ def grid_pack_native(tidx: np.ndarray, time: np.ndarray, open_: np.ndarray,
 
 
 def wire_encode_native(bars: np.ndarray, mask: np.ndarray,
-                       inv_tick: float = 100.0):
+                       inv_tick: float = 100.0,
+                       n_threads: Optional[int] = None):
     """One-pass native wire pack of ``bars [..., T, 240, 5] f32``.
 
-    Returns ``(base, dclose, dohl, volume, vol_scale)`` with the leading
-    batch shape preserved — ``dclose``/``dohl`` narrowed to int8 and
-    ``volume`` to uint16 board lots when the batch's stats allow — or None
-    when the batch is unrepresentable (caller falls back to shipping raw
-    f32 — data/wire.py).
+    Returns ``(base, dclose, dohl, volume, stats)`` with the leading
+    batch shape preserved, or None when the batch is unrepresentable
+    (caller falls back to shipping raw f32 — data/wire.py).
+
+    Tickers are independent, so the pass chunks across ``n_threads``
+    (default: up to 8 cores; the ctypes call releases the GIL). Chunk
+    stats merge by max/all, so the result is bit-identical to one pass.
     """
     lib = load()
     if lib is None:
@@ -131,25 +134,44 @@ def wire_encode_native(bars: np.ndarray, mask: np.ndarray,
     bars = np.ascontiguousarray(bars, np.float32)
     lead = bars.shape[:-2]  # [..., T]
     n = int(np.prod(lead)) if lead else 1
-    m8 = np.ascontiguousarray(mask, np.uint8)
-    base = np.empty(lead, np.float32)
-    dclose = np.empty(lead + (240,), np.int16)
-    dohl = np.empty(lead + (240, 3), np.int16)
-    volume = np.empty(lead + (240,), np.int32)
-    stats = np.zeros(4, np.int64)
+    m8 = np.ascontiguousarray(mask, np.uint8).reshape(n, 240)
+    bars_f = bars.reshape(n, 240, 5)
+    base = np.empty((n,), np.float32)
+    dclose = np.empty((n, 240), np.int16)
+    dohl = np.empty((n, 240, 3), np.int16)
+    volume = np.empty((n, 240), np.int32)
 
     def p(a, t):
         return a.ctypes.data_as(ctypes.POINTER(t))
 
-    rc = lib.wire_encode(p(bars, ctypes.c_float), p(m8, ctypes.c_uint8),
-                         n, float(inv_tick), p(base, ctypes.c_float),
-                         p(dclose, ctypes.c_int16),
-                         p(dohl, ctypes.c_int16),
-                         p(volume, ctypes.c_int32),
-                         p(stats, ctypes.c_int64))
-    if rc < 0:
-        return None
-    return base, dclose, dohl, volume, stats
+    def run(lo: int, hi: int, stats: np.ndarray):
+        return lib.wire_encode(
+            p(bars_f[lo:hi], ctypes.c_float), p(m8[lo:hi], ctypes.c_uint8),
+            hi - lo, float(inv_tick), p(base[lo:hi], ctypes.c_float),
+            p(dclose[lo:hi], ctypes.c_int16), p(dohl[lo:hi], ctypes.c_int16),
+            p(volume[lo:hi], ctypes.c_int32), p(stats, ctypes.c_int64))
+
+    if n_threads is None:
+        n_threads = min(os.cpu_count() or 1, 8)
+    n_threads = max(1, min(n_threads, n))
+    if n_threads == 1:
+        stats = np.zeros(4, np.int64)
+        if run(0, n, stats) < 0:
+            return None
+    else:
+        import concurrent.futures as cf
+        bounds = np.linspace(0, n, n_threads + 1).astype(int)
+        chunk_stats = [np.zeros(4, np.int64) for _ in range(n_threads)]
+        with cf.ThreadPoolExecutor(n_threads) as ex:
+            rcs = list(ex.map(run, bounds[:-1], bounds[1:], chunk_stats))
+        if any(rc < 0 for rc in rcs):
+            return None
+        s = np.stack(chunk_stats)
+        stats = np.array([s[:, 0].max(), s[:, 1].max(),
+                          int(s[:, 2].all()), s[:, 3].max()], np.int64)
+    return (base.reshape(lead), dclose.reshape(lead + (240,)),
+            dohl.reshape(lead + (240, 3)), volume.reshape(lead + (240,)),
+            stats)
 
 
 def narrow_wire(base, dclose, dohl, volume, stats, floor=None):
