@@ -12,7 +12,8 @@ Layering (mirrors reference layer map, SURVEY.md §1):
   L1 kernels      -> :mod:`.models`     (58 factors as fused jit graphs)
                      :mod:`.oracle`     (numpy/pandas polars-semantics oracle)
   L2 pipeline     -> :mod:`.pipeline`   (incremental compute driver + cache)
-  L3 evaluation   -> :mod:`.factor`, :mod:`.evaluation`
+  L3 evaluation   -> :mod:`.factor` (+ :mod:`.eval_ops`, :mod:`.frames`,
+                     :mod:`.plotting`)
   L4 scale-out    -> :mod:`.parallel`   (mesh/sharding/collectives)
 """
 

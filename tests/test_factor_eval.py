@@ -169,3 +169,23 @@ def test_coverage_and_parquet_roundtrip(tmp_path, pv_setup):
                                   f.factor_exposure["code"])
     np.testing.assert_allclose(g.factor_exposure["toy"],
                                f.factor_exposure["toy"])
+
+
+def test_three_chart_types_render_headless(tmp_path, pv_setup, rng):
+    """The reference's three chart types (coverage bar, IC bar+cumsum,
+    group cumulative returns — SURVEY.md C14) render to PNG with no
+    display."""
+    pv, days, codes, path = pv_setup
+    fwd = frames.forward_returns(pv["code"], pv["date"], pv["pct_change"], 5)
+    value = fwd + rng.normal(0, 0.05, len(fwd))
+    f = Factor("toy").set_exposure(pv["code"], pv["date"], value)
+    p_cov = str(tmp_path / "cov.png")
+    p_ic = str(tmp_path / "ic.png")
+    p_grp = str(tmp_path / "grp.png")
+    f.coverage(plot=True, save_path=p_cov)
+    f.ic_test(future_days=5, plot=True, save_path=p_ic, daily_pv_path=path)
+    f.group_test(frequency="week", plot=True, save_path=p_grp,
+                 daily_pv_path=path)
+    import os
+    for p in (p_cov, p_ic, p_grp):
+        assert os.path.getsize(p) > 5_000, p
