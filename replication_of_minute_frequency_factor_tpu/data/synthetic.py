@@ -8,7 +8,7 @@ chip-factor tie handling).
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict
 
 import numpy as np
 

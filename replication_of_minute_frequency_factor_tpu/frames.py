@@ -9,7 +9,7 @@ presence mask are the hand-off format to :mod:`.eval_ops`.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 

@@ -18,7 +18,6 @@ from ..ops import (
     masked_last,
     masked_mean,
     masked_product,
-    masked_std,
     bottomk_threshold,
     topk_threshold,
 )

@@ -4,7 +4,6 @@ import numpy as np
 import pytest
 
 from replication_of_minute_frequency_factor_tpu import search
-from replication_of_minute_frequency_factor_tpu.ops import masked_mean
 
 
 @pytest.fixture
