@@ -14,6 +14,9 @@ one polars pass per factor per day-file on all CPU cores.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import jax
@@ -30,6 +33,32 @@ DAYS_PER_BATCH = 8
 TRADING_DAYS_PER_YEAR = 244
 WARMUP = 1
 ITERS = 5
+
+_SUFFIX = os.environ.get("BENCH_METRIC_SUFFIX", "")
+
+
+def _ensure_device_reachable():
+    """The attached-TPU tunnel occasionally wedges, and a wedged tunnel
+    hangs the interpreter at backend init — before any code can time out.
+    Probe it from a killable child; after a few failed probes, fall back
+    to the host CPU with the metric renamed so the number can't be read
+    as a TPU result."""
+    if "PALLAS_AXON_POOL_IPS" not in os.environ:
+        return  # not tunnel-attached; let jax pick its platform
+    probe = "import jax; jax.devices()"
+    for _ in range(3):
+        try:
+            if subprocess.run([sys.executable, "-c", probe],
+                              timeout=120, capture_output=True).returncode == 0:
+                return
+        except subprocess.TimeoutExpired:
+            pass
+        time.sleep(60)
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_METRIC_SUFFIX"] = "_cpu_fallback_tunnel_down"
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
 def make_batch(rng, n_days=DAYS_PER_BATCH, n_tickers=N_TICKERS):
@@ -48,6 +77,7 @@ def make_batch(rng, n_days=DAYS_PER_BATCH, n_tickers=N_TICKERS):
 
 
 def main():
+    _ensure_device_reachable()  # may exec into a CPU-fallback run
     rng = np.random.default_rng(0)
     names = factor_names()
     batches = [make_batch(rng) for _ in range(2)]
@@ -85,7 +115,7 @@ def main():
     full_year = per_batch * (TRADING_DAYS_PER_YEAR / DAYS_PER_BATCH)
     target = 60.0
     print(json.dumps({
-        "metric": "cicc58_5000tickers_1yr_wall",
+        "metric": "cicc58_5000tickers_1yr_wall" + _SUFFIX,
         "value": round(full_year, 3),
         "unit": "s",
         "vs_baseline": round(target / full_year, 3),

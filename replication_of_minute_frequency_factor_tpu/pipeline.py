@@ -381,4 +381,6 @@ def compute_exposures(
     result.timings = timer.totals()
     if cache_path is not None and len(result):
         result.save(cache_path)
+    if cache_path is not None and failures:
+        failures.save(cache_path + ".failures.json")
     return result

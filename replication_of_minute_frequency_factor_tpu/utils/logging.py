@@ -65,3 +65,12 @@ class FailureReport:
         lines = [f"{len(self.failures)} failed:"]
         lines += [f"  {f.key} ({f.source}): {f.error}" for f in self.failures]
         return "\n".join(lines)
+
+    def save(self, path: str) -> None:
+        """Write the ledger as JSON (one record per failed day) so a
+        skipped day is inspectable after the run, not just a log line."""
+        import json
+        with open(path, "w") as fh:
+            json.dump([{"key": f.key, "source": f.source, "error": f.error,
+                        "trace": f.trace} for f in self.failures], fh,
+                      indent=1)

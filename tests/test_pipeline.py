@@ -110,13 +110,19 @@ def test_failed_day_is_skipped_and_reported(minute_dir, tmp_path):
         if date == bad:
             raise RuntimeError("injected fault")
 
+    cache = str(tmp_path / "f.parquet")
     t = compute_exposures(minute_dir, NAMES, cfg=_cfg(), progress=False,
-                          fault_hook=hook)
+                          fault_hook=hook, cache_path=cache)
     assert len(t.failures) == 1
     assert t.failures.keys() == [str(bad)]
     assert "injected fault" in t.failures.summary()
     assert bad not in t.columns["date"]
     assert len(np.unique(t.columns["date"])) == 2
+    # the ledger persists next to the cache for post-run inspection
+    import json
+    with open(cache + ".failures.json") as fh:
+        rec = json.load(fh)
+    assert rec[0]["key"] == str(bad) and "injected fault" in rec[0]["error"]
 
 
 def test_atomic_write_leaves_no_temp_on_failure(tmp_path):
