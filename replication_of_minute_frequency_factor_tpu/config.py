@@ -47,6 +47,9 @@ class Config:
     #: stock_pool= (data/io.py read_stock_pool); None keeps the
     #: reference's only-'full' behaviour (quirk Q9)
     stock_pool_path: Optional[str] = None
+    #: capture a jax.profiler trace of each compute_exposures run into
+    #: this directory (open with tensorboard / xprof); None = off
+    profile_dir: Optional[str] = None
     #: ship day batches as tick-deltas (int8/int16), lot volume
     #: (uint16/int32) and a bit-packed mask (data/wire.py, ~3.4x fewer
     #: wire bytes on typical data; auto-falls back to f32 when
@@ -63,6 +66,7 @@ class Config:
             "MFF_BACKEND": "backend",
             "MFF_ROLLING_IMPL": "rolling_impl",
             "MFF_STOCK_POOL_PATH": "stock_pool_path",
+            "MFF_PROFILE_DIR": "profile_dir",
         }
         for env, field in mapping.items():
             if env in os.environ:

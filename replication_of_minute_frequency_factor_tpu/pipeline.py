@@ -309,6 +309,10 @@ def compute_exposures(
     failures = FailureReport()
     timer = Timer()
     parts: List[ExposureTable] = []
+    profiling = False
+    if cfg.profile_dir and files:
+        jax.profiler.start_trace(cfg.profile_dir)
+        profiling = True
     iterator: Sequence = files
     if progress and files:
         try:
@@ -360,7 +364,14 @@ def compute_exposures(
                     cols[n] = wide[n].to_numpy(np.float32)
                 parts.append(ExposureTable(cols))
     else:
-        _run_device_pipeline(read_batches(), names, cfg, timer, parts)
+        try:
+            _run_device_pipeline(read_batches(), names, cfg, timer, parts)
+        finally:
+            if profiling:
+                jax.profiler.stop_trace()
+                profiling = False
+    if profiling:  # numpy-backend run never hit the device pipeline
+        jax.profiler.stop_trace()
 
     if parts:
         new = ExposureTable.concat(parts).sort()

@@ -160,3 +160,14 @@ def test_single_factor_view_matches_reference_shape(minute_dir):
     one = t.single("mmt_am")
     assert set(one) == {"code", "date", "mmt_am"}
     assert len(one["mmt_am"]) == len(t)
+
+
+def test_profile_trace_capture(minute_dir, tmp_path):
+    """cfg.profile_dir writes an inspectable jax.profiler trace."""
+    import os
+    pdir = str(tmp_path / "trace")
+    compute_exposures(minute_dir, ("vol_return1min",),
+                      cfg=Config(days_per_batch=2, profile_dir=pdir),
+                      progress=False)
+    found = [os.path.join(r, f) for r, _, fs in os.walk(pdir) for f in fs]
+    assert found, "no trace files captured"
