@@ -58,7 +58,10 @@ def _ensure_device_reachable():
            if k != "PALLAS_AXON_POOL_IPS"}
     env["JAX_PLATFORMS"] = "cpu"
     env["BENCH_METRIC_SUFFIX"] = "_cpu_fallback_tunnel_down"
-    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    # re-exec THIS script only (sys.argv could be a caller like
+    # benchmarks/ladder.py, which would re-emit its earlier configs)
+    os.execve(sys.executable,
+              [sys.executable, os.path.abspath(__file__)], env)
 
 
 def make_batch(rng, n_days=DAYS_PER_BATCH, n_tickers=N_TICKERS):

@@ -168,7 +168,12 @@ def read_stock_pool(path: str, pool: str,
     code = code.astype(object)
     keep = np.ones(len(code), bool)
     if "pool" in raw:
-        keep = np.asarray(raw["pool"]).astype(str) == pool
+        pools = np.asarray(raw["pool"]).astype(str)
+        keep = pools == pool
+        if not keep.any():
+            raise ValueError(
+                f"stock pool {pool!r} matches no rows in {path}; "
+                f"available pools: {sorted(set(pools))}")
     dates = np.sort(np.asarray(dates, "datetime64[D]"))
     if not interval:
         d = coerce_dates(raw["date"])[keep]

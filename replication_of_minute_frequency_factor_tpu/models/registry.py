@@ -87,8 +87,20 @@ def compute_factors(bars, mask, names: Optional[Sequence[str]] = None,
 
 @functools.partial(jax.jit, static_argnames=("names", "replicate_quirks",
                                              "rolling_impl"))
+def _compute_factors_jit(bars, mask, names, replicate_quirks, rolling_impl):
+    return compute_factors(bars, mask, names, replicate_quirks, rolling_impl)
+
+
 def compute_factors_jit(bars, mask, names: Optional[Tuple[str, ...]] = None,
                         replicate_quirks: bool = True,
                         rolling_impl: Optional[str] = None):
-    """One fused XLA graph computing every requested factor."""
-    return compute_factors(bars, mask, names, replicate_quirks, rolling_impl)
+    """One fused XLA graph computing every requested factor.
+
+    ``rolling_impl=None`` resolves ``Config.rolling_impl`` here, *outside*
+    the jit boundary, so the resolved value is the cache key and flipping
+    the config can never serve a stale compiled graph."""
+    if rolling_impl is None:
+        from ..config import get_config
+        rolling_impl = get_config().rolling_impl
+    return _compute_factors_jit(bars, mask, names, replicate_quirks,
+                                rolling_impl)

@@ -155,24 +155,38 @@ xs_rank = _xs_wrap(_rank_body)
 # sharded factor computation
 # --------------------------------------------------------------------------
 
+@functools.lru_cache(maxsize=32)
+def _sharded_fn(mesh: Mesh, batched: bool, names, replicate_quirks: bool,
+                rolling_impl: str):
+    out_spec = P(*day_batch_spec(batched)[:2]) if batched else P(TICKERS_AXIS)
+    return jax.jit(
+        functools.partial(
+            compute_factors, names=names, replicate_quirks=replicate_quirks,
+            rolling_impl=rolling_impl),
+        in_shardings=(NamedSharding(mesh, day_batch_spec(batched)),
+                      NamedSharding(mesh, mask_spec(batched))),
+        out_shardings=NamedSharding(mesh, out_spec),
+    )
+
+
 def sharded_compute_factors(
     bars, mask, mesh: Mesh,
     names: Optional[Tuple[str, ...]] = None,
     replicate_quirks: bool = True,
+    rolling_impl: Optional[str] = None,
 ):
     """All 58 kernels over a mesh-sharded day batch.
 
     Inputs follow :func:`..parallel.mesh.shard_day_batch` placement; outputs
     are ``{name: [D, T]}`` sharded ``P('days', 'tickers')``. The graph
     contains no collectives — XLA compiles one fully data-parallel module.
+    The jitted wrapper caches per (mesh, shape-kind, names, quirks,
+    rolling_impl), and a None ``rolling_impl`` resolves the config value
+    here so the backend choice is always part of that key.
     """
-    batched = bars.ndim == 4
-    out_spec = P(*day_batch_spec(batched)[:2]) if batched else P(TICKERS_AXIS)
-    fn = jax.jit(
-        functools.partial(
-            compute_factors, names=names, replicate_quirks=replicate_quirks),
-        in_shardings=(NamedSharding(mesh, day_batch_spec(batched)),
-                      NamedSharding(mesh, mask_spec(batched))),
-        out_shardings=NamedSharding(mesh, out_spec),
-    )
+    if rolling_impl is None:
+        from ..config import get_config
+        rolling_impl = get_config().rolling_impl
+    fn = _sharded_fn(mesh, bars.ndim == 4, names, replicate_quirks,
+                     rolling_impl)
     return fn(bars, mask)

@@ -33,13 +33,25 @@ from .models.registry import compute_factors, compute_factors_jit, factor_names
 
 @functools.partial(jax.jit, static_argnames=("names", "replicate_quirks",
                                              "rolling_impl"))
-def _compute_from_wire(base, dclose, dohl, volume, maskbits, vol_scale,
-                       names, replicate_quirks, rolling_impl=None):
-    """Fused on-device wire-decode + all-factor graph (one XLA module)."""
+def _compute_from_wire_jit(base, dclose, dohl, volume, maskbits, vol_scale,
+                           names, replicate_quirks, rolling_impl):
     bars, m = wire.decode(base, dclose, dohl, volume, maskbits, vol_scale)
     return compute_factors(bars, m, names=names,
                            replicate_quirks=replicate_quirks,
                            rolling_impl=rolling_impl)
+
+
+def _compute_from_wire(base, dclose, dohl, volume, maskbits, vol_scale,
+                       names, replicate_quirks, rolling_impl=None):
+    """Fused on-device wire-decode + all-factor graph (one XLA module).
+
+    A None ``rolling_impl`` resolves the config value before the jit
+    boundary so the choice is always part of the cache key."""
+    if rolling_impl is None:
+        rolling_impl = get_config().rolling_impl
+    return _compute_from_wire_jit(base, dclose, dohl, volume, maskbits,
+                                  vol_scale, names, replicate_quirks,
+                                  rolling_impl)
 from .utils.logging import get_logger, FailureReport
 from .utils.tracing import Timer, trace_annotation
 
@@ -188,9 +200,15 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
     if cfg.mesh_shape is not None:
         from jax.sharding import NamedSharding
         from .parallel.mesh import day_batch_spec, make_mesh, mask_spec
-        n_dev = len(jax.devices())
-        mesh = make_mesh((1, n_dev))  # tickers-wide (mesh.py rationale)
-        n_shards = n_dev
+        if cfg.mesh_shape[0] != 1:
+            raise ValueError(
+                f"mesh_shape {cfg.mesh_shape}: the streaming pipeline "
+                "shards the tickers axis only (batch day counts vary, the "
+                "last batch would not divide a days axis) — use "
+                "mesh_shape=(1, n); the days axis is for "
+                "parallel.sharded_compute_factors on fixed batches")
+        n_shards = cfg.mesh_shape[1]
+        mesh = make_mesh(cfg.mesh_shape, jax.devices()[:n_shards])
         shardings = wire.mesh_shardings(mesh)
         bars_sharding = (NamedSharding(mesh, day_batch_spec()),
                          NamedSharding(mesh, mask_spec()))
@@ -346,32 +364,30 @@ def compute_exposures(
         if batch:
             yield batch
 
-    if cfg.backend == "numpy":
-        # CPU oracle path: reference (polars) semantics in f64
-        # (SURVEY.md §7 backend dispatch; container has no polars)
-        import pandas as pd
-        from .oracle import compute_oracle
-        for batch in read_batches():
-            for date, d in batch:
-                df = pd.DataFrame(
-                    {k: d[k] for k in ("code", "time", "open", "high",
-                                       "low", "close", "volume")})
-                df["date"] = date
-                wide = compute_oracle(df, names)
-                cols = {"code": wide["code"].to_numpy(dtype=object),
-                        "date": np.full(len(wide), date, "datetime64[D]")}
-                for n in names:
-                    cols[n] = wide[n].to_numpy(np.float32)
-                parts.append(ExposureTable(cols))
-    else:
-        try:
+    try:
+        if cfg.backend == "numpy":
+            # CPU oracle path: reference (polars) semantics in f64
+            # (SURVEY.md §7 backend dispatch; container has no polars)
+            import pandas as pd
+            from .oracle import compute_oracle
+            for batch in read_batches():
+                for date, d in batch:
+                    df = pd.DataFrame(
+                        {k: d[k] for k in ("code", "time", "open", "high",
+                                           "low", "close", "volume")})
+                    df["date"] = date
+                    wide = compute_oracle(df, names)
+                    cols = {"code": wide["code"].to_numpy(dtype=object),
+                            "date": np.full(len(wide), date,
+                                            "datetime64[D]")}
+                    for n in names:
+                        cols[n] = wide[n].to_numpy(np.float32)
+                    parts.append(ExposureTable(cols))
+        else:
             _run_device_pipeline(read_batches(), names, cfg, timer, parts)
-        finally:
-            if profiling:
-                jax.profiler.stop_trace()
-                profiling = False
-    if profiling:  # numpy-backend run never hit the device pipeline
-        jax.profiler.stop_trace()
+    finally:
+        if profiling:
+            jax.profiler.stop_trace()
 
     if parts:
         new = ExposureTable.concat(parts).sort()
@@ -392,6 +408,12 @@ def compute_exposures(
     result.timings = timer.totals()
     if cache_path is not None and len(result):
         result.save(cache_path)
-    if cache_path is not None and failures:
-        failures.save(cache_path + ".failures.json")
+    if cache_path is not None:
+        if failures:
+            failures.save(cache_path + ".failures.json")
+        else:  # don't leave a stale ledger from an earlier run
+            import os
+            ledger = cache_path + ".failures.json"
+            if os.path.exists(ledger):
+                os.remove(ledger)
     return result

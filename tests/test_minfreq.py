@@ -216,5 +216,12 @@ def test_stock_pool_membership(tmp_path):
                                        stock_pool="hs300").factor_exposure
             got = {(c, str(d)) for c, d in zip(out["code"], out["date"])}
             assert got == want, path
+        # a typo'd pool name raises instead of silently emptying the factor
+        set_config(Config(stock_pool_path=p_exact))
+        f = MinFreqFactor("x")
+        f.set_exposure(code_col, date_col, vals)
+        with pytest.raises(ValueError, match="available pools"):
+            f.cal_final_exposure(1, method="o", mode="days",
+                                 stock_pool="hs3000")
     finally:
         set_config(old)

@@ -123,6 +123,18 @@ def test_failed_day_is_skipped_and_reported(minute_dir, tmp_path):
     with open(cache + ".failures.json") as fh:
         rec = json.load(fh)
     assert rec[0]["key"] == str(bad) and "injected fault" in rec[0]["error"]
+    # a clean rerun clears the stale ledger
+    compute_exposures(minute_dir, NAMES, cfg=_cfg(), progress=False,
+                      cache_path=cache)
+    assert not os.path.exists(cache + ".failures.json")
+
+
+def test_mesh_shape_days_axis_rejected(minute_dir):
+    with pytest.raises(ValueError, match="tickers axis only"):
+        compute_exposures(
+            minute_dir, NAMES, cfg=Config(days_per_batch=2,
+                                          mesh_shape=(2, 2)),
+            progress=False)
 
 
 def test_atomic_write_leaves_no_temp_on_failure(tmp_path):
