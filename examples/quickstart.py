@@ -1,0 +1,93 @@
+"""End-to-end quickstart: synthetic data -> all-factor compute -> cache ->
+evaluation charts — the workflow the reference drove from its notebook
+(SURVEY.md §1 L4), runnable anywhere (CPU or TPU):
+
+    python examples/quickstart.py [workdir]
+
+Writes day files + a daily-PV file under ``workdir`` (default: a temp
+dir), computes every factor incrementally with the multi-factor cache,
+then evaluates one factor (coverage/IC/decile backtest) and saves the
+three chart PNGs.
+"""
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))  # repo checkout without pip install
+
+from replication_of_minute_frequency_factor_tpu import (  # noqa: E402
+    Config, MinFreqFactor, compute_exposures, set_config)
+from replication_of_minute_frequency_factor_tpu.data.synthetic import synth_day  # noqa: E402
+
+N_CODES = 100
+DATES = [np.datetime64("2024-01-01") + np.timedelta64(i, "D")
+         for i in range(10)]
+
+
+def make_data(root: str, rng) -> None:
+    mdir = os.path.join(root, "kline")
+    os.makedirs(mdir, exist_ok=True)
+    codes = None
+    pv_rows = {k: [] for k in ("Trddt", "Stkcd", "ChangeRatio", "Dsmvosd",
+                               "Dsmvtll")}
+    for d in DATES:
+        cols = synth_day(rng, n_codes=N_CODES, missing_prob=0.02,
+                         zero_volume_prob=0.01)
+        codes = sorted(set(cols["code"]))
+        name = str(d).replace("-", "") + ".parquet"
+        pq.write_table(
+            pa.table({k: cols[k] for k in ("code", "time", "open", "high",
+                                           "low", "close", "volume")}),
+            os.path.join(mdir, name))
+        for c in codes:
+            pv_rows["Trddt"].append(str(d))          # ISO date strings
+            pv_rows["Stkcd"].append(c)               # CSMAR names: renamed
+            pv_rows["ChangeRatio"].append(float(rng.normal(0, 0.02)))
+            pv_rows["Dsmvosd"].append(float(1e9 * (1 + rng.random())))
+            pv_rows["Dsmvtll"].append(float(2e9 * (1 + rng.random())))
+    pq.write_table(pa.table(pv_rows), os.path.join(root, "pv.parquet"))
+
+
+def main() -> None:
+    root = sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp()
+    rng = np.random.default_rng(0)
+    make_data(root, rng)
+    set_config(Config(
+        minute_dir=os.path.join(root, "kline"),
+        daily_pv_path=os.path.join(root, "pv.parquet"),
+        factor_dir=os.path.join(root, "factors"),
+        days_per_batch=4,
+    ))
+    os.makedirs(os.path.join(root, "factors"), exist_ok=True)
+
+    # 1) every factor in one fused pass per batch, cached + resumable
+    table = compute_exposures(
+        cache_path=os.path.join(root, "factors", "all.parquet"))
+    print(f"computed {len(table.factor_names)} factors, {len(table)} rows "
+          f"({table.timings})")
+
+    # 2) the reference-shaped single-factor workflow
+    f = MinFreqFactor("vol_return1min")
+    f.cal_exposure_by_min_data()       # resumes from cache instantly
+    f.coverage(save_path=os.path.join(root, "coverage.png"))
+    f.ic_test(future_days=2, save_path=os.path.join(root, "ic.png"))
+    f.group_test(frequency="week", group_num=5,
+                 save_path=os.path.join(root, "groups.png"))
+    print(f"IC={f.IC:.4f} ICIR={f.ICIR:.4f} "
+          f"rank_IC={f.rank_IC:.4f} rank_ICIR={f.rank_ICIR:.4f}")
+
+    # 3) calendar/rolling resampling of the daily exposure
+    weekly = f.cal_final_exposure("week", method="z")
+    print(f"weekly z-scored factor: {weekly.factor_name}, "
+          f"{len(weekly.factor_exposure['code'])} rows")
+    print(f"outputs in {root}")
+
+
+if __name__ == "__main__":
+    main()
