@@ -129,6 +129,31 @@ def test_failed_day_is_skipped_and_reported(minute_dir, tmp_path):
     assert not os.path.exists(cache + ".failures.json")
 
 
+def test_wire_unrepresentable_day_falls_back_to_raw(tmp_path, rng):
+    """Off-tick prices make wire.encode return None; the pipeline must
+    ship raw f32 and produce the same numbers it would with wire off."""
+    d = tmp_path / "kline_offtick"
+    d.mkdir()
+    cols = synth_day(rng, n_codes=6, date="2024-01-02")
+    for k in ("open", "high", "low", "close"):
+        cols[k] = cols[k] + 0.0005  # off the 0.01 CNY tick grid
+    arrays = {"code": pa.array([str(c) for c in cols["code"]]),
+              "time": pa.array(cols["time"])}
+    for k in ("open", "high", "low", "close", "volume"):
+        arrays[k] = pa.array(cols[k])
+    pq.write_table(pa.table(arrays), str(d / "20240102.parquet"))
+
+    on = compute_exposures(str(d), NAMES, cfg=_cfg(), progress=False)
+    off = compute_exposures(
+        str(d), NAMES, cfg=Config(days_per_batch=2, wire_transfer=False),
+        progress=False)
+    assert len(on) == 6 and not on.failures
+    assert "wire_encode" in on.timings  # encode attempted, fell back
+    for n in NAMES:
+        np.testing.assert_allclose(on.columns[n], off.columns[n],
+                                   rtol=1e-6, equal_nan=True)
+
+
 def test_mesh_shape_days_axis_rejected(minute_dir):
     with pytest.raises(ValueError, match="tickers axis only"):
         compute_exposures(
