@@ -97,3 +97,34 @@ def test_shard_day_batch_pads_and_masks(mesh):
     assert n == 10
     assert bars_s.shape[1] % 4 == 0
     assert not np.asarray(mask_s)[:, n:].any()
+
+
+def test_multihost_helpers_single_process(mesh):
+    """shard_from_host_local on one process: this host owns the whole
+    tickers axis, so the resulting global arrays must equal plain
+    shard_day_batch placement, and factors computed from them match."""
+    from replication_of_minute_frequency_factor_tpu.parallel import multihost
+
+    rng = np.random.default_rng(3)
+    cols = synth_day(rng, n_codes=16)
+    g = grid_day(cols["code"], cols["time"], cols["open"], cols["high"],
+                 cols["low"], cols["close"], cols["volume"])
+    bars = np.stack([g.bars, g.bars])
+    mask = np.stack([g.mask, g.mask])
+
+    multihost.initialize()  # no coordinator: must be a harmless no-op
+    gmesh = multihost.global_mesh((2, 4))
+    t_pad = -(-bars.shape[1] // 4) * 4
+    pad = [(0, 0), (0, t_pad - bars.shape[1])]
+    bars_p = np.pad(bars, pad + [(0, 0), (0, 0)])
+    mask_p = np.pad(mask, pad + [(0, 0)])
+    gb, gm = multihost.shard_from_host_local(bars_p, mask_p, gmesh)
+    assert gb.shape == bars_p.shape and gm.shape == mask_p.shape
+
+    names = ("vol_return1min", "mmt_am")
+    out = sharded_compute_factors(gb, gm, gmesh, names=names)
+    ref = compute_factors_jit(bars, mask, names=names)
+    for n in names:
+        np.testing.assert_allclose(
+            np.asarray(out[n])[:, :bars.shape[1]], np.asarray(ref[n]),
+            rtol=1e-6, equal_nan=True)
