@@ -2,5 +2,17 @@
 # Hermetic CPU-only test run: unsetting PALLAS_AXON_POOL_IPS stops the
 # container's sitecustomize from dialing the TPU tunnel at interpreter
 # start (a wedged tunnel otherwise hangs every python process).
-exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-    python -m pytest tests/ -q "$@"
+#
+# With no arguments, also exercises the driver entry points
+# (__graft_entry__.py) on an 8-device virtual CPU mesh after the suite.
+set -e
+run() {
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu "$@"
+}
+if [ "$#" -gt 0 ]; then
+    exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python -m pytest -q "$@"
+fi
+run python -m pytest tests/ -q
+run env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python __graft_entry__.py
