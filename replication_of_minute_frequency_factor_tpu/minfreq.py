@@ -51,6 +51,7 @@ class MinFreqFactor(Factor):
         self,
         calculate_method: Union[str, Callable, None] = None,
         path: Optional[str] = None,
+        n_jobs: Optional[int] = None,
         minute_dir: Optional[str] = None,
         cfg: Optional[Config] = None,
         progress: bool = True,
@@ -64,7 +65,12 @@ class MinFreqFactor(Factor):
         (MinuteFrequentFactorCICC.py:50); names are the jit-friendly
         equivalent. The exposure cache at ``path`` follows the reference's
         contract: only day files newer than the cached max date recompute.
+
+        ``n_jobs`` (the reference's joblib process count, :54) is accepted
+        for drop-in compatibility and ignored: there is no process pool —
+        days batch through one fused device graph.
         """
+        del n_jobs
         cfg = cfg or get_config()
         name = self.factor_name
         if calculate_method is not None:
