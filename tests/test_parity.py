@@ -55,8 +55,13 @@ RTOL_OVERRIDE = {
 
 #: denominator moment below which skew/kurt ratios are pure noise — the
 #: ratio flips by percents between f64 and f32 copies of the *same* input
-#: (docs/DESIGN.md precision policy), so comparing it asserts nothing
-DEGENERATE_KURT = 1e-3
+#: (docs/DESIGN.md precision policy), so comparing it asserts nothing.
+#: Scale: excess kurtosis of ~210 near-normal samples has sampling std
+#: ~sqrt(24/n) = 0.34, so |kurt| < 0.05 is deep inside noise, and a
+#: ~1e-4 absolute moment wobble (f32 input rounding) moves the ratio by
+#: whole percents there. Both moments are still compared individually at
+#: sharp tolerances — only the ratio is skipped.
+DEGENERATE_KURT = 0.05
 #: rank-unit allowance for doc_pdf* under noisy scenarios: a cumulative
 #: share within float rounding of the quantile edge crosses one unique-
 #: return group earlier/later; systematic errors are hundreds of units
