@@ -46,13 +46,13 @@ def load() -> Optional[ctypes.CDLL]:
     except OSError:
         return None
     lib.grid_pack_abi_version.restype = ctypes.c_int64
-    if lib.grid_pack_abi_version() != 5:
+    if lib.grid_pack_abi_version() != 6:
         # stale build from an older source tree: rebuild once
         if not _build():
             return None
         lib = ctypes.CDLL(_LIB_PATH)
         lib.grid_pack_abi_version.restype = ctypes.c_int64
-        if lib.grid_pack_abi_version() != 5:
+        if lib.grid_pack_abi_version() != 6:
             return None
     lib.grid_pack.restype = ctypes.c_int64
     lib.grid_pack.argtypes = [
@@ -78,7 +78,7 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_int16),   # dclose out
         ctypes.POINTER(ctypes.c_int16),   # dohl out
         ctypes.POINTER(ctypes.c_int32),   # volume out
-        ctypes.POINTER(ctypes.c_int64),   # stats out [4]
+        ctypes.POINTER(ctypes.c_int64),   # stats out [5]
     ]
     _lib = lib
     return _lib
@@ -155,28 +155,42 @@ def wire_encode_native(bars: np.ndarray, mask: np.ndarray,
         n_threads = min(os.cpu_count() or 1, 8)
     n_threads = max(1, min(n_threads, n))
     if n_threads == 1:
-        stats = np.zeros(4, np.int64)
+        stats = np.zeros(5, np.int64)
         if run(0, n, stats) < 0:
             return None
     else:
         import concurrent.futures as cf
         bounds = np.linspace(0, n, n_threads + 1).astype(int)
-        chunk_stats = [np.zeros(4, np.int64) for _ in range(n_threads)]
+        chunk_stats = [np.zeros(5, np.int64) for _ in range(n_threads)]
         with cf.ThreadPoolExecutor(n_threads) as ex:
             rcs = list(ex.map(run, bounds[:-1], bounds[1:], chunk_stats))
         if any(rc < 0 for rc in rcs):
             return None
         s = np.stack(chunk_stats)
         stats = np.array([s[:, 0].max(), s[:, 1].max(),
-                          int(s[:, 2].all()), s[:, 3].max()], np.int64)
+                          int(s[:, 2].all()), s[:, 3].max(),
+                          int(s[:, 4].all())], np.int64)
     return (base.reshape(lead), dclose.reshape(lead + (240,)),
             dohl.reshape(lead + (240, 3)), volume.reshape(lead + (240,)),
             stats)
 
 
+def pack_wick(dohl: np.ndarray) -> np.ndarray:
+    """int16 ``[..., 240, 3]`` open/high/low deltas -> uint8 ``[..., 240, 2]``
+    wick packing: byte0 = int8 open-close delta (two's complement), byte1 =
+    (high-wick << 4) | low-wick, the wicks measured from the bar body.
+    Caller guarantees representability (stats wick flag)."""
+    dop = dohl[..., 0]
+    h_off = (dohl[..., 1] - np.maximum(dop, 0)).astype(np.uint8)
+    l_off = (np.minimum(dop, 0) - dohl[..., 2]).astype(np.uint8)
+    return np.stack([dop.astype(np.int8).view(np.uint8),
+                     (h_off << 4) | l_off], axis=-1)
+
+
 def narrow_wire(base, dclose, dohl, volume, stats, floor=None):
     """Shared narrowing policy for both encode paths (native + numpy):
-    int8 deltas and uint16 lot-volume whenever the batch stats fit.
+    wick-packed/int8 deltas and uint16 lot-volume whenever the batch
+    stats fit.
 
     ``floor`` (a mutable dict, threaded through a pipeline run) makes the
     choice widen-only across batches: once one batch needs a wide dtype,
@@ -185,11 +199,15 @@ def narrow_wire(base, dclose, dohl, volume, stats, floor=None):
     data-dependent flip-flopping that would recompile the fused factor
     graph."""
     floor = floor if floor is not None else {}
-    dmax_ohl, dmax_c, v_lots, vmax = (int(s) for s in stats)
-    if dmax_ohl <= 127 and not floor.get("dohl_wide"):
+    dmax_ohl, dmax_c, v_lots, vmax, wick_ok = (int(s) for s in stats)
+    ohl_fit = floor.get("ohl_fit", "wick")
+    if wick_ok and ohl_fit == "wick":
+        dohl = pack_wick(dohl)
+    elif dmax_ohl <= 127 and ohl_fit in ("wick", "i8"):
         dohl = dohl.astype(np.int8)
+        floor["ohl_fit"] = "i8"
     else:
-        floor["dohl_wide"] = True
+        floor["ohl_fit"] = "i16"
     if dmax_c <= 127 and not floor.get("dclose_wide"):
         dclose = dclose.astype(np.int8)
     else:

@@ -79,9 +79,11 @@ int64_t grid_pack(const int64_t* tidx, const int64_t* time,
 //   bars [n*240*5] f32, mask [n*240] u8  ->
 //   base [n] f32, dclose [n*240] i16, dohl [n*240*3] i16,
 //   volume [n*240] i32 (caller-zeroing not required; every lane is written)
-//   stats[4]: max |open/high/low delta|, max |close delta|, all-volumes-
-//   divisible-by-100 flag, max volume — callers use these to narrow dohl /
-//   dclose to int8 and volume to uint16 lots when they fit.
+//   stats[5]: max |open/high/low delta|, max |close delta|, all-volumes-
+//   divisible-by-100 flag, max volume, wick-packable flag (every valid
+//   lane has |open-close| <= 127 ticks and high/low within 15 ticks of
+//   the bar body) — callers use these to narrow dohl to 2-byte
+//   wick-packed or int8, dclose to int8, volume to uint16 lots.
 // Returns -1 if the batch is unrepresentable (off-tick price, delta
 // overflow, fractional/negative/overflowing volume) — outputs are garbage
 // and the caller ships raw f32 instead; 0 on success.
@@ -92,6 +94,7 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
   int32_t dmax_ohl_all = 0, dmax_c_all = 0;
   int64_t vmax_all = 0;
   bool v_lots = true;  // every volume divisible by 100 (A-share board lot)
+  bool wick_ok = true;
   for (int64_t t = 0; t < n_tickers; ++t) {
     const float* tb = bars + t * kNSlots * kNFields;
     const uint8_t* tm = mask + t * kNSlots;
@@ -169,6 +172,12 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
                     al = dl < 0 ? -dl : dl;
       int32_t a = ao > ah ? ao : ah;
       a = a > al ? a : al;
+      // wick offsets vs the bar body (dh >= 0 and dl <= 0 on clean data;
+      // anything else fails the range check and falls back)
+      const int32_t h_off = dh - (dop > 0 ? dop : 0);
+      const int32_t l_off = (dop < 0 ? dop : 0) - dl;
+      wick_ok &= (ao <= 127) & (h_off >= 0) & (h_off <= 15) &
+                 (l_off >= 0) & (l_off <= 15);
       dmax_c = dmax_c > ac ? dmax_c : ac;
       dmax_ohl = dmax_ohl > a ? dmax_ohl : a;
       tdc[s] = static_cast<int16_t>(dc);
@@ -190,10 +199,11 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
   stats[1] = dmax_c_all;
   stats[2] = v_lots ? 1 : 0;
   stats[3] = vmax_all;
+  stats[4] = wick_ok ? 1 : 0;
   return 0;
 }
 
 // Exported so Python can assert ABI compatibility at load time.
-int64_t grid_pack_abi_version() { return 5; }
+int64_t grid_pack_abi_version() { return 6; }
 
 }  // extern "C"

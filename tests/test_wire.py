@@ -28,7 +28,10 @@ def test_roundtrip_exact(batch):
     bars, mask = batch
     w = wire.encode(bars, mask)
     assert w is not None
-    assert w.dohl.dtype == np.int8  # synthetic intra-bar ranges are narrow
+    # synthetic intra-bar wicks are narrow -> 2-byte wick packing
+    assert w.dohl.dtype == np.uint8 and w.dohl.shape[-1] == 2
+    # synthetic volumes aren't board lots, so volume ships int32 here;
+    # lot data reaches ~0.25 (bench batches)
     assert w.nbytes < 0.4 * (bars.nbytes + mask.nbytes)
     out_bars, out_mask = wire.decode(*w.arrays)
     out_bars = np.asarray(out_bars)
@@ -89,13 +92,21 @@ def test_widen_only_floor_is_sticky(batch):
     wide = bars.copy()
     i = tuple(np.argwhere(mask)[0])
     wide[i][1] = wide[i][3] + 3.0  # 300-tick intra-bar range
+    mid = bars.copy()
+    mid[i][1] = mid[i][3] + 0.25  # 25-tick wick: too wide for nibbles
     floor = {}
     a = wire.encode(bars, mask, floor=floor)
-    assert a.dohl.dtype == np.int8
+    assert a.dohl.dtype == np.uint8 and a.dohl.shape[-1] == 2
+    m_ = wire.encode(mid, mask, floor=floor)
+    assert m_.dohl.dtype == np.int8 and m_.dohl.shape[-1] == 3
     b = wire.encode(wide, mask, floor=floor)
     assert b.dohl.dtype == np.int16
     c = wire.encode(bars, mask, floor=floor)  # narrow again -> stays wide
     assert c.dohl.dtype == np.int16
+    # the mid batch round-trips exactly through the int8 path
+    out_mid, _ = wire.decode(*wire.encode(mid, mask).arrays)
+    np.testing.assert_allclose(np.asarray(out_mid)[i][1], mid[i][1],
+                               rtol=2.5e-7)
     # and decode of the widened batch still round-trips
     out_bars, _ = wire.decode(*c.arrays)
     np.testing.assert_allclose(np.asarray(out_bars)[mask][:, 3],
