@@ -91,10 +91,10 @@ def encode(bars: np.ndarray, mask: np.ndarray, tick: float = TICK,
     if use_native is None or use_native:
         from .. import native
         if native.available():
-            out = native.wire_encode_native(bars, mask, round(1.0 / tick))
+            out = native.wire_encode_native(bars, mask, round(1.0 / tick),
+                                            floor=floor)
             if out is not None:
-                base, dclose, dohl, volume, vol_scale = narrow_wire(
-                    *out, floor=floor)
+                base, dclose, dohl, volume, vol_scale = out
                 return WireBatch(base=base, dclose=dclose, dohl=dohl,
                                  volume=volume, maskbits=pack_mask(mask),
                                  vol_scale=vol_scale)

@@ -46,13 +46,13 @@ def load() -> Optional[ctypes.CDLL]:
     except OSError:
         return None
     lib.grid_pack_abi_version.restype = ctypes.c_int64
-    if lib.grid_pack_abi_version() != 6:
+    if lib.grid_pack_abi_version() != 7:
         # stale build from an older source tree: rebuild once
         if not _build():
             return None
         lib = ctypes.CDLL(_LIB_PATH)
         lib.grid_pack_abi_version.restype = ctypes.c_int64
-        if lib.grid_pack_abi_version() != 6:
+        if lib.grid_pack_abi_version() != 7:
             return None
     lib.grid_pack.restype = ctypes.c_int64
     lib.grid_pack.argtypes = [
@@ -74,11 +74,14 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_uint8),   # mask [n,240]
         ctypes.c_int64,                   # n_tickers (flattened)
         ctypes.c_double,                  # inv_tick
+        ctypes.c_int64,                   # dclose_mode (0 i8, 1 i16)
+        ctypes.c_int64,                   # ohl_mode (0 wick, 1 i8, 2 i16)
+        ctypes.c_int64,                   # vol_mode (0 u16, 1 lots, 2 i32)
         ctypes.POINTER(ctypes.c_float),   # base out
-        ctypes.POINTER(ctypes.c_int16),   # dclose out
-        ctypes.POINTER(ctypes.c_int16),   # dohl out
-        ctypes.POINTER(ctypes.c_int32),   # volume out
-        ctypes.POINTER(ctypes.c_int64),   # stats out [5]
+        ctypes.c_void_p,                  # dclose out
+        ctypes.c_void_p,                  # dohl out
+        ctypes.c_void_p,                  # volume out
+        ctypes.POINTER(ctypes.c_int64),   # viol out [3]
     ]
     _lib = lib
     return _lib
@@ -115,64 +118,92 @@ def grid_pack_native(tidx: np.ndarray, time: np.ndarray, open_: np.ndarray,
     return bars, mask.astype(bool)
 
 
+#: per-field format ladders, narrowest first (shared with the numpy path)
+DCLOSE_DTYPES = (np.int8, np.int16)
+OHL_SHAPES = ((2, np.uint8), (3, np.int8), (3, np.int16))
+VOL_DTYPES = (np.uint16, np.uint16, np.int32)  # raw u16 / lots u16 / i32
+
+
 def wire_encode_native(bars: np.ndarray, mask: np.ndarray,
                        inv_tick: float = 100.0,
-                       n_threads: Optional[int] = None):
-    """One-pass native wire pack of ``bars [..., T, 240, 5] f32``.
+                       n_threads: Optional[int] = None,
+                       floor: Optional[dict] = None):
+    """One-pass native wire pack of ``bars [..., T, 240, 5] f32`` directly
+    into the narrowest formats the data (and the widen-only ``floor``)
+    allow.
 
-    Returns ``(base, dclose, dohl, volume, stats)`` with the leading
-    batch shape preserved, or None when the batch is unrepresentable
-    (caller falls back to shipping raw f32 — data/wire.py).
+    Returns ``(base, dclose, dohl, volume, vol_scale)`` with the leading
+    batch shape preserved, or None when the batch is unrepresentable in
+    any format (caller falls back to shipping raw f32 — data/wire.py).
+    When a requested narrow format overflows mid-pass the encoder aborts
+    with violation flags and the pass retries one step wider (bounded by
+    the ladder length, and ``floor`` makes widenings sticky per run).
 
-    Tickers are independent, so the pass chunks across ``n_threads``
-    (default: up to 8 cores; the ctypes call releases the GIL). Chunk
-    stats merge by max/all, so the result is bit-identical to one pass.
+    Tickers are independent, so each pass chunks across ``n_threads``
+    (default: up to 8 cores; the ctypes call releases the GIL).
     """
     lib = load()
     if lib is None:
         return None
+    floor = floor if floor is not None else {}
     bars = np.ascontiguousarray(bars, np.float32)
     lead = bars.shape[:-2]  # [..., T]
     n = int(np.prod(lead)) if lead else 1
     m8 = np.ascontiguousarray(mask, np.uint8).reshape(n, 240)
     bars_f = bars.reshape(n, 240, 5)
     base = np.empty((n,), np.float32)
-    dclose = np.empty((n, 240), np.int16)
-    dohl = np.empty((n, 240, 3), np.int16)
-    volume = np.empty((n, 240), np.int32)
-
-    def p(a, t):
-        return a.ctypes.data_as(ctypes.POINTER(t))
-
-    def run(lo: int, hi: int, stats: np.ndarray):
-        return lib.wire_encode(
-            p(bars_f[lo:hi], ctypes.c_float), p(m8[lo:hi], ctypes.c_uint8),
-            hi - lo, float(inv_tick), p(base[lo:hi], ctypes.c_float),
-            p(dclose[lo:hi], ctypes.c_int16), p(dohl[lo:hi], ctypes.c_int16),
-            p(volume[lo:hi], ctypes.c_int32), p(stats, ctypes.c_int64))
 
     if n_threads is None:
         n_threads = min(os.cpu_count() or 1, 8)
     n_threads = max(1, min(n_threads, n))
-    if n_threads == 1:
-        stats = np.zeros(5, np.int64)
-        if run(0, n, stats) < 0:
-            return None
-    else:
-        import concurrent.futures as cf
-        bounds = np.linspace(0, n, n_threads + 1).astype(int)
-        chunk_stats = [np.zeros(5, np.int64) for _ in range(n_threads)]
-        with cf.ThreadPoolExecutor(n_threads) as ex:
-            rcs = list(ex.map(run, bounds[:-1], bounds[1:], chunk_stats))
+    bounds = np.linspace(0, n, n_threads + 1).astype(int)
+
+    def p(a, t=None):
+        if t is None:
+            return ctypes.c_void_p(a.ctypes.data)
+        return a.ctypes.data_as(ctypes.POINTER(t))
+
+    while True:
+        cm = floor.get("dclose_mode", 0)
+        om = floor.get("ohl_mode", 0)
+        vm = floor.get("vol_mode", 0)
+        dclose = np.empty((n, 240), DCLOSE_DTYPES[cm])
+        width, odt = OHL_SHAPES[om]
+        dohl = np.empty((n, 240, width), odt)
+        volume = np.empty((n, 240), VOL_DTYPES[vm])
+        viols = [np.zeros(3, np.int64) for _ in range(n_threads)]
+
+        def run(lo: int, hi: int, viol: np.ndarray):
+            return lib.wire_encode(
+                p(bars_f[lo:hi], ctypes.c_float),
+                p(m8[lo:hi], ctypes.c_uint8),
+                hi - lo, float(inv_tick), cm, om, vm,
+                p(base[lo:hi], ctypes.c_float),
+                p(dclose[lo:hi]), p(dohl[lo:hi]), p(volume[lo:hi]),
+                p(viol, ctypes.c_int64))
+
+        if n_threads == 1:
+            rcs = [run(0, n, viols[0])]
+        else:
+            import concurrent.futures as cf
+            with cf.ThreadPoolExecutor(n_threads) as ex:
+                rcs = list(ex.map(run, bounds[:-1], bounds[1:], viols))
         if any(rc < 0 for rc in rcs):
             return None
-        s = np.stack(chunk_stats)
-        stats = np.array([s[:, 0].max(), s[:, 1].max(),
-                          int(s[:, 2].all()), s[:, 3].max(),
-                          int(s[:, 4].all())], np.int64)
+        if not any(rc == 1 for rc in rcs):
+            break
+        v = np.stack(viols).any(axis=0)
+        if v[0]:
+            floor["dclose_mode"] = cm + 1
+        if v[1]:
+            floor["ohl_mode"] = om + 1
+        if v[2]:
+            floor["vol_mode"] = vm + 1
+
+    vol_scale = 100.0 if floor.get("vol_mode", 0) == 1 else 1.0
     return (base.reshape(lead), dclose.reshape(lead + (240,)),
-            dohl.reshape(lead + (240, 3)), volume.reshape(lead + (240,)),
-            stats)
+            dohl.reshape(lead + (240, dohl.shape[-1])),
+            volume.reshape(lead + (240,)), vol_scale)
 
 
 def pack_wick(dohl: np.ndarray) -> np.ndarray:
@@ -188,38 +219,36 @@ def pack_wick(dohl: np.ndarray) -> np.ndarray:
 
 
 def narrow_wire(base, dclose, dohl, volume, stats, floor=None):
-    """Shared narrowing policy for both encode paths (native + numpy):
-    wick-packed/int8 deltas and uint16 lot-volume whenever the batch
-    stats fit.
-
-    ``floor`` (a mutable dict, threaded through a pipeline run) makes the
-    choice widen-only across batches: once one batch needs a wide dtype,
-    later batches keep it, so the jit cache sees a bounded set of
-    signatures (at most one widening per field per run) instead of
-    data-dependent flip-flopping that would recompile the fused factor
-    graph."""
+    """Numpy-path narrowing, matching the native encoder's mode ladders
+    exactly (per field: first mode at or above the widen-only ``floor``
+    that fits the batch stats). The native path instead writes final
+    formats directly and widens on violation — same resulting modes, so
+    both paths stay bit-compatible (tests/test_native.py)."""
     floor = floor if floor is not None else {}
     dmax_ohl, dmax_c, v_lots, vmax, wick_ok = (int(s) for s in stats)
-    ohl_fit = floor.get("ohl_fit", "wick")
-    if wick_ok and ohl_fit == "wick":
-        dohl = pack_wick(dohl)
-    elif dmax_ohl <= 127 and ohl_fit in ("wick", "i8"):
-        dohl = dohl.astype(np.int8)
-        floor["ohl_fit"] = "i8"
-    else:
-        floor["ohl_fit"] = "i16"
-    if dmax_c <= 127 and not floor.get("dclose_wide"):
+
+    def pick(key, fits):
+        mode = floor.get(key, 0)
+        while not fits[mode]:
+            mode += 1
+        if mode > floor.get(key, 0):
+            floor[key] = mode
+        return mode
+
+    cm = pick("dclose_mode", (dmax_c <= 127, True))
+    if cm == 0:
         dclose = dclose.astype(np.int8)
-    else:
-        floor["dclose_wide"] = True
+    om = pick("ohl_mode", (bool(wick_ok), dmax_ohl <= 127, True))
+    if om == 0:
+        dohl = pack_wick(dohl)
+    elif om == 1:
+        dohl = dohl.astype(np.int8)
+    vm = pick("vol_mode", (vmax <= 0xFFFF,
+                           bool(v_lots) and vmax // 100 <= 0xFFFF, True))
     vol_scale = 1.0
-    vol_fit = floor.get("vol_fit", "u16")
-    if vmax <= 0xFFFF and vol_fit == "u16":
+    if vm == 0:
         volume = volume.astype(np.uint16)
-    elif v_lots and vmax // 100 <= 0xFFFF and vol_fit in ("u16", "lots"):
+    elif vm == 1:
         volume = (volume // 100).astype(np.uint16)
         vol_scale = 100.0
-        floor["vol_fit"] = "lots"
-    else:
-        floor["vol_fit"] = "i32"
     return base, dclose, dohl, volume, vol_scale

@@ -67,40 +67,47 @@ int64_t grid_pack(const int64_t* tidx, const int64_t* time,
 }
 
 // Pack a dense [n_tickers, 240, 5] f32 grid into the compact wire format
-// (data/wire.py): per-ticker first-valid close as f32 base, int16 close
-// tick-delta vs previous valid close, int16 open/high/low tick-delta vs
-// same-bar close (the caller narrows to int8 when the returned max fits),
-// int32 volume. Two passes per ticker, both L1-resident: a branch-light
+// (data/wire.py), writing the FINAL narrow dtypes in one pass. The caller
+// requests a format per field (its widen-only floor) and the encoder
+// aborts with violation flags when the data does not fit, so the common
+// case is a single pass that writes ~5 bytes/bar with no host-side
+// re-narrowing; widenings are rare (bounded per run) retries.
+//
+// Modes — dclose: 0 = int8, 1 = int16.
+//         ohl:    0 = 2-byte wick pack (int8 open-close delta + nibble
+//                     high/low wick offsets), 1 = int8 x3, 2 = int16 x3.
+//         vol:    0 = uint16 shares, 1 = uint16 board lots (shares/100),
+//                 2 = int32 shares.
+// Two passes per ticker, both L1-resident: a branch-light
 // tick-conversion/validation sweep the compiler can keep in vector
 // registers (rint inlines to a rounding instruction; llround would be a
 // libm call per field), then the sequential previous-close scan. Rounding
 // mode (nearest-even vs half-away) cannot change accept/reject semantics:
 // any value ~0.5 ticks off-grid already fails the 1e-3 alignment check.
 //   bars [n*240*5] f32, mask [n*240] u8  ->
-//   base [n] f32, dclose [n*240] i16, dohl [n*240*3] i16,
-//   volume [n*240] i32 (caller-zeroing not required; every lane is written)
-//   stats[5]: max |open/high/low delta|, max |close delta|, all-volumes-
-//   divisible-by-100 flag, max volume, wick-packable flag (every valid
-//   lane has |open-close| <= 127 ticks and high/low within 15 ticks of
-//   the bar body) — callers use these to narrow dohl to 2-byte
-//   wick-packed or int8, dclose to int8, volume to uint16 lots.
-// Returns -1 if the batch is unrepresentable (off-tick price, delta
-// overflow, fractional/negative/overflowing volume) — outputs are garbage
-// and the caller ships raw f32 instead; 0 on success.
+//   base [n] f32, dclose/dohl/volume in the requested formats
+//   (caller-zeroing not required; every lane is written on success)
+// Returns 0 on success; -1 if the batch is unrepresentable in ANY format
+// (off-tick price, >int16 delta, fractional/negative/overflowing volume)
+// — caller ships raw f32; 1 when a requested narrow mode overflowed —
+// viol[0..2] name the fields (dclose/ohl/vol), outputs are partial
+// garbage, caller widens those modes and retries.
 int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
-                    double inv_tick, float* base, int16_t* dclose,
-                    int16_t* dohl, int32_t* volume, int64_t* stats) {
+                    double inv_tick, int64_t dclose_mode, int64_t ohl_mode,
+                    int64_t vol_mode, float* base, void* dclose_out,
+                    void* dohl_out, void* volume_out, int64_t* viol) {
   const double kAlignTol = 1e-3;
-  int32_t dmax_ohl_all = 0, dmax_c_all = 0;
-  int64_t vmax_all = 0;
-  bool v_lots = true;  // every volume divisible by 100 (A-share board lot)
-  bool wick_ok = true;
+  int8_t* dc8 = static_cast<int8_t*>(dclose_out);
+  int16_t* dc16 = static_cast<int16_t*>(dclose_out);
+  uint8_t* ohl_w = static_cast<uint8_t*>(dohl_out);
+  int8_t* ohl8 = static_cast<int8_t*>(dohl_out);
+  int16_t* ohl16 = static_cast<int16_t*>(dohl_out);
+  uint16_t* v16 = static_cast<uint16_t*>(volume_out);
+  int32_t* v32 = static_cast<int32_t*>(volume_out);
+  viol[0] = viol[1] = viol[2] = 0;
   for (int64_t t = 0; t < n_tickers; ++t) {
     const float* tb = bars + t * kNSlots * kNFields;
     const uint8_t* tm = mask + t * kNSlots;
-    int16_t* tdc = dclose + t * kNSlots;
-    int16_t* tdo = dohl + t * kNSlots * 3;
-    int32_t* tv = volume + t * kNSlots;
 
     // pass 1: prices -> integer ticks with masked-lane zeroing. Per-lane
     // validity folds into one flag via negated comparisons, so a NaN in any
@@ -146,64 +153,77 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
     }
     if (bad) return -1;
 
-    // pass 2: sequential previous-valid-close deltas + output writes.
+    // pass 2: sequential previous-valid-close deltas + mode-directed
+    // output writes with overflow detection.
     int32_t prev = 0;
     bool have_base = false;
     double base_val = 0.0;
-    int32_t dmax_c = 0, dmax_ohl = 0;
     for (int64_t s = 0; s < kNSlots; ++s) {
-      int16_t* d = tdo + s * 3;
-      if (!tm[s]) {
-        tdc[s] = 0;
-        d[0] = d[1] = d[2] = 0;
-        tv[s] = 0;
-        continue;
-      }
-      const int32_t c = ct[s];
-      if (!have_base) {
-        have_base = true;
+      const int64_t i = t * kNSlots + s;
+      int32_t dc = 0, dop = 0, dh = 0, dl = 0;
+      int64_t v = 0;
+      if (tm[s]) {
+        const int32_t c = ct[s];
+        if (!have_base) {
+          have_base = true;
+          prev = c;
+          base_val = c / inv_tick;
+        }
+        dc = c - prev;
+        dop = ot[s] - c;
+        dh = ht[s] - c;
+        dl = lt[s] - c;
+        v = vt[s];
         prev = c;
-        base_val = c / inv_tick;
       }
-      const int32_t dc = c - prev, dop = ot[s] - c, dh = ht[s] - c,
-                    dl = lt[s] - c;
       const int32_t ac = dc < 0 ? -dc : dc;
       const int32_t ao = dop < 0 ? -dop : dop, ah = dh < 0 ? -dh : dh,
                     al = dl < 0 ? -dl : dl;
       int32_t a = ao > ah ? ao : ah;
       a = a > al ? a : al;
-      // wick offsets vs the bar body (dh >= 0 and dl <= 0 on clean data;
-      // anything else fails the range check and falls back)
-      const int32_t h_off = dh - (dop > 0 ? dop : 0);
-      const int32_t l_off = (dop < 0 ? dop : 0) - dl;
-      wick_ok &= (ao <= 127) & (h_off >= 0) & (h_off <= 15) &
-                 (l_off >= 0) & (l_off <= 15);
-      dmax_c = dmax_c > ac ? dmax_c : ac;
-      dmax_ohl = dmax_ohl > a ? dmax_ohl : a;
-      tdc[s] = static_cast<int16_t>(dc);
-      d[0] = static_cast<int16_t>(dop);
-      d[1] = static_cast<int16_t>(dh);
-      d[2] = static_cast<int16_t>(dl);
-      const int64_t v = vt[s];
-      tv[s] = static_cast<int32_t>(v);
-      v_lots &= (v % 100) == 0;
-      vmax_all = vmax_all > v ? vmax_all : v;
-      prev = c;
+      if (ac > 32767 || a > 32767) return -1;
+      if (dclose_mode == 0) {
+        if (ac > 127) viol[0] = 1;
+        dc8[i] = static_cast<int8_t>(dc);
+      } else {
+        dc16[i] = static_cast<int16_t>(dc);
+      }
+      if (ohl_mode == 0) {
+        // wick pack: int8 body delta + nibble wick offsets off the body
+        const int32_t h_off = dh - (dop > 0 ? dop : 0);
+        const int32_t l_off = (dop < 0 ? dop : 0) - dl;
+        if (ao > 127 || h_off < 0 || h_off > 15 || l_off < 0 || l_off > 15)
+          viol[1] = 1;
+        ohl_w[i * 2] = static_cast<uint8_t>(static_cast<int8_t>(dop));
+        ohl_w[i * 2 + 1] =
+            static_cast<uint8_t>(((h_off & 0xF) << 4) | (l_off & 0xF));
+      } else if (ohl_mode == 1) {
+        if (a > 127) viol[1] = 1;
+        ohl8[i * 3] = static_cast<int8_t>(dop);
+        ohl8[i * 3 + 1] = static_cast<int8_t>(dh);
+        ohl8[i * 3 + 2] = static_cast<int8_t>(dl);
+      } else {
+        ohl16[i * 3] = static_cast<int16_t>(dop);
+        ohl16[i * 3 + 1] = static_cast<int16_t>(dh);
+        ohl16[i * 3 + 2] = static_cast<int16_t>(dl);
+      }
+      if (vol_mode == 0) {
+        if (v > 0xFFFF) viol[2] = 1;
+        v16[i] = static_cast<uint16_t>(v);
+      } else if (vol_mode == 1) {
+        if ((v % 100) != 0 || v / 100 > 0xFFFF) viol[2] = 1;
+        v16[i] = static_cast<uint16_t>(v / 100);
+      } else {
+        v32[i] = static_cast<int32_t>(v);
+      }
+      if (viol[0] | viol[1] | viol[2]) return 1;  // caller widens + retries
     }
-    if (dmax_c > 32767 || dmax_ohl > 32767) return -1;
-    dmax_ohl_all = dmax_ohl_all > dmax_ohl ? dmax_ohl_all : dmax_ohl;
-    dmax_c_all = dmax_c_all > dmax_c ? dmax_c_all : dmax_c;
     base[t] = static_cast<float>(base_val);
   }
-  stats[0] = dmax_ohl_all;
-  stats[1] = dmax_c_all;
-  stats[2] = v_lots ? 1 : 0;
-  stats[3] = vmax_all;
-  stats[4] = wick_ok ? 1 : 0;
   return 0;
 }
 
 // Exported so Python can assert ABI compatibility at load time.
-int64_t grid_pack_abi_version() { return 6; }
+int64_t grid_pack_abi_version() { return 7; }
 
 }  // extern "C"
