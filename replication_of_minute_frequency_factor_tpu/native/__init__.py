@@ -22,15 +22,20 @@ _tried = False
 
 
 def _build() -> bool:
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-mtune=native", "-fno-math-errno", "-shared",
-             "-fPIC", "-o", _LIB_PATH,
-             os.path.join(_DIR, "gridpack.cpp")],
-            check=True, capture_output=True, timeout=120)
-        return True
-    except (OSError, subprocess.SubprocessError):
-        return False
+    # -march=native unlocks the wide vectors the encoder's pass-1 loop is
+    # shaped for (AVX-512: 8 doubles/vector); fall back to -mtune for
+    # toolchains where native ISA probing fails.
+    for arch_flag in ("-march=native", "-mtune=native"):
+        try:
+            subprocess.run(
+                ["g++", "-O3", arch_flag, "-fno-math-errno", "-shared",
+                 "-fPIC", "-o", _LIB_PATH,
+                 os.path.join(_DIR, "gridpack.cpp")],
+                check=True, capture_output=True, timeout=120)
+            return True
+        except (OSError, subprocess.SubprocessError):
+            continue
+    return False
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -46,13 +51,13 @@ def load() -> Optional[ctypes.CDLL]:
     except OSError:
         return None
     lib.grid_pack_abi_version.restype = ctypes.c_int64
-    if lib.grid_pack_abi_version() != 7:
+    if lib.grid_pack_abi_version() != 8:
         # stale build from an older source tree: rebuild once
         if not _build():
             return None
         lib = ctypes.CDLL(_LIB_PATH)
         lib.grid_pack_abi_version.restype = ctypes.c_int64
-        if lib.grid_pack_abi_version() != 7:
+        if lib.grid_pack_abi_version() != 8:
             return None
     lib.grid_pack.restype = ctypes.c_int64
     lib.grid_pack.argtypes = [

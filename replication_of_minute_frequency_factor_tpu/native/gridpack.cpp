@@ -114,23 +114,38 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
     // field marks the lane bad (NaN fails every ordered comparison) rather
     // than resetting a running maximum; casts are blended to zero on bad
     // lanes to keep them defined.
-    int32_t ot[kNSlots], ht[kNSlots], lt[kNSlots], ct[kNSlots];
-    int64_t vt[kNSlots];
+    //
+    // The interleaved [240, 5] layout defeats the vectorizer (stride-5 f32
+    // loads have no vectype on gcc 12), so a scalar deinterleave into
+    // per-field buffers runs first; the double-precision convert/validate
+    // loop over the contiguous buffers then vectorizes (8 doubles/vector
+    // under -march=native AVX-512, the lane_bad mask as a compare mask).
+    alignas(64) float of[kNSlots], hf[kNSlots], lf[kNSlots], cf[kNSlots],
+        vf[kNSlots];
+    alignas(64) int32_t ot[kNSlots], ht[kNSlots], lt[kNSlots], ct[kNSlots],
+        vt[kNSlots];
+    for (int64_t s = 0; s < kNSlots; ++s) {
+      of[s] = tb[s * kNFields + 0];
+      hf[s] = tb[s * kNFields + 1];
+      lf[s] = tb[s * kNFields + 2];
+      cf[s] = tb[s * kNFields + 3];
+      vf[s] = tb[s * kNFields + 4];
+    }
     // |o/h/l| ticks beyond 2^22+32767 guarantee an int16 delta overflow
     // (|d| >= |field| - |close| > 32767 given the close <= 2^22 bound), so
     // rejecting them here is equivalent to the pass-2 dmax check while
-    // keeping every int32 cast below in range.
+    // keeping every int32 cast below in range. Volume (< 2^31) fits int32.
     const double kCMax = static_cast<double>(1LL << 22);
     const double kPMax = static_cast<double>((1LL << 22) + 32767);
     const double kVMax = static_cast<double>(1LL << 31);
     int bad = 0;
     for (int64_t s = 0; s < kNSlots; ++s) {
       const double m = tm[s] ? 1.0 : 0.0;
-      const double o = tb[s * kNFields + 0] * inv_tick * m;
-      const double h = tb[s * kNFields + 1] * inv_tick * m;
-      const double l = tb[s * kNFields + 2] * inv_tick * m;
-      const double c = tb[s * kNFields + 3] * inv_tick * m;
-      const double v = static_cast<double>(tb[s * kNFields + 4]) * m;
+      const double o = of[s] * inv_tick * m;
+      const double h = hf[s] * inv_tick * m;
+      const double l = lf[s] * inv_tick * m;
+      const double c = cf[s] * inv_tick * m;
+      const double v = static_cast<double>(vf[s]) * m;
       const double ro = __builtin_rint(o), rh = __builtin_rint(h),
                    rl = __builtin_rint(l), rc = __builtin_rint(c),
                    rv = __builtin_rint(v);
@@ -149,81 +164,136 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
       ht[s] = lane_bad ? 0 : static_cast<int32_t>(rh);
       lt[s] = lane_bad ? 0 : static_cast<int32_t>(rl);
       ct[s] = lane_bad ? 0 : static_cast<int32_t>(rc);
-      vt[s] = lane_bad ? 0 : static_cast<int64_t>(rv);
+      vt[s] = lane_bad ? 0 : static_cast<int32_t>(rv);
     }
     if (bad) return -1;
 
-    // pass 2: sequential previous-valid-close deltas + mode-directed
-    // output writes with overflow detection.
-    int32_t prev = 0;
-    bool have_base = false;
-    double base_val = 0.0;
-    for (int64_t s = 0; s < kNSlots; ++s) {
-      const int64_t i = t * kNSlots + s;
-      int32_t dc = 0, dop = 0, dh = 0, dl = 0;
-      int64_t v = 0;
-      if (tm[s]) {
-        const int32_t c = ct[s];
-        if (!have_base) {
-          have_base = true;
+    // pass 2a: previous-valid-close scan — the one genuinely sequential
+    // dependency, kept to ~4 scalar int ops per slot.
+    alignas(64) int32_t dcv[kNSlots];
+    {
+      int32_t prev = 0;
+      bool have_base = false;
+      double base_val = 0.0;
+      for (int64_t s = 0; s < kNSlots; ++s) {
+        int32_t d = 0;
+        if (tm[s]) {
+          const int32_t c = ct[s];
+          if (!have_base) {
+            have_base = true;
+            prev = c;
+            base_val = c / inv_tick;
+          }
+          d = c - prev;
           prev = c;
-          base_val = c / inv_tick;
         }
-        dc = c - prev;
-        dop = ot[s] - c;
-        dh = ht[s] - c;
-        dl = lt[s] - c;
-        v = vt[s];
-        prev = c;
+        dcv[s] = d;
       }
-      const int32_t ac = dc < 0 ? -dc : dc;
-      const int32_t ao = dop < 0 ? -dop : dop, ah = dh < 0 ? -dh : dh,
-                    al = dl < 0 ? -dl : dl;
-      int32_t a = ao > ah ? ao : ah;
-      a = a > al ? a : al;
-      if (ac > 32767 || a > 32767) return -1;
-      if (dclose_mode == 0) {
-        if (ac > 127) viol[0] = 1;
-        dc8[i] = static_cast<int8_t>(dc);
-      } else {
-        dc16[i] = static_cast<int16_t>(dc);
-      }
-      if (ohl_mode == 0) {
-        // wick pack: int8 body delta + nibble wick offsets off the body
-        const int32_t h_off = dh - (dop > 0 ? dop : 0);
-        const int32_t l_off = (dop < 0 ? dop : 0) - dl;
-        if (ao > 127 || h_off < 0 || h_off > 15 || l_off < 0 || l_off > 15)
-          viol[1] = 1;
-        ohl_w[i * 2] = static_cast<uint8_t>(static_cast<int8_t>(dop));
-        ohl_w[i * 2 + 1] =
-            static_cast<uint8_t>(((h_off & 0xF) << 4) | (l_off & 0xF));
-      } else if (ohl_mode == 1) {
-        if (a > 127) viol[1] = 1;
-        ohl8[i * 3] = static_cast<int8_t>(dop);
-        ohl8[i * 3 + 1] = static_cast<int8_t>(dh);
-        ohl8[i * 3 + 2] = static_cast<int8_t>(dl);
-      } else {
-        ohl16[i * 3] = static_cast<int16_t>(dop);
-        ohl16[i * 3 + 1] = static_cast<int16_t>(dh);
-        ohl16[i * 3 + 2] = static_cast<int16_t>(dl);
-      }
-      if (vol_mode == 0) {
-        if (v > 0xFFFF) viol[2] = 1;
-        v16[i] = static_cast<uint16_t>(v);
-      } else if (vol_mode == 1) {
-        if ((v % 100) != 0 || v / 100 > 0xFFFF) viol[2] = 1;
-        v16[i] = static_cast<uint16_t>(v / 100);
-      } else {
-        v32[i] = static_cast<int32_t>(v);
-      }
-      if (viol[0] | viol[1] | viol[2]) return 1;  // caller widens + retries
+      base[t] = static_cast<float>(base_val);
     }
-    base[t] = static_cast<float>(base_val);
+
+    // pass 2b: body/wick deltas + int16 range reduction, vectorized.
+    // Masked lanes were zeroed in pass 1, so their deltas are zero with
+    // no branch.
+    alignas(64) int32_t dov[kNSlots], dhv[kNSlots], dlv[kNSlots];
+    int32_t acmax = 0, amax = 0;
+    for (int64_t s = 0; s < kNSlots; ++s) {
+      const int32_t dop = ot[s] - ct[s], dh = ht[s] - ct[s],
+                    dl = lt[s] - ct[s];
+      dov[s] = dop;
+      dhv[s] = dh;
+      dlv[s] = dl;
+      const int32_t ac = dcv[s] < 0 ? -dcv[s] : dcv[s];
+      int32_t a = dop < 0 ? -dop : dop;
+      const int32_t ah = dh < 0 ? -dh : dh, al = dl < 0 ? -dl : dl;
+      a = a > ah ? a : ah;
+      a = a > al ? a : al;
+      acmax = acmax > ac ? acmax : ac;
+      amax = amax > a ? amax : a;
+    }
+    if (acmax > 32767 || amax > 32767) return -1;
+
+    // pass 2c: mode-directed narrow writes, one loop per mode so each
+    // write loop vectorizes with no per-slot mode branch. Overflow flags
+    // accumulate across the ticker and abort after it (outputs are
+    // partial garbage on a widen-retry, same contract as before).
+    const int64_t off = t * kNSlots;
+    if (dclose_mode == 0) {
+      int32_t v0 = 0;
+      for (int64_t s = 0; s < kNSlots; ++s) {
+        const int32_t d = dcv[s], a = d < 0 ? -d : d;
+        v0 |= a > 127;
+        dc8[off + s] = static_cast<int8_t>(d);
+      }
+      viol[0] |= v0;
+    } else {
+      for (int64_t s = 0; s < kNSlots; ++s)
+        dc16[off + s] = static_cast<int16_t>(dcv[s]);
+    }
+    if (ohl_mode == 0) {
+      // wick pack: int8 body delta + nibble wick offsets off the body.
+      // Both bytes store as one little-endian uint16 (byte0 = body,
+      // byte1 = wick nibbles) so the loop is a plain int32->uint16 pack.
+      uint16_t* ohl_p = reinterpret_cast<uint16_t*>(ohl_w) + off;
+      int32_t v1 = 0;
+      for (int64_t s = 0; s < kNSlots; ++s) {
+        const int32_t dop = dov[s];
+        const int32_t h_off = dhv[s] - (dop > 0 ? dop : 0);
+        const int32_t l_off = (dop < 0 ? dop : 0) - dlv[s];
+        const int32_t ao = dop < 0 ? -dop : dop;
+        v1 |= (ao > 127) | (h_off < 0) | (h_off > 15) | (l_off < 0) |
+              (l_off > 15);
+        ohl_p[s] = static_cast<uint16_t>(
+            static_cast<uint8_t>(static_cast<int8_t>(dop)) |
+            ((((h_off & 0xF) << 4) | (l_off & 0xF)) << 8));
+      }
+      viol[1] |= v1;
+    } else if (ohl_mode == 1) {
+      int32_t v1 = 0;
+      for (int64_t s = 0; s < kNSlots; ++s) {
+        const int32_t dop = dov[s], dh = dhv[s], dl = dlv[s];
+        int32_t a = dop < 0 ? -dop : dop;
+        const int32_t ah = dh < 0 ? -dh : dh, al = dl < 0 ? -dl : dl;
+        a = a > ah ? a : ah;
+        a = a > al ? a : al;
+        v1 |= a > 127;
+        ohl8[(off + s) * 3] = static_cast<int8_t>(dop);
+        ohl8[(off + s) * 3 + 1] = static_cast<int8_t>(dh);
+        ohl8[(off + s) * 3 + 2] = static_cast<int8_t>(dl);
+      }
+      viol[1] |= v1;
+    } else {
+      for (int64_t s = 0; s < kNSlots; ++s) {
+        ohl16[(off + s) * 3] = static_cast<int16_t>(dov[s]);
+        ohl16[(off + s) * 3 + 1] = static_cast<int16_t>(dhv[s]);
+        ohl16[(off + s) * 3 + 2] = static_cast<int16_t>(dlv[s]);
+      }
+    }
+    if (vol_mode == 0) {
+      int32_t v2 = 0;
+      for (int64_t s = 0; s < kNSlots; ++s) {
+        v2 |= vt[s] > 0xFFFF;
+        v16[off + s] = static_cast<uint16_t>(vt[s]);
+      }
+      viol[2] |= v2;
+    } else if (vol_mode == 1) {
+      int32_t v2 = 0;
+      for (int64_t s = 0; s < kNSlots; ++s) {
+        const int32_t q = vt[s] / 100;
+        v2 |= (vt[s] - q * 100 != 0) | (q > 0xFFFF);
+        v16[off + s] = static_cast<uint16_t>(q);
+      }
+      viol[2] |= v2;
+    } else {
+      for (int64_t s = 0; s < kNSlots; ++s)
+        v32[off + s] = vt[s];
+    }
+    if (viol[0] | viol[1] | viol[2]) return 1;  // caller widens + retries
   }
   return 0;
 }
 
 // Exported so Python can assert ABI compatibility at load time.
-int64_t grid_pack_abi_version() { return 7; }
+int64_t grid_pack_abi_version() { return 8; }
 
 }  // extern "C"
