@@ -13,6 +13,10 @@
 #include <cmath>
 #include <cstdint>
 
+#if defined(__AVX512F__)
+#include <immintrin.h>
+#endif
+
 namespace {
 
 constexpr int64_t kAmOpenMsm = 9 * 60 + 30;  // 570
@@ -32,6 +36,44 @@ inline int64_t TimeToSlot(int64_t t) {
     return hm - kPmOpenMsm + kAmSlots;
   return -1;
 }
+
+#if defined(__AVX512F__)
+// Index vectors for the 5x16 deinterleave transpose: each 80-float block
+// (16 slots x 5 interleaved fields) lands in five zmm registers; four
+// two-source permutes per field funnel the stride-5 lanes into one
+// contiguous 16-lane output. permutex2var index space is the 32-element
+// concat of its two sources, so the tables are just the global offsets.
+struct DeintIdx {
+  __m512i i01[5], i23[5], icomb[5], i4[5];
+  DeintIdx() {
+    alignas(64) int v01[16], v23[16], vc[16], v4[16];
+    for (int f = 0; f < 5; ++f) {
+      int n01 = 0, n23 = 0;
+      for (int j = 0; j < 16; ++j) v01[j] = v23[j] = vc[j] = 0;
+      for (int s = 0; s < 16; ++s) {
+        const int p = 5 * s + f;
+        if (p < 32)
+          v01[n01++] = p;
+        else if (p < 64)
+          v23[n23++] = p - 32;
+      }
+      int n = 0;
+      for (int j = 0; j < n01; ++j) vc[n++] = j;
+      for (int j = 0; j < n23; ++j) vc[n++] = 16 + j;
+      for (int j = 0; j < 16; ++j) v4[j] = j;
+      for (int s = 0; s < 16; ++s) {
+        const int p = 5 * s + f;
+        if (p >= 64) v4[s] = 16 + (p - 64);
+      }
+      i01[f] = _mm512_load_si512(v01);
+      i23[f] = _mm512_load_si512(v23);
+      icomb[f] = _mm512_load_si512(vc);
+      i4[f] = _mm512_load_si512(v4);
+    }
+  }
+};
+const DeintIdx kDeint;
+#endif
 
 }  // namespace
 
@@ -115,15 +157,36 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
     // than resetting a running maximum; casts are blended to zero on bad
     // lanes to keep them defined.
     //
-    // The interleaved [240, 5] layout defeats the vectorizer (stride-5 f32
-    // loads have no vectype on gcc 12), so a scalar deinterleave into
-    // per-field buffers runs first; the double-precision convert/validate
-    // loop over the contiguous buffers then vectorizes (8 doubles/vector
-    // under -march=native AVX-512, the lane_bad mask as a compare mask).
+    // The interleaved [240, 5] layout defeats the auto-vectorizer
+    // (stride-5 f32 loads have no vectype on gcc 12), so a deinterleave
+    // into per-field buffers runs first — a permute-tree transpose on
+    // AVX-512 builds (kDeint), a scalar copy elsewhere; the
+    // double-precision convert/validate loop over the contiguous buffers
+    // then auto-vectorizes (8 doubles/vector, lane_bad as a compare mask).
     alignas(64) float of[kNSlots], hf[kNSlots], lf[kNSlots], cf[kNSlots],
         vf[kNSlots];
     alignas(64) int32_t ot[kNSlots], ht[kNSlots], lt[kNSlots], ct[kNSlots],
         vt[kNSlots];
+#if defined(__AVX512F__)
+    {
+      float* outs[5] = {of, hf, lf, cf, vf};
+      for (int64_t blk = 0; blk < kNSlots / 16; ++blk) {
+        const float* src = tb + blk * 80;
+        const __m512 z0 = _mm512_loadu_ps(src);
+        const __m512 z1 = _mm512_loadu_ps(src + 16);
+        const __m512 z2 = _mm512_loadu_ps(src + 32);
+        const __m512 z3 = _mm512_loadu_ps(src + 48);
+        const __m512 z4 = _mm512_loadu_ps(src + 64);
+        for (int f = 0; f < 5; ++f) {
+          const __m512 a01 = _mm512_permutex2var_ps(z0, kDeint.i01[f], z1);
+          const __m512 a23 = _mm512_permutex2var_ps(z2, kDeint.i23[f], z3);
+          __m512 r = _mm512_permutex2var_ps(a01, kDeint.icomb[f], a23);
+          r = _mm512_permutex2var_ps(r, kDeint.i4[f], z4);
+          _mm512_store_ps(outs[f] + blk * 16, r);
+        }
+      }
+    }
+#else
     for (int64_t s = 0; s < kNSlots; ++s) {
       of[s] = tb[s * kNFields + 0];
       hf[s] = tb[s * kNFields + 1];
@@ -131,6 +194,7 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
       cf[s] = tb[s * kNFields + 3];
       vf[s] = tb[s * kNFields + 4];
     }
+#endif
     // |o/h/l| ticks beyond 2^22+32767 guarantee an int16 delta overflow
     // (|d| >= |field| - |close| > 32767 given the close <= 2^22 bound), so
     // rejecting them here is equivalent to the pass-2 dmax check while
