@@ -24,9 +24,9 @@ import numpy as np
 
 from replication_of_minute_frequency_factor_tpu.data import wire
 from replication_of_minute_frequency_factor_tpu.models.registry import (
-    compute_factors_jit, factor_names)
+    factor_names)
 from replication_of_minute_frequency_factor_tpu.pipeline import (
-    _compute_from_wire)
+    compute_packed)
 
 N_TICKERS = 5000
 DAYS_PER_BATCH = 8
@@ -89,16 +89,16 @@ def main():
     use_wire = wire.encode(bars[:1], mask[:1]) is not None
 
     def dispatch(b, m):
-        """One pipeline step, dispatched asynchronously: host pack -> wire
-        transfer -> fused on-device decode + 58-factor graph (falls back to
-        raw f32 when the wire format can't represent the batch)."""
+        """One pipeline step, dispatched asynchronously: host pack -> ONE
+        buffer over the wire -> fused on-device unpack + decode + 58-factor
+        graph -> ONE stacked output tensor (falls back to raw f32 when the
+        wire format can't represent the batch)."""
         if use_wire:
             w = wire.encode(b, m)
-            arrs = wire.put(w)
-            return _compute_from_wire(*arrs, names=names,
-                                      replicate_quirks=True)
-        return compute_factors_jit(jax.device_put(b), jax.device_put(m),
-                                   names=names)
+            return compute_packed(w.arrays, "wire", names=names,
+                                  replicate_quirks=True)
+        return compute_packed((b, m.view(np.uint8)), "raw", names=names,
+                              replicate_quirks=True)
 
     for _ in range(WARMUP):
         jax.block_until_ready(dispatch(bars, mask))

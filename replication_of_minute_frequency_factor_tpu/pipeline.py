@@ -23,6 +23,7 @@ import pyarrow as pa
 import functools
 
 import jax
+import jax.numpy as jnp
 
 from .config import Config, get_config
 from .data import io as dio
@@ -52,6 +53,39 @@ def _compute_from_wire(base, dclose, dohl, volume, maskbits, vol_scale,
     return _compute_from_wire_jit(base, dclose, dohl, volume, maskbits,
                                   vol_scale, names, replicate_quirks,
                                   rolling_impl)
+
+
+@functools.partial(jax.jit, static_argnames=("spec", "kind", "names",
+                                             "replicate_quirks",
+                                             "rolling_impl"))
+def _compute_packed_jit(buf, spec, kind, names, replicate_quirks,
+                        rolling_impl):
+    """Single-buffer variant of the fused graph: ONE uint8 input (unpacked
+    by static-offset bitcasts on device) and ONE stacked ``[F, ...]``
+    output, so a batch costs one transfer each way over the tunnel instead
+    of 6 in + ~58 out (see wire.pack_arrays). ``kind`` is 'wire' or 'raw'
+    (the raw-f32 fallback ships ``(bars, mask)`` through the same path)."""
+    arrs = wire.unpack(buf, spec)
+    if kind == "wire":
+        bars, m = wire.decode(*arrs)
+    else:
+        bars, m = arrs  # mask ships as uint8 (bool has no bitcast type)
+        m = m.astype(bool)
+    out = compute_factors(bars, m, names=names,
+                          replicate_quirks=replicate_quirks,
+                          rolling_impl=rolling_impl)
+    return jnp.stack([out[n] for n in names])
+
+
+def compute_packed(arrays, kind, names, replicate_quirks=True,
+                   rolling_impl=None):
+    """Host entry for the packed path: pack -> one device_put -> fused
+    graph -> stacked [len(names), D, T] result (still on device)."""
+    if rolling_impl is None:
+        rolling_impl = get_config().rolling_impl
+    buf, spec = wire.pack_arrays(arrays)
+    return _compute_packed_jit(jax.device_put(buf), spec, kind, names,
+                               replicate_quirks, rolling_impl)
 from .utils.logging import get_logger, FailureReport
 from .utils.tracing import Timer, trace_annotation
 
@@ -246,16 +280,29 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
     def launch(item):
         dates, codes, present, w, bars, mask = item
         with trace_annotation("factor_batch"):
-            if w is not None:
+            if mesh is None:
+                # single-device: one packed buffer in, one stacked tensor
+                # out — one tunnel round trip each way per batch
+                if w is not None:
+                    out = compute_packed(
+                        w.arrays, "wire", names=names,
+                        replicate_quirks=cfg.replicate_quirks,
+                        rolling_impl=cfg.rolling_impl)
+                else:
+                    out = compute_packed(
+                        (bars, np.asarray(mask).view(np.uint8)), "raw",
+                        names=names,
+                        replicate_quirks=cfg.replicate_quirks,
+                        rolling_impl=cfg.rolling_impl)
+            elif w is not None:
                 arrs = wire.put(w, shardings)
                 out = _compute_from_wire(
                     *arrs, names=names,
                     replicate_quirks=cfg.replicate_quirks,
                     rolling_impl=cfg.rolling_impl)
             else:
-                if bars_sharding is not None:
-                    bars = jax.device_put(bars, bars_sharding[0])
-                    mask = jax.device_put(mask, bars_sharding[1])
+                bars = jax.device_put(bars, bars_sharding[0])
+                mask = jax.device_put(mask, bars_sharding[1])
                 out = compute_factors_jit(
                     bars, mask, names=names,
                     replicate_quirks=cfg.replicate_quirks,
@@ -265,7 +312,11 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
     def materialize(pending):
         dates, codes, present, out = pending
         with timer("device"):
-            out = {k: np.asarray(v) for k, v in out.items()}
+            if isinstance(out, dict):
+                out = {k: np.asarray(v) for k, v in out.items()}
+            else:  # stacked [F, D, T] from the packed path
+                stacked = np.asarray(out)
+                out = {n: stacked[j] for j, n in enumerate(names)}
         for i, date in enumerate(dates):
             sel = present[i]
             cols = {"code": codes[sel].astype(object),

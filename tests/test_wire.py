@@ -1,5 +1,6 @@
 """Wire format: exact round-trip, fallback detection, factor equality."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -149,3 +150,40 @@ def test_wide_intrabar_range_widens_dohl_not_fallback(batch):
         out_bars, out_mask = wire.decode(*w.arrays)
         np.testing.assert_allclose(
             np.asarray(out_bars)[i][1], b[i][1], rtol=2.5e-7)
+
+
+def test_pack_unpack_roundtrip(batch):
+    """Single-buffer transfer: pack_arrays -> device unpack must return
+    every wire array bit-exactly (dtypes, shapes, scalar included)."""
+    bars, mask = batch
+    w = wire.encode(bars, mask, use_native=False)
+    buf, spec = wire.pack_arrays(w.arrays)
+    assert buf.dtype == np.uint8 and buf.ndim == 1
+    out = wire.unpack(jnp.asarray(buf), spec)
+    for got, want in zip(out, w.arrays):
+        want = np.asarray(want)
+        assert np.asarray(got).dtype == want.dtype
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_packed_compute_matches_dict_paths(batch):
+    """The packed single-buffer pipeline path (wire and raw-f32 fallback)
+    must equal the per-array dict path factor-for-factor."""
+    from replication_of_minute_frequency_factor_tpu.pipeline import (
+        _compute_from_wire, compute_packed)
+
+    bars, mask = batch
+    names = ("vol_return1min", "mmt_pm", "doc_kurt", "liq_amihud_1min")
+    w = wire.encode(bars, mask, use_native=False)
+    want = _compute_from_wire(*w.arrays, names=names, replicate_quirks=True)
+    got = np.asarray(compute_packed(w.arrays, "wire", names=names,
+                                    replicate_quirks=True))
+    assert got.shape == (len(names),) + bars.shape[:2]
+    for j, n in enumerate(names):
+        np.testing.assert_array_equal(got[j], np.asarray(want[n]), err_msg=n)
+    got_raw = np.asarray(compute_packed(
+        (bars, mask.view(np.uint8)), "raw", names=names,
+        replicate_quirks=True))
+    for j, n in enumerate(names):
+        np.testing.assert_allclose(got_raw[j], got[j], rtol=2e-5, atol=1e-7,
+                                   err_msg=n)
