@@ -101,18 +101,34 @@ def encode(bars: np.ndarray, mask: np.ndarray, tick: float = TICK,
             return None  # native says unrepresentable; semantics match numpy
         if use_native:
             raise RuntimeError("native wire encoder unavailable")
-    o, h, l, c, v = (bars[..., i] for i in range(5))
+    # float64 throughout, matching the native double sweep bit-for-bit:
+    # under NEP 50 a bare ``f32_array / tick`` would stay FLOAT32 and
+    # round high tick counts to different integers than the f64 native
+    # path (~0.34-tick quotient error at 4e6 ticks). Multiply by the
+    # integral inverse (what the native code does) rather than dividing
+    # by the non-representable 0.01.
+    inv = round(1.0 / tick)
+    o, h, l, c, v = (bars[..., i].astype(np.float64) for i in range(5))
 
-    ct = np.rint(c / tick)
-    # tick alignment of every price field on valid lanes
+    ct = np.rint(c * inv)
+    # Tick alignment of every price field on valid lanes: absolute 1e-3
+    # ticks plus a relative 4-f32-ulp term — prices arrive as f32, whose
+    # representation error measured in ticks grows with magnitude and
+    # passes 1e-3 near 84 CNY (native/gridpack.cpp applies the same
+    # formula; an earlier np.allclose here hid an implicit rtol=1e-5
+    # that disagreed with the native path at high prices).
     for p in (o, h, l, c):
-        pt = p / tick
-        if not np.allclose(pt[mask], np.rint(pt[mask]), atol=1e-3):
+        pt = (p * inv)[mask]
+        r = np.rint(pt)
+        if not (np.abs(pt - r) <= 1e-3 + 2.4e-7 * np.abs(r)).all():
             return None
     if np.abs(ct[mask]).max(initial=0) > 2**22:  # f32-exact tick range
         return None
     vv = v[mask]
-    if len(vv) and (not np.allclose(vv, np.rint(vv), atol=1e-3)
+    # volume integrality is ABSOLUTE 1e-3 (no relative term): f32 holds
+    # fractional volumes up to 2^23, e.g. 4194304.5, which allclose's
+    # implicit rtol=1e-5 would wave through while the native path rejects
+    if len(vv) and (not (np.abs(vv - np.rint(vv)) <= 1e-3).all()
                     or vv.max(initial=0) >= 2**31 or vv.min(initial=0) < 0):
         return None
 
@@ -130,9 +146,9 @@ def encode(bars: np.ndarray, mask: np.ndarray, tick: float = TICK,
         np.take_along_axis(ctm, np.maximum(prev_valid, 0), axis=-1),
         base_ct[..., None])
     dclose = np.where(mask, ct - prev_ct, 0.0)
-    dopen = np.where(mask, np.rint(o / tick) - ct, 0.0)
-    dhigh = np.where(mask, np.rint(h / tick) - ct, 0.0)
-    dlow = np.where(mask, np.rint(l / tick) - ct, 0.0)
+    dopen = np.where(mask, np.rint(o * inv) - ct, 0.0)
+    dhigh = np.where(mask, np.rint(h * inv) - ct, 0.0)
+    dlow = np.where(mask, np.rint(l * inv) - ct, 0.0)
     dohl = np.stack([dopen, dhigh, dlow], axis=-1)
     dohl_max = int(np.abs(dohl).max(initial=0))
     dclose_max = int(np.abs(dclose).max(initial=0))
@@ -148,7 +164,7 @@ def encode(bars: np.ndarray, mask: np.ndarray, tick: float = TICK,
              int((vol_i % 100 == 0).all()), int(vol_i.max(initial=0)),
              wick_ok)
     base, dclose, dohl, volume, vol_scale = narrow_wire(
-        (base_ct / round(1.0 / tick)).astype(np.float32),
+        (base_ct / inv).astype(np.float32),
         dclose.astype(np.int16), dohl.astype(np.int16),
         vol_i.astype(np.int32), stats, floor=floor)
     return WireBatch(base=base, dclose=dclose, dohl=dohl, volume=volume,

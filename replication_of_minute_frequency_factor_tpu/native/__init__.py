@@ -51,13 +51,13 @@ def load() -> Optional[ctypes.CDLL]:
     except OSError:
         return None
     lib.grid_pack_abi_version.restype = ctypes.c_int64
-    if lib.grid_pack_abi_version() != 8:
+    if lib.grid_pack_abi_version() != 9:
         # stale build from an older source tree: rebuild once
         if not _build():
             return None
         lib = ctypes.CDLL(_LIB_PATH)
         lib.grid_pack_abi_version.restype = ctypes.c_int64
-        if lib.grid_pack_abi_version() != 8:
+        if lib.grid_pack_abi_version() != 9:
             return None
     lib.grid_pack.restype = ctypes.c_int64
     lib.grid_pack.argtypes = [

@@ -138,7 +138,14 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
                     double inv_tick, int64_t dclose_mode, int64_t ohl_mode,
                     int64_t vol_mode, float* base, void* dclose_out,
                     void* dohl_out, void* volume_out, int64_t* viol) {
+  // Tick-alignment tolerance: absolute 1e-3 ticks PLUS a relative term of
+  // 4 f32 ulps. Prices arrive as f32, so a genuinely tick-aligned price
+  // carries up to half an ulp of representation error — which, measured
+  // in ticks, grows with magnitude and passes 1e-3 near 84 CNY at a 0.01
+  // tick. An absolute-only tolerance would spuriously reject every
+  // high-priced ticker (data/wire.py applies the same formula).
   const double kAlignTol = 1e-3;
+  const double kRelTol = 2.4e-7;
   int8_t* dc8 = static_cast<int8_t*>(dclose_out);
   int16_t* dc16 = static_cast<int16_t*>(dclose_out);
   uint8_t* ohl_w = static_cast<uint8_t*>(dohl_out);
@@ -177,60 +184,143 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
         const __m512 z2 = _mm512_loadu_ps(src + 32);
         const __m512 z3 = _mm512_loadu_ps(src + 48);
         const __m512 z4 = _mm512_loadu_ps(src + 64);
+        // masked-out lanes zero HERE (not in the sweeps): the sweeps stay
+        // single-type pure-float loops, and a NaN parked on a dead lane
+        // can never flag the batch (numpy-oracle semantics)
+        const __m128i mb = _mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(tm + blk * 16));
+        const __mmask16 live = _mm_test_epi8_mask(mb, mb);
         for (int f = 0; f < 5; ++f) {
           const __m512 a01 = _mm512_permutex2var_ps(z0, kDeint.i01[f], z1);
           const __m512 a23 = _mm512_permutex2var_ps(z2, kDeint.i23[f], z3);
           __m512 r = _mm512_permutex2var_ps(a01, kDeint.icomb[f], a23);
           r = _mm512_permutex2var_ps(r, kDeint.i4[f], z4);
-          _mm512_store_ps(outs[f] + blk * 16, r);
+          _mm512_store_ps(outs[f] + blk * 16, _mm512_maskz_mov_ps(live, r));
         }
       }
     }
 #else
     for (int64_t s = 0; s < kNSlots; ++s) {
-      of[s] = tb[s * kNFields + 0];
-      hf[s] = tb[s * kNFields + 1];
-      lf[s] = tb[s * kNFields + 2];
-      cf[s] = tb[s * kNFields + 3];
-      vf[s] = tb[s * kNFields + 4];
+      // masked lanes zero here so the sweeps are pure float loops (and a
+      // NaN parked on a dead lane can never flag the batch)
+      of[s] = tm[s] ? tb[s * kNFields + 0] : 0.0f;
+      hf[s] = tm[s] ? tb[s * kNFields + 1] : 0.0f;
+      lf[s] = tm[s] ? tb[s * kNFields + 2] : 0.0f;
+      cf[s] = tm[s] ? tb[s * kNFields + 3] : 0.0f;
+      vf[s] = tm[s] ? tb[s * kNFields + 4] : 0.0f;
     }
 #endif
     // |o/h/l| ticks beyond 2^22+32767 guarantee an int16 delta overflow
     // (|d| >= |field| - |close| > 32767 given the close <= 2^22 bound), so
     // rejecting them here is equivalent to the pass-2 dmax check while
     // keeping every int32 cast below in range. Volume (< 2^31) fits int32.
-    const double kCMax = static_cast<double>(1LL << 22);
-    const double kPMax = static_cast<double>((1LL << 22) + 32767);
-    const double kVMax = static_cast<double>(1LL << 31);
-    int bad = 0;
+    //
+    // Masked-out lanes select to 0.0 (not a multiply by 0, which would
+    // leak a NaN through), matching the numpy oracle: garbage on a masked
+    // lane is zeroed, never a reason to reject the batch. Validity checks
+    // are per-field negated comparisons so a NaN in ANY live field flags
+    // its lane (a running max would wash the NaN out after one step).
+    //
+    // Fast sweep in f32 (16 lanes/vector): exact for the bound checks
+    // (the bounds and every in-range rounded tick are f32-representable)
+    // and for volume (float minus its nearest integer is exact). The one
+    // inexact step is the price*inv_tick product, so the alignment test
+    // carries a +/- margin of 2 f32 ulps: lanes inside
+    // [tol - margin, tol + margin] are inconclusive and send the ticker
+    // to the double-precision sweep. Aligned prices stay conclusive at
+    // every magnitude below kBigF ticks (the relative tolerance grows in
+    // step with the f32 error), so in practice the double sweep runs only
+    // above ~20,000 CNY or on adversarial near-boundary values.
+    const float itF = static_cast<float>(inv_tick);
+    const float kTolF = 1e-3f;
+    const float kRelF = 2.4e-7f;   // relative term: 4 f32 ulps
+    const float kMargF = 1.2e-7f;  // 2 ulp of an f32 product
+    const float kCMaxF = static_cast<float>(1LL << 22);
+    const float kPMaxF = static_cast<float>((1LL << 22) + 32767);
+    const float kVMaxF = static_cast<float>(1LL << 31);
+    const float kVClampF = 2147483520.0f;  // largest f32 below 2^31
+    const float kBigF = 2.0e6f;  // ticks beyond which f32 accept is vacuous
+    int rej = 0, inc = 0;
     for (int64_t s = 0; s < kNSlots; ++s) {
-      const double m = tm[s] ? 1.0 : 0.0;
-      const double o = of[s] * inv_tick * m;
-      const double h = hf[s] * inv_tick * m;
-      const double l = lf[s] * inv_tick * m;
-      const double c = cf[s] * inv_tick * m;
-      const double v = static_cast<double>(vf[s]) * m;
-      const double ro = __builtin_rint(o), rh = __builtin_rint(h),
-                   rl = __builtin_rint(l), rc = __builtin_rint(c),
-                   rv = __builtin_rint(v);
-      double e = fabs(o - ro);
-      e = e > fabs(h - rh) ? e : fabs(h - rh);
-      e = e > fabs(l - rl) ? e : fabs(l - rl);
-      e = e > fabs(c - rc) ? e : fabs(c - rc);
-      e = e > fabs(v - rv) ? e : fabs(v - rv);
-      double p = fabs(ro);
-      p = p > fabs(rh) ? p : fabs(rh);
-      p = p > fabs(rl) ? p : fabs(rl);
-      const int lane_bad = !(e <= kAlignTol) | !(fabs(rc) <= kCMax) |
-                           !(p <= kPMax) | !(rv >= 0.0) | !(rv < kVMax);
-      bad |= lane_bad;
-      ot[s] = lane_bad ? 0 : static_cast<int32_t>(ro);
-      ht[s] = lane_bad ? 0 : static_cast<int32_t>(rh);
-      lt[s] = lane_bad ? 0 : static_cast<int32_t>(rl);
-      ct[s] = lane_bad ? 0 : static_cast<int32_t>(rc);
-      vt[s] = lane_bad ? 0 : static_cast<int32_t>(rv);
+      const float o = of[s] * itF, h = hf[s] * itF, l = lf[s] * itF,
+                  c = cf[s] * itF, v = vf[s];
+      const float ro = __builtin_rintf(o), rh = __builtin_rintf(h),
+                  rl = __builtin_rintf(l), rc = __builtin_rintf(c),
+                  rv = __builtin_rintf(v);
+      const float eo = fabsf(o - ro), eh = fabsf(h - rh),
+                  el = fabsf(l - rl), ec = fabsf(c - rc);
+      const float go = fabsf(o) * kMargF, gh = fabsf(h) * kMargF,
+                  gl = fabsf(l) * kMargF, gc = fabsf(c) * kMargF;
+      // per-field tolerance = absolute + relative (see kRelTol above);
+      // the +/- go margin brackets this sweep's own product rounding
+      const float to = kTolF + kRelF * fabsf(ro),
+                  th = kTolF + kRelF * fabsf(rh),
+                  tl = kTolF + kRelF * fabsf(rl),
+                  tc = kTolF + kRelF * fabsf(rc);
+      rej |= !(eo <= to + go) | !(eh <= th + gh) |
+             !(el <= tl + gl) | !(ec <= tc + gc) |
+             !(fabsf(v - rv) <= kTolF) |
+             !(fabsf(rc) <= kCMaxF) | !(fabsf(ro) <= kPMaxF) |
+             !(fabsf(rh) <= kPMaxF) | !(fabsf(rl) <= kPMaxF) |
+             !(v >= 0.0f) | !(rv < kVMaxF);
+      // "within tolerance => same integer as the double path" needs
+      // tol + margin < 0.5 tick; above kBigF ticks the band is vacuous
+      // (and f32/f64 rint can differ by one), so those lanes are always
+      // inconclusive and take the double sweep
+      inc |= (eo > to - go) | (eh > th - gh) | (el > tl - gl) |
+             (ec > tc - gc) |
+             !(fabsf(ro) <= kBigF) | !(fabsf(rh) <= kBigF) |
+             !(fabsf(rl) <= kBigF) | !(fabsf(rc) <= kBigF);
+      // clamped casts keep out-of-range/NaN lanes defined (such lanes
+      // always come with rej or inc set, so the values are never shipped).
+      // Ternary clamps, not fminf/fmaxf: the libm pair's IEEE NaN
+      // semantics block vectorization; the negated first compare sends a
+      // NaN to the clamp floor instead of through the cast.
+      const float co = !(ro > -kPMaxF) ? -kPMaxF : ro;
+      const float ch = !(rh > -kPMaxF) ? -kPMaxF : rh;
+      const float cl = !(rl > -kPMaxF) ? -kPMaxF : rl;
+      const float cc = !(rc > -kPMaxF) ? -kPMaxF : rc;
+      const float cv = !(rv > 0.0f) ? 0.0f : rv;
+      ot[s] = static_cast<int32_t>(co > kPMaxF ? kPMaxF : co);
+      ht[s] = static_cast<int32_t>(ch > kPMaxF ? kPMaxF : ch);
+      lt[s] = static_cast<int32_t>(cl > kPMaxF ? kPMaxF : cl);
+      ct[s] = static_cast<int32_t>(cc > kPMaxF ? kPMaxF : cc);
+      vt[s] = static_cast<int32_t>(cv > kVClampF ? kVClampF : cv);
     }
-    if (bad) return -1;
+    if (rej) return -1;
+    if (inc) {
+      // double-precision sweep: f32 couldn't separate the alignment
+      // tolerance from its own product rounding at this magnitude
+      const double kCMax = static_cast<double>(1LL << 22);
+      const double kPMax = static_cast<double>((1LL << 22) + 32767);
+      const double kVMax = static_cast<double>(1LL << 31);
+      int bad = 0;
+      for (int64_t s = 0; s < kNSlots; ++s) {
+        const double o = of[s] * inv_tick, h = hf[s] * inv_tick,
+                     l = lf[s] * inv_tick, c = cf[s] * inv_tick,
+                     v = static_cast<double>(vf[s]);
+        const double ro = __builtin_rint(o), rh = __builtin_rint(h),
+                     rl = __builtin_rint(l), rc = __builtin_rint(c),
+                     rv = __builtin_rint(v);
+        const int lane_bad =
+            !(fabs(o - ro) <= kAlignTol + kRelTol * fabs(ro)) |
+            !(fabs(h - rh) <= kAlignTol + kRelTol * fabs(rh)) |
+            !(fabs(l - rl) <= kAlignTol + kRelTol * fabs(rl)) |
+            !(fabs(c - rc) <= kAlignTol + kRelTol * fabs(rc)) |
+            !(fabs(v - rv) <= kAlignTol) |
+            !(fabs(rc) <= kCMax) | !(fabs(ro) <= kPMax) |
+            !(fabs(rh) <= kPMax) | !(fabs(rl) <= kPMax) |
+            !(v >= 0.0) | !(rv < kVMax);  // raw v: -0.0004 must reject
+            // (rv would round it to -0.0, which passes >= 0)
+        bad |= lane_bad;
+        ot[s] = lane_bad ? 0 : static_cast<int32_t>(ro);
+        ht[s] = lane_bad ? 0 : static_cast<int32_t>(rh);
+        lt[s] = lane_bad ? 0 : static_cast<int32_t>(rl);
+        ct[s] = lane_bad ? 0 : static_cast<int32_t>(rc);
+        vt[s] = lane_bad ? 0 : static_cast<int32_t>(rv);
+      }
+      if (bad) return -1;
+    }
 
     // pass 2a: previous-valid-close scan — the one genuinely sequential
     // dependency, kept to ~4 scalar int ops per slot.
@@ -358,6 +448,6 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
 }
 
 // Exported so Python can assert ABI compatibility at load time.
-int64_t grid_pack_abi_version() { return 8; }
+int64_t grid_pack_abi_version() { return 9; }
 
 }  // extern "C"

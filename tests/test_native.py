@@ -124,3 +124,156 @@ def test_abi_and_slot_formula_parity(rng):
     placed = np.flatnonzero(g.mask[0])
     np.testing.assert_array_equal(np.sort(placed),
                                   np.sort(want[want >= 0]))
+
+
+def _assert_wire_equal(a, b):
+    assert (a is None) == (b is None)
+    if a is None:
+        return
+    for x, y, nm in zip(a.arrays, b.arrays,
+                        ("base", "dclose", "dohl", "volume", "mask", "vs")):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.dtype == y.dtype, nm
+        np.testing.assert_array_equal(x, y, err_msg=nm)
+
+
+def test_masked_lane_garbage_is_zeroed_not_rejected(rng):
+    """Garbage (NaN/inf/off-tick) parked on a masked-OUT lane must not
+    reject the batch or change the encoding — the numpy oracle ignores
+    dead lanes entirely, and the native fast path must match."""
+    from replication_of_minute_frequency_factor_tpu.data import wire
+    cols = synth_day(rng, n_codes=6, missing_prob=0.2)
+    g = grid_day(cols["code"], cols["time"], cols["open"], cols["high"],
+                 cols["low"], cols["close"], cols["volume"])
+    bars, mask = g.bars[None].copy(), g.mask[None]
+    dead = np.argwhere(~mask[0])
+    assert len(dead) >= 3
+    bars[0][tuple(dead[0])] = np.nan
+    bars[0][tuple(dead[1])][3] = np.inf
+    bars[0][tuple(dead[2])][0] = 12.34567  # off-tick on a dead lane
+    a = wire.encode(bars, mask, use_native=True)
+    b = wire.encode(bars, mask, use_native=False)
+    assert a is not None
+    _assert_wire_equal(a, b)
+    clean = wire.encode(np.where(mask[..., None], bars, 0.0).astype(
+        np.float32), mask, use_native=True)
+    _assert_wire_equal(a, clean)
+
+
+def test_high_price_ticker_bit_parity(rng):
+    """A Moutai-class (~1700 CNY) ticker: the f32 sweep's relative
+    tolerance keeps it conclusive (no double fallback), and the encoding
+    must stay bit-identical to the numpy oracle."""
+    from replication_of_minute_frequency_factor_tpu.data import wire
+    cols = synth_day(rng, n_codes=6, missing_prob=0.1)
+    g = grid_day(cols["code"], cols["time"], cols["open"], cols["high"],
+                 cols["low"], cols["close"], cols["volume"])
+    bars, mask = g.bars[None].copy(), g.mask[None]
+    # rescale one ticker to ~1700 CNY (Moutai-class), tick-aligned
+    hot = np.round(bars[0, 2] * 37.0, 2).astype(np.float32)
+    bars[0, 2] = np.where(mask[0, 2, :, None], hot, 0.0)
+    a = wire.encode(bars, mask, use_native=True)
+    b = wire.encode(bars, mask, use_native=False)
+    _assert_wire_equal(a, b)
+    if a is not None:
+        dec, dm = wire.decode(*a.arrays)
+        np.testing.assert_allclose(
+            np.asarray(dec)[0, 2][mask[0, 2]],
+            bars[0, 2][mask[0, 2]], rtol=3e-7)
+    # an off-tick value on the high-priced ticker still rejects via the
+    # double sweep (both paths agree)
+    vi = np.argwhere(mask[0, 2])
+    bad = bars.copy()
+    bad[0, 2][tuple(vi[0])][3] = bad[0, 2][tuple(vi[0])][3] + 0.005
+    assert wire.encode(bad, mask, use_native=True) is None
+    assert wire.encode(bad, mask, use_native=False) is None
+
+
+def test_fractional_large_volume_rejected_by_both(rng):
+    """4194304.5 is f32-representable (spacing 0.5 between 2^22 and 2^23):
+    an absolute integrality check must reject it on both paths — an
+    implicit relative tolerance would wave it through."""
+    from replication_of_minute_frequency_factor_tpu.data import wire
+    cols = synth_day(rng, n_codes=4)
+    g = grid_day(cols["code"], cols["time"], cols["open"], cols["high"],
+                 cols["low"], cols["close"], cols["volume"])
+    bars, mask = g.bars[None].copy(), g.mask[None]
+    vi = np.argwhere(mask[0])
+    bars[0][tuple(vi[0])][4] = 4194304.5
+    assert wire.encode(bars, mask, use_native=True) is None
+    assert wire.encode(bars, mask, use_native=False) is None
+
+
+def test_boundary_tick_magnitudes_stay_bit_parity(rng):
+    """Near the 2^22-tick close bound the f32 product can round to a
+    different integer tick than the double path; those magnitudes must
+    route to the double sweep so native stays bit-identical to numpy."""
+    from replication_of_minute_frequency_factor_tpu.data import wire
+    cols = synth_day(rng, n_codes=4, missing_prob=0.1)
+    g = grid_day(cols["code"], cols["time"], cols["open"], cols["high"],
+                 cols["low"], cols["close"], cols["volume"])
+    bars, mask = g.bars[None].copy(), g.mask[None]
+    # park one ticker just under the 2^22 tick cap (~41942 CNY) with
+    # deliberately non-grid f32 PRICES (volume untouched — a scaled
+    # volume would reject both paths and make the test vacuous): the
+    # magnitude-relative tolerance exceeds one tick up there, so both
+    # paths accept and must agree on every rounded tick
+    t = bars[0, 1]
+    scale = 41942.0 / np.maximum(t[..., 3:4], 1e-6)
+    bars[0, 1, :, :4] = np.where(mask[0, 1, :, None],
+                                 (t[..., :4] * scale).astype(np.float32),
+                                 0.0)
+    a = wire.encode(bars, mask, use_native=True)
+    assert a is not None, "boundary batch must actually encode"
+    a = wire.encode(bars, mask, use_native=True)
+    b = wire.encode(bars, mask, use_native=False)
+    _assert_wire_equal(a, b)
+
+
+def test_tiny_negative_volume_rejected_by_both(rng):
+    """-0.0004 volume rounds to -0.0 which passes a rounded >=0 check;
+    the sign test must use the RAW value so native matches the numpy
+    oracle's vv.min() < 0 rejection."""
+    from replication_of_minute_frequency_factor_tpu.data import wire
+    cols = synth_day(rng, n_codes=4)
+    g = grid_day(cols["code"], cols["time"], cols["open"], cols["high"],
+                 cols["low"], cols["close"], cols["volume"])
+    bars, mask = g.bars[None].copy(), g.mask[None]
+    vi = np.argwhere(mask[0])
+    bars[0][tuple(vi[0])][4] = -0.0004
+    assert wire.encode(bars, mask, use_native=True) is None
+    assert wire.encode(bars, mask, use_native=False) is None
+    # exact -0.0 is a legitimate zero volume for both
+    bars[0][tuple(vi[0])][4] = -0.0
+    a = wire.encode(bars, mask, use_native=True)
+    b = wire.encode(bars, mask, use_native=False)
+    _assert_wire_equal(a, b)
+    assert a is not None
+
+
+def test_double_sweep_covered_and_bit_parity(rng):
+    """Above kBigF=2e6 ticks (> ~20,000 CNY) every lane routes to the
+    double-precision sweep; its output must be bit-identical to the (f64)
+    numpy oracle, and off-tick values there must still reject."""
+    from replication_of_minute_frequency_factor_tpu.data import wire
+    cols = synth_day(rng, n_codes=4, missing_prob=0.1)
+    g = grid_day(cols["code"], cols["time"], cols["open"], cols["high"],
+                 cols["low"], cols["close"], cols["volume"])
+    bars, mask = g.bars[None].copy(), g.mask[None]
+    t = bars[0, 1]
+    scale = 30000.0 / np.maximum(t[..., 3:4], 1e-6)  # ~3e6 ticks
+    bars[0, 1, :, :4] = np.where(mask[0, 1, :, None],
+                                 (t[..., :4] * scale).astype(np.float32),
+                                 0.0)
+    a = wire.encode(bars, mask, use_native=True)
+    b = wire.encode(bars, mask, use_native=False)
+    assert a is not None, "3e6-tick batch must encode via the double sweep"
+    _assert_wire_equal(a, b)
+    # a price pushed >1.6 ticks off-grid at this magnitude (beyond the
+    # ~1.72-tick relative tolerance needs >... use 3 ticks) must reject
+    bad = bars.copy()
+    vi = np.argwhere(mask[0, 1])
+    bad[0, 1][tuple(vi[0])][3] += 0.03 * 1.5  # 4.5 ticks off at f32 scale
+    ra = wire.encode(bad, mask, use_native=True)
+    rb = wire.encode(bad, mask, use_native=False)
+    assert (ra is None) == (rb is None)
