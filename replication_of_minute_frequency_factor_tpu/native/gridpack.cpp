@@ -13,7 +13,8 @@
 #include <cmath>
 #include <cstdint>
 
-#if defined(__AVX512F__)
+#if defined(__AVX512F__) && defined(__AVX512BW__) && \
+    defined(__AVX512VL__)
 #include <immintrin.h>
 #endif
 
@@ -37,7 +38,8 @@ inline int64_t TimeToSlot(int64_t t) {
   return -1;
 }
 
-#if defined(__AVX512F__)
+#if defined(__AVX512F__) && defined(__AVX512BW__) && \
+    defined(__AVX512VL__)
 // Index vectors for the 5x16 deinterleave transpose: each 80-float block
 // (16 slots x 5 interleaved fields) lands in five zmm registers; four
 // two-source permutes per field funnel the stride-5 lanes into one
@@ -174,7 +176,8 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
         vf[kNSlots];
     alignas(64) int32_t ot[kNSlots], ht[kNSlots], lt[kNSlots], ct[kNSlots],
         vt[kNSlots];
-#if defined(__AVX512F__)
+#if defined(__AVX512F__) && defined(__AVX512BW__) && \
+    defined(__AVX512VL__)
     {
       float* outs[5] = {of, hf, lf, cf, vf};
       for (int64_t blk = 0; blk < kNSlots / 16; ++blk) {
@@ -287,7 +290,9 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
       ct[s] = static_cast<int32_t>(cc > kPMaxF ? kPMaxF : cc);
       vt[s] = static_cast<int32_t>(cv > kVClampF ? kVClampF : cv);
     }
-    if (rej) return -1;
+    // inc outranks rej: every f32-only spurious rejection (tick
+    // rounding at the kPMax/kCMax boundary above kBigF) also sets inc on
+    // that lane, and the double sweep reproduces every genuine one
     if (inc) {
       // double-precision sweep: f32 couldn't separate the alignment
       // tolerance from its own product rounding at this magnitude
@@ -320,6 +325,8 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
         vt[s] = lane_bad ? 0 : static_cast<int32_t>(rv);
       }
       if (bad) return -1;
+    } else if (rej) {
+      return -1;
     }
 
     // pass 2a: previous-valid-close scan — the one genuinely sequential

@@ -77,15 +77,25 @@ def _compute_packed_jit(buf, spec, kind, names, replicate_quirks,
     return jnp.stack([out[n] for n in names])
 
 
-def compute_packed(arrays, kind, names, replicate_quirks=True,
-                   rolling_impl=None):
-    """Host entry for the packed path: pack -> one device_put -> fused
-    graph -> stacked [len(names), D, T] result (still on device)."""
+def compute_packed_prepared(buf, spec, kind, names, replicate_quirks=True,
+                            rolling_impl=None):
+    """Device half of the packed path: one device_put of an already-packed
+    buffer -> fused graph -> stacked [len(names), D, T] result (still on
+    device). The streaming pipeline packs on its producer thread and
+    calls this from the consumer, so the multi-MB host concatenate
+    overlaps device compute."""
     if rolling_impl is None:
         rolling_impl = get_config().rolling_impl
-    buf, spec = wire.pack_arrays(arrays)
     return _compute_packed_jit(jax.device_put(buf), spec, kind, names,
                                replicate_quirks, rolling_impl)
+
+
+def compute_packed(arrays, kind, names, replicate_quirks=True,
+                   rolling_impl=None):
+    """One-call packed path: pack + transfer + compute (see above)."""
+    buf, spec = wire.pack_arrays(arrays)
+    return compute_packed_prepared(buf, spec, kind, names,
+                                   replicate_quirks, rolling_impl)
 from .utils.logging import get_logger, FailureReport
 from .utils.tracing import Timer, trace_annotation
 
@@ -263,7 +273,19 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
                 if cfg.wire_transfer:
                     with timer("wire_encode"):
                         w = wire.encode(bars, mask, floor=wire_floor)
-                if w is not None:
+                if mesh is None:
+                    # single-device: pack HERE so the multi-MB host
+                    # concatenate overlaps device compute; ship one
+                    # (buf, spec, kind) triple through the queue
+                    with timer("pack"):
+                        if w is not None:
+                            w = wire.pack_arrays(w.arrays) + ("wire",)
+                        else:
+                            w = wire.pack_arrays(
+                                (bars, np.asarray(mask).view(np.uint8))
+                            ) + ("raw",)
+                    bars = mask = None
+                elif w is not None:
                     # the raw grid is only a fallback for unrepresentable
                     # batches; don't keep ~4 uncompressed copies alive in
                     # the queue + in-flight slots
@@ -281,19 +303,14 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
         dates, codes, present, w, bars, mask = item
         with trace_annotation("factor_batch"):
             if mesh is None:
-                # single-device: one packed buffer in, one stacked tensor
-                # out — one tunnel round trip each way per batch
-                if w is not None:
-                    out = compute_packed(
-                        w.arrays, "wire", names=names,
-                        replicate_quirks=cfg.replicate_quirks,
-                        rolling_impl=cfg.rolling_impl)
-                else:
-                    out = compute_packed(
-                        (bars, np.asarray(mask).view(np.uint8)), "raw",
-                        names=names,
-                        replicate_quirks=cfg.replicate_quirks,
-                        rolling_impl=cfg.rolling_impl)
+                # single-device: one packed buffer in (packed on the
+                # producer thread), one stacked tensor out — one tunnel
+                # round trip each way per batch
+                buf, spec, kind = w
+                out = compute_packed_prepared(
+                    buf, spec, kind, names=names,
+                    replicate_quirks=cfg.replicate_quirks,
+                    rolling_impl=cfg.rolling_impl)
             elif w is not None:
                 arrs = wire.put(w, shardings)
                 out = _compute_from_wire(
