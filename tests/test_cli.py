@@ -1,0 +1,112 @@
+"""CLI driver (``__main__.py``): the notebook-replacement workflow
+compute -> evaluate -> list, end to end on synthetic day files."""
+
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from replication_of_minute_frequency_factor_tpu.__main__ import main
+from replication_of_minute_frequency_factor_tpu.data.synthetic import synth_day
+
+
+@pytest.fixture
+def workspace(tmp_path, rng):
+    kline = tmp_path / "kline"
+    kline.mkdir()
+    days = ["2024-01-02", "2024-01-03", "2024-01-04", "2024-01-05",
+            "2024-01-08", "2024-01-09", "2024-01-10", "2024-01-11"]
+    codes = None
+    for ds in days:
+        cols = synth_day(rng, n_codes=8, date=ds, missing_prob=0.05)
+        arrays = {"code": pa.array([str(c) for c in cols["code"]]),
+                  "time": pa.array(cols["time"])}
+        for k in ("open", "high", "low", "close", "volume"):
+            arrays[k] = pa.array(cols[k])
+        pq.write_table(pa.table(arrays),
+                       str(kline / (ds.replace("-", "") + ".parquet")))
+        codes = sorted({str(c) for c in cols["code"]})
+    dd = np.array(days, dtype="datetime64[D]")
+    rows = {k: [] for k in ("code", "date", "pct_change", "tmc", "cmc")}
+    for c in codes:
+        rows["code"] += [c] * len(dd)
+        rows["date"].append(dd)
+        rows["pct_change"].append(rng.normal(0, 0.01, len(dd)))
+        mc = rng.uniform(1e9, 5e10)
+        rows["tmc"].append(np.full(len(dd), mc))
+        rows["cmc"].append(np.full(len(dd), mc * 0.7))
+    pv = str(tmp_path / "pv.parquet")
+    pq.write_table(pa.table({
+        "code": pa.array(rows["code"]),
+        "date": pa.array(np.concatenate(rows["date"])),
+        "pct_change": pa.array(np.concatenate(rows["pct_change"])),
+        "tmc": pa.array(np.concatenate(rows["tmc"])),
+        "cmc": pa.array(np.concatenate(rows["cmc"])),
+    }), pv)
+    return str(kline), pv, str(tmp_path / "factors.parquet"), str(tmp_path)
+
+
+def test_compute_then_evaluate(workspace, capsys):
+    kline, pv, cache, tmp = workspace
+    rc = main(["compute", "--minute-dir", kline, "--cache", cache,
+               "--factors", "vol_return1min,mmt_pm", "--days-per-batch",
+               "2", "--quiet"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["days"] == 8 and out["factors"] == 2
+    assert os.path.exists(cache)
+
+    plots = os.path.join(tmp, "charts")
+    rc = main(["evaluate", "--factor", "vol_return1min", "--cache", cache,
+               "--daily-pv", pv, "--future-days", "1",
+               "--frequency", "week", "--plots", plots])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert np.isfinite(out["IC"]) and np.isfinite(out["rank_ICIR"])
+    for kind in ("coverage", "ic", "group"):
+        assert os.path.exists(
+            os.path.join(plots, f"vol_return1min_{kind}.png")), kind
+
+    # unknown factor: clean error, not a traceback
+    rc = main(["evaluate", "--factor", "nope", "--cache", cache,
+               "--daily-pv", pv])
+    assert rc == 2
+
+
+def test_list_factors(capsys):
+    assert main(["list-factors", "--json"]) == 0
+    names = json.loads(capsys.readouterr().out)
+    assert len(names) == 58 and "doc_kurt" in names
+
+
+def test_compute_rejects_unknown_factor(workspace, capsys):
+    kline, _, cache, _ = workspace
+    rc = main(["compute", "--minute-dir", kline, "--cache", cache,
+               "--factors", "vol_return1mim", "--quiet"])
+    assert rc == 2
+    assert not os.path.exists(cache)
+
+
+def test_evaluate_disjoint_pv_reports_null_stats(workspace, capsys,
+                                                 tmp_path):
+    """No shared (code, date) cross-section: stats must come back null
+    (not a float(None) traceback)."""
+    kline, pv, cache, tmp = workspace
+    assert main(["compute", "--minute-dir", kline, "--cache", cache,
+                 "--factors", "mmt_pm", "--quiet"]) == 0
+    capsys.readouterr()
+    other = str(tmp_path / "pv_other.parquet")
+    dd = np.array(["2030-01-02", "2030-01-03"], dtype="datetime64[D]")
+    pq.write_table(pa.table({
+        "code": pa.array(["999999"] * 2), "date": pa.array(dd),
+        "pct_change": pa.array([0.01, -0.01]),
+        "tmc": pa.array([1e9, 1e9]), "cmc": pa.array([7e8, 7e8]),
+    }), other)
+    rc = main(["evaluate", "--factor", "mmt_pm", "--cache", cache,
+               "--daily-pv", other])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["IC"] is None and out["rank_ICIR"] is None
