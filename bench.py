@@ -82,8 +82,14 @@ def main():
     import queue
     import threading
 
+    from replication_of_minute_frequency_factor_tpu.config import (
+        apply_compilation_cache, get_config)
     from replication_of_minute_frequency_factor_tpu.pipeline import (
         compute_packed_prepared)
+
+    # persistent XLA cache (when configured) turns the ~20-40s warmup
+    # compile into a disk hit on repeat runs
+    apply_compilation_cache(get_config())
 
     rng = np.random.default_rng(0)
     names = factor_names()

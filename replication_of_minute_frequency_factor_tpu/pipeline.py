@@ -25,7 +25,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from .config import Config, get_config
+from .config import Config, apply_compilation_cache, get_config
 from .data import io as dio
 from .data import wire
 from .data.minute import grid_day
@@ -373,6 +373,7 @@ def compute_exposures(
     * ``fault_hook(date)`` is the fault-injection test hook (SURVEY.md §5).
     """
     cfg = cfg or get_config()
+    apply_compilation_cache(cfg)
     minute_dir = minute_dir or cfg.minute_dir
     names = tuple(names) if names is not None else factor_names()
 

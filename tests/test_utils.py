@@ -67,3 +67,40 @@ def test_pipeline_debug_validate_and_timings(tmp_path, rng):
     t = compute_exposures(str(d), ("mmt_am",), cfg=cfg, progress=False)
     assert len(t) > 0
     assert {"io", "grid", "device"} <= set(t.timings)
+
+
+def test_compilation_cache_populates(tmp_path):
+    """Config.compilation_cache_dir routes compiled executables to disk:
+    after one pipeline-style jitted call, the directory must hold cache
+    entries (so driver re-runs skip the fused-graph compile)."""
+    import jax
+    import jax.numpy as jnp
+
+    from replication_of_minute_frequency_factor_tpu.config import (
+        Config, apply_compilation_cache)
+
+    d = str(tmp_path / "xla_cache")
+    prev_t = jax.config.jax_persistent_cache_min_compile_time_secs
+    prev_s = jax.config.jax_persistent_cache_min_entry_size_bytes
+    apply_compilation_cache(Config(compilation_cache_dir=d))
+    # production code leaves persistence thresholds alone; drop them so
+    # this sub-second CPU graph persists
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    try:
+        @jax.jit
+        def f(x):
+            return (x * 3.0 + 1.0).sum()
+
+        f(jnp.arange(128, dtype=jnp.float32)).block_until_ready()
+        import os
+        assert os.path.isdir(d) and len(os.listdir(d)) > 0
+    finally:
+        # an unset-dir call must restore the pre-mutation state (the
+        # sticky-global regression this guards against)
+        apply_compilation_cache(Config())
+        assert jax.config.jax_compilation_cache_dir != d
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prev_t)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          prev_s)
