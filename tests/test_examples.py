@@ -1,0 +1,24 @@
+"""Both examples must run end to end as real subprocesses (the docs
+point users at them; a stale API reference dies here, not on a user)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("script", ["quickstart.py",
+                                    "advanced_evaluation.py"])
+def test_example_runs(script, tmp_path):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env["PYTHONPATH"] = os.pathsep.join(
+        filter(None, [REPO, env.get("PYTHONPATH")]))
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=280, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
