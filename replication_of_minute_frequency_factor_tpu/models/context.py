@@ -160,7 +160,18 @@ class DayContext:
         return self._get("rolling_beta", f)
 
     def beta_moments(self):
-        """(mean, std ddof=1, last, n_windows) of beta over valid windows."""
+        """(mean, std ddof=1, last, n_windows) of beta over valid windows.
+
+        ``std`` snaps to exactly 0 below f32 resolution (16 ulps of the
+        beta scale): when two windows' betas are EQUAL in exact
+        arithmetic — e.g. the dropped bar's (low, high) coincides with
+        the added bar's, fuzz seed 739 — the f64 oracle computes std==0
+        and takes the degenerate branch of ``mmt_ols_qrs``/
+        ``mmt_ols_beta_zscore_last``, while f32 conv round-off yields a
+        tiny nonzero std whose z-scores are pure noise amplification. A
+        sub-resolution std asserts a spread f32 cannot distinguish, so
+        reporting 0 is the honest value (and matches the oracle's
+        branch)."""
         def f():
             st = self.rolling50
             valid, beta = st["valid"], self.rolling_beta
@@ -168,6 +179,9 @@ class DayContext:
             mean = masked_mean(beta, valid)
             std = masked_std(beta, valid)
             last = masked_last(beta, valid)
+            scale = jnp.maximum(jnp.abs(mean), jnp.abs(last))
+            std = jnp.where(std <= 16 * jnp.finfo(jnp.float32).eps * scale,
+                            0.0, std)
             return mean, std, last, n
         return self._get("beta_moments", f)
 

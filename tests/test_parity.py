@@ -28,6 +28,18 @@ ATOL = {
     "shape_skratio": 1e-4, "shape_skratioVol": 1e-4,
     "doc_skew": 1e-3, "doc_kurt": 5e-3, "doc_std": 1e-3,
     "mmt_ols_qrs": 1e-4, "mmt_ols_beta_zscore_last": 1e-4,
+    # Pearson correlations are dimensionless in [-1, 1]; when the true
+    # correlation is ~0 the f32 covariance is a near-cancelling 240-term
+    # sum, so the ABSOLUTE error bound is ~n*eps_f32 ~ 1.4e-5 while the
+    # relative error is unbounded (fuzz seeds 206/217/218: |r| ~ 1e-4
+    # with ~3e-6 absolute diffs). 3e-5 keeps the check sharp everywhere
+    # a correlation is distinguishable from zero.
+    "corr_prv": 3e-5, "corr_prvr": 3e-5, "corr_pv": 3e-5,
+    "corr_pvd": 3e-5, "corr_pvl": 3e-5, "corr_pvr": 3e-5,
+    # mean of ret/volume-share terms that can nearly cancel: absolute
+    # error ~ max|term|*n*eps_f32 ~ 1e-5 when the mean lands near zero
+    # (fuzz seed 330: value -5.6e-4, diff 3e-6)
+    "trade_top20retRatio": 1e-5, "trade_top50retRatio": 1e-5,
 }
 
 # On short rounded-price days these stds/moments are pure tick-rounding
@@ -62,10 +74,51 @@ RTOL_OVERRIDE = {
 #: whole percents there. Both moments are still compared individually at
 #: sharp tolerances — only the ratio is skipped.
 DEGENERATE_KURT = 0.05
+#: beta z-score numerator below which the mmt_ols z family is f32 noise:
+#: each window's beta carries ~1e-6 relative f32 error (conv formulation,
+#: ops/rolling.py), so when the oracle's own |beta_last - beta_mean| is
+#: under 1e-5 of the beta scale the numerator is entirely inside that
+#: noise and (beta_last-mean)/std is unreproducible at f32 regardless of
+#: how healthy std is (fuzz seed 850: numerator 8.1e-6, qrs 3.5% off;
+#: seed 982: numerator 1.9e-6, qrs 53% off). beta_mean itself is still
+#: compared sharply — only the z-score factors skip.
+DEGENERATE_BETA_Z = 1e-5
+#: ALSO skip when the oracle's own beta std sits near the product's f32
+#: sub-resolution snap (context.beta_moments: std <= 16 ulp of scale
+#: snaps to 0): in that band the two sides legitimately take different
+#: branches (f64 std is exactly nonzero, f32 std snapped), so the
+#: z-score/qrs values are incomparable by construction. 64 ulps covers
+#: the snap boundary with margin.
+DEGENERATE_BETA_STD = 64 * np.finfo(np.float32).eps
+
+
+def _degenerate_beta_codes(df):
+    """Codes whose oracle beta z numerator is sub-noise (see above)."""
+    from replication_of_minute_frequency_factor_tpu.oracle.kernels import (
+        Group, _beta, _rolling50)
+    out = set()
+    for code, sub in df.sort_values("time").groupby("code"):
+        g = Group(sub["time"].to_numpy(), sub["open"].to_numpy(),
+                  sub["high"].to_numpy(), sub["low"].to_numpy(),
+                  sub["close"].to_numpy(), sub["volume"].to_numpy())
+        st = _rolling50(g)
+        if len(st["var_x"]) < 2:
+            continue
+        b = _beta(st)
+        num = abs(float(b[-1]) - float(np.mean(b)))
+        std = float(np.std(b, ddof=1))
+        scale = max(abs(float(np.mean(b))), abs(float(b[-1])), 1e-30)
+        if (not np.isfinite(num) or num < DEGENERATE_BETA_Z * scale
+                or std < DEGENERATE_BETA_STD * scale):
+            out.add(code)
+    return out
 #: rank-unit allowance for doc_pdf* under noisy scenarios: a cumulative
 #: share within float rounding of the quantile edge crosses one unique-
-#: return group earlier/later; systematic errors are hundreds of units
-PDF_RANK_SLACK = 6.0
+#: return group earlier/later, shifting the result by that group's
+#: average-rank midpoint — up to half the tie-group size (fuzz seed 781:
+#: a 27-member tie group moved doc_pdf95 by 13.5). Systematic errors are
+#: hundreds of units.
+PDF_RANK_SLACK = 20.0
 
 
 def _check(label, name, code, ov, jvv, noisy, failures, aux=None):
@@ -101,6 +154,7 @@ def _check(label, name, code, ov, jvv, noisy, failures, aux=None):
 def _compare(day, label, noisy=False):
     df = pd.DataFrame(day)
     oracle = compute_oracle(df).set_index("code")
+    beta_degenerate = _degenerate_beta_codes(df)
     g = grid_day(day["code"], day["time"], day["open"], day["high"],
                  day["low"], day["close"], day["volume"])
     jax_out = {k: np.asarray(v)
@@ -110,6 +164,9 @@ def _compare(day, label, noisy=False):
     failures = []
     for name in factor_names():
         for ti, code in enumerate(g.codes):
+            if (name in ("mmt_ols_qrs", "mmt_ols_beta_zscore_last")
+                    and code in beta_degenerate):
+                continue  # z-score of sub-noise beta spread; see above
             in_oracle = code in oracle.index
             ov = oracle.loc[code, name] if in_oracle else np.nan
             aux = ({k: oracle.loc[code, k]
@@ -148,11 +205,18 @@ def test_parity_kitchen_sink(seed):
         f"sink{seed}", noisy=True)
 
 
-@pytest.mark.parametrize("seed", [116, 120])
+@pytest.mark.parametrize("seed", [116, 120, 206, 217, 218, 330, 739, 781,
+                                  850, 982])
 def test_parity_boundary_regressions(seed):
     """Seeds found by fuzzing that land exactly on precision boundaries:
     116 (near-zero kurtosis -> degenerate skratio), 120 (volume-share
-    cumsum within rounding of the doc_pdf80 edge)."""
+    cumsum within rounding of the doc_pdf80 edge), 206/217/218
+    (near-zero Pearson correlations where f32 cancellation makes the
+    relative error unbounded — see the corr_* ATOL entries), 330
+    (near-cancelling trade_top20retRatio mean), 739 (two windows with
+    exactly-equal betas: the beta_std sub-resolution snap), 781 (a
+    27-member tie group at the doc_pdf95 edge), 850/982 (sub-noise beta
+    z-score numerators — DEGENERATE_BETA_Z)."""
     rng = np.random.default_rng(seed)
     _compare(
         synth_day(rng, n_codes=10, missing_prob=0.12, zero_volume_prob=0.12,
