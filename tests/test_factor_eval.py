@@ -189,3 +189,31 @@ def test_three_chart_types_render_headless(tmp_path, pv_setup, rng):
     import os
     for p in (p_cov, p_ic, p_grp):
         assert os.path.getsize(p) > 5_000, p
+
+
+def test_qcut_polars_duplicate_break_semantics(rng):
+    """Reference quirk Q11: polars qcut(allow_duplicates=True) KEEPS
+    duplicate quantile breakpoints — tied data yields gapped (not
+    compacted) labels, and a degenerate cross-section (one valid value,
+    or all values equal) lands in bin 0 rather than pandas' NaN."""
+    # heavy ties: labels must equal first-bin searchsorted over
+    # uncollapsed linear-interpolation breaks
+    x = np.round(rng.normal(0, 1, (3, 40)), 1).astype(np.float32)
+    m = rng.random((3, 40)) > 0.2
+    k = 7
+    labels = np.asarray(eval_ops.qcut_labels(np.nan_to_num(x), m, k))
+    for d in range(3):
+        xs = x[d, m[d]].astype(np.float64)
+        breaks = np.quantile(xs, [(i + 1) / k for i in range(k - 1)])
+        np.testing.assert_array_equal(
+            labels[d][m[d]], np.searchsorted(breaks, xs, side="left"))
+    # single valid value -> bin 0 (polars), not dropped
+    m1 = np.zeros((1, 8), bool)
+    m1[0, 3] = True
+    l1 = np.asarray(eval_ops.qcut_labels(np.ones((1, 8), np.float32), m1, 5))
+    assert l1[0, 3] == 0
+    # all-equal cross-section -> every valid lane bin 0
+    me = np.ones((1, 8), bool)
+    le = np.asarray(eval_ops.qcut_labels(
+        np.full((1, 8), 2.5, np.float32), me, 4))
+    assert (le[0] == 0).all()

@@ -93,7 +93,12 @@ DEGENERATE_BETA_STD = 64 * np.finfo(np.float32).eps
 
 
 def _degenerate_beta_codes(df):
-    """Codes whose oracle beta z numerator is sub-noise (see above)."""
+    """Codes whose oracle beta z numerator is sub-noise (see above).
+
+    Re-runs the oracle's rolling pass per code (compute_oracle's memoised
+    Groups aren't exposed); a deliberate duplication — ~1s per _compare —
+    to keep the skip policy test-side instead of widening the oracle API.
+    """
     from replication_of_minute_frequency_factor_tpu.oracle.kernels import (
         Group, _beta, _rolling50)
     out = set()

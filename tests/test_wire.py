@@ -187,3 +187,56 @@ def test_packed_compute_matches_dict_paths(batch):
     for j, n in enumerate(names):
         np.testing.assert_allclose(got_raw[j], got[j], rtol=2e-5, atol=1e-7,
                                    err_msg=n)
+
+
+def test_wire_fuzz_native_numpy_byte_parity():
+    """Compact randomized sweep (the long-run version cleared 700 seeds):
+    random shapes, price scales from 0.05 to 41000 CNY, volume modes,
+    halts, dead-lane garbage, off-tick poison — native and numpy must
+    agree byte-for-byte (including widen floors and None verdicts) and
+    decode must round-trip within 3e-7."""
+    from replication_of_minute_frequency_factor_tpu import native
+    if not native.available():
+        pytest.skip("no C++ toolchain")
+    for seed in range(24):
+        rng = np.random.default_rng(9000 + seed)
+        D = int(rng.integers(1, 3))
+        T = int(rng.integers(2, 12))
+        base_price = float(rng.choice([0.05, 12.0, 300.0, 1700.0, 41000.0]))
+        shape = (D, T, 240)
+        close = base_price * np.exp(np.cumsum(
+            rng.normal(0, rng.choice([1e-4, 5e-3]), shape), -1))
+        open_ = close * (1 + rng.normal(0, 1e-4, shape))
+        high = np.maximum(open_, close) * (
+            1 + np.abs(rng.normal(0, 2e-4, shape)))
+        low = np.minimum(open_, close) * (
+            1 - np.abs(rng.normal(0, 2e-4, shape)))
+        volume = (rng.integers(0, 1000, shape) *
+                  int(rng.choice([1, 100, 100000]))).astype(np.float64)
+        bars = np.stack([open_, high, low, close, volume], -1)
+        bars[..., :4] = np.round(bars[..., :4], 2)
+        bars = np.maximum(bars, 0.01 * (np.arange(5) < 4)).astype(np.float32)
+        mask = rng.random(shape) > rng.choice([0.0, 0.05, 0.5])
+        if rng.random() < 0.4:
+            mask[:, int(rng.integers(0, T))] = False  # halt
+            dead = np.argwhere(~mask)
+            bars[tuple(dead[0])] = np.nan  # dead-lane garbage
+        if rng.random() < 0.25:
+            live = np.argwhere(mask)
+            if len(live):
+                bars[tuple(live[0])][3] += 0.003  # off-tick poison
+        fa, fb = {}, {}
+        a = wire.encode(bars, mask, use_native=True, floor=fa)
+        b = wire.encode(bars, mask, use_native=False, floor=fb)
+        assert (a is None) == (b is None), seed
+        if a is None:
+            continue
+        assert fa == fb, seed
+        for x, y in zip(a.arrays, b.arrays):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                          err_msg=str(seed))
+        dec, dm = wire.decode(*a.arrays)
+        assert np.array_equal(np.asarray(dm), mask), seed
+        err = np.abs(np.asarray(dec)[mask] - bars[mask]) / np.maximum(
+            np.abs(bars[mask]), 1e-6)
+        assert err.max() < 3e-7, (seed, err.max())
