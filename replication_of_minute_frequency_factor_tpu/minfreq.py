@@ -162,24 +162,38 @@ class MinFreqFactor(Factor):
                 mean = s / cnt
                 std1 = np.sqrt(np.maximum(ss - cnt * mean**2, 0.0)
                                / (cnt - 1))
+            # exactly-constant groups: sum-of-squares rounding can leave a
+            # tiny nonzero std (turning the z-score's 0/0 into garbage);
+            # segment min==max detects them exactly. cnt==1 keeps its NaN
+            # std (ddof=1), matching polars' null.
+            smin = np.full(n, np.inf)
+            smax = np.full(n, -np.inf)
+            np.minimum.at(smin, seg[~nanv], v[~nanv])
+            np.maximum.at(smax, seg[~nanv], v[~nanv])
+            const_s = (cnt > 0) & (smin == smax)
+            mean = np.where(const_s, smin, mean)
+            std1 = np.where(const_s & (cnt > 1), 0.0, std1)
             # 'last' skips NaN like polars .last() skips... (polars last()
             # returns the literal last element; NaN rows were never written
             # by the pipeline as nulls — keep literal last)
             last = frames.segment_last(v, seg, n)
-            if method == "o":
-                out = last
-            elif method == "m":
-                out = mean
-            elif method == "z":
-                out = (last - mean) / std1
-            else:
-                out = std1
+            with np.errstate(invalid="ignore", divide="ignore"):
+                if method == "o":
+                    out = last
+                elif method == "m":
+                    out = mean
+                elif method == "z":
+                    out = (last - mean) / std1  # 0/0 (constant) -> NaN
+                else:
+                    out = std1
             out_code = frames.segment_last(np.asarray(code, object)[order],
                                            seg, n)
             out_date = frames.segment_last(period[order], seg, n)
             new_name = f"{frequency}_{self.factor_name}_{method}"
         elif mode == "days":
             t = int(frequency)
+            if t < 1:
+                raise ValueError(f"rolling window must be >= 1 day, got {t}")
             order = np.lexsort((date, code))
             c, v = np.asarray(code, object)[order], val[order]
             grp_start = np.r_[True, c[1:] != c[:-1]]
@@ -201,6 +215,23 @@ class MinFreqFactor(Factor):
                 mean = wsum / t
                 var0 = np.maximum(wss / t - mean**2, 0.0)  # ddof=0 (:222,234)
                 std0 = np.sqrt(var0)
+            # Exactly-constant windows (every window when t == 1):
+            # prefix-sum differencing cannot represent their zero variance
+            # — cs rounding leaves std0 tiny-nonzero or mean != v, turning
+            # the z-score's 0/0 into garbage. A window ending at idx is
+            # constant iff the run of adjacent-equal non-NaN values ending
+            # there spans it (O(n), vs O(n*t) windowed min/max); its mean
+            # is then the row's own value exactly. Windows crossing code
+            # groups or containing NaN are masked by ok/wbad below, so a
+            # run continuing across a group boundary never ships.
+            eq = np.zeros(len(v), bool)
+            if len(v) > 1:
+                eq[1:] = ~nanv[1:] & ~nanv[:-1] & (v[1:] == v[:-1])
+            run = idx - np.maximum.accumulate(np.where(~eq, idx, 0))
+            const_w = (run >= t - 1) & ~nanv
+            mean = np.where(const_w, v, mean)  # const_w excludes NaN rows
+            std0 = np.where(const_w, 0.0, std0)
+            with np.errstate(invalid="ignore", divide="ignore"):
                 if method == "o":
                     res = v.copy()
                     res[~ok] = np.nan

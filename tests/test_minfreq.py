@@ -225,3 +225,50 @@ def test_stock_pool_membership(tmp_path):
                                  stock_pool="hs3000")
     finally:
         set_config(old)
+
+
+def test_final_exposure_constant_windows_exact():
+    """Exactly-constant windows/groups must yield std == 0.0 and z ==
+    NaN (0/0) — prefix-sum rounding once left a tiny nonzero std whose
+    z-score was garbage (resample fuzz; t=1 makes EVERY window
+    constant). Calendar single-member groups keep NaN std (ddof=1)."""
+    code = np.array(["600000"] * 5, object)
+    date = np.array([f"2024-01-0{d}" for d in range(2, 7)],
+                    dtype="datetime64[D]")
+    val = np.array([2.5, 2.5, 2.5, 2.5, 3.0], np.float32)
+    f = MinFreqFactor("toy").set_exposure(code, date, val)
+
+    z1 = f.cal_final_exposure(1, method="z", mode="days").factor_exposure
+    assert np.isnan(z1["toy_1_z"]).all()
+
+    s3 = f.cal_final_exposure(3, method="std", mode="days").factor_exposure
+    np.testing.assert_array_equal(
+        s3["toy_3_std"][2:4], np.zeros(2, np.float32))  # constant windows
+    z3 = f.cal_final_exposure(3, method="z", mode="days").factor_exposure
+    assert np.isnan(z3["toy_3_z"][2:4]).all()
+    np.testing.assert_allclose(z3["toy_3_z"][4], 1.4142135, rtol=1e-6)
+
+    m2 = f.cal_final_exposure(2, method="m", mode="days").factor_exposure
+    np.testing.assert_array_equal(m2["toy_2_m"][1:4],
+                                  np.full(3, 2.5, np.float32))
+
+    # calendar: the 5-day week group has spread (std1 ddof=1)
+    wz = f.cal_final_exposure("week", method="z").factor_exposure
+    np.testing.assert_allclose(wz["week_toy_z"], [1.7888544], rtol=1e-6)
+    # constant calendar group -> std exactly 0, z NaN
+    vc = np.full(5, 7.25, np.float32)
+    fc = MinFreqFactor("toy").set_exposure(code, date, vc)
+    ws = fc.cal_final_exposure("week", method="std").factor_exposure
+    np.testing.assert_array_equal(ws["week_toy_std"], [0.0])
+    wz = fc.cal_final_exposure("week", method="z").factor_exposure
+    assert np.isnan(wz["week_toy_z"]).all()
+
+
+def test_final_exposure_rejects_nonpositive_window():
+    f = MinFreqFactor("toy").set_exposure(
+        np.array(["600000"], object),
+        np.array(["2024-01-02"], dtype="datetime64[D]"),
+        np.array([1.0], np.float32))
+    for bad in (0, -3):
+        with pytest.raises(ValueError):
+            f.cal_final_exposure(bad, method="z", mode="days")
