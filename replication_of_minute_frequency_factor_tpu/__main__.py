@@ -77,6 +77,12 @@ def _add_list(sub: "argparse._SubParsersAction") -> None:
     p.add_argument("--json", action="store_true", dest="as_json")
 
 
+def _add_doctor(sub: "argparse._SubParsersAction") -> None:
+    sub.add_parser(
+        "doctor", help="environment diagnostics: device probe (hang-proof),"
+        " native encoder status, config")
+
+
 def cmd_compute(args: argparse.Namespace) -> int:
     from .config import Config
     from .models.registry import factor_names
@@ -197,6 +203,55 @@ def cmd_list_factors(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_doctor(args: argparse.Namespace) -> int:
+    """Diagnose the runtime without risking a hang: an attached-TPU
+    tunnel that has wedged blocks jax backend init in-process, so the
+    device probe runs in a killable child (the same trick bench.py
+    uses)."""
+    import dataclasses
+    import os
+    import subprocess
+
+    from . import native
+    from .config import get_config
+
+    report = {}
+    probe = ("import jax, json; "
+             "print(json.dumps([str(d) for d in jax.devices()]))")
+    try:
+        out = subprocess.run([sys.executable, "-c", probe], timeout=60,
+                             capture_output=True, text=True)
+        if out.returncode == 0:
+            try:
+                report["devices"] = json.loads(
+                    out.stdout.strip().splitlines()[-1])
+                report["device_probe"] = "ok"
+            except (json.JSONDecodeError, IndexError):
+                # probe exited 0 but stdout wasn't the JSON payload (e.g.
+                # a sitecustomize/atexit print) — still a diagnostic, not
+                # a crash
+                report["device_probe"] = "error"
+                report["device_error"] = (
+                    "unparseable probe output: " + out.stdout[-300:])
+        else:
+            report["device_probe"] = "error"
+            report["device_error"] = out.stderr.strip()[-500:]
+    except subprocess.TimeoutExpired:
+        report["device_probe"] = (
+            "TIMEOUT — backend init hung; if this machine uses an "
+            "attached-TPU tunnel it is likely wedged (retry later, or "
+            "unset PALLAS_AXON_POOL_IPS and set JAX_PLATFORMS=cpu for "
+            "CPU-only work)")
+    report["native_encoder"] = "built" if native.available() else (
+        "unavailable (no C++ toolchain?) — numpy fallback in use")
+    report["tunnel_env"] = "PALLAS_AXON_POOL_IPS" in os.environ
+    report["config"] = dataclasses.asdict(get_config())
+    report["mff_env_overrides"] = {
+        k: v for k, v in os.environ.items() if k.startswith("MFF_")}
+    print(json.dumps(report, indent=2))
+    return 0 if report["device_probe"] == "ok" else 1
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m replication_of_minute_frequency_factor_tpu",
@@ -205,9 +260,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_compute(sub)
     _add_evaluate(sub)
     _add_list(sub)
+    _add_doctor(sub)
     args = ap.parse_args(argv)
     return {"compute": cmd_compute, "evaluate": cmd_evaluate,
-            "list-factors": cmd_list_factors}[args.cmd](args)
+            "list-factors": cmd_list_factors,
+            "doctor": cmd_doctor}[args.cmd](args)
 
 
 if __name__ == "__main__":

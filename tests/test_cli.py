@@ -110,3 +110,15 @@ def test_evaluate_disjoint_pv_reports_null_stats(workspace, capsys,
     assert rc == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["IC"] is None and out["rank_ICIR"] is None
+
+
+def test_doctor_reports_environment(capsys):
+    """doctor must produce a well-formed report in ANY environment — its
+    whole purpose is diagnosing degraded ones, so only the report's shape
+    (and rc consistency) is asserted, not environment health."""
+    rc = main(["doctor"])
+    out = json.loads(capsys.readouterr().out)
+    assert "device_probe" in out
+    assert (rc == 0) == (out["device_probe"] == "ok")
+    assert out["native_encoder"].startswith(("built", "unavailable"))
+    assert "config" in out and "days_per_batch" in out["config"]
