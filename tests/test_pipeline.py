@@ -208,3 +208,41 @@ def test_profile_trace_capture(minute_dir, tmp_path):
                       progress=False)
     found = [os.path.join(r, f) for r, _, fs in os.walk(pdir) for f in fs]
     assert found, "no trace files captured"
+
+
+def test_failed_day_retry_semantics(minute_dir, tmp_path, rng):
+    """Resume is keyed on the cache's max date (reference :79-81), which
+    gives failed days two different fates (pinned by the pipeline fuzz):
+    a failed day NEWER than everything cached is retried on the next run
+    (self-healing), while one OLDER than the cache max stays skipped —
+    exactly the reference's behavior, where a dropped mid-history day is
+    lost until the cache is rebuilt."""
+    cache = str(tmp_path / "f.parquet")
+
+    def fail_on(target):
+        def hook(date):
+            if str(date) == target:
+                raise RuntimeError("injected")
+        return hook
+
+    # fail the LAST day -> cache max is an earlier day -> retried
+    t1 = compute_exposures(minute_dir, NAMES, cache_path=cache, cfg=_cfg(),
+                           progress=False, fault_hook=fail_on("2024-01-04"))
+    assert set(map(str, np.unique(t1.columns["date"]))) == {
+        "2024-01-02", "2024-01-03"}
+    t2 = compute_exposures(minute_dir, NAMES, cache_path=cache, cfg=_cfg(),
+                           progress=False)
+    assert set(map(str, np.unique(t2.columns["date"]))) == {
+        "2024-01-02", "2024-01-03", "2024-01-04"}
+    assert not t2.failures
+
+    # fail a MIDDLE day -> cache max is the last day -> stays lost
+    cache2 = str(tmp_path / "g.parquet")
+    t3 = compute_exposures(minute_dir, NAMES, cache_path=cache2, cfg=_cfg(),
+                           progress=False, fault_hook=fail_on("2024-01-03"))
+    assert set(map(str, np.unique(t3.columns["date"]))) == {
+        "2024-01-02", "2024-01-04"}
+    t4 = compute_exposures(minute_dir, NAMES, cache_path=cache2, cfg=_cfg(),
+                           progress=False)
+    assert set(map(str, np.unique(t4.columns["date"]))) == {
+        "2024-01-02", "2024-01-04"}
