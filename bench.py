@@ -44,14 +44,15 @@ def _ensure_device_reachable():
     if "PALLAS_AXON_POOL_IPS" not in os.environ:
         return  # not tunnel-attached; let jax pick its platform
     probe = "import jax; jax.devices()"
-    for _ in range(3):
+    for attempt in range(2):
         try:
             if subprocess.run([sys.executable, "-c", probe],
-                              timeout=120, capture_output=True).returncode == 0:
+                              timeout=90, capture_output=True).returncode == 0:
                 return
         except subprocess.TimeoutExpired:
             pass
-        time.sleep(60)
+        if attempt == 0:
+            time.sleep(30)
     env = {k: v for k, v in os.environ.items()
            if k != "PALLAS_AXON_POOL_IPS"}
     env["JAX_PLATFORMS"] = "cpu"
