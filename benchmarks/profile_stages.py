@@ -1,0 +1,90 @@
+"""Per-stage timing of the headline bench loop on the attached device.
+
+Breaks one pipeline step into host encode / pack / device_put / fused
+compute, then times the double-buffered loop itself — the gap between
+sum-of-stages and measured per-batch is transfer/round-trip overhead the
+tunnel adds under load (what the single-buffer path exists to minimize).
+
+Run on the TPU:  python benchmarks/profile_stages.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from bench import make_batch  # noqa: E402
+from replication_of_minute_frequency_factor_tpu.data import wire  # noqa: E402
+from replication_of_minute_frequency_factor_tpu.models.registry import (  # noqa: E402
+    factor_names)
+from replication_of_minute_frequency_factor_tpu.pipeline import (  # noqa: E402
+    _compute_packed_jit)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    names = factor_names()
+    batches = [make_batch(rng) for _ in range(2)]
+    bars, mask = batches[0]
+
+    # warm (compile + first transfers)
+    w = wire.encode(bars, mask)
+    buf, spec = wire.pack_arrays(w.arrays)
+    out = _compute_packed_jit(jax.device_put(buf), spec, "wire", names,
+                              True, "conv")
+    jax.block_until_ready(out)
+
+    def best(f, n=5):
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            r = f()
+            if r is not None:
+                jax.block_until_ready(r)
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    enc = best(lambda: wire.encode(bars, mask))
+    pack = best(lambda: wire.pack_arrays(w.arrays))
+    put = best(lambda: jax.device_put(buf))
+    comp = best(lambda: _compute_packed_jit(jax.device_put(buf), spec,
+                                            "wire", names, True, "conv"))
+    print(f"stages: encode {enc*1e3:.0f}ms  pack {pack*1e3:.0f}ms  "
+          f"put {put*1e3:.0f}ms  put+compute {comp*1e3:.0f}ms  "
+          f"wire {buf.nbytes/1e6:.1f}MB")
+
+    # the measured double-buffered loop, per-iteration breakdown
+    import queue
+    import threading
+    q: "queue.Queue" = queue.Queue(maxsize=2)
+    ITERS = 5
+
+    def produce():
+        for i in range(ITERS):
+            wi = wire.encode(*batches[i % 2])
+            q.put(wire.pack_arrays(wi.arrays))
+
+    t0 = time.perf_counter()
+    threading.Thread(target=produce, daemon=True).start()
+    outs = []
+    for i in range(ITERS):
+        ta = time.perf_counter()
+        bi, si = q.get()
+        tb = time.perf_counter()
+        outs.append(_compute_packed_jit(jax.device_put(bi), si, "wire",
+                                        names, True, "conv"))
+        tc = time.perf_counter()
+        if i >= 2:
+            jax.block_until_ready(outs[i - 2])
+        td = time.perf_counter()
+        print(f"iter {i}: queue-wait {1e3*(tb-ta):.0f}ms  "
+              f"dispatch {1e3*(tc-tb):.0f}ms  block {1e3*(td-tc):.0f}ms")
+    jax.block_until_ready(outs)
+    print(f"loop per-batch: {(time.perf_counter()-t0)/ITERS*1e3:.0f}ms")
+
+
+if __name__ == "__main__":
+    main()

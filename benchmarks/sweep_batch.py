@@ -1,0 +1,35 @@
+"""Sweep DAYS_PER_BATCH on the real TPU to find the best bench config."""
+import sys, time, json
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np, jax
+import bench
+from replication_of_minute_frequency_factor_tpu.data import wire
+from replication_of_minute_frequency_factor_tpu.pipeline import compute_packed_prepared
+from replication_of_minute_frequency_factor_tpu.models.registry import factor_names
+
+names = factor_names()
+for D in (8, 16, 32):
+    rng = np.random.default_rng(0)
+    batches = [bench.make_batch(rng, n_days=D) for _ in range(2)]
+    def ep(b, m):
+        w = wire.encode(b, m)
+        return wire.pack_arrays(w.arrays) + ("wire",)
+    def launch(item):
+        buf, spec, kind = item
+        return compute_packed_prepared(buf, spec, kind, names=names, replicate_quirks=True)
+    t0=time.perf_counter(); jax.block_until_ready(launch(ep(*batches[0]))); warm=time.perf_counter()-t0
+    import queue, threading
+    ITERS = max(3, 32 // D)  # amortize over >= 32 days per config
+    q = queue.Queue(maxsize=2)
+    def produce():
+        for i in range(ITERS): q.put(ep(*batches[i % 2]))
+    t0=time.perf_counter(); threading.Thread(target=produce, daemon=True).start()
+    outs=[]
+    for i in range(ITERS):
+        outs.append(launch(q.get()))
+        if i >= 2: jax.block_until_ready(outs[i-2])
+    jax.block_until_ready(outs)
+    per = (time.perf_counter()-t0)/ITERS
+    print(json.dumps({"days": D, "per_batch_s": round(per,3),
+                      "full_year_s": round(per*244/D,3), "warm_s": round(warm,1)}))

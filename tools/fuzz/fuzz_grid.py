@@ -1,0 +1,36 @@
+"""grid_day native-vs-numpy fuzz: random long rows with off-grid times,
+unknown codes, duplicates (last-write-wins), sub-minute stamps."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+import numpy as np
+from replication_of_minute_frequency_factor_tpu.data.minute import grid_day
+from replication_of_minute_frequency_factor_tpu import sessions
+
+fails = []
+lo, hi = int(sys.argv[1]), int(sys.argv[2])
+for seed in range(lo, hi):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(1, 2000))
+    n_codes = int(rng.integers(1, 12))
+    codes = np.array([f"{600000 + rng.integers(0, n_codes):06d}"
+                      for _ in range(n)])
+    kind = rng.random(n)
+    times = np.where(kind < 0.7,
+                     sessions.GRID_TIMES[rng.integers(0, 240, n)],
+                     rng.choice([92900000, 113000000, 120000000, 150000000,
+                                 93000001, 130000000 - 100000, 0, 235959999,
+                                 93000000 + 50000], n))
+    f = [np.round(rng.uniform(1, 100, n), 2) for _ in range(4)]
+    v = rng.integers(0, 1e6, n).astype(np.float64)
+    a = grid_day(codes, times, *f, v, use_native=True)
+    b = grid_day(codes, times, *f, v, use_native=False)
+    try:
+        np.testing.assert_array_equal(a.codes, b.codes)
+        np.testing.assert_array_equal(a.mask, b.mask)
+        np.testing.assert_array_equal(a.bars, b.bars)
+    except AssertionError as e:
+        fails.append(seed); print(f"SEED {seed}: {str(e)[:200]}", flush=True)
+    if (seed - lo + 1) % 100 == 0:
+        print(f"...{seed-lo+1} done, {len(fails)} failures", flush=True)
+print(f"DONE {hi-lo} seeds, {len(fails)} failures: {fails}")

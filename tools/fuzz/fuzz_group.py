@@ -1,0 +1,105 @@
+"""group_test full-value fuzz vs a pandas oracle implementing the
+reference chain: per-date polars-qcut -> per-(code,period) compounded
+return + last group/caps -> 1-period lag per code -> weighted group
+means -> cumprod."""
+import sys, os, tempfile
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+import numpy as np, pandas as pd
+import pyarrow as pa, pyarrow.parquet as pq
+from replication_of_minute_frequency_factor_tpu import Factor
+from replication_of_minute_frequency_factor_tpu import frames
+
+def polars_qcut(xs, k):
+    breaks = np.quantile(xs, [(i + 1) / k for i in range(k - 1)])
+    return np.searchsorted(breaks, xs, side="left")
+
+fails = []
+lo, hi = int(sys.argv[1]), int(sys.argv[2])
+td = tempfile.mkdtemp()
+for seed in range(lo, hi):
+    rng = np.random.default_rng(seed)
+    n_codes = int(rng.integers(3, 10)); n_days = int(rng.integers(8, 30))
+    K = int(rng.integers(2, 6))
+    freq = str(rng.choice(["week", "month"]))
+    wparam = rng.choice([None, "tmc", "cmc"])
+    codes = [f"{600000+i:06d}" for i in range(n_codes)]
+    days = np.array(sorted(np.datetime64("2024-01-01") + i for i in
+                    rng.choice(120, n_days, replace=False)))
+    pv_rows = []
+    for c in codes:
+        keep = rng.random(n_days) > rng.choice([0.0, 0.2])
+        for d in days[keep]:
+            pv_rows.append((c, d, rng.normal(0, 0.02),
+                            rng.uniform(1e9, 1e10), rng.uniform(7e8, 9e9)))
+    pv = pd.DataFrame(pv_rows, columns=["code", "date", "pct_change",
+                                        "tmc", "cmc"])
+    pv_path = os.path.join(td, f"pv{seed}.parquet")
+    pq.write_table(pa.table({
+        "code": pa.array(pv["code"]),
+        "date": pa.array(pv["date"].to_numpy().astype("datetime64[D]")),
+        "pct_change": pa.array(pv["pct_change"]),
+        "tmc": pa.array(pv["tmc"]), "cmc": pa.array(pv["cmc"])}), pv_path)
+    exp = pv.sample(frac=rng.uniform(0.6, 1.0), random_state=seed)[
+        ["code", "date"]].copy()
+    exp["v"] = rng.normal(0, 1, len(exp)).astype(np.float32)
+    if rng.random() < 0.4:
+        exp["v"] = np.round(exp["v"], 1)
+    exp.loc[exp.sample(frac=0.08, random_state=seed + 1).index, "v"] = np.nan
+    f = Factor("toy").set_exposure(
+        exp["code"].to_numpy(object),
+        exp["date"].to_numpy().astype("datetime64[D]"),
+        exp["v"].to_numpy(np.float32))
+    try:
+        got = f.group_test(frequency=freq, weight_param=wparam, group_num=K,
+                           plot=False, return_df=True,
+                           daily_pv_path=pv_path)
+        # ---- oracle ----
+        e = exp.dropna(subset=["v"]).copy()
+        e["date"] = e["date"].to_numpy().astype("datetime64[D]")
+        # per-date polars qcut over the exposure cross-section
+        e["grp"] = -1
+        for d, g in e.groupby("date"):
+            e.loc[g.index, "grp"] = polars_qcut(
+                g["v"].to_numpy(np.float32).astype(np.float64), K)
+        pvo = pv.copy()
+        pvo["date"] = pvo["date"].to_numpy().astype("datetime64[D]")
+        j = pvo.merge(e[["code", "date", "grp"]], on=["code", "date"],
+                      how="left")
+        j["grp"] = j["grp"].fillna(-1)
+        j["period"] = frames.period_start(
+            j["date"].to_numpy().astype("datetime64[D]"), freq)
+        agg = j.sort_values("date").groupby(["code", "period"]).agg(
+            ret=("pct_change", lambda s: np.prod(1 + s) - 1),
+            grp=("grp", "last"), tmc=("tmc", "last"), cmc=("cmc", "last"),
+        ).reset_index()
+        agg = agg.sort_values(["code", "period"])
+        for col in ("grp", "tmc", "cmc"):
+            agg[col] = agg.groupby("code")[col].shift(1)
+        agg = agg[agg["grp"].notna() & (agg["grp"] >= 0)]
+        w = np.ones(len(agg)) if wparam is None else agg[wparam].to_numpy()
+        agg["w"] = w
+        want = agg.groupby(["period", "grp"]).apply(
+            lambda g: np.average(g["ret"], weights=g["w"]),
+            include_groups=False)
+        # compare
+        periods = got["period"]; rm = got["group_return"]
+        for (p, gl), wv in want.items():
+            pi = np.searchsorted(periods, np.datetime64(p, "D"))
+            assert pi < len(periods) and periods[pi] == np.datetime64(p, "D"), (p, periods)
+            gv = rm[pi, int(gl)]
+            assert np.isclose(gv, wv, rtol=2e-4, atol=1e-6), \
+                (p, gl, gv, wv)
+        # and no extra values where oracle has none
+        want_keys = {(np.datetime64(p, "D"), int(gl)) for (p, gl) in want.index}
+        for pi, p in enumerate(periods):
+            for gl in range(K):
+                if np.isfinite(rm[pi, gl]):
+                    assert (p, gl) in want_keys, ("extra", p, gl, rm[pi, gl])
+    except AssertionError as e_:
+        fails.append(seed); print(f"SEED {seed}: {str(e_)[:250]}", flush=True)
+    except Exception as e_:
+        fails.append(seed); print(f"SEED {seed} CRASH: {e_!r}", flush=True)
+    if (seed - lo + 1) % 20 == 0:
+        print(f"...{seed-lo+1} done, {len(fails)} failures", flush=True)
+print(f"DONE {hi-lo} seeds, {len(fails)} failures: {fails}")

@@ -1,0 +1,29 @@
+"""Extended parity fuzz: many seeds x adversarial day shapes."""
+import sys, traceback
+import os
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, _REPO); sys.path.insert(0, os.path.join(_REPO, 'tests'))
+import numpy as np
+import test_parity as tp
+from replication_of_minute_frequency_factor_tpu.data.synthetic import synth_day
+
+fails = []
+lo, hi = int(sys.argv[1]), int(sys.argv[2])
+for seed in range(lo, hi):
+    rng = np.random.default_rng(seed)
+    try:
+        tp._compare(
+            synth_day(rng, n_codes=10, missing_prob=0.12,
+                      zero_volume_prob=0.12, constant_price_codes=2,
+                      short_day_codes=3),
+            f"fuzz{seed}", noisy=True)
+    except AssertionError as e:
+        fails.append((seed, str(e)[:400]))
+        print(f"SEED {seed} FAILED:\n{str(e)[:400]}\n", flush=True)
+    except Exception as e:
+        fails.append((seed, f"crash: {e}"))
+        print(f"SEED {seed} CRASHED: {e}", flush=True)
+        traceback.print_exc()
+    if (seed - lo + 1) % 20 == 0:
+        print(f"...{seed - lo + 1} seeds done, {len(fails)} failures", flush=True)
+print(f"DONE {hi-lo} seeds, {len(fails)} failures: {[s for s,_ in fails]}")

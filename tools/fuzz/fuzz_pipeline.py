@@ -1,0 +1,88 @@
+"""Pipeline fuzz: random day sequences, cache staleness, fault injection,
+batch sizes — incremental resume must be exact and failure isolation
+complete."""
+import sys, os, tempfile, shutil
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+import numpy as np
+import pyarrow as pa, pyarrow.parquet as pq
+from replication_of_minute_frequency_factor_tpu.data.synthetic import synth_day
+from replication_of_minute_frequency_factor_tpu.pipeline import (
+    compute_exposures, ExposureTable)
+from replication_of_minute_frequency_factor_tpu.config import Config
+
+def write_day(d, rng, date_str, n_codes):
+    cols = synth_day(rng, n_codes=n_codes, date=date_str, missing_prob=0.05)
+    arrays = {"code": pa.array([str(c) for c in cols["code"]]),
+              "time": pa.array(cols["time"])}
+    for k in ("open", "high", "low", "close", "volume"):
+        arrays[k] = pa.array(cols[k])
+    pq.write_table(pa.table(arrays),
+                   os.path.join(d, date_str.replace("-", "") + ".parquet"))
+
+fails = []
+lo, hi = int(sys.argv[1]), int(sys.argv[2])
+NAMES = ("vol_return1min", "mmt_pm", "doc_kurt")
+for seed in range(lo, hi):
+    rng = np.random.default_rng(seed)
+    td = tempfile.mkdtemp()
+    try:
+        kline = os.path.join(td, "kline"); os.mkdir(kline)
+        n_codes = int(rng.integers(3, 10))
+        n1 = int(rng.integers(1, 8)); n2 = int(rng.integers(1, 6))
+        all_days = sorted(str(np.datetime64("2024-01-02") + int(i))
+                          for i in rng.choice(60, n1 + n2, replace=False))
+        for ds in all_days[:n1]:
+            write_day(kline, rng, ds, n_codes)
+        cache = os.path.join(td, "cache.parquet")
+        dpb = int(rng.integers(1, 5))
+        cfg = Config(days_per_batch=dpb)
+        # fault injection on a random first-phase day
+        bad_day = (np.datetime64(all_days[int(rng.integers(0, n1))])
+                   if rng.random() < 0.3 else None)
+        def hook(date):
+            if bad_day is not None and date == bad_day:
+                raise RuntimeError("injected")
+        t1 = compute_exposures(kline, NAMES, cache_path=cache, cfg=cfg,
+                               progress=False, fault_hook=hook)
+        days1 = set(map(str, t1.columns["date"]))
+        want1 = set(all_days[:n1]) - ({str(bad_day)} if bad_day is not None
+                                      else set())
+        assert days1 == want1, (days1, want1)
+        if bad_day is not None:
+            assert len(t1.failures) == 1
+            assert os.path.exists(cache + ".failures.json")
+        # phase 2: add newer days, resume (no hook) — only new days compute;
+        # the injected day stays absent (it is older than cache max)
+        for ds in all_days[n1:]:
+            write_day(kline, rng, ds, n_codes)
+        t2 = compute_exposures(kline, NAMES, cache_path=cache,
+                               cfg=cfg, progress=False)
+        days2 = set(map(str, t2.columns["date"]))
+        if not days1:
+            # phase 1 wrote no cache (its only day failed): full recompute
+            want2 = set(all_days)
+        else:
+            # resume: everything strictly newer than the cache max is
+            # (re)computed — including a failed day that sorts after it
+            want2 = days1 | {d for d in all_days if d > max(days1)}
+        assert days2 == want2, (days2, want2)
+        # cache reload
+        t3 = ExposureTable.load(cache)
+        assert set(map(str, t3.columns["date"])) == days2
+        assert t3.factor_names == NAMES
+        # values stable across a no-op rerun
+        t4 = compute_exposures(kline, NAMES, cache_path=cache,
+                               cfg=cfg, progress=False)
+        for n in NAMES:
+            np.testing.assert_array_equal(
+                np.asarray(t4.columns[n]), np.asarray(t2.columns[n]), err_msg=n)
+    except AssertionError as e:
+        fails.append(seed); print(f"SEED {seed}: {str(e)[:250]}", flush=True)
+    except Exception as e:
+        fails.append(seed); print(f"SEED {seed} CRASH: {e!r}", flush=True)
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+    if (seed - lo + 1) % 20 == 0:
+        print(f"...{seed-lo+1} done, {len(fails)} failures", flush=True)
+print(f"DONE {hi-lo} seeds, {len(fails)} failures: {fails}")

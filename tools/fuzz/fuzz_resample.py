@@ -1,0 +1,109 @@
+"""cal_final_exposure fuzz vs pandas across all (mode, frequency, method)
+combos, random sparsity and series lengths."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+import numpy as np, pandas as pd
+from replication_of_minute_frequency_factor_tpu import MinFreqFactor
+
+fails = []
+lo, hi = int(sys.argv[1]), int(sys.argv[2])
+for seed in range(lo, hi):
+    rng = np.random.default_rng(seed)
+    n_codes = int(rng.integers(2, 8))
+    n_days = int(rng.integers(3, 40))
+    start = np.datetime64("2024-01-01") + int(rng.integers(0, 300))
+    # each code holds a random subset of business days (ragged panels)
+    all_days = np.array([start + i for i in range(n_days)],
+                        dtype="datetime64[D]")
+    rows = {"code": [], "date": [], "value": []}
+    for c in range(n_codes):
+        keep = rng.random(n_days) > rng.choice([0.0, 0.3])
+        if not keep.any():
+            keep[0] = True
+        d = all_days[keep]
+        rows["code"] += [f"{600000+c:06d}"] * len(d)
+        rows["date"].append(d)
+        v = rng.normal(0, 1, len(d))
+        v[rng.random(len(d)) < 0.1] = np.nan  # NaN exposures allowed
+        rows["value"].append(v)
+    code = np.array(rows["code"], dtype=object)
+    date = np.concatenate(rows["date"])
+    value = np.concatenate(rows["value"]).astype(np.float32)
+
+    f = MinFreqFactor("toy").set_exposure(code, date, value)
+    df = pd.DataFrame({"code": code, "date": date, "value": value})
+
+    try:
+        for mode, freq in (("calendar", "week"), ("calendar", "month"),
+                           ("days", int(rng.integers(1, 6)))):
+            for method in ("o", "m", "z", "std"):
+                f2 = MinFreqFactor("toy").set_exposure(code, date, value)
+                out = f2.cal_final_exposure(freq, method=method,
+                                            mode=mode).factor_exposure
+                col = [k for k in out if k not in ("code", "date")][0]
+                got = pd.DataFrame({k: out[k] for k in ("code", "date")} |
+                                   {"v": out[col]})
+                # pandas oracle
+                want_rows = []
+                for c, g in df.groupby("code"):
+                    g = g.sort_values("date").set_index("date")["value"]
+                    g.index = pd.to_datetime(g.index)
+                    if mode == "calendar":
+                        rule = {"week": "W-MON", "month": "MS"}[freq]
+                        # polars group_by_dynamic: windows start Monday /
+                        # month start, label = window start
+                        grp = g.groupby(pd.Grouper(freq="W-MON", label="left",
+                                        closed="left") if freq == "week"
+                                        else pd.Grouper(freq="MS"))
+                        for period, s in grp:
+                            s = s.dropna() if False else s
+                            if not len(s):
+                                continue
+                            # calendar mode: polars default ddof=1
+                            # (SURVEY Q11; ddof=0 is ONLY the rolling
+                            # mode's explicit :222,234)
+                            if method == "o": w = s.iloc[-1]
+                            elif method == "m": w = s.mean()
+                            elif method == "z":
+                                sd = s.std(ddof=1)
+                                w = ((s.iloc[-1] - s.mean()) / sd
+                                     if sd > 0 else np.nan)
+                            else: w = s.std(ddof=1)
+                            lbl = period if freq == "month" else period
+                            want_rows.append((c, lbl, w))
+                    else:
+                        t = freq
+                        r = g.rolling(t, min_periods=t)
+                        if method == "o": w = g.where(r.count() >= t)
+                        elif method == "m": w = r.mean()
+                        elif method == "z":
+                            sd = r.std(ddof=0)
+                            w = (g - r.mean()) / sd
+                        else: w = r.std(ddof=0)
+                        for dt_, wv in w.items():
+                            want_rows.append((c, dt_, wv))
+                want = pd.DataFrame(want_rows, columns=["code", "date", "w"])
+                want["date"] = want["date"].dt.normalize()
+                got["date"] = pd.to_datetime(got["date"])
+                merged = got.merge(want, on=["code", "date"], how="outer",
+                                   indicator=True)
+                if mode == "days":  # same grid: full outer match expected
+                    bad = merged[(merged._merge != "both")]
+                    if len(bad):
+                        raise AssertionError(
+                            f"{mode}/{freq}/{method}: row mismatch\n{bad.head()}")
+                both = merged[merged._merge == "both"]
+                a, b = both["v"].to_numpy(float), both["w"].to_numpy(float)
+                ok = np.isclose(a, b, rtol=2e-4, atol=1e-5) | (
+                    np.isnan(a) & np.isnan(b))
+                if not ok.all():
+                    i = np.flatnonzero(~ok)[0]
+                    raise AssertionError(
+                        f"{mode}/{freq}/{method}: {both.iloc[i].to_dict()}")
+    except AssertionError as e:
+        fails.append(seed)
+        print(f"SEED {seed}: {str(e)[:300]}", flush=True)
+    if (seed - lo + 1) % 20 == 0:
+        print(f"...{seed-lo+1} done, {len(fails)} failures", flush=True)
+print(f"DONE {hi-lo} seeds, {len(fails)} failures: {fails}")

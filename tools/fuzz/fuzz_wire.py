@@ -1,0 +1,64 @@
+"""Wire encoder fuzz: native vs numpy byte parity + decode roundtrip
+across random batch shapes, price scales, and pathologies."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+import numpy as np
+from replication_of_minute_frequency_factor_tpu.data import wire
+
+fails = []
+lo, hi = int(sys.argv[1]), int(sys.argv[2])
+for seed in range(lo, hi):
+    rng = np.random.default_rng(seed)
+    D = int(rng.integers(1, 3)); T = int(rng.integers(2, 30))
+    base_price = float(rng.choice([0.05, 3.0, 12.0, 80.0, 300.0, 1700.0,
+                                   30000.0, 41000.0]))
+    shape = (D, T, 240)
+    close = base_price * np.exp(np.cumsum(
+        rng.normal(0, rng.choice([1e-4, 1e-3, 5e-3]), shape), -1))
+    open_ = close * (1 + rng.normal(0, 1e-4, shape))
+    high = np.maximum(open_, close) * (1 + np.abs(rng.normal(0, 2e-4, shape)))
+    low = np.minimum(open_, close) * (1 - np.abs(rng.normal(0, 2e-4, shape)))
+    vol_kind = rng.integers(0, 3)
+    volume = (rng.integers(0, 1000, shape) *
+              (100 if vol_kind == 0 else 1)).astype(np.float64)
+    if vol_kind == 2:
+        volume *= 1e5  # big volumes -> int32 mode
+    bars = np.stack([open_, high, low, close, volume], -1)
+    bars[..., :4] = np.round(bars[..., :4], 2)
+    bars = np.maximum(bars, 0.01 * (np.arange(5) < 4)).astype(np.float32)
+    mask = rng.random(shape) > rng.choice([0.0, 0.05, 0.5])
+    if rng.random() < 0.3:  # halted ticker
+        mask[:, rng.integers(0, T)] = False
+    if rng.random() < 0.3:  # garbage on dead lanes
+        dead = np.argwhere(~mask)
+        for i in range(min(3, len(dead))):
+            bars[tuple(dead[i])] = [np.nan, np.inf, -5, 1e12, -3.3][i % 5]
+    if rng.random() < 0.2:  # off-tick poison on a live lane
+        live = np.argwhere(mask)
+        if len(live):
+            bars[tuple(live[0])][3] += 0.003
+    fa, fb = {}, {}
+    a = wire.encode(bars, mask, use_native=True, floor=fa)
+    b = wire.encode(bars, mask, use_native=False, floor=fb)
+    try:
+        assert (a is None) == (b is None), (a is None, b is None)
+        if a is not None:
+            assert fa == fb, (fa, fb)
+            for x, y, nm in zip(a.arrays, b.arrays,
+                                "base dclose dohl volume mask vs".split()):
+                assert np.asarray(x).dtype == np.asarray(y).dtype, nm
+                np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                              err_msg=nm)
+            dec, dm = wire.decode(*a.arrays)
+            assert np.array_equal(np.asarray(dm), mask)
+            db = np.asarray(dec)
+            err = np.abs(db[mask] - bars[mask]) / np.maximum(
+                np.abs(bars[mask]), 1e-6)
+            assert err.max() < 3e-7, err.max()
+    except AssertionError as e:
+        fails.append(seed)
+        print(f"SEED {seed} FAILED: {str(e)[:300]}", flush=True)
+    if (seed - lo + 1) % 100 == 0:
+        print(f"...{seed - lo + 1} done, {len(fails)} failures", flush=True)
+print(f"DONE {hi-lo} seeds, {len(fails)} failures: {fails}")
