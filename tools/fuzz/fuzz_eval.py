@@ -46,7 +46,7 @@ for seed in range(lo, hi):
             np.testing.assert_array_equal(got, want,
                                           err_msg=f"{seed}/{d}/k={k}")
             # invalid lanes carry a sentinel, never a bin label
-            assert not np.isin(labels[d][~m[d]], np.arange(k)).any() or                 (~m[d]).sum() == 0
+            assert not np.isin(labels[d][~m[d]], np.arange(k)).any()
             # (pandas cross-check lives in the suite on tie-free
             # fixtures; at fuzz scale values land exactly on interpolated
             # breaks and pandas' boundary handling differs by one label)

@@ -3,7 +3,6 @@ reference chain: per-date polars-qcut -> per-(code,period) compounded
 return + last group/caps -> 1-period lag per code -> weighted group
 means -> cumprod."""
 import sys, os, tempfile
-import os
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
 import numpy as np, pandas as pd
 import pyarrow as pa, pyarrow.parquet as pq
@@ -103,3 +102,5 @@ for seed in range(lo, hi):
     if (seed - lo + 1) % 20 == 0:
         print(f"...{seed-lo+1} done, {len(fails)} failures", flush=True)
 print(f"DONE {hi-lo} seeds, {len(fails)} failures: {fails}")
+import shutil
+shutil.rmtree(td, ignore_errors=True)

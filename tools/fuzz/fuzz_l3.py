@@ -1,7 +1,6 @@
 """L3 fuzz: Factor.ic_test / group_test vs pandas oracles over random
 ragged panels (NaNs, disjoint codes, short histories, ties)."""
 import sys, os, tempfile
-import os
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
 import numpy as np, pandas as pd, scipy.stats
 import pyarrow as pa, pyarrow.parquet as pq
@@ -95,3 +94,5 @@ for seed in range(lo, hi):
     if (seed - lo + 1) % 25 == 0:
         print(f"...{seed-lo+1} done, {len(fails)} failures", flush=True)
 print(f"DONE {hi-lo} seeds, {len(fails)} failures: {fails}")
+import shutil
+shutil.rmtree(td, ignore_errors=True)

@@ -2,7 +2,6 @@
 batch sizes — incremental resume must be exact and failure isolation
 complete."""
 import sys, os, tempfile, shutil
-import os
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
 import numpy as np
 import pyarrow as pa, pyarrow.parquet as pq

@@ -50,14 +50,12 @@ for seed in range(lo, hi):
                     g = g.sort_values("date").set_index("date")["value"]
                     g.index = pd.to_datetime(g.index)
                     if mode == "calendar":
-                        rule = {"week": "W-MON", "month": "MS"}[freq]
                         # polars group_by_dynamic: windows start Monday /
                         # month start, label = window start
                         grp = g.groupby(pd.Grouper(freq="W-MON", label="left",
                                         closed="left") if freq == "week"
                                         else pd.Grouper(freq="MS"))
                         for period, s in grp:
-                            s = s.dropna() if False else s
                             if not len(s):
                                 continue
                             # calendar mode: polars default ddof=1
@@ -70,8 +68,7 @@ for seed in range(lo, hi):
                                 w = ((s.iloc[-1] - s.mean()) / sd
                                      if sd > 0 else np.nan)
                             else: w = s.std(ddof=1)
-                            lbl = period if freq == "month" else period
-                            want_rows.append((c, lbl, w))
+                            want_rows.append((c, period, w))
                     else:
                         t = freq
                         r = g.rolling(t, min_periods=t)
