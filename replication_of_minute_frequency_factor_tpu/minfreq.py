@@ -34,13 +34,14 @@ class MinFreqFactor(Factor):
     # ------------------------------------------------------------------
     # cache resolution (reference :27-48)
     # ------------------------------------------------------------------
-    def _read_exposure(self, path: Optional[str] = None):
+    def _read_exposure(self, path: Optional[str] = None, default=None):
         """Load a cached exposure. ``path`` may be the parquet file itself
-        or a directory containing ``<factor_name>.parquet``; returns None
-        when no cache exists (the caller then computes from scratch)."""
+        or a directory containing ``<factor_name>.parquet``; returns
+        ``default`` when no cache exists (the caller then computes from
+        scratch) — the reference's third positional argument (:27-48)."""
         path = self._resolve_path(path)
         if not os.path.exists(path):
-            return None
+            return default
         self.read_parquet(path)
         return self.factor_exposure
 

@@ -16,3 +16,8 @@ fi
 run python -m pytest tests/ -q
 run env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python __graft_entry__.py
+# fallback path: a 1-device platform must self-heal onto a virtual mesh
+# (unset XLA_FLAGS so an inherited force-host flag can't pre-create 8
+# devices and skip the path under test)
+run env -u XLA_FLAGS python -c \
+    "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -54,6 +54,25 @@ def test_cal_exposure_by_min_data_and_resume(minute_dir, tmp_path, rng):
     assert len(f2.factor_exposure["code"]) > n_before
 
 
+def test_read_exposure_default_and_roundtrip(minute_dir, tmp_path, rng):
+    """C9: file-or-dir resolution plus the reference's return-``default``
+    -when-missing contract (MinuteFrequentFactorCICC.py:27-48)."""
+    cfg = Config(days_per_batch=2)
+    cache_dir = str(tmp_path / "factors")
+    f = MinFreqFactor("vol_return1min")
+    sentinel = object()
+    assert f._read_exposure(cache_dir, sentinel) is sentinel
+    f.cal_exposure_by_min_data(minute_dir=minute_dir, path=cache_dir,
+                               cfg=cfg, progress=False)
+    g = MinFreqFactor("vol_return1min")
+    exp = g._read_exposure(cache_dir, sentinel)  # dir form
+    assert exp is not sentinel and len(exp["code"]) > 0
+    h = MinFreqFactor("vol_return1min")
+    exp2 = h._read_exposure(
+        os.path.join(cache_dir, "vol_return1min.parquet"))  # file form
+    np.testing.assert_array_equal(exp2["code"], exp["code"])
+
+
 def test_custom_name_with_aliased_kernel(minute_dir, tmp_path):
     cfg = Config(days_per_batch=4)
     f = MinFreqFactor("my_custom_vol")
