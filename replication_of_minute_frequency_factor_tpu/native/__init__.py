@@ -51,13 +51,13 @@ def load() -> Optional[ctypes.CDLL]:
     except OSError:
         return None
     lib.grid_pack_abi_version.restype = ctypes.c_int64
-    if lib.grid_pack_abi_version() != 9:
+    if lib.grid_pack_abi_version() != 10:
         # stale build from an older source tree: rebuild once
         if not _build():
             return None
         lib = ctypes.CDLL(_LIB_PATH)
         lib.grid_pack_abi_version.restype = ctypes.c_int64
-        if lib.grid_pack_abi_version() != 9:
+        if lib.grid_pack_abi_version() != 10:
             return None
     lib.grid_pack.restype = ctypes.c_int64
     lib.grid_pack.argtypes = [
@@ -80,8 +80,10 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.c_int64,                   # n_tickers (flattened)
         ctypes.c_double,                  # inv_tick
         ctypes.c_int64,                   # dclose_mode (0 i8, 1 i16)
-        ctypes.c_int64,                   # ohl_mode (0 wick, 1 i8, 2 i16)
-        ctypes.c_int64,                   # vol_mode (0 u16, 1 lots, 2 i32)
+        ctypes.c_int64,                   # ohl_mode (0 tight, 1 wick,
+                                          #           2 i8x3, 3 i16x3)
+        ctypes.c_int64,                   # vol_mode (0/1 10-bit shares/
+                                          #   lots, 2/3 u16, 4 i32)
         ctypes.POINTER(ctypes.c_float),   # base out
         ctypes.c_void_p,                  # dclose out
         ctypes.c_void_p,                  # dohl out
@@ -125,8 +127,13 @@ def grid_pack_native(tidx: np.ndarray, time: np.ndarray, open_: np.ndarray,
 
 #: per-field format ladders, narrowest first (shared with the numpy path)
 DCLOSE_DTYPES = (np.int8, np.int16)
-OHL_SHAPES = ((2, np.uint8), (3, np.int8), (3, np.int16))
-VOL_DTYPES = (np.uint16, np.uint16, np.int32)  # raw u16 / lots u16 / i32
+#: tight 1-byte pack / 2-byte wick pack / int8 x3 / int16 x3
+OHL_SHAPES = ((1, np.uint8), (2, np.uint8), (3, np.int8), (3, np.int16))
+#: (slots-axis length, dtype): 10-bit packed shares / 10-bit packed lots /
+#: u16 shares / u16 lots / i32 shares
+VOL_SHAPES = ((300, np.uint8), (300, np.uint8),
+              (240, np.uint16), (240, np.uint16), (240, np.int32))
+VOL_LOT_MODES = (1, 3)  # modes whose unit is the 100-share board lot
 
 
 def wire_encode_native(bars: np.ndarray, mask: np.ndarray,
@@ -175,7 +182,8 @@ def wire_encode_native(bars: np.ndarray, mask: np.ndarray,
         dclose = np.empty((n, 240), DCLOSE_DTYPES[cm])
         width, odt = OHL_SHAPES[om]
         dohl = np.empty((n, 240, width), odt)
-        volume = np.empty((n, 240), VOL_DTYPES[vm])
+        vlen, vdt = VOL_SHAPES[vm]
+        volume = np.empty((n, vlen), vdt)
         viols = [np.zeros(3, np.int64) for _ in range(n_threads)]
 
         def run(lo: int, hi: int, viol: np.ndarray):
@@ -205,10 +213,10 @@ def wire_encode_native(bars: np.ndarray, mask: np.ndarray,
         if v[2]:
             floor["vol_mode"] = vm + 1
 
-    vol_scale = 100.0 if floor.get("vol_mode", 0) == 1 else 1.0
+    vol_scale = 100.0 if floor.get("vol_mode", 0) in VOL_LOT_MODES else 1.0
     return (base.reshape(lead), dclose.reshape(lead + (240,)),
             dohl.reshape(lead + (240, dohl.shape[-1])),
-            volume.reshape(lead + (240,)), vol_scale)
+            volume.reshape(lead + (volume.shape[-1],)), vol_scale)
 
 
 def pack_wick(dohl: np.ndarray) -> np.ndarray:
@@ -223,6 +231,34 @@ def pack_wick(dohl: np.ndarray) -> np.ndarray:
                      (h_off << 4) | l_off], axis=-1)
 
 
+def pack_tight(dohl: np.ndarray) -> np.ndarray:
+    """int16 ``[..., 240, 3]`` open/high/low deltas -> uint8 ``[..., 240, 1]``
+    tight packing: int4 open-close delta (two's complement, -8..7) |
+    (high-wick & 3) << 4 | (low-wick & 3) << 6, wicks measured from the
+    bar body. Caller guarantees representability (stats tight flag)."""
+    dop = dohl[..., 0]
+    h_off = (dohl[..., 1] - np.maximum(dop, 0)).astype(np.uint8)
+    l_off = (np.minimum(dop, 0) - dohl[..., 2]).astype(np.uint8)
+    b = (dop.astype(np.int8).view(np.uint8) & 0xF) \
+        | (h_off << 4) | (l_off << 6)
+    return b[..., None]
+
+
+def pack_vol10(vol: np.ndarray) -> np.ndarray:
+    """int ``[..., 240]`` volumes (each <= 1023) -> uint8 ``[..., 300]``:
+    four 10-bit values per 5 bytes, little-endian bit order (value k's
+    bit b lands at stream bit 10k+b)."""
+    g = vol.reshape(vol.shape[:-1] + (60, 4)).astype(np.uint16)
+    v0, v1, v2, v3 = (g[..., i] for i in range(4))
+    out = np.empty(vol.shape[:-1] + (60, 5), np.uint8)
+    out[..., 0] = v0 & 0xFF
+    out[..., 1] = (v0 >> 8) | ((v1 & 0x3F) << 2)
+    out[..., 2] = (v1 >> 6) | ((v2 & 0xF) << 4)
+    out[..., 3] = (v2 >> 4) | ((v3 & 0x3) << 6)
+    out[..., 4] = v3 >> 2
+    return out.reshape(vol.shape[:-1] + (300,))
+
+
 def narrow_wire(base, dclose, dohl, volume, stats, floor=None):
     """Numpy-path narrowing, matching the native encoder's mode ladders
     exactly (per field: first mode at or above the widen-only ``floor``
@@ -230,7 +266,8 @@ def narrow_wire(base, dclose, dohl, volume, stats, floor=None):
     formats directly and widens on violation — same resulting modes, so
     both paths stay bit-compatible (tests/test_native.py)."""
     floor = floor if floor is not None else {}
-    dmax_ohl, dmax_c, v_lots, vmax, wick_ok = (int(s) for s in stats)
+    dmax_ohl, dmax_c, v_lots, vmax, wick_ok, tight_ok = \
+        (int(s) for s in stats)
 
     def pick(key, fits):
         mode = floor.get(key, 0)
@@ -243,17 +280,27 @@ def narrow_wire(base, dclose, dohl, volume, stats, floor=None):
     cm = pick("dclose_mode", (dmax_c <= 127, True))
     if cm == 0:
         dclose = dclose.astype(np.int8)
-    om = pick("ohl_mode", (bool(wick_ok), dmax_ohl <= 127, True))
+    om = pick("ohl_mode", (bool(tight_ok), bool(wick_ok),
+                           dmax_ohl <= 127, True))
     if om == 0:
-        dohl = pack_wick(dohl)
+        dohl = pack_tight(dohl)
     elif om == 1:
+        dohl = pack_wick(dohl)
+    elif om == 2:
         dohl = dohl.astype(np.int8)
-    vm = pick("vol_mode", (vmax <= 0xFFFF,
+    vm = pick("vol_mode", (vmax <= 1023,
+                           bool(v_lots) and vmax // 100 <= 1023,
+                           vmax <= 0xFFFF,
                            bool(v_lots) and vmax // 100 <= 0xFFFF, True))
     vol_scale = 1.0
     if vm == 0:
-        volume = volume.astype(np.uint16)
+        volume = pack_vol10(volume)
     elif vm == 1:
+        volume = pack_vol10(volume // 100)
+        vol_scale = 100.0
+    elif vm == 2:
+        volume = volume.astype(np.uint16)
+    elif vm == 3:
         volume = (volume // 100).astype(np.uint16)
         vol_scale = 100.0
     return base, dclose, dohl, volume, vol_scale

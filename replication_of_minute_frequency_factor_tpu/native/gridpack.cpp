@@ -118,10 +118,12 @@ int64_t grid_pack(const int64_t* tidx, const int64_t* time,
 // re-narrowing; widenings are rare (bounded per run) retries.
 //
 // Modes — dclose: 0 = int8, 1 = int16.
-//         ohl:    0 = 2-byte wick pack (int8 open-close delta + nibble
-//                     high/low wick offsets), 1 = int8 x3, 2 = int16 x3.
-//         vol:    0 = uint16 shares, 1 = uint16 board lots (shares/100),
-//                 2 = int32 shares.
+//         ohl:    0 = 1-byte tight pack (int4 open-close delta | 2-bit
+//                     high/low wick offsets), 1 = 2-byte wick pack (int8
+//                     delta + nibble wicks), 2 = int8 x3, 3 = int16 x3.
+//         vol:    0 = 10-bit packed shares (4 values / 5 bytes, <= 1023),
+//                 1 = 10-bit packed board lots (shares/100),
+//                 2 = uint16 shares, 3 = uint16 lots, 4 = int32 shares.
 // Two passes per ticker, both L1-resident: a branch-light
 // tick-conversion/validation sweep the compiler can keep in vector
 // registers (rint inlines to a rounding instruction; llround would be a
@@ -392,6 +394,21 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
         dc16[off + s] = static_cast<int16_t>(dcv[s]);
     }
     if (ohl_mode == 0) {
+      // tight pack: int4 body delta | 2-bit wick offsets off the body,
+      // one byte per bar.
+      uint8_t* ohl_t = ohl_w + off;
+      int32_t v1 = 0;
+      for (int64_t s = 0; s < kNSlots; ++s) {
+        const int32_t dop = dov[s];
+        const int32_t h_off = dhv[s] - (dop > 0 ? dop : 0);
+        const int32_t l_off = (dop < 0 ? dop : 0) - dlv[s];
+        v1 |= (dop < -8) | (dop > 7) | (h_off < 0) | (h_off > 3) |
+              (l_off < 0) | (l_off > 3);
+        ohl_t[s] = static_cast<uint8_t>((dop & 0xF) | ((h_off & 3) << 4) |
+                                        ((l_off & 3) << 6));
+      }
+      viol[1] |= v1;
+    } else if (ohl_mode == 1) {
       // wick pack: int8 body delta + nibble wick offsets off the body.
       // Both bytes store as one little-endian uint16 (byte0 = body,
       // byte1 = wick nibbles) so the loop is a plain int32->uint16 pack.
@@ -409,7 +426,7 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
             ((((h_off & 0xF) << 4) | (l_off & 0xF)) << 8));
       }
       viol[1] |= v1;
-    } else if (ohl_mode == 1) {
+    } else if (ohl_mode == 2) {
       int32_t v1 = 0;
       for (int64_t s = 0; s < kNSlots; ++s) {
         const int32_t dop = dov[s], dh = dhv[s], dl = dlv[s];
@@ -430,14 +447,38 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
         ohl16[(off + s) * 3 + 2] = static_cast<int16_t>(dlv[s]);
       }
     }
-    if (vol_mode == 0) {
+    if (vol_mode <= 1) {
+      // 10-bit pack, four values per 5 bytes (little-endian bit stream);
+      // mode 1 packs board lots (shares/100) instead of shares.
+      uint8_t* vp = static_cast<uint8_t*>(volume_out) + t * (kNSlots / 4 * 5);
+      const int32_t div = vol_mode == 1 ? 100 : 1;
+      int32_t v2 = 0;
+      for (int64_t g = 0; g < kNSlots / 4; ++g) {
+        int32_t q[4];
+        for (int k = 0; k < 4; ++k) {
+          const int32_t raw = vt[g * 4 + k];
+          const int32_t u = raw / div;
+          v2 |= (raw - u * div != 0) | (u > 1023);
+          q[k] = u & 1023;
+        }
+        vp[g * 5 + 0] = static_cast<uint8_t>(q[0] & 0xFF);
+        vp[g * 5 + 1] =
+            static_cast<uint8_t>((q[0] >> 8) | ((q[1] & 0x3F) << 2));
+        vp[g * 5 + 2] =
+            static_cast<uint8_t>((q[1] >> 6) | ((q[2] & 0xF) << 4));
+        vp[g * 5 + 3] =
+            static_cast<uint8_t>((q[2] >> 4) | ((q[3] & 0x3) << 6));
+        vp[g * 5 + 4] = static_cast<uint8_t>(q[3] >> 2);
+      }
+      viol[2] |= v2;
+    } else if (vol_mode == 2) {
       int32_t v2 = 0;
       for (int64_t s = 0; s < kNSlots; ++s) {
         v2 |= vt[s] > 0xFFFF;
         v16[off + s] = static_cast<uint16_t>(vt[s]);
       }
       viol[2] |= v2;
-    } else if (vol_mode == 1) {
+    } else if (vol_mode == 3) {
       int32_t v2 = 0;
       for (int64_t s = 0; s < kNSlots; ++s) {
         const int32_t q = vt[s] / 100;
@@ -455,6 +496,6 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
 }
 
 // Exported so Python can assert ABI compatibility at load time.
-int64_t grid_pack_abi_version() { return 9; }
+int64_t grid_pack_abi_version() { return 10; }
 
 }  // extern "C"

@@ -129,6 +129,20 @@ def test_failed_day_is_skipped_and_reported(minute_dir, tmp_path):
     assert not os.path.exists(cache + ".failures.json")
 
 
+def test_corrupted_day_file_is_skipped_and_reported(minute_dir, tmp_path):
+    """I/O failures isolate per day exactly like kernel failures (C8:
+    reference catches *any* per-file exception, MinuteFrequentFactorCICC.py
+    :20-25) — a truncated/garbage parquet must not take down the run."""
+    bad = [f for f in os.listdir(minute_dir) if f.startswith("20240103")][0]
+    with open(os.path.join(minute_dir, bad), "wb") as fh:
+        fh.write(b"not a parquet file")
+    t = compute_exposures(minute_dir, NAMES, cfg=_cfg(), progress=False,
+                          cache_path=str(tmp_path / "f.parquet"))
+    assert t.failures.keys() == ["2024-01-03"]
+    assert np.datetime64("2024-01-03") not in t.columns["date"]
+    assert len(np.unique(t.columns["date"])) == 2
+
+
 def test_wire_unrepresentable_day_falls_back_to_raw(tmp_path, rng):
     """Off-tick prices make wire.encode return None; the pipeline must
     ship raw f32 and produce the same numbers it would with wire off."""

@@ -7,6 +7,7 @@ import numpy as np
 from replication_of_minute_frequency_factor_tpu.data import wire
 
 fails = []
+modes_seen = set()
 lo, hi = int(sys.argv[1]), int(sys.argv[2])
 for seed in range(lo, hi):
     rng = np.random.default_rng(seed)
@@ -19,11 +20,18 @@ for seed in range(lo, hi):
     open_ = close * (1 + rng.normal(0, 1e-4, shape))
     high = np.maximum(open_, close) * (1 + np.abs(rng.normal(0, 2e-4, shape)))
     low = np.minimum(open_, close) * (1 - np.abs(rng.normal(0, 2e-4, shape)))
-    vol_kind = rng.integers(0, 3)
-    volume = (rng.integers(0, 1000, shape) *
-              (100 if vol_kind == 0 else 1)).astype(np.float64)
-    if vol_kind == 2:
-        volume *= 1e5  # big volumes -> int32 mode
+    # volume kinds span the full mode ladder: 10-bit lots / 10-bit
+    # shares / i32 / u16 shares / u16 lots
+    vol_kind = rng.integers(0, 5)
+    if vol_kind in (0, 1):
+        volume = (rng.integers(0, 1000, shape) *
+                  (100 if vol_kind == 0 else 1)).astype(np.float64)
+    elif vol_kind == 2:
+        volume = rng.integers(0, 1000, shape).astype(np.float64) * 1e5
+    elif vol_kind == 3:
+        volume = rng.integers(0, 60000, shape).astype(np.float64)
+    else:
+        volume = (rng.integers(0, 60000, shape) * 100).astype(np.float64)
     bars = np.stack([open_, high, low, close, volume], -1)
     bars[..., :4] = np.round(bars[..., :4], 2)
     bars = np.maximum(bars, 0.01 * (np.arange(5) < 4)).astype(np.float32)
@@ -41,6 +49,9 @@ for seed in range(lo, hi):
     fa, fb = {}, {}
     a = wire.encode(bars, mask, use_native=True, floor=fa)
     b = wire.encode(bars, mask, use_native=False, floor=fb)
+    if a is not None:
+        modes_seen.add(("o%d" % fa.get("ohl_mode", 0),
+                        "v%d" % fa.get("vol_mode", 0)))
     try:
         assert (a is None) == (b is None), (a is None, b is None)
         if a is not None:
@@ -61,4 +72,4 @@ for seed in range(lo, hi):
         print(f"SEED {seed} FAILED: {str(e)[:300]}", flush=True)
     if (seed - lo + 1) % 100 == 0:
         print(f"...{seed - lo + 1} done, {len(fails)} failures", flush=True)
-print(f"DONE {hi-lo} seeds, {len(fails)} failures: {fails}")
+print(f"DONE {hi-lo} seeds, {len(fails)} failures: {fails}; modes: {sorted(modes_seen)}")
