@@ -55,10 +55,10 @@ class Config:
     #: once-per-machine cost instead of once-per-process (applied lazily
     #: by the pipeline via apply_compilation_cache); None = off
     compilation_cache_dir: Optional[str] = None
-    #: ship day batches as tick-deltas (int8/int16), lot volume
-    #: (uint16/int32) and a bit-packed mask (data/wire.py, ~3.4x fewer
-    #: wire bytes on typical data; auto-falls back to f32 when
-    #: unrepresentable)
+    #: ship day batches as packed tick-deltas (int4-pair/int8/int16),
+    #: packed lot volume (10-bit/uint16/int32) and a bit-packed mask
+    #: (data/wire.py, ~7x fewer wire bytes on typical data; auto-falls
+    #: back to f32 when unrepresentable)
     wire_transfer: bool = True
 
     @classmethod

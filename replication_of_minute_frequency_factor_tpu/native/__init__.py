@@ -51,13 +51,13 @@ def load() -> Optional[ctypes.CDLL]:
     except OSError:
         return None
     lib.grid_pack_abi_version.restype = ctypes.c_int64
-    if lib.grid_pack_abi_version() != 10:
+    if lib.grid_pack_abi_version() != 11:
         # stale build from an older source tree: rebuild once
         if not _build():
             return None
         lib = ctypes.CDLL(_LIB_PATH)
         lib.grid_pack_abi_version.restype = ctypes.c_int64
-        if lib.grid_pack_abi_version() != 10:
+        if lib.grid_pack_abi_version() != 11:
             return None
     lib.grid_pack.restype = ctypes.c_int64
     lib.grid_pack.argtypes = [
@@ -79,7 +79,8 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_uint8),   # mask [n,240]
         ctypes.c_int64,                   # n_tickers (flattened)
         ctypes.c_double,                  # inv_tick
-        ctypes.c_int64,                   # dclose_mode (0 i8, 1 i16)
+        ctypes.c_int64,                   # dclose_mode (0 int4-pair,
+                                          #   1 i8, 2 i16)
         ctypes.c_int64,                   # ohl_mode (0 tight, 1 wick,
                                           #           2 i8x3, 3 i16x3)
         ctypes.c_int64,                   # vol_mode (0/1 10-bit shares/
@@ -126,7 +127,8 @@ def grid_pack_native(tidx: np.ndarray, time: np.ndarray, open_: np.ndarray,
 
 
 #: per-field format ladders, narrowest first (shared with the numpy path)
-DCLOSE_DTYPES = (np.int8, np.int16)
+#: (slots-axis length, dtype): int4-pair pack / int8 / int16
+DCLOSE_SHAPES = ((120, np.uint8), (240, np.int8), (240, np.int16))
 #: tight 1-byte pack / 2-byte wick pack / int8 x3 / int16 x3
 OHL_SHAPES = ((1, np.uint8), (2, np.uint8), (3, np.int8), (3, np.int16))
 #: (slots-axis length, dtype): 10-bit packed shares / 10-bit packed lots /
@@ -179,7 +181,8 @@ def wire_encode_native(bars: np.ndarray, mask: np.ndarray,
         cm = floor.get("dclose_mode", 0)
         om = floor.get("ohl_mode", 0)
         vm = floor.get("vol_mode", 0)
-        dclose = np.empty((n, 240), DCLOSE_DTYPES[cm])
+        clen, cdt = DCLOSE_SHAPES[cm]
+        dclose = np.empty((n, clen), cdt)
         width, odt = OHL_SHAPES[om]
         dohl = np.empty((n, 240, width), odt)
         vlen, vdt = VOL_SHAPES[vm]
@@ -214,7 +217,7 @@ def wire_encode_native(bars: np.ndarray, mask: np.ndarray,
             floor["vol_mode"] = vm + 1
 
     vol_scale = 100.0 if floor.get("vol_mode", 0) in VOL_LOT_MODES else 1.0
-    return (base.reshape(lead), dclose.reshape(lead + (240,)),
+    return (base.reshape(lead), dclose.reshape(lead + (dclose.shape[-1],)),
             dohl.reshape(lead + (240, dohl.shape[-1])),
             volume.reshape(lead + (volume.shape[-1],)), vol_scale)
 
@@ -242,6 +245,15 @@ def pack_tight(dohl: np.ndarray) -> np.ndarray:
     b = (dop.astype(np.int8).view(np.uint8) & 0xF) \
         | (h_off << 4) | (l_off << 6)
     return b[..., None]
+
+
+def pack_dclose4(dclose: np.ndarray) -> np.ndarray:
+    """int16 ``[..., 240]`` close deltas (each |d| <= 7) -> uint8
+    ``[..., 120]``: two int4 two's-complement deltas per byte, even slot
+    in the low nibble."""
+    u = (dclose.astype(np.int8).view(np.uint8) & 0xF) \
+        .reshape(dclose.shape[:-1] + (dclose.shape[-1] // 2, 2))
+    return (u[..., 0] | (u[..., 1] << 4)).astype(np.uint8)
 
 
 def pack_vol10(vol: np.ndarray) -> np.ndarray:
@@ -277,8 +289,10 @@ def narrow_wire(base, dclose, dohl, volume, stats, floor=None):
             floor[key] = mode
         return mode
 
-    cm = pick("dclose_mode", (dmax_c <= 127, True))
+    cm = pick("dclose_mode", (dmax_c <= 7, dmax_c <= 127, True))
     if cm == 0:
+        dclose = pack_dclose4(dclose)
+    elif cm == 1:
         dclose = dclose.astype(np.int8)
     om = pick("ohl_mode", (bool(tight_ok), bool(wick_ok),
                            dmax_ohl <= 127, True))

@@ -114,10 +114,11 @@ int64_t grid_pack(const int64_t* tidx, const int64_t* time,
 // (data/wire.py), writing the FINAL narrow dtypes in one pass. The caller
 // requests a format per field (its widen-only floor) and the encoder
 // aborts with violation flags when the data does not fit, so the common
-// case is a single pass that writes ~5 bytes/bar with no host-side
+// case is a single pass that writes ~3 bytes/bar with no host-side
 // re-narrowing; widenings are rare (bounded per run) retries.
 //
-// Modes — dclose: 0 = int8, 1 = int16.
+// Modes — dclose: 0 = int4-pair pack (two deltas/byte, |d| <= 7),
+//                 1 = int8, 2 = int16.
 //         ohl:    0 = 1-byte tight pack (int4 open-close delta | 2-bit
 //                     high/low wick offsets), 1 = 2-byte wick pack (int8
 //                     delta + nibble wicks), 2 = int8 x3, 3 = int16 x3.
@@ -382,6 +383,18 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
     // partial garbage on a widen-retry, same contract as before).
     const int64_t off = t * kNSlots;
     if (dclose_mode == 0) {
+      // int4-pair pack: two two's-complement deltas per byte, even slot
+      // in the low nibble.
+      uint8_t* dc4 = static_cast<uint8_t*>(dclose_out) + t * (kNSlots / 2);
+      int32_t v0 = 0;
+      for (int64_t g = 0; g < kNSlots / 2; ++g) {
+        const int32_t d0 = dcv[g * 2], d1 = dcv[g * 2 + 1];
+        const int32_t a0 = d0 < 0 ? -d0 : d0, a1 = d1 < 0 ? -d1 : d1;
+        v0 |= (a0 > 7) | (a1 > 7);
+        dc4[g] = static_cast<uint8_t>((d0 & 0xF) | ((d1 & 0xF) << 4));
+      }
+      viol[0] |= v0;
+    } else if (dclose_mode == 1) {
       int32_t v0 = 0;
       for (int64_t s = 0; s < kNSlots; ++s) {
         const int32_t d = dcv[s], a = d < 0 ? -d : d;
@@ -496,6 +509,6 @@ int64_t wire_encode(const float* bars, const uint8_t* mask, int64_t n_tickers,
 }
 
 // Exported so Python can assert ABI compatibility at load time.
-int64_t grid_pack_abi_version() { return 10; }
+int64_t grid_pack_abi_version() { return 11; }
 
 }  // extern "C"

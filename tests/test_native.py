@@ -59,7 +59,9 @@ def test_native_wire_encode_matches_numpy(rng):
     b = wire.encode(bars, mask, use_native=False)
     assert a is not None and b is not None
     np.testing.assert_array_equal(a.base, b.base)
-    # identical narrowing choices (int8 deltas / uint16 lot volume)
+    # identical narrowing choices across the three mode ladders
+    # (int4-pair/int8/int16 deltas, tight/wick/per-field OHL,
+    # 10-bit/u16/i32 volume)
     assert a.dclose.dtype == b.dclose.dtype
     assert a.dohl.dtype == b.dohl.dtype
     assert a.volume.dtype == b.volume.dtype
@@ -88,6 +90,49 @@ def test_native_wire_encode_matches_numpy(rng):
     nan_only[0][tuple(vi[0])][4] = np.nan
     assert wire.encode(nan_only, mask, use_native=True) is None
     assert wire.encode(nan_only, mask, use_native=False) is None
+
+
+def test_wire_int4_dclose_mode_pinned():
+    """The narrowest dclose rung (int4-pair, mode 0) specifically: a day
+    whose tick deltas stay within +/-7 must select it on BOTH encoders,
+    pack byte-identically (even slot in the low nibble), and decode to
+    the exact tick walk — including across masked gaps and a widen-only
+    escalation at exactly +/-8."""
+    from replication_of_minute_frequency_factor_tpu.data import wire
+
+    deltas = np.zeros(240, np.int64)
+    deltas[1], deltas[2], deltas[3] = 7, -7, 1    # boundary values
+    deltas[9], deltas[11], deltas[100] = 5, -3, 2  # odd + even slots
+    mask = np.ones((1, 1, 240), bool)
+    mask[0, 0, 4:9] = False                        # gap accumulates
+    ct = 1000 + np.cumsum(deltas)
+    close = (ct * 0.01).astype(np.float32)
+    vol = np.full(240, 500.0, np.float32)
+    bars = np.stack([close, close, close, close, vol], -1)[None, None]
+    encs = []
+    for use_native in (True, False):
+        w = wire.encode(bars, mask, use_native=use_native)
+        assert w.dclose.shape[-1] == 120 and w.dclose.dtype == np.uint8
+        encs.append(w)
+        ob, om = map(np.asarray, wire.decode(*w.arrays))
+        np.testing.assert_array_equal(om, mask)
+        got = np.round(ob[0, 0, :, 3] / 0.01).astype(np.int64)
+        np.testing.assert_array_equal(got[mask[0, 0]], ct[mask[0, 0]])
+    np.testing.assert_array_equal(encs[0].dclose, encs[1].dclose)
+    # +/-8 is out of the int4 range: both encoders widen to int8 and the
+    # decoded ticks are unchanged
+    d8 = deltas.copy()
+    d8[11] = 8
+    ct8 = 1000 + np.cumsum(d8)
+    c8 = (ct8 * 0.01).astype(np.float32)
+    b8 = np.stack([c8, c8, c8, c8, vol], -1)[None, None]
+    for use_native in (True, False):
+        f = {}
+        w8 = wire.encode(b8, mask, use_native=use_native, floor=f)
+        assert w8.dclose.dtype == np.int8 and f["dclose_mode"] == 1
+        ob8, _ = map(np.asarray, wire.decode(*w8.arrays))
+        got8 = np.round(ob8[0, 0, :, 3] / 0.01).astype(np.int64)
+        np.testing.assert_array_equal(got8[mask[0, 0]], ct8[mask[0, 0]])
 
 
 def test_wire_encode_threaded_matches_single(rng):

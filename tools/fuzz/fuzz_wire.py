@@ -50,7 +50,8 @@ for seed in range(lo, hi):
     a = wire.encode(bars, mask, use_native=True, floor=fa)
     b = wire.encode(bars, mask, use_native=False, floor=fb)
     if a is not None:
-        modes_seen.add(("o%d" % fa.get("ohl_mode", 0),
+        modes_seen.add(("c%d" % fa.get("dclose_mode", 0),
+                        "o%d" % fa.get("ohl_mode", 0),
                         "v%d" % fa.get("vol_mode", 0)))
     try:
         assert (a is None) == (b is None), (a is None, b is None)
