@@ -56,7 +56,19 @@ def qcut_labels(exposure, valid, group_num: int):
         lo = jnp.floor(pos).astype(jnp.int32)
         hi = jnp.ceil(pos).astype(jnp.int32)
         frac = pos - lo
-        edges = sx[lo] * (1 - frac) + sx[hi] * frac
+        # np.quantile's exact _lerp, branch included: a + t*(b-a) below
+        # t=0.5, b - (b-a)*(1-t) at or above. The two-product form
+        # lo*(1-frac) + hi*frac is inexact in f32 even when both
+        # endpoints are EQUAL (fuzz seed 6290: a [-0.1, -0.1]
+        # cross-section produced an edge one ulp below the tied value,
+        # shifting its bucket), and the single-sided a + t*(b-a) still
+        # sits one ulp off numpy for frac >= 0.5 with distinct
+        # endpoints — only the two-sided form reproduces the oracle's
+        # edges bit-for-bit (both branches are exact for d == 0).
+        d = sx[hi] - sx[lo]
+        edges = jnp.where(frac >= 0.5,
+                          sx[hi] - d * (1 - frac),
+                          sx[lo] + frac * d)
         # right-closed buckets like polars/pandas qcut: x <= edge_i -> bucket i
         lab = jnp.sum(x[:, None] > edges[None, :], axis=-1)
         return jnp.where(m & (n > 0), lab, -1)

@@ -217,6 +217,16 @@ def test_qcut_polars_duplicate_break_semantics(rng):
     le = np.asarray(eval_ops.qcut_labels(
         np.full((1, 8), 2.5, np.float32), me, 4))
     assert (le[0] == 0).all()
+    # ... including values f32 can't represent exactly: a two-product
+    # lerp once nudged the edge one ulp below the tied value and shifted
+    # its bucket (fuzz seed 6290, a [-0.1, -0.1] cross-section). 2.5
+    # alone can't catch that — it IS representable.
+    for v in (-0.1, 0.3, 1e-7, -3.3333):
+        mv = np.zeros((1, 8), bool)
+        mv[0, :2] = True
+        lv = np.asarray(eval_ops.qcut_labels(
+            np.full((1, 8), v, np.float32), mv, 5))
+        assert (lv[0, :2] == 0).all(), (v, lv)
 
 
 def test_group_test_values_match_pandas_oracle(pv_setup, rng):

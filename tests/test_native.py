@@ -135,6 +135,56 @@ def test_wire_int4_dclose_mode_pinned():
         np.testing.assert_array_equal(got8[mask[0, 0]], ct8[mask[0, 0]])
 
 
+def test_wire_tight_ohl_and_vol10_layout_pinned():
+    """Byte layouts of the other two narrowest rungs, deterministically:
+    tight OHL = int4 body | 2-bit wicks (high << 4, low << 6); vol10 =
+    four 10-bit values per 5 bytes, little-endian bit stream. Checked
+    against hand-computed bytes on both encoders, plus exact decode."""
+    from replication_of_minute_frequency_factor_tpu.data import wire
+
+    tick = 0.01
+    ct = np.full(240, 2000, np.int64)         # flat close at 20.00 CNY
+    dop = np.zeros(240, np.int64)
+    h_off = np.zeros(240, np.int64)
+    l_off = np.zeros(240, np.int64)
+    # slot 0: open 3 ticks above close, wicks 0 -> byte (3&0xF) = 0x03
+    dop[0] = 3
+    # slot 1: open 2 below, high wick 1, low wick 2
+    #   -> (-2 & 0xF) | (1 << 4) | (2 << 6) = 0x0E | 0x10 | 0x80 = 0x9E
+    dop[1], h_off[1], l_off[1] = -2, 1, 2
+    # slot 2: boundary body -8 (allowed), wicks 3
+    #   -> 0x08 | 0x30 | 0xC0 = 0xF8
+    dop[2], h_off[2], l_off[2] = -8, 3, 3
+    ot = ct + dop
+    ht = np.maximum(ct, ot) + h_off
+    lt = np.minimum(ct, ot) - l_off
+    vol_lots = np.zeros(240, np.int64)
+    vol_lots[:4] = [1, 2, 3, 1023]  # one full 5-byte group
+    bars = np.stack([(ot * tick), (ht * tick), (lt * tick), (ct * tick),
+                     vol_lots * 100.0], -1).astype(np.float32)[None, None]
+    mask = np.ones((1, 1, 240), bool)
+    for use_native in (True, False):
+        w = wire.encode(bars, mask, use_native=use_native)
+        assert w.dohl.shape[-1] == 1 and w.volume.shape[-1] == 300
+        assert w.vol_scale == 100.0
+        got_ohl = w.dohl[0, 0, :3, 0]
+        np.testing.assert_array_equal(got_ohl, [0x03, 0x9E, 0xF8])
+        # vol10 group: v=[1,2,3,1023] ->
+        # b0=1, b1=(0)|(2&0x3F)<<2=8, b2=(2>>6)|(3&0xF)<<4=0x30,
+        # b3=(3>>4)|(1023&3)<<6=0xC0, b4=1023>>2=0xFF
+        np.testing.assert_array_equal(
+            w.volume[0, 0, :5], [0x01, 0x08, 0x30, 0xC0, 0xFF])
+        ob, om = map(np.asarray, wire.decode(*w.arrays))
+        np.testing.assert_array_equal(om, mask)
+        np.testing.assert_array_equal(
+            np.round(ob[0, 0, :, 0] / tick).astype(np.int64), ot)
+        np.testing.assert_array_equal(
+            np.round(ob[0, 0, :, 1] / tick).astype(np.int64), ht)
+        np.testing.assert_array_equal(
+            np.round(ob[0, 0, :, 2] / tick).astype(np.int64), lt)
+        np.testing.assert_array_equal(ob[0, 0, :, 4], vol_lots * 100.0)
+
+
 def test_wire_encode_threaded_matches_single(rng):
     """Chunked multi-thread encode is bit-identical to one pass, including
     the merged narrowing stats."""
