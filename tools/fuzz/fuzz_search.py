@@ -1,0 +1,190 @@
+"""Symbolic-search interpreter fuzz: the vmapped postfix evaluator
+(search.eval_programs) vs an independent same-precision (f32) numpy
+oracle over random genomes, day shapes, and masks.
+
+Comparison policy: condition-aware tolerance (error relative to the
+chain's own max intermediate magnitude, since cancellation makes the
+final value arbitrarily smaller than its inputs) and degeneracy skips
+for measure-zero branch flips (zscore of a near-constant series; a
+protected-divide divisor within its accumulated f32 uncertainty of the
+gate). A systematic interpreter bug diverges on healthy lanes across
+many seeds, which neither escape hatch can mask."""
+import sys
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+import numpy as np
+from replication_of_minute_frequency_factor_tpu import search
+
+
+def np_features(bars, mask):
+    # f32 throughout: this is an IMPLEMENTATION oracle (same formulas,
+    # same precision, independent code), not a precision oracle — an f64
+    # oracle branches differently at the protected-divide |b| > eps and
+    # zscore sd > 0 gates and diverges arbitrarily on measure-zero input
+    o, h, l, c, v = (bars[..., i].astype(np.float32) for i in range(5))
+    eps = np.float32(1e-12)
+    ret = (c - o) / np.where(np.abs(o) > eps, o, 1.0)
+    vshare = v / np.maximum(
+        np.sum(np.where(mask, v, 0.0), axis=-1, keepdims=True), 1.0)
+    hlr = (h - l) / np.where(np.abs(l) > eps, l, 1.0)
+    tod = np.broadcast_to(
+        np.linspace(-1.0, 1.0, bars.shape[-2]).astype(np.float32),
+        mask.shape)
+    feats = np.stack([o, h, l, c, v, ret, vshare, hlr, tod])
+    assert feats.dtype == np.float32  # a single f64 input would promote all
+    return feats
+
+
+def np_masked_mean(x, m):
+    n = m.sum(-1)
+    s = np.where(m, x, np.float32(0.0)).sum(-1, dtype=np.float32)
+    return np.where(n > 0, s / np.maximum(n, 1),
+                    np.float32(np.nan)).astype(np.float32)
+
+
+def np_masked_std(x, m, ddof=1):
+    n = m.sum(-1)
+    mu = np_masked_mean(x, m)
+    d = np.where(m, x - mu[..., None], np.float32(0.0))
+    m2 = (d ** 2).sum(-1, dtype=np.float32)
+    with np.errstate(invalid="ignore"):
+        return np.sqrt(np.where(n > ddof, m2 / np.maximum(n - ddof, 1),
+                                np.float32(np.nan))).astype(np.float32)
+
+
+def np_unary(k, x, m, flag=None):
+    mu = np_masked_mean(x, m)
+    sd = np_masked_std(x, m)
+    if flag is not None and k == 4:
+        # zscore of a (near-)constant series: whether f32 sd rounds to
+        # exactly 0 depends on reduction order, and the sd > 0 branch
+        # then swings the result by ~1/ulp — incomparable by
+        # construction, like the parity suite's beta-std snap band
+        with np.errstate(invalid="ignore"):
+            xmax = np.max(np.where(m, np.abs(x), 0.0), axis=-1)
+        # only where sd is defined: an all-masked/n<=1 lane has sd NaN
+        # and must STAY comparable so the halted-ticker -> NaN property
+        # is still asserted
+        flag |= np.isfinite(sd) & (sd <= 64 * np.finfo(np.float32).eps
+                                   * np.nan_to_num(xmax))
+    with np.errstate(invalid="ignore"):
+        z = (x - mu[..., None]) / np.where(sd[..., None] > 0,
+                                           sd[..., None], 1.0)
+    lag = np.concatenate([x[..., :1], x[..., :-1]], axis=-1)
+    return [x, -x, np.abs(x), np.log1p(np.abs(x)).astype(np.float32),
+            z.astype(np.float32), lag,
+            np.cumsum(np.where(m, x, np.float32(0.0)), axis=-1,
+                      dtype=np.float32)][k]
+
+
+def np_binary(k, a, b, m=None, flag=None, scale=None):
+    eps = np.float32(1e-6)
+    if flag is not None and k == 3:
+        # protected divide: a divisor within its own accumulated f32
+        # uncertainty of the 1e-6 gate (or of zero) can take either
+        # branch / either sign depending on upstream rounding — e.g. a
+        # masked cumsum of the tod ramp crosses zero mid-series with
+        # ~240*eps*scale of noise. Flag those lanes as incomparable;
+        # systematic interpreter bugs diverge on lanes far from the
+        # gate too, so this cannot mask one.
+        uncert = 480 * np.finfo(np.float32).eps \
+            * np.maximum(scale, 1.0)[..., None]
+        near = m & (np.abs(b).astype(np.float64) <= eps + uncert)
+        flag |= near.any(axis=-1)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return [a + b, a - b, a * b,
+                (a / np.where(np.abs(b) > eps, b,
+                              np.where(b >= 0, eps,
+                                       -eps))).astype(np.float32),
+                np.minimum(a, b), np.maximum(a, b)][k]
+
+
+def np_eval(genome, bars, mask, skeleton):
+    """Returns (value [D,T], chain_scale [D,T]) — chain_scale is the max
+    |intermediate| seen per (day, ticker) across the whole program, the
+    magnitude against which f32 rounding of the chain is relative."""
+    feats = np_features(bars, mask)
+    stack = []
+    scale = np.zeros(mask.shape[:-1], np.float64)
+    degenerate = np.zeros(mask.shape[:-1], bool)
+
+    def see(x):
+        with np.errstate(invalid="ignore"):
+            mx = np.max(np.where(mask, np.abs(x.astype(np.float64)), 0.0),
+                        axis=-1)
+        np.maximum(scale, np.nan_to_num(mx), out=scale)
+        return x
+
+    for slot, kind in enumerate(skeleton):
+        g = int(genome[slot])
+        if kind == search.PUSH:
+            stack.append(see(feats[g]))
+        elif kind == search.UNARY:
+            stack.append(see(np_unary(g, stack.pop(), mask,
+                                      flag=degenerate)))
+        else:
+            b = stack.pop()
+            a = stack.pop()
+            stack.append(see(np_binary(g, a, b, mask, flag=degenerate,
+                                       scale=scale)))
+    return np_masked_mean(stack[0], mask), scale, degenerate
+
+
+fails = []
+lo, hi = int(sys.argv[1]), int(sys.argv[2])
+for seed in range(lo, hi):
+    rng = np.random.default_rng(seed)
+    D = int(rng.integers(1, 3))
+    T = int(rng.integers(2, 8))
+    shape = (D, T, 240)
+    close = 10.0 * np.exp(np.cumsum(
+        rng.normal(0, 1e-3, shape), -1)).astype(np.float32)
+    open_ = (close * (1 + rng.normal(0, 1e-4, shape))).astype(np.float32)
+    high = np.maximum(open_, close) * 1.0002
+    low = np.minimum(open_, close) * 0.9998
+    vol = (rng.integers(0, 1000, shape) * 100).astype(np.float32)
+    bars = np.stack([open_, high, low, close, vol], -1).astype(np.float32)
+    mask = rng.random(shape) > rng.choice([0.0, 0.1, 0.6])
+    if rng.random() < 0.3:
+        mask[:, 0] = False  # halted ticker -> NaN factor
+    P = int(rng.integers(1, 24))
+    genomes = search.random_population(rng, P)
+    got = np.asarray(search.eval_programs(
+        genomes, bars, mask, search.DEFAULT_SKELETON))
+    try:
+        for p in range(P):
+            want, scale, degen = np_eval(genomes[p], bars, mask,
+                                         search.DEFAULT_SKELETON)
+            cmp_ok = ~degen
+            assert (np.isnan(got[p][cmp_ok]) == np.isnan(want[cmp_ok])).all(), \
+                (seed, p, got[p], want)
+            # both sides inf: agreement iff the signs match (a product
+            # chain can legitimately overflow f32)
+            ji, oi = np.isinf(got[p]), np.isinf(want)
+            both_inf = ji & oi & cmp_ok
+            assert (np.sign(got[p][both_inf])
+                    == np.sign(want[both_inf])).all(), (seed, p)
+            assert (ji == oi)[cmp_ok].all(), (seed, p, got[p], want)
+            fin = ~np.isnan(want) & ~oi & cmp_ok
+            if fin.any():
+                # condition-aware tolerance: XLA's fusion/FMA and numpy
+                # differ by ~1 ulp per op, and cancellation-heavy chains
+                # (zero-mean cumsum -> mean) make the FINAL value
+                # arbitrarily smaller than the intermediates it was
+                # computed from — so error is judged relative to the
+                # chain's own magnitude, n_slots * 240-term reductions
+                # deep: ~240 * 15 * eps_f32 ~ 4e-4 worst case.
+                denom = np.maximum(scale[fin], 1.0)
+                rel = np.abs(got[p][fin].astype(np.float64)
+                             - want[fin].astype(np.float64)) / denom
+                assert rel.max() < 5e-4, (seed, p, rel.max(),
+                                          genomes[p].tolist())
+    except AssertionError as e:
+        fails.append(seed)
+        print(f"SEED {seed} FAILED: {str(e)[:300]}", flush=True)
+    except Exception as e:  # keep the sweep alive like the sibling harnesses
+        fails.append(seed)
+        print(f"SEED {seed} CRASH: {e!r}", flush=True)
+    if (seed - lo + 1) % 25 == 0:
+        print(f"...{seed - lo + 1} done, {len(fails)} failures", flush=True)
+print(f"DONE {hi-lo} seeds, {len(fails)} failures: {fails}")
