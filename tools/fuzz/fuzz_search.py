@@ -172,12 +172,19 @@ for seed in range(lo, hi):
                 # (zero-mean cumsum -> mean) make the FINAL value
                 # arbitrarily smaller than the intermediates it was
                 # computed from — so error is judged relative to the
-                # chain's own magnitude, n_slots * 240-term reductions
-                # deep: ~240 * 15 * eps_f32 ~ 4e-4 worst case.
+                # chain's own magnitude. The bound: chained 240-term
+                # cumsums compound rounding ~n*eps per link relative to
+                # their INPUT l1-mass, which can exceed the max-|value|
+                # scale tracked here when the cumsum itself cancels
+                # (observed up to 1.1e-3 on cumsum-of-zscore chains,
+                # seeds 224/310). 2e-3 covers that with 2x margin and
+                # still exposes real op bugs: a systematic distortion at
+                # op magnitude shows as >= 1/240 ~ 4e-3 of chain scale
+                # even when diluted by the final mean over 240 slots.
                 denom = np.maximum(scale[fin], 1.0)
                 rel = np.abs(got[p][fin].astype(np.float64)
                              - want[fin].astype(np.float64)) / denom
-                assert rel.max() < 5e-4, (seed, p, rel.max(),
+                assert rel.max() < 2e-3, (seed, p, rel.max(),
                                           genomes[p].tolist())
     except AssertionError as e:
         fails.append(seed)
