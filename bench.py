@@ -94,6 +94,14 @@ def main():
 
     rng = np.random.default_rng(0)
     names = factor_names()
+    iters, warmup = ITERS, WARMUP
+    if _SUFFIX == "_cpu_fallback_tunnel_down":
+        # CPU fallback specifically (not any externally set suffix): the
+        # number is a tunnel-down indicator, not a TPU perf claim — one
+        # warmup + two timed batches keeps the round-end run a few
+        # minutes instead of ten (the per-batch -> full-year
+        # extrapolation is unchanged)
+        iters, warmup = 2, 1
     batches = [make_batch(rng) for _ in range(2)]
     bars, mask = batches[0]
 
@@ -116,7 +124,7 @@ def main():
         return compute_packed_prepared(buf, spec, kind, names=names,
                                        replicate_quirks=True)
 
-    for _ in range(WARMUP):
+    for _ in range(warmup):
         jax.block_until_ready(launch(encode_pack(bars, mask)))
         jax.block_until_ready(launch(encode_pack(*batches[1])))
 
@@ -129,18 +137,18 @@ def main():
     q: "queue.Queue" = queue.Queue(maxsize=2)
 
     def produce():
-        for i in range(ITERS):
+        for i in range(iters):
             q.put(encode_pack(*batches[i % 2]))
 
     t0 = time.perf_counter()
     threading.Thread(target=produce, daemon=True).start()
     outs = []
-    for i in range(ITERS):
+    for i in range(iters):
         outs.append(launch(q.get()))
         if i >= 2:
             jax.block_until_ready(outs[i - 2])
     jax.block_until_ready(outs)
-    per_batch = (time.perf_counter() - t0) / ITERS
+    per_batch = (time.perf_counter() - t0) / iters
     full_year = per_batch * (TRADING_DAYS_PER_YEAR / DAYS_PER_BATCH)
     target = 60.0
     print(json.dumps({
