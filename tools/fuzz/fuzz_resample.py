@@ -47,7 +47,12 @@ for seed in range(lo, hi):
                 # pandas oracle
                 want_rows = []
                 for c, g in df.groupby("code"):
-                    g = g.sort_values("date").set_index("date")["value"]
+                    # f64: pandas on raw f32 loses the z-score's tiny
+                    # deviations to cancellation (seed 10706: two values
+                    # 1.2e-4 apart -> oracle 3e-4 off the exact +-1/sqrt2
+                    # while the library lands it exactly)
+                    g = g.sort_values("date").set_index("date")["value"] \
+                        .astype(np.float64)
                     g.index = pd.to_datetime(g.index)
                     if mode == "calendar":
                         # polars group_by_dynamic: windows start Monday /
