@@ -12,11 +12,22 @@ lo, hi = int(sys.argv[1]), int(sys.argv[2])
 for seed in range(lo, hi):
     rng = np.random.default_rng(seed)
     try:
-        tp._compare(
-            synth_day(rng, n_codes=10, missing_prob=0.12,
-                      zero_volume_prob=0.12, constant_price_codes=2,
-                      short_day_codes=3),
-            f"fuzz{seed}", noisy=True)
+        # rotate the scenario shape too (universe size, sparsity,
+        # degenerate-code mix) so sweeps explore beyond one fixed
+        # day-shape distribution; seeds below 10k keep the historical
+        # shape so the regression-pinned seeds stay reproducible
+        if seed < 10_000:
+            kw = dict(n_codes=10, missing_prob=0.12, zero_volume_prob=0.12,
+                      constant_price_codes=2, short_day_codes=3)
+        else:
+            n_codes = int(rng.integers(3, 40))
+            kw = dict(
+                n_codes=n_codes,
+                missing_prob=float(rng.choice([0.02, 0.12, 0.35])),
+                zero_volume_prob=float(rng.choice([0.0, 0.12, 0.4])),
+                constant_price_codes=int(rng.integers(0, n_codes // 2 + 1)),
+                short_day_codes=int(rng.integers(0, n_codes // 2 + 1)))
+        tp._compare(synth_day(rng, **kw), f"fuzz{seed}", noisy=True)
     except AssertionError as e:
         fails.append((seed, str(e)[:400]))
         print(f"SEED {seed} FAILED:\n{str(e)[:400]}\n", flush=True)

@@ -32,7 +32,10 @@ for seed in range(lo, hi):
     value = np.concatenate(rows["value"]).astype(np.float32)
 
     f = MinFreqFactor("toy").set_exposure(code, date, value)
-    df = pd.DataFrame({"code": code, "date": date, "value": value})
+    # f64 once here: pandas on raw f32 loses the z-score's deviations to
+    # cancellation (seed 10706) — the library computes in f64 too
+    df = pd.DataFrame({"code": code, "date": date,
+                       "value": value.astype(np.float64)})
 
     try:
         for mode, freq in (("calendar", "week"), ("calendar", "month"),
@@ -47,12 +50,7 @@ for seed in range(lo, hi):
                 # pandas oracle
                 want_rows = []
                 for c, g in df.groupby("code"):
-                    # f64: pandas on raw f32 loses the z-score's tiny
-                    # deviations to cancellation (seed 10706: two values
-                    # 1.2e-4 apart -> oracle 3e-4 off the exact +-1/sqrt2
-                    # while the library lands it exactly)
-                    g = g.sort_values("date").set_index("date")["value"] \
-                        .astype(np.float64)
+                    g = g.sort_values("date").set_index("date")["value"]
                     g.index = pd.to_datetime(g.index)
                     if mode == "calendar":
                         # polars group_by_dynamic: windows start Monday /
