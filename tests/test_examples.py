@@ -1,4 +1,4 @@
-"""Both examples must run end to end as real subprocesses (the docs
+"""Every example must run end to end as a real subprocess (the docs
 point users at them; a stale API reference dies here, not on a user)."""
 
 import os
@@ -11,7 +11,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.mark.parametrize("script", ["quickstart.py",
-                                    "advanced_evaluation.py"])
+                                    "advanced_evaluation.py",
+                                    "symbolic_search.py"])
 def test_example_runs(script, tmp_path):
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     env["PYTHONPATH"] = os.pathsep.join(
