@@ -123,8 +123,11 @@ def config4(rng):
 
 
 def config5(rng, scale=1.0):
-    """Symbolic search: one vmapped fitness evaluation of a 10k-candidate
-    population over a day tensor (the hot loop of search.evolve)."""
+    """Symbolic search: one fitness call over a 10k-candidate population
+    (the hot loop of search.evolve). At this scale fitness auto-chunks
+    the population through an internal lax.map (~559-candidate chunks on
+    this day shape) so its HBM temporaries fit the chip — the timing is
+    the sequential chunked pass, not a single 10k vmap."""
     from replication_of_minute_frequency_factor_tpu import search
 
     pop_n = max(64, int(10_000 * scale))

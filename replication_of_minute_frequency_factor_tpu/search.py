@@ -130,17 +130,54 @@ def eval_programs(genomes, bars, mask,
     return jax.vmap(one)(genomes)
 
 
-@functools.partial(jax.jit, static_argnames=("skeleton",))
+#: auto-chunk budget: per-candidate stack temporaries are ``[D, T, 240]``
+#: and the interpreter keeps ~8 of them alive, so cap each vmapped chunk
+#: at this many f32 elements per temporary (128M = 512 MB -> ~4 GB live)
+_CHUNK_ELEMS = 128 * 1024 * 1024
+
+
+def auto_chunk(mask_shape) -> int:
+    """Largest population chunk whose ``[chunk, *mask_shape]`` stack
+    temporaries stay inside the ``_CHUNK_ELEMS`` budget."""
+    per_candidate = int(np.prod(mask_shape))
+    return max(1, _CHUNK_ELEMS // per_candidate)
+
+
+@functools.partial(jax.jit, static_argnames=("skeleton", "chunk"))
 def fitness(genomes, bars, mask, fwd_ret, fwd_valid,
-            skeleton: Tuple[int, ...] = DEFAULT_SKELETON):
-    """|mean per-date cross-sectional IC| per candidate -> ``[P]``."""
-    vals = eval_programs(genomes, bars, mask, skeleton)  # [P, D, T]
-    valid = jnp.isfinite(vals) & fwd_valid[None]
-    ic = masked_corr(jnp.where(valid, vals, 0.0),
-                     jnp.broadcast_to(jnp.where(valid, fwd_ret[None], 0.0),
-                                      vals.shape),
-                     valid)  # [P, D]
-    return jnp.abs(jnp.nanmean(ic, axis=-1))
+            skeleton: Tuple[int, ...] = DEFAULT_SKELETON,
+            chunk: int | None = None):
+    """|mean per-date cross-sectional IC| per candidate -> ``[P]``.
+
+    Large populations evaluate as a sequential ``lax.map`` over
+    ``chunk``-sized slices so HBM temporaries stay bounded: a single
+    10k-candidate vmap over a ``[1, 1000, 240]`` day materialises ~75 GB
+    of ``[P, D, T, 240]`` stack temporaries — far past a 16 GB chip.
+    ``chunk=None`` picks the largest chunk whose temporaries fit the
+    budget from the (static) day-tensor shape at trace time.
+    """
+    p_total = genomes.shape[0]
+    if chunk is None:
+        chunk = auto_chunk(mask.shape)
+
+    def chunk_fitness(g):
+        vals = eval_programs(g, bars, mask, skeleton)  # [p, D, T]
+        valid = jnp.isfinite(vals) & fwd_valid[None]
+        ic = masked_corr(jnp.where(valid, vals, 0.0),
+                         jnp.broadcast_to(
+                             jnp.where(valid, fwd_ret[None], 0.0),
+                             vals.shape),
+                         valid)  # [p, D]
+        return jnp.abs(jnp.nanmean(ic, axis=-1))
+
+    if p_total <= chunk:
+        return chunk_fitness(genomes)
+    pad = -p_total % chunk
+    g = genomes
+    if pad:
+        g = jnp.concatenate([g, jnp.zeros((pad, g.shape[1]), g.dtype)])
+    out = jax.lax.map(chunk_fitness, g.reshape(-1, chunk, g.shape[1]))
+    return out.reshape(-1)[:p_total]
 
 
 def _gene_bounds(skeleton):
@@ -170,8 +207,9 @@ def evolve(bars, mask, fwd_ret, fwd_valid,
     """Host-side GA around the device fitness kernel.
 
     Tournament-free truncation GA: keep the elite, refill with uniform
-    crossover of elite pairs + per-gene mutation. Every candidate in a
-    generation evaluates in ``ceil(pop/device_batch)`` fused device calls.
+    crossover of elite pairs + per-gene mutation. Each generation is ONE
+    fused device call; HBM stays bounded by ``fitness``'s internal
+    ``lax.map`` chunking, capped at ``min(device_batch, auto_chunk)``.
     """
     rng = np.random.default_rng(seed)
     bounds = _gene_bounds(skeleton)
@@ -180,12 +218,11 @@ def evolve(bars, mask, fwd_ret, fwd_valid,
     history = []
     best_g, best_f = genomes[0], -1.0
 
+    chunk = min(device_batch, auto_chunk(np.shape(mask)))
     for _ in range(generations):
-        fits = np.concatenate([
-            np.asarray(fitness(jnp.asarray(genomes[i:i + device_batch]),
-                               bars, mask, fwd_ret, fwd_valid,
-                               skeleton=skeleton))
-            for i in range(0, pop, device_batch)])
+        fits = np.asarray(fitness(jnp.asarray(genomes), bars, mask,
+                                  fwd_ret, fwd_valid,
+                                  skeleton=skeleton, chunk=chunk))
         fits = np.nan_to_num(fits, nan=-1.0)
         order = np.argsort(-fits)
         if fits[order[0]] > best_f:

@@ -63,3 +63,44 @@ def test_evolve_recovers_planted_signal(day_batch, rng):
     assert res.fitness > 0.5, search.describe(res.genome)
     # monotone-ish improvement
     assert res.history[-1] >= res.history[0]
+
+
+def test_fitness_chunked_matches_unchunked(day_batch, rng):
+    """Population chunking (the HBM bound for 10k-candidate fitness calls)
+    must not change any candidate's fitness — including a padded tail."""
+    bars, mask = day_batch
+    fwd = rng.normal(0, 0.02, bars.shape[:2]).astype(np.float32)
+    fwd_valid = np.ones_like(fwd, bool)
+    pop = search.random_population(rng, 101)  # 101 % 16 != 0 -> pad path
+
+    whole = np.asarray(search.fitness(pop, bars, mask, fwd, fwd_valid,
+                                      chunk=101))
+    chunked = np.asarray(search.fitness(pop, bars, mask, fwd, fwd_valid,
+                                        chunk=16))
+    np.testing.assert_allclose(chunked, whole, rtol=1e-5, atol=1e-7)
+
+
+def test_auto_chunk_derivation():
+    """Pin the shape -> chunk formula that bounds fitness HBM temporaries
+    (the ladder's 10k x [1,1000,240] config must actually chunk)."""
+    ladder_shape = (1, 1000, 240)
+    chunk = search.auto_chunk(ladder_shape)
+    assert chunk == search._CHUNK_ELEMS // (1000 * 240)
+    assert chunk < 10_000  # the OOM config takes the chunked path
+    # ~8 live [chunk, D, T, 240] f32 temporaries must fit a 16 GB chip
+    assert chunk * 1000 * 240 * 4 * 8 < 16e9
+    # tiny day tensors stay unchunked; degenerate shapes never hit 0
+    assert search.auto_chunk((3, 40, 240)) > 4000
+    assert search.auto_chunk((244, 5000, 240)) == 1
+
+
+def test_fitness_auto_chunk_executes(day_batch, rng):
+    """chunk=None resolves from the static day shape and runs."""
+    bars, mask = day_batch
+    fwd = rng.normal(0, 0.02, bars.shape[:2]).astype(np.float32)
+    fwd_valid = np.ones_like(fwd, bool)
+    pop = search.random_population(rng, 64)
+    auto = np.asarray(search.fitness(pop, bars, mask, fwd, fwd_valid))
+    explicit = np.asarray(search.fitness(pop, bars, mask, fwd, fwd_valid,
+                                         chunk=64))
+    np.testing.assert_allclose(auto, explicit, rtol=1e-5, atol=1e-7)
