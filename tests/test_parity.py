@@ -139,6 +139,17 @@ PDF_RANK_SLACK = 20.0
 
 
 def _check(label, name, code, ov, jvv, noisy, failures, aux=None):
+    if aux is not None and name in ("shape_skratio", "shape_skratioVol"):
+        # a degenerate denominator makes the ratio pure noise on EITHER
+        # side of any nan/inf/finite boundary (seed 30044: three
+        # symmetric return values -> f64 kurt exactly 0 -> oracle inf,
+        # while f32 skew is exactly 0 -> jax 0.0), so this skip must
+        # precede the nan/inf branches; see DEGENERATE_KURT
+        denom = aux.get(
+            "shape_kurt" if name == "shape_skratio" else "shape_kurtVol",
+            np.nan)
+        if np.isfinite(denom) and abs(denom) < DEGENERATE_KURT:
+            return
     if np.isnan(ov) != np.isnan(jvv):
         failures.append(f"{label}/{name}/{code}: nan mismatch "
                         f"oracle={ov} jax={jvv}")
@@ -155,15 +166,8 @@ def _check(label, name, code, ov, jvv, noisy, failures, aux=None):
     atol = ATOL.get(name, ATOL["default"])
     if noisy and name in NOISE_FACTORS:
         atol = max(atol, NOISE_ATOL)
-    if aux is not None:
-        if name in ("shape_skratio", "shape_skratioVol"):
-            denom = aux.get(
-                "shape_kurt" if name == "shape_skratio" else "shape_kurtVol",
-                np.nan)
-            if np.isfinite(denom) and abs(denom) < DEGENERATE_KURT:
-                return  # ratio of noise; see DEGENERATE_KURT
-        if name.startswith("doc_pdf"):
-            atol = max(atol, PDF_RANK_SLACK)
+    if aux is not None and name.startswith("doc_pdf"):
+        atol = max(atol, PDF_RANK_SLACK)
     if not np.isclose(ov, jvv, rtol=rtol, atol=atol):
         failures.append(f"{label}/{name}/{code}: oracle={ov!r} jax={jvv!r}")
 
@@ -244,6 +248,30 @@ def test_parity_boundary_regressions(seed):
         synth_day(rng, n_codes=10, missing_prob=0.12, zero_volume_prob=0.12,
                   constant_price_codes=2, short_day_codes=3),
         f"boundary{seed}", noisy=True)
+
+
+def wide_scenario_kw(rng):
+    """Scenario sampler shared with tools/fuzz/fuzz_parity.py for seeds
+    >= 10k (the rng draw ORDER is part of seed reproducibility)."""
+    n_codes = int(rng.integers(3, 40))
+    return dict(
+        n_codes=n_codes,
+        missing_prob=float(rng.choice([0.02, 0.12, 0.35])),
+        zero_volume_prob=float(rng.choice([0.0, 0.12, 0.4])),
+        constant_price_codes=int(rng.integers(0, n_codes // 2 + 1)),
+        short_day_codes=int(rng.integers(0, n_codes // 2 + 1)))
+
+
+@pytest.mark.parametrize("seed", [30044])
+def test_parity_wide_scenario_regressions(seed):
+    """Fuzz seeds from the widened (>=10k) scenario space: 30044 (a code
+    whose returns take three symmetric values, so skew and kurtosis are
+    both ~0 — f64 kurt is exactly 0 giving oracle skratio inf while f32
+    skew is exactly 0 giving jax 0.0; the degenerate-kurt skip must
+    precede the inf-mismatch branch)."""
+    rng = np.random.default_rng(seed)
+    _compare(synth_day(rng, **wide_scenario_kw(rng)), f"wide{seed}",
+             noisy=True)
 
 
 def test_parity_multiday_batch(rng):

@@ -20,13 +20,7 @@ for seed in range(lo, hi):
             kw = dict(n_codes=10, missing_prob=0.12, zero_volume_prob=0.12,
                       constant_price_codes=2, short_day_codes=3)
         else:
-            n_codes = int(rng.integers(3, 40))
-            kw = dict(
-                n_codes=n_codes,
-                missing_prob=float(rng.choice([0.02, 0.12, 0.35])),
-                zero_volume_prob=float(rng.choice([0.0, 0.12, 0.4])),
-                constant_price_codes=int(rng.integers(0, n_codes // 2 + 1)),
-                short_day_codes=int(rng.integers(0, n_codes // 2 + 1)))
+            kw = tp.wide_scenario_kw(rng)
         tp._compare(synth_day(rng, **kw), f"fuzz{seed}", noisy=True)
     except AssertionError as e:
         fails.append((seed, str(e)[:400]))
