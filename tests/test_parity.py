@@ -136,6 +136,75 @@ def _degenerate_beta_codes(df):
 #: a 27-member tie group moved doc_pdf95 by 13.5). Systematic errors are
 #: hundreds of units.
 PDF_RANK_SLACK = 20.0
+#: accumulation-noise band around the doc_pdf threshold: the device cumsum
+#: runs in f32 over up to 240 shares (each itself f32-rounded), so a
+#: cumulative share within ~240*eps_f32 of the threshold can cross one
+#:   group earlier/later than the f64 oracle
+PDF_EDGE_EPS = 3e-5
+_PDF_THRESHOLDS = {"doc_pdf60": 0.6, "doc_pdf70": 0.7, "doc_pdf80": 0.8,
+                   "doc_pdf90": 0.9, "doc_pdf95": 0.95}
+
+
+def _doc_pdf_acceptable(df: pd.DataFrame):
+    """Acceptance sets for doc_pdf* on a single-date frame.
+
+    Two measure-zero channels make the rank legitimately backend-dependent
+    (docs/DESIGN.md precision policy):
+      * threshold crossing: a group's cumulative share within float
+        rounding of the quantile edge crosses one group earlier/later —
+        modelled by re-reading the crossing at threshold +/- PDF_EDGE_EPS;
+      * tie structure: group-by-EXACT-float-return collapses f64-distinct
+        returns at f32 resolution (fuzz seed 30202: two cross-code global
+        tie groups merged, moving the average rank by 31.5), and can also
+        split or merge the crossing group itself — modelled by running
+        the walk a second time with the returns quantized to f32 before
+        ranking (and only the returns; see the share note below).
+    Returns ``{(code, factor): {acceptable rank values}}``; a jax value is
+    OK if it is within the normal slack of ANY member.
+
+    The walk itself (share definition, exact-value grouping, crossing
+    comparator) is the oracle's own ``_doc_pdf`` on ``Group`` objects —
+    only the global-rank wiring is rebuilt here, mirroring
+    ``compute_oracle``'s driver, because the f32 channel needs the DERIVED
+    return quantized before ranking (f32 division is correctly rounded,
+    so f64-divide-then-cast equals the device's f32 divide bit-for-bit).
+    Shares stay f64: they differ from device f32 shares by <=1 ulp each,
+    which the PDF_EDGE_EPS band already covers.
+    """
+    from replication_of_minute_frequency_factor_tpu.oracle.kernels import (
+        Group, _doc_pdf)
+    from replication_of_minute_frequency_factor_tpu.oracle.stats import (
+        rank_average)
+    df = df.sort_values(["code", "time"], kind="stable")
+    code = df["code"].to_numpy()
+    cols = {c: df[c].to_numpy(np.float64)
+            for c in ("open", "high", "low", "close", "volume")}
+    time = df["time"].to_numpy(np.int64)
+    starts = np.r_[0, np.nonzero(code[1:] != code[:-1])[0] + 1, len(code)]
+    spans = list(zip(starts[:-1], starts[1:]))
+    out: dict = {}
+    for quantize in (False, True):
+        eod = np.empty(len(df), np.float64)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            c = cols["close"]
+            if quantize:
+                c = c.astype(np.float32).astype(np.float64)
+            for b0, b1 in spans:
+                eod[b0:b1] = c[b1 - 1] / c[b0:b1]
+        if quantize:
+            eod = eod.astype(np.float32).astype(np.float64)
+        grank = rank_average(eod)
+        for b0, b1 in spans:
+            g = Group(time=time[b0:b1],
+                      **{k: v[b0:b1] for k, v in cols.items()},
+                      grank=grank[b0:b1])
+            for name, thr in _PDF_THRESHOLDS.items():
+                acc = out.setdefault((code[b0], name), set())
+                for t in (thr - PDF_EDGE_EPS, thr, thr + PDF_EDGE_EPS):
+                    val = _doc_pdf(g, t)
+                    if np.isfinite(val):
+                        acc.add(float(val))
+    return out
 
 
 def _check(label, name, code, ov, jvv, noisy, failures, aux=None):
@@ -183,6 +252,7 @@ def _compare(day, label, noisy=False):
     assert set(jax_out) == set(factor_names())
 
     failures = []
+    pdf_acceptable = None  # lazy: only built when a doc_pdf check fails
     for name in factor_names():
         for ti, code in enumerate(g.codes):
             if (name in ("mmt_ols_qrs", "mmt_ols_beta_zscore_last")
@@ -193,8 +263,23 @@ def _compare(day, label, noisy=False):
             aux = ({k: oracle.loc[code, k]
                     for k in ("shape_kurt", "shape_kurtVol")}
                    if in_oracle else {})
-            _check(label, name, code, ov, jax_out[name][ti], noisy, failures,
-                   aux=aux)
+            jvv = jax_out[name][ti]
+            if name in _PDF_THRESHOLDS:
+                tmp: list = []
+                _check(label, name, code, ov, jvv, noisy, tmp, aux=aux)
+                if not tmp:
+                    continue
+                if pdf_acceptable is None:
+                    pdf_acceptable = _doc_pdf_acceptable(df)
+                def _alt_ok(alt):
+                    t2: list = []
+                    _check(label, name, code, alt, jvv, noisy, t2, aux=aux)
+                    return not t2
+                if not any(_alt_ok(a)
+                           for a in pdf_acceptable.get((code, name), ())):
+                    failures.extend(tmp)
+                continue
+            _check(label, name, code, ov, jvv, noisy, failures, aux=aux)
     assert not failures, "\n".join(failures[:40]) + f"\n({len(failures)} total)"
 
 
@@ -262,13 +347,17 @@ def wide_scenario_kw(rng):
         short_day_codes=int(rng.integers(0, n_codes // 2 + 1)))
 
 
-@pytest.mark.parametrize("seed", [30044])
+@pytest.mark.parametrize("seed", [30044, 30202, 30658])
 def test_parity_wide_scenario_regressions(seed):
     """Fuzz seeds from the widened (>=10k) scenario space: 30044 (a code
     whose returns take three symmetric values, so skew and kurtosis are
     both ~0 — f64 kurt is exactly 0 giving oracle skratio inf while f32
     skew is exactly 0 giving jax 0.0; the degenerate-kurt skip must
-    precede the inf-mismatch branch)."""
+    precede the inf-mismatch branch); 30202 (f32 quantization merges two
+    cross-code global return tie groups, moving doc_pdf90/95's average
+    rank by 31.5 — the f32-quantized acceptance walk); 30658 (a
+    cumulative share exactly ON the 0.9 edge in f64, one ulp above —
+    the threshold +/- PDF_EDGE_EPS acceptance band)."""
     rng = np.random.default_rng(seed)
     _compare(synth_day(rng, **wide_scenario_kw(rng)), f"wide{seed}",
              noisy=True)
