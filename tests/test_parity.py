@@ -241,6 +241,41 @@ def _check(label, name, code, ov, jvv, noisy, failures, aux=None):
         failures.append(f"{label}/{name}/{code}: oracle={ov!r} jax={jvv!r}")
 
 
+def _check_cell(label, name, code, ov, jvv, noisy, failures, aux,
+                pdf_acceptance):
+    """One (factor, code) comparison — THE comparator protocol, shared by
+    the single-day and multiday paths so policy fixes can't diverge.
+    ``pdf_acceptance`` is a zero-arg callable returning that date's
+    (lazily built) ``{(code, name): values}`` doc_pdf acceptance sets."""
+    if name in _PDF_THRESHOLDS:
+        tmp: list = []
+        _check(label, name, code, ov, jvv, noisy, tmp, aux=aux)
+        if not tmp:
+            return
+
+        def _alt_ok(alt):
+            t2: list = []
+            _check(label, name, code, alt, jvv, noisy, t2, aux=aux)
+            return not t2
+        if not any(_alt_ok(a)
+                   for a in pdf_acceptance().get((code, name), ())):
+            failures.extend(tmp)
+        return
+    _check(label, name, code, ov, jvv, noisy, failures, aux=aux)
+
+
+def _lazy(build):
+    """Memoise a zero-arg builder (the doc_pdf acceptance sets are only
+    computed when some doc_pdf cell actually fails the primary check)."""
+    cache: list = []
+
+    def get():
+        if not cache:
+            cache.append(build())
+        return cache[0]
+    return get
+
+
 def _compare(day, label, noisy=False):
     df = pd.DataFrame(day)
     oracle = compute_oracle(df).set_index("code")
@@ -252,7 +287,7 @@ def _compare(day, label, noisy=False):
     assert set(jax_out) == set(factor_names())
 
     failures = []
-    pdf_acceptable = None  # lazy: only built when a doc_pdf check fails
+    pdf_acceptance = _lazy(lambda: _doc_pdf_acceptable(df))
     for name in factor_names():
         for ti, code in enumerate(g.codes):
             if (name in ("mmt_ols_qrs", "mmt_ols_beta_zscore_last")
@@ -263,23 +298,8 @@ def _compare(day, label, noisy=False):
             aux = ({k: oracle.loc[code, k]
                     for k in ("shape_kurt", "shape_kurtVol")}
                    if in_oracle else {})
-            jvv = jax_out[name][ti]
-            if name in _PDF_THRESHOLDS:
-                tmp: list = []
-                _check(label, name, code, ov, jvv, noisy, tmp, aux=aux)
-                if not tmp:
-                    continue
-                if pdf_acceptable is None:
-                    pdf_acceptable = _doc_pdf_acceptable(df)
-                def _alt_ok(alt):
-                    t2: list = []
-                    _check(label, name, code, alt, jvv, noisy, t2, aux=aux)
-                    return not t2
-                if not any(_alt_ok(a)
-                           for a in pdf_acceptable.get((code, name), ())):
-                    failures.extend(tmp)
-                continue
-            _check(label, name, code, ov, jvv, noisy, failures, aux=aux)
+            _check_cell(label, name, code, ov, jax_out[name][ti], noisy,
+                        failures, aux, pdf_acceptance)
     assert not failures, "\n".join(failures[:40]) + f"\n({len(failures)} total)"
 
 
@@ -363,35 +383,58 @@ def test_parity_wide_scenario_regressions(seed):
              noisy=True)
 
 
-def test_parity_multiday_batch(rng):
-    """Two days batched on a leading axis vs a two-date oracle frame —
-    notably the doc_pdf* global rank must be per-day on both sides."""
-    day1 = synth_day(rng, n_codes=6, missing_prob=0.05, date="2024-01-02")
-    day2 = synth_day(rng, n_codes=6, missing_prob=0.05, date="2024-01-03")
-    df = pd.concat([pd.DataFrame(day1), pd.DataFrame(day2)])
+def _compare_multiday(days, label, noisy=False):
+    """Days batched on a leading axis vs a multi-date oracle frame, with
+    the full single-day comparator machinery (degenerate-beta skips,
+    doc_pdf acceptance sets) applied per date — the production path is
+    batched (pipeline days_per_batch), so parity must hold here too.
+    Notably the doc_pdf* global rank must be per-day on both sides."""
+    df = pd.concat([pd.DataFrame(d) for d in days])
     oracle = compute_oracle(df).set_index(["code", "date"])
 
-    g1 = grid_day(day1["code"], day1["time"], day1["open"], day1["high"],
-                  day1["low"], day1["close"], day1["volume"])
-    g2 = grid_day(day2["code"], day2["time"], day2["open"], day2["high"],
-                  day2["low"], day2["close"], day2["volume"],
-                  codes=g1.codes)
-    bars = np.stack([g1.bars, g2.bars])
-    mask = np.stack([g1.mask, g2.mask])
+    beta_deg = set()
+    for d, sub in df.groupby("date"):
+        beta_deg |= {(c, d) for c in _degenerate_beta_codes(sub)}
+
+    grids = [grid_day(d["code"], d["time"], d["open"], d["high"],
+                      d["low"], d["close"], d["volume"],
+                      codes=np.unique(np.concatenate(
+                          [d["code"] for d in days])))
+             for d in days]
+    codes = grids[0].codes  # grid_day re-sorts; read the axis back off it
+    bars = np.stack([g.bars for g in grids])
+    mask = np.stack([g.mask for g in grids])
     out = {k: np.asarray(v)
            for k, v in compute_factors_jit(bars, mask).items()}
 
+    dates = [d["date"][0] for d in days]
     failures = []
+    pdf_acc = {d: _lazy(lambda d=d: _doc_pdf_acceptable(df[df.date == d]))
+               for d in dates}
     for name in factor_names():
-        assert out[name].shape == (2, len(g1.codes))
-        for di, d in enumerate([day1["date"][0], day2["date"][0]]):
-            for ti, code in enumerate(g1.codes):
+        assert out[name].shape == (len(days), len(codes))
+        for di, d in enumerate(dates):
+            for ti, code in enumerate(codes):
+                if (name in ("mmt_ols_qrs", "mmt_ols_beta_zscore_last")
+                        and (code, d) in beta_deg):
+                    continue
                 key = (code, d)
-                ov = (oracle.loc[key, name]
-                      if key in oracle.index else np.nan)
-                _check(f"multiday{di}", name, code, ov,
-                       out[name][di, ti], True, failures)
-    assert not failures, "\n".join(failures[:40])
+                in_oracle = key in oracle.index
+                ov = oracle.loc[key, name] if in_oracle else np.nan
+                aux = ({k: oracle.loc[key, k]
+                        for k in ("shape_kurt", "shape_kurtVol")}
+                       if in_oracle else {})
+                _check_cell(f"{label}d{di}", name, code, ov,
+                            out[name][di, ti], noisy, failures, aux,
+                            pdf_acc[d])
+    assert not failures, "\n".join(failures[:40]) + f"\n({len(failures)} total)"
+
+
+def test_parity_multiday_batch(rng):
+    _compare_multiday(
+        [synth_day(rng, n_codes=6, missing_prob=0.05, date="2024-01-02"),
+         synth_day(rng, n_codes=6, missing_prob=0.05, date="2024-01-03")],
+        "multiday", noisy=True)
 
 
 def test_quirk_aliases(rng):

@@ -21,7 +21,16 @@ for seed in range(lo, hi):
                       constant_price_codes=2, short_day_codes=3)
         else:
             kw = tp.wide_scenario_kw(rng)
-        tp._compare(synth_day(rng, **kw), f"fuzz{seed}", noisy=True)
+        # seeds >= 31k: a third of runs exercise the BATCHED multiday
+        # path (the production shape) — 2-3 days stacked on the leading
+        # axis vs a multi-date oracle frame
+        if seed >= 31_000 and rng.random() < 0.35:
+            n_days = int(rng.integers(2, 4))
+            days = [synth_day(rng, **kw, date=f"2024-01-{2 + i:02d}")
+                    for i in range(n_days)]
+            tp._compare_multiday(days, f"fuzz{seed}", noisy=True)
+        else:
+            tp._compare(synth_day(rng, **kw), f"fuzz{seed}", noisy=True)
     except AssertionError as e:
         fails.append((seed, str(e)[:400]))
         print(f"SEED {seed} FAILED:\n{str(e)[:400]}\n", flush=True)
