@@ -367,7 +367,24 @@ def wide_scenario_kw(rng):
         short_day_codes=int(rng.integers(0, n_codes // 2 + 1)))
 
 
-@pytest.mark.parametrize("seed", [30044, 30202, 30658])
+def run_wide_scenario_seed(seed, label=None):
+    """One wide-scenario fuzz seed, exactly as tools/fuzz/fuzz_parity.py
+    runs it (same rng draw order; seeds >= 31k may take the batched
+    multiday branch) — shared so pinned regressions replay the harness
+    bit-for-bit."""
+    rng = np.random.default_rng(seed)
+    kw = wide_scenario_kw(rng)
+    label = label or f"wide{seed}"
+    if seed >= 31_000 and rng.random() < 0.35:
+        n_days = int(rng.integers(2, 4))
+        days = [synth_day(rng, **kw, date=f"2024-01-{2 + i:02d}")
+                for i in range(n_days)]
+        _compare_multiday(days, label, noisy=True)
+    else:
+        _compare(synth_day(rng, **kw), label, noisy=True)
+
+
+@pytest.mark.parametrize("seed", [30044, 30202, 30658, 31069])
 def test_parity_wide_scenario_regressions(seed):
     """Fuzz seeds from the widened (>=10k) scenario space: 30044 (a code
     whose returns take three symmetric values, so skew and kurtosis are
@@ -377,10 +394,10 @@ def test_parity_wide_scenario_regressions(seed):
     cross-code global return tie groups, moving doc_pdf90/95's average
     rank by 31.5 — the f32-quantized acceptance walk); 30658 (a
     cumulative share exactly ON the 0.9 edge in f64, one ulp above —
-    the threshold +/- PDF_EDGE_EPS acceptance band)."""
-    rng = np.random.default_rng(seed)
-    _compare(synth_day(rng, **wide_scenario_kw(rng)), f"wide{seed}",
-             noisy=True)
+    the threshold +/- PDF_EDGE_EPS acceptance band); 31069 (multiday
+    batch whose degenerate-beta skip keys must hash-match: pandas
+    Timestamp vs np.datetime64)."""
+    run_wide_scenario_seed(seed)
 
 
 def _compare_multiday(days, label, noisy=False):
@@ -389,12 +406,19 @@ def _compare_multiday(days, label, noisy=False):
     doc_pdf acceptance sets) applied per date — the production path is
     batched (pipeline days_per_batch), so parity must hold here too.
     Notably the doc_pdf* global rank must be per-day on both sides."""
-    df = pd.concat([pd.DataFrame(d) for d in days])
+    dfs = [pd.DataFrame(d) for d in days]
+    df = pd.concat(dfs)
     oracle = compute_oracle(df).set_index(["code", "date"])
 
+    # key the skip set with the SAME np.datetime64 objects the cell loop
+    # uses: a pandas groupby would yield pd.Timestamp keys, which compare
+    # equal to np.datetime64 but do not hash-equal in a set (fuzz seed
+    # 31069: the skip silently never fired and a degenerate beta-z cell
+    # was compared)
     beta_deg = set()
-    for d, sub in df.groupby("date"):
-        beta_deg |= {(c, d) for c in _degenerate_beta_codes(sub)}
+    for day, sub in zip(days, dfs):
+        beta_deg |= {(c, day["date"][0])
+                     for c in _degenerate_beta_codes(sub)}
 
     grids = [grid_day(d["code"], d["time"], d["open"], d["high"],
                       d["low"], d["close"], d["volume"],
