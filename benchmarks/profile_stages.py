@@ -27,31 +27,40 @@ from replication_of_minute_frequency_factor_tpu.pipeline import (  # noqa: E402
 def main():
     rng = np.random.default_rng(0)
     names = factor_names()
-    batches = [make_batch(rng) for _ in range(2)]
-    bars, mask = batches[0]
+    N = 5
+    # every timed transfer ships DISTINCT bytes (separate sets for the
+    # put stage, the put+compute stage, and the loop below), so a
+    # content-addressed cache anywhere in the transfer path cannot
+    # flatter a stage — see bench.py
+    batches = [make_batch(rng) for _ in range(N)]
+    comp_packed = [wire.pack_arrays(wire.encode(*make_batch(rng)).arrays)
+                   for _ in range(N)]
 
-    # warm (compile + first transfers)
-    w = wire.encode(bars, mask)
+    # warm (compile + first transfers) — its own batch
+    w = wire.encode(*make_batch(rng))
     buf, spec = wire.pack_arrays(w.arrays)
     out = _compute_packed_jit(jax.device_put(buf), spec, "wire", names,
                               True, "conv")
     jax.block_until_ready(out)
 
-    def best(f, n=5):
+    def best(f, items):
         ts = []
-        for _ in range(n):
+        for it in items:
             t0 = time.perf_counter()
-            r = f()
+            r = f(it)
             if r is not None:
                 jax.block_until_ready(r)
             ts.append(time.perf_counter() - t0)
         return min(ts)
 
-    enc = best(lambda: wire.encode(bars, mask))
-    pack = best(lambda: wire.pack_arrays(w.arrays))
-    put = best(lambda: jax.device_put(buf))
-    comp = best(lambda: _compute_packed_jit(jax.device_put(buf), spec,
-                                            "wire", names, True, "conv"))
+    wires = [wire.encode(b, m) for b, m in batches]
+    packed = [wire.pack_arrays(wi.arrays) for wi in wires]
+    enc = best(lambda bm: wire.encode(*bm), batches)
+    pack = best(lambda wi: wire.pack_arrays(wi.arrays), wires)
+    put = best(lambda p: jax.device_put(p[0]), packed)
+    comp = best(lambda p: _compute_packed_jit(jax.device_put(p[0]), p[1],
+                                              "wire", names, True, "conv"),
+                comp_packed)
     print(f"stages: encode {enc*1e3:.0f}ms  pack {pack*1e3:.0f}ms  "
           f"put {put*1e3:.0f}ms  put+compute {comp*1e3:.0f}ms  "
           f"wire {buf.nbytes/1e6:.1f}MB")
@@ -62,9 +71,12 @@ def main():
     q: "queue.Queue" = queue.Queue(maxsize=2)
     ITERS = 5
 
+    del batches, wires, packed, comp_packed  # stage-timing data is dead
+    loop_batches = [make_batch(rng) for _ in range(ITERS)]
+
     def produce():
         for i in range(ITERS):
-            wi = wire.encode(*batches[i % 2])
+            wi = wire.encode(*loop_batches[i])
             q.put(wire.pack_arrays(wi.arrays))
 
     t0 = time.perf_counter()

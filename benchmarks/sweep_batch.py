@@ -11,19 +11,21 @@ from replication_of_minute_frequency_factor_tpu.models.registry import factor_na
 names = factor_names()
 for D in (8, 16, 32):
     rng = np.random.default_rng(0)
-    batches = [bench.make_batch(rng, n_days=D) for _ in range(2)]
+    ITERS = max(3, 32 // D)  # amortize over >= 32 days per config
+    # distinct bytes every iteration (incl. warmup) so transfer-path
+    # content caching cannot flatter the number — see bench.py
+    batches = [bench.make_batch(rng, n_days=D) for _ in range(ITERS + 1)]
     def ep(b, m):
         w = wire.encode(b, m)
         return wire.pack_arrays(w.arrays) + ("wire",)
     def launch(item):
         buf, spec, kind = item
         return compute_packed_prepared(buf, spec, kind, names=names, replicate_quirks=True)
-    t0=time.perf_counter(); jax.block_until_ready(launch(ep(*batches[0]))); warm=time.perf_counter()-t0
+    t0=time.perf_counter(); jax.block_until_ready(launch(ep(*batches[ITERS]))); warm=time.perf_counter()-t0
     import queue, threading
-    ITERS = max(3, 32 // D)  # amortize over >= 32 days per config
     q = queue.Queue(maxsize=2)
     def produce():
-        for i in range(ITERS): q.put(ep(*batches[i % 2]))
+        for i in range(ITERS): q.put(ep(*batches[i]))
     t0=time.perf_counter(); threading.Thread(target=produce, daemon=True).start()
     outs=[]
     for i in range(ITERS):

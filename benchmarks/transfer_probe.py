@@ -1,0 +1,59 @@
+"""Does the attached-TPU link dedupe repeated identical buffers?
+
+bench.py alternates the SAME two packed batches across its timed
+iterations. If the tunnel (or any layer under jax.device_put) caches
+transfers by content, those iterations ride the cache and the headline
+understates true streaming cost over 31 distinct batches. This probe
+settles it: time device_put+ready for (a) one buffer sent repeatedly,
+(b) a fresh random buffer of the same size each time, (c) the same
+LOGICAL bytes in a freshly allocated array each time (catches id()- or
+pointer-keyed caching as distinct from content-keyed).
+
+Run on the TPU:  python benchmarks/transfer_probe.py [size_mb]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+SIZE_MB = float(sys.argv[1]) if len(sys.argv) > 1 else 28.0
+N = 6
+
+
+def timed_put(buf):
+    t0 = time.perf_counter()
+    jax.block_until_ready(jax.device_put(buf))
+    return time.perf_counter() - t0
+
+
+def main():
+    rng = np.random.default_rng(0)
+    nbytes = int(SIZE_MB * 1e6)
+    base = rng.integers(0, 256, nbytes, dtype=np.uint8)
+
+    print(f"platform={jax.devices()[0].platform}  size={SIZE_MB:.1f}MB")
+    timed_put(base)  # first-touch / warmup
+
+    same = [timed_put(base) for _ in range(N)]
+    fresh = [timed_put(rng.integers(0, 256, nbytes, dtype=np.uint8))
+             for _ in range(N)]
+    copies = [timed_put(base.copy()) for _ in range(N)]
+
+    def fmt(ts):
+        return (f"min {min(ts)*1e3:7.1f}ms  med {sorted(ts)[len(ts)//2]*1e3:7.1f}ms  "
+                f"-> {SIZE_MB/1e3/min(ts):6.2f} GB/s at min")
+
+    print("same buffer      :", fmt(same))
+    print("fresh random     :", fmt(fresh))
+    print("copy of same     :", fmt(copies))
+    ratio = min(same) / min(fresh)
+    print(f"same/fresh ratio : {ratio:.3f}  "
+          f"({'DEDUP SUSPECTED' if ratio < 0.5 else 'no dedup evidence'})")
+
+
+if __name__ == "__main__":
+    main()
