@@ -155,8 +155,12 @@ def main():
     for i in range(iters):
         outs.append(launch(q.get()))
         if i >= 2:
-            jax.block_until_ready(outs[i - 2])
-    jax.block_until_ready(outs)
+            # materialize to host like the real driver's pipeline lag
+            # (pipeline.materialize): the [58, D, T] result crosses the
+            # link too (~9 MB/batch), so it belongs in the wall clock
+            np.asarray(outs[i - 2])
+    for o in outs[-2:]:
+        np.asarray(o)
     per_batch = (time.perf_counter() - t0) / iters
     full_year = per_batch * (TRADING_DAYS_PER_YEAR / DAYS_PER_BATCH)
     target = 60.0

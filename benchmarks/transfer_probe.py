@@ -11,14 +11,11 @@ pointer-keyed caching as distinct from content-keyed).
 
 Run on the TPU:  python benchmarks/transfer_probe.py [size_mb]
 """
-import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-import jax  # noqa: E402
-import numpy as np  # noqa: E402
+import jax
+import numpy as np
 
 SIZE_MB = float(sys.argv[1]) if len(sys.argv) > 1 else 28.0
 N = 6
