@@ -355,10 +355,12 @@ def test_parity_boundary_regressions(seed):
         f"boundary{seed}", noisy=True)
 
 
-def wide_scenario_kw(rng):
+def wide_scenario_kw(rng, big=False):
     """Scenario sampler shared with tools/fuzz/fuzz_parity.py for seeds
-    >= 10k (the rng draw ORDER is part of seed reproducibility)."""
-    n_codes = int(rng.integers(3, 40))
+    >= 10k (the rng draw ORDER is part of seed reproducibility).
+    ``big`` (seeds >= 32k) draws 40-120 code universes — richer
+    cross-code tie structures for the global-rank chip factors."""
+    n_codes = int(rng.integers(40, 121)) if big else int(rng.integers(3, 40))
     return dict(
         n_codes=n_codes,
         missing_prob=float(rng.choice([0.02, 0.12, 0.35])),
@@ -373,7 +375,7 @@ def run_wide_scenario_seed(seed, label=None):
     multiday branch) — shared so pinned regressions replay the harness
     bit-for-bit."""
     rng = np.random.default_rng(seed)
-    kw = wide_scenario_kw(rng)
+    kw = wide_scenario_kw(rng, big=seed >= 32_000)
     label = label or f"wide{seed}"
     if seed >= 31_000 and rng.random() < 0.35:
         n_days = int(rng.integers(2, 4))
