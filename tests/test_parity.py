@@ -8,6 +8,7 @@ ties). NaN/absent positions must agree exactly; values to per-factor f32
 tolerances.
 """
 
+import jax
 import numpy as np
 import pandas as pd
 import pytest
@@ -484,3 +485,34 @@ def test_quirk_aliases(rng):
     assert not np.allclose(fixed["mmt_bottom20VolumeRet"],
                            out["mmt_bottom50VolumeRet"])
     assert not np.allclose(fixed["doc_vol50_ratio"], out["doc_vol5_ratio"])
+
+
+@pytest.mark.parametrize("name,distort", [
+    ("vol_return1min", lambda v: v * 1.01),      # 1% scale error
+    ("mmt_am", lambda v: v + 1e-2),              # absolute offset (the
+    # factor is a ~1.0 close/open ratio, so +1e-3 would hide inside the
+    # default 2e-3 rtol — caught when the jit-cache fix armed this case)
+    ("doc_pdf90", lambda v: v + 60.0),           # systematic rank shift
+    ("shape_skew", lambda v: v * 1.05),          # noisy-family factor
+])
+def test_comparator_detects_injected_distortion(rng, monkeypatch,
+                                                name, distort):
+    """Meta-test: after every acceptance mechanism (degeneracy skips,
+    doc_pdf acceptance sets, noise atols — noisy=True arms the loosest
+    tolerance path), a genuinely distorted kernel must STILL fail the
+    compare on ITS OWN factor — guards the comparator against growing
+    too loose. The jit cache keys on shapes + static args only, not on
+    registry contents, so it is cleared around the mutation (before: a
+    clean same-shape graph from an earlier test must not mask the
+    mutation; after: the mutated graph must not leak to later tests)."""
+    from replication_of_minute_frequency_factor_tpu.models import registry
+    orig = registry.resolve(name)
+    monkeypatch.setitem(registry.FACTORS, name,
+                        lambda ctx: distort(orig(ctx)))
+    jax.clear_caches()
+    try:
+        with pytest.raises(AssertionError, match=f"mutated/{name}/"):
+            _compare(synth_day(rng, n_codes=23, missing_prob=0.1),
+                     "mutated", noisy=True)
+    finally:
+        jax.clear_caches()
