@@ -85,6 +85,13 @@ RTOL_OVERRIDE = {
 #: whole percents there. Both moments are still compared individually at
 #: sharp tolerances — only the ratio is skipped.
 DEGENERATE_KURT = 0.05
+#: absolute f32 wobble of a kurtosis estimate (observed 6.6e-4 on a
+#: 29-bar day, fuzz seed 32461); just above the DEGENERATE_KURT cutoff
+#: this alone puts ~KURT_ABS_NOISE/|kurt| of relative error into the
+#: skew/kurt ratio, so the ratio's rtol widens by that term — a smooth
+#: generalization of the hard skip that stays sharp for healthy kurt
+#: (at |kurt|=1 it adds 0.15%)
+KURT_ABS_NOISE = 1.5e-3
 #: beta z-score numerator below which the mmt_ols z family is f32 noise:
 #: each window's beta carries eps_beta ~ 1e-6..3e-6 relative f32 error
 #: (conv formulation, ops/rolling.py), so the z relative error is
@@ -209,6 +216,7 @@ def _doc_pdf_acceptable(df: pd.DataFrame):
 
 
 def _check(label, name, code, ov, jvv, noisy, failures, aux=None):
+    ratio_denom = None
     if aux is not None and name in ("shape_skratio", "shape_skratioVol"):
         # a degenerate denominator makes the ratio pure noise on EITHER
         # side of any nan/inf/finite boundary (seed 30044: three
@@ -220,6 +228,8 @@ def _check(label, name, code, ov, jvv, noisy, failures, aux=None):
             np.nan)
         if np.isfinite(denom) and abs(denom) < DEGENERATE_KURT:
             return
+        if np.isfinite(denom):
+            ratio_denom = abs(denom)
     if np.isnan(ov) != np.isnan(jvv):
         failures.append(f"{label}/{name}/{code}: nan mismatch "
                         f"oracle={ov} jax={jvv}")
@@ -234,6 +244,8 @@ def _check(label, name, code, ov, jvv, noisy, failures, aux=None):
         return
     rtol = RTOL_OVERRIDE.get(name, RTOL["default"])
     atol = ATOL.get(name, ATOL["default"])
+    if ratio_denom is not None:
+        rtol += KURT_ABS_NOISE / ratio_denom  # see KURT_ABS_NOISE
     if noisy and name in NOISE_FACTORS:
         atol = max(atol, NOISE_ATOL)
     if aux is not None and name.startswith("doc_pdf"):
@@ -387,7 +399,7 @@ def run_wide_scenario_seed(seed, label=None):
         _compare(synth_day(rng, **kw), label, noisy=True)
 
 
-@pytest.mark.parametrize("seed", [30044, 30202, 30658, 31069])
+@pytest.mark.parametrize("seed", [30044, 30202, 30658, 31069, 32461])
 def test_parity_wide_scenario_regressions(seed):
     """Fuzz seeds from the widened (>=10k) scenario space: 30044 (a code
     whose returns take three symmetric values, so skew and kurtosis are
@@ -399,7 +411,8 @@ def test_parity_wide_scenario_regressions(seed):
     cumulative share exactly ON the 0.9 edge in f64, one ulp above —
     the threshold +/- PDF_EDGE_EPS acceptance band); 31069 (multiday
     batch whose degenerate-beta skip keys must hash-match: pandas
-    Timestamp vs np.datetime64)."""
+    Timestamp vs np.datetime64); 32461 (kurt 1.8% above the degenerate
+    cutoff on a 29-bar day — the KURT_ABS_NOISE rtol widening)."""
     run_wide_scenario_seed(seed)
 
 
@@ -494,6 +507,9 @@ def test_quirk_aliases(rng):
     # default 2e-3 rtol — caught when the jit-cache fix armed this case)
     ("doc_pdf90", lambda v: v + 60.0),           # systematic rank shift
     ("shape_skew", lambda v: v * 1.05),          # noisy-family factor
+    ("shape_skratio", lambda v: v * 1.1),        # exercises the widened
+    # KURT_ABS_NOISE rtol path: 10% clears even the +3% band at the
+    # degenerate-kurt boundary
 ])
 def test_comparator_detects_injected_distortion(rng, monkeypatch,
                                                 name, distort):
