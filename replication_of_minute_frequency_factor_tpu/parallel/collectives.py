@@ -41,45 +41,92 @@ from .mesh import TICKERS_AXIS, day_batch_spec, mask_spec
 # psum-based masked moments (inside shard_map)
 # --------------------------------------------------------------------------
 
-def _moments(x, mask, axis_name):
-    """Global (count, sum, sum-of-squares) over the sharded last axis."""
-    xm = jnp.where(mask, x, 0.0)
-    n = jax.lax.psum(jnp.sum(mask, axis=-1), axis_name)
-    s = jax.lax.psum(jnp.sum(xm, axis=-1), axis_name)
-    ss = jax.lax.psum(jnp.sum(xm * xm, axis=-1), axis_name)
-    return n, s, ss
+# plain Python scalars, not jnp arrays: building an Array here would commit
+# the default backend at import time (ops/masked.py does the same)
+_NAN = jnp.nan
+_NO_LANE = 2**30  # "no valid lane on this shard" index sentinel
+
+
+def _count_mean_many(arrays, mask, axis_name):
+    """Global count + per-array masked means over the sharded last axis,
+    as ``(n, mean_0, mean_1, ...)``; means NaN if n=0.
+
+    The count rides the same fused tuple psum as every sum (one
+    all-reduce total); it is carried in f32, exact for any count below
+    2^24 lanes.
+    """
+    n, *sums = jax.lax.psum(
+        (jnp.sum(mask, axis=-1, dtype=jnp.float32),)
+        + tuple(jnp.sum(jnp.where(mask, a, 0.0), axis=-1) for a in arrays),
+        axis_name)
+    nn = jnp.maximum(n, 1)
+    return (n,) + tuple(jnp.where(n > 0, s / nn, _NAN) for s in sums)
+
+
+def _count_mean(x, mask, axis_name):
+    return _count_mean_many((x,), mask, axis_name)
+
+
+def _first_valid_many(arrays, mask, axis_name):
+    """Values at the globally-first valid lane of the sharded cross-section
+    (NaN if none), for several arrays sharing one mask. Mirrors
+    ``ops.masked.masked_first`` under sharding: each shard offers its first
+    valid *global* column index, ``pmin`` picks the winner, and one psum of
+    the one-hot-selected values broadcasts them — the index machinery and
+    collectives are shared across the arrays (one pmin + one fused psum),
+    which matters on the ICI-bound per-date eval path."""
+    t_local = mask.shape[-1]
+    shard = jax.lax.axis_index(axis_name)
+    gcol = jnp.arange(t_local, dtype=jnp.int32) + shard * t_local
+    gidx = jnp.where(mask, gcol, _NO_LANE)
+    gmin = jax.lax.pmin(jnp.min(gidx, axis=-1), axis_name)
+    here = gidx == gmin[..., None]
+    vals = jax.lax.psum(
+        tuple(jnp.sum(jnp.where(here, a, 0.0), axis=-1) for a in arrays),
+        axis_name)
+    has = gmin < _NO_LANE
+    return tuple(jnp.where(has, v, _NAN) for v in vals)
 
 
 def xs_masked_mean_local(x, mask, axis_name=TICKERS_AXIS):
-    n, s, _ = _moments(x, mask, axis_name)
-    return s / n
+    _, mean = _count_mean(x, mask, axis_name)
+    return mean
 
 
 def xs_masked_std_local(x, mask, axis_name=TICKERS_AXIS, ddof: int = 1):
-    """Cross-device masked std, polars default ddof=1 (SURVEY.md Q11)."""
-    n, s, ss = _moments(x, mask, axis_name)
-    mean = s / n
-    var = (ss - n * mean * mean) / (n - ddof)
-    return jnp.sqrt(jnp.maximum(var, 0.0))
+    """Cross-device masked std, polars default ddof=1 (SURVEY.md Q11).
+
+    Two-pass like ``ops.masked.masked_std`` (psum mean, then psum of squared
+    deviations): the one-pass ``ss - n*mean^2`` form leaks f32 cancellation
+    noise (~1e-4 relative) on near-constant cross-sections and returns
+    0/inf instead of NaN when ``n <= ddof``.
+    """
+    n, mean = _count_mean(x, mask, axis_name)
+    d = jnp.where(mask, x - mean[..., None], 0.0)
+    m2 = jax.lax.psum(jnp.sum(d * d, axis=-1), axis_name)
+    var = jnp.where(n > ddof, m2 / jnp.maximum(n - ddof, 1), _NAN)
+    return jnp.sqrt(var)
 
 
 def xs_pearson_local(x, y, mask, axis_name=TICKERS_AXIS):
     """Masked Pearson correlation across the sharded axis (per leading row).
 
-    The per-date IC of Factor.py:172-177 under ticker sharding: five psums.
+    The per-date IC of Factor.py:172-177 under ticker sharding. Mirrors
+    ``ops.masked.masked_corr``: both series anchored to their globally-first
+    valid value (shift-invariant; makes constant series yield exactly-zero
+    variance, hence NaN as polars), then two-pass moments via psum.
     """
-    xm = jnp.where(mask, x, 0.0)
-    ym = jnp.where(mask, y, 0.0)
-    n = jax.lax.psum(jnp.sum(mask, axis=-1), axis_name)
-    sx = jax.lax.psum(jnp.sum(xm, axis=-1), axis_name)
-    sy = jax.lax.psum(jnp.sum(ym, axis=-1), axis_name)
-    sxx = jax.lax.psum(jnp.sum(xm * xm, axis=-1), axis_name)
-    syy = jax.lax.psum(jnp.sum(ym * ym, axis=-1), axis_name)
-    sxy = jax.lax.psum(jnp.sum(xm * ym, axis=-1), axis_name)
-    cov = sxy - sx * sy / n
-    vx = sxx - sx * sx / n
-    vy = syy - sy * sy / n
-    return cov / jnp.sqrt(vx * vy)
+    ax, ay = _first_valid_many((x, y), mask, axis_name)
+    x = x - ax[..., None]
+    y = y - ay[..., None]
+    n, mx, my = _count_mean_many((x, y), mask, axis_name)
+    dx = jnp.where(mask, x - mx[..., None], 0.0)
+    dy = jnp.where(mask, y - my[..., None], 0.0)
+    cov, vx, vy = jax.lax.psum(
+        (jnp.sum(dx * dy, axis=-1), jnp.sum(dx * dx, axis=-1),
+         jnp.sum(dy * dy, axis=-1)), axis_name)
+    r = cov / jnp.sqrt(vx * vy)  # zero variance -> NaN, as polars
+    return jnp.where(n > 1, r, _NAN)
 
 
 def xs_rank_local(x, mask, axis_name=TICKERS_AXIS):

@@ -128,3 +128,36 @@ def test_multihost_helpers_single_process(mesh):
         np.testing.assert_allclose(
             np.asarray(out[n])[:, :bars.shape[1]], np.asarray(ref[n]),
             rtol=1e-6, equal_nan=True)
+
+
+def test_xs_collective_degenerate_rows_match_local():
+    """fuzz_parallel finds, pinned: the collective moments must mirror the
+    local two-pass semantics — n <= ddof gives NaN (not inf/0 from the old
+    one-pass ``ss - n*mean^2`` form), a constant cross-section gives
+    exactly-zero std and NaN correlation, and inf/NaN in masked-out lanes
+    never leaks into the psums."""
+    tick_mesh = make_mesh((1, 8))
+    x = np.zeros((4, 16), np.float32)
+    y = np.zeros((4, 16), np.float32)
+    m = np.zeros((4, 16), bool)
+    m[0, 5] = True                 # single valid lane: n - ddof == 0
+    m[1] = True                    # constant cross-section
+    x[1] = 0.1                     # 0.1 is inexact in f32: the one-pass
+    y[1] = 0.3                     # form leaked ~1e-4 cancellation noise
+    m[2, ::3] = True               # ordinary row with poison elsewhere
+    x[2] = np.where(m[2], np.arange(16, dtype=np.float32), np.inf)
+    y[2] = np.where(m[2], np.arange(16, dtype=np.float32)[::-1], np.nan)
+    # row 3 stays all-masked: every stat must be NaN, not 0/0 garbage
+
+    std = np.asarray(xs_masked_std(tick_mesh, x, m))
+    ic = np.asarray(xs_pearson(tick_mesh, x, y, m))
+    mean = np.asarray(xs_masked_mean(tick_mesh, x, m))
+
+    assert np.isnan(std[0]) and np.isnan(ic[0])
+    # constant row: std carries only ulp-level two-pass noise (the local
+    # path behaves identically — neither anchors std), and the anchored
+    # correlation sees exactly-zero variance, hence NaN as polars
+    assert std[1] < 1e-6 and np.isnan(ic[1])
+    np.testing.assert_allclose(ic[2], -1.0, rtol=1e-6)
+    np.testing.assert_allclose(mean[2], np.arange(16)[::3].mean(), rtol=1e-6)
+    assert np.isnan(std[3]) and np.isnan(ic[3]) and np.isnan(mean[3])

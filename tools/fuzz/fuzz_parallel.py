@@ -1,0 +1,202 @@
+"""Sharded-vs-local differential fuzz over the virtual 8-device CPU mesh.
+
+The seam under test is parallel/: shard_map collectives (xs_masked_mean /
+xs_masked_std / xs_pearson / xs_rank) and sharded_compute_factors with
+shard_day_batch padding, against their single-device counterparts
+(ops.masked_* / compute_factors_jit). Randomizes mesh shape, device-subset
+size, pre-pad ticker counts (so the zero-pad + mask=False lanes are
+exercised), degenerate masks (all-masked dates, single valid lane), exact
+ties across shard boundaries, constant cross-sections, and inf/NaN
+poison in masked-out lanes (which psum-style zeroing must ignore).
+
+Shapes and factor-name subsets draw from small fixed pools so the compile
+count stays bounded while the data randomizes freely.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from replication_of_minute_frequency_factor_tpu import ops  # noqa: E402
+from replication_of_minute_frequency_factor_tpu.models.registry import (  # noqa: E402
+    compute_factors_jit, factor_names)
+from replication_of_minute_frequency_factor_tpu.parallel import (  # noqa: E402
+    make_mesh, shard_day_batch, sharded_compute_factors,
+    xs_masked_mean, xs_masked_std, xs_pearson, xs_rank)
+
+MESH_POOL = ((1, 8), (2, 4), (4, 2), (1, 4), (2, 2), (1, 2), (1, 1))
+XS_D_POOL = (1, 3, 6)
+XS_T_POOL = (5, 17, 40)
+FACTOR_D_POOL = (1, 2)
+FACTOR_T_POOL = (7, 16, 23)
+NAME_POOL = (
+    ("vol_return1min", "mmt_pm", "liq_openvol", "shape_skew"),
+    ("doc_pdf60", "doc_vol10_ratio", "doc_kurt"),
+    ("mmt_ols_qrs", "mmt_ols_beta_zscore_last"),
+    ("corr_prv", "trade_headRatio", "vol_upRatio", "liq_amihud_1min"),
+)
+FULL_NAMES = factor_names()
+
+_meshes = {}
+
+
+def get_mesh(shape):
+    if shape not in _meshes:
+        n = shape[0] * shape[1]
+        _meshes[shape] = make_mesh(shape, devices=jax.devices()[:n])
+    return _meshes[shape]
+
+
+def pad_xs(x, m, mult):
+    """Zero-pad the tickers axis to a shard multiple, mask=False lanes."""
+    rem = x.shape[-1] % mult
+    if rem == 0:
+        return x, m
+    pad = mult - rem
+    xp = np.pad(x, [(0, 0), (0, pad)])
+    mp = np.pad(m, [(0, 0), (0, pad)])
+    return xp, mp
+
+
+def xs_case(rng, seed):
+    shape = MESH_POOL[int(rng.integers(len(MESH_POOL)))]
+    mesh = get_mesh(shape)
+    n_d = XS_D_POOL[int(rng.integers(len(XS_D_POOL)))]
+    n_t = XS_T_POOL[int(rng.integers(len(XS_T_POOL)))]
+    x = rng.normal(0, 1, (n_d, n_t)).astype(np.float32)
+    y = (0.4 * x + rng.normal(0, 1, x.shape)).astype(np.float32)
+    m = rng.random(x.shape) > rng.choice([0.0, 0.2, 0.7])
+    if rng.random() < 0.3:
+        m[int(rng.integers(n_d))] = False       # all-masked date
+    if rng.random() < 0.3:
+        d = int(rng.integers(n_d))
+        m[d] = False
+        m[d, int(rng.integers(n_t))] = True     # single valid lane
+    if rng.random() < 0.4:
+        # constant cross-section at an f32-INEXACT value: an exactly
+        # representable constant (0.25) cancels bit-for-bit even in the
+        # one-pass form and would never trigger the cancellation bug class
+        x[int(rng.integers(n_d))] = 0.1
+    if rng.random() < 0.5:
+        x = np.round(x, 1).astype(np.float32)   # shard-crossing ties
+    if rng.random() < 0.4:
+        poison = rng.choice([np.inf, -np.inf, np.nan])
+        x = np.where(m, x, np.float32(poison))
+        y = np.where(m, y, np.float32(poison))
+    xp, mp = pad_xs(x, m, shape[1])
+    yp, _ = pad_xs(y, m, shape[1])
+    # masked-out lanes may hold poison; the wrappers contract is that they
+    # never read them, so ship them as-is
+    mean = np.asarray(xs_masked_mean(mesh, xp, mp))
+    std = np.asarray(xs_masked_std(mesh, xp, mp))
+    ic = np.asarray(xs_pearson(mesh, xp, yp, mp))
+    rk = np.asarray(xs_rank(mesh, xp, mp))[:, :n_t]
+
+    xc = np.where(m, x, 0.0).astype(np.float32)
+    yc = np.where(m, y, 0.0).astype(np.float32)
+    ref_mean = np.asarray(ops.masked_mean(xc, m))
+    ref_std = np.asarray(ops.masked_std(xc, m))
+    ref_ic = np.asarray(ops.masked_corr(xc, yc, m))
+    ref_rk = np.asarray(ops.rank_average(xc, m))
+
+    np.testing.assert_allclose(mean, ref_mean, rtol=2e-4, atol=1e-5,
+                               equal_nan=True, err_msg=f"{seed} mean")
+    np.testing.assert_allclose(std, ref_std, rtol=2e-4, atol=1e-5,
+                               equal_nan=True, err_msg=f"{seed} std")
+    # the finite pattern must match BOTH ways: sharded-finite where the
+    # oracle is NaN is exactly the divergence class this fuzzer exists to
+    # catch (a dropped n>1 gate or anchoring turns zero-variance NaN into
+    # finite garbage), so it is never excused; oracle-finite where the
+    # sharded path is NaN is excused only when the true correlation is
+    # near zero (catastrophic f32 cancellation on either side of 0)
+    fin, ref_fin = np.isfinite(ic), np.isfinite(ref_ic)
+    assert not (fin & ~ref_fin).any(), \
+        (seed, "sharded finite where oracle NaN", ic, ref_ic)
+    lost = ~fin & ref_fin
+    assert np.abs(ref_ic[lost]).max(initial=0) < 2e-3, \
+        (seed, "sharded NaN where oracle finite", ic, ref_ic)
+    both = fin & ref_fin
+    np.testing.assert_allclose(ic[both], ref_ic[both], rtol=2e-3, atol=2e-3,
+                               err_msg=f"{seed} ic")
+    np.testing.assert_allclose(rk[m], ref_rk[m], rtol=1e-6,
+                               err_msg=f"{seed} rank")
+
+
+def factor_case(rng, seed):
+    shape = MESH_POOL[int(rng.integers(len(MESH_POOL)))]
+    mesh = get_mesh(shape)
+    n_d = FACTOR_D_POOL[int(rng.integers(len(FACTOR_D_POOL)))]
+    n_t = FACTOR_T_POOL[int(rng.integers(len(FACTOR_T_POOL)))]
+    if rng.random() < 0.06:
+        names, n_d, n_t = FULL_NAMES, 1, 16  # bound the big compile to one shape
+    else:
+        names = NAME_POOL[int(rng.integers(len(NAME_POOL)))]
+    s = (n_d, n_t, 240)
+    close = 10.0 * np.exp(np.cumsum(rng.normal(0, 1e-3, s), -1))
+    open_ = close * (1 + rng.normal(0, 1e-4, s))
+    high = np.maximum(open_, close) * (1 + np.abs(rng.normal(0, 2e-4, s)))
+    low = np.minimum(open_, close) * (1 - np.abs(rng.normal(0, 2e-4, s)))
+    volume = (rng.integers(0, 500, s) * rng.choice([1, 100])).astype(float)
+    if rng.random() < 0.5:
+        volume[rng.random(s) < 0.2] = 0.0       # zero-volume bars
+    bars = np.stack([open_, high, low, close, volume], -1).astype(np.float32)
+    mask = rng.random(s) > rng.choice([0.0, 0.05, 0.5])
+    if rng.random() < 0.3:
+        t = int(rng.integers(n_t))
+        mask[:, t] = False
+        mask[:, t, :int(rng.integers(1, 60))] = True   # <50-bar ticker
+    if rng.random() < 0.2:
+        mask[:, int(rng.integers(n_t))] = False        # fully halted ticker
+
+    local = compute_factors_jit(bars, mask, names=names)
+    sb, sm, nt = shard_day_batch(bars, mask, mesh)
+    shd = sharded_compute_factors(sb, sm, mesh, names=names)
+    assert nt == n_t
+    for k in names:
+        a = np.asarray(local[k])
+        b = np.asarray(shd[k])[:n_d, :n_t]  # days axis pads to a shard
+        # multiple too (mask=False lanes); slice both axes back
+        assert a.shape == b.shape, (seed, k, a.shape, b.shape)
+        same_finite = np.isfinite(a) == np.isfinite(b)
+        assert same_finite.all(), (seed, k, "finite pattern",
+                                   np.argwhere(~same_finite)[:4])
+        f = np.isfinite(a)
+        np.testing.assert_allclose(
+            a[f], b[f], rtol=5e-5, atol=1e-7,
+            err_msg=f"{seed} {k}")
+        nan_a, nan_b = np.isnan(a), np.isnan(b)
+        assert (nan_a == nan_b).all(), (seed, k, "nan pattern")
+
+
+def main():
+    lo, hi = int(sys.argv[1]), int(sys.argv[2])
+    fails = []
+    for seed in range(lo, hi):
+        rng = np.random.default_rng(seed)
+        try:
+            xs_case(rng, seed)
+            if rng.random() < 0.6:
+                factor_case(rng, seed)
+        except AssertionError as e:
+            fails.append(seed)
+            print(f"SEED {seed}: {str(e)[:300]}", flush=True)
+        if (seed - lo + 1) % 25 == 0:
+            print(f"...{seed-lo+1} done, {len(fails)} failures", flush=True)
+    print(f"DONE {hi-lo} seeds, {len(fails)} failures: {fails}")
+
+
+if __name__ == "__main__":
+    main()
