@@ -153,7 +153,12 @@ def main():
     threading.Thread(target=produce, daemon=True).start()
     outs = []
     for i in range(iters):
-        outs.append(launch(q.get()))
+        out = launch(q.get())
+        # start the result's device->host copy immediately (as the real
+        # driver does) so the slow upstream link overlaps the next
+        # batch's ingest; np.asarray below then finds the bytes landed
+        out.copy_to_host_async()
+        outs.append(out)
         if i >= 2:
             # materialize to host like the real driver's pipeline lag
             # (pipeline.materialize): the [58, D, T] result crosses the

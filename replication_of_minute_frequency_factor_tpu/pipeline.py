@@ -324,6 +324,16 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
                     bars, mask, names=names,
                     replicate_quirks=cfg.replicate_quirks,
                     rolling_impl=cfg.rolling_impl)
+        # Start the device->host copy now, not at materialize time: the
+        # result transfer (the [F, D, T] block is ~9 MB/batch and the
+        # attached-chip link is far slower device->host than host->device)
+        # then overlaps the NEXT batch's ingest instead of serializing
+        # after it. np.asarray in materialize finds the bytes already
+        # (or partially) landed.
+        vals = out.values() if isinstance(out, dict) else (out,)
+        for v in vals:
+            if hasattr(v, "copy_to_host_async"):  # skip test doubles
+                v.copy_to_host_async()
         return dates, codes, present, out
 
     def materialize(pending):
