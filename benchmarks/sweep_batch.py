@@ -29,9 +29,11 @@ for D in (8, 16, 32):
     t0=time.perf_counter(); threading.Thread(target=produce, daemon=True).start()
     outs=[]
     for i in range(ITERS):
-        outs.append(launch(q.get()))
-        if i >= 2: jax.block_until_ready(outs[i-2])
-    jax.block_until_ready(outs)
+        out = launch(q.get())
+        out.copy_to_host_async()  # mirror bench.py: results cross the link too
+        outs.append(out)
+        if i >= 2: np.asarray(outs[i-2])
+    for o in outs[-2:]: np.asarray(o)
     per = (time.perf_counter()-t0)/ITERS
     print(json.dumps({"days": D, "per_batch_s": round(per,3),
                       "full_year_s": round(per*244/D,3), "warm_s": round(warm,1)}))

@@ -1,4 +1,5 @@
 """Does the attached-TPU link dedupe repeated identical buffers?
+And how asymmetric is it?
 
 bench.py alternates the SAME two packed batches across its timed
 iterations. If the tunnel (or any layer under jax.device_put) caches
@@ -8,6 +9,12 @@ settles it: time device_put+ready for (a) one buffer sent repeatedly,
 (b) a fresh random buffer of the same size each time, (c) the same
 LOGICAL bytes in a freshly allocated array each time (catches id()- or
 pointer-keyed caching as distinct from content-keyed).
+
+It then times the REVERSE direction — device->host np.asarray of
+distinct on-device buffers — because the link is asymmetric in
+practice and the upstream leg is what the pipeline's result
+materialization pays (~9 MB of [F, D, T] per batch; see the
+copy_to_host_async overlap in pipeline._run_device_pipeline).
 
 Run on the TPU:  python benchmarks/transfer_probe.py [size_mb]
 """
@@ -50,6 +57,33 @@ def main():
     ratio = min(same) / min(fresh)
     print(f"same/fresh ratio : {ratio:.3f}  "
           f"({'DEDUP SUSPECTED' if ratio < 0.5 else 'no dedup evidence'})")
+
+    # ---- reverse direction: device->host, distinct bytes each read ----
+    n_f32 = nbytes // 4
+    x = jax.device_put(rng.random(n_f32).astype(np.float32))
+    jax.block_until_ready(x)
+    down, down_async = [], []
+    for i in range(N):
+        y = x * np.float32(i + 2)           # distinct device bytes (i=0
+        #   must differ from x itself, whose bytes already crossed)
+        jax.block_until_ready(y)
+        t0 = time.perf_counter()
+        np.asarray(y)
+        down.append(time.perf_counter() - t0)
+    for i in range(N):
+        y = x + np.float32(i + 1)
+        jax.block_until_ready(y)
+        t0 = time.perf_counter()
+        y.copy_to_host_async()              # issue, then consume
+        np.asarray(y)
+        down_async.append(time.perf_counter() - t0)
+    print("device->host     :", fmt(down))
+    print("  (async-issued) :", fmt(down_async))
+    updown = min(fresh) / min(down)
+    print(f"up/down asymmetry: host->device is {1/updown:.2f}x the "
+          f"device->host rate" if updown < 1 else
+          f"up/down asymmetry: device->host is {updown:.2f}x the "
+          f"host->device rate")
 
 
 if __name__ == "__main__":
