@@ -78,6 +78,62 @@ def make_batch(rng, n_days=DAYS_PER_BATCH, n_tickers=N_TICKERS):
     return bars.astype(np.float32), mask
 
 
+def probe_link(rng, nbytes=28_000_000):
+    """One bandwidth sample each way, distinct bytes (see the caching
+    note in main): host->device via device_put of ``nbytes``, then
+    device->host sized like one batch's [F, D, T] result (~9.3 MB;
+    2_325_000 u8 elements widened to f32), with a smaller warm read
+    first so stream setup isn't counted as bandwidth."""
+    buf = np.frombuffer(rng.bytes(nbytes), np.uint8)
+    t0 = time.perf_counter()
+    dev = jax.device_put(buf)
+    jax.block_until_ready(dev)
+    down = round(buf.nbytes / 1e6 / (time.perf_counter() - t0), 1)
+    np.asarray(dev[:1_000_000].astype(np.float32))
+    up = dev[:2_325_000].astype(np.float32) + np.float32(1)
+    jax.block_until_ready(up)
+    t0 = time.perf_counter()
+    np.asarray(up)
+    return down, round(up.size * 4 / 1e6 / (time.perf_counter() - t0), 1)
+
+
+def measure_link(rng, threshold_mbps=20.0, wait_budget_s=240.0,
+                 sleep_s=45.0):
+    """Link probe with a bounded wait-for-weather loop.
+
+    The tunnel's bandwidth swings >10x hour to hour. If the probe
+    catches it badly degraded, wait (bounded) for a healthier window
+    rather than recording link weather as the headline — the wait is
+    reported in the bench JSON (``link_wait_s``), and the timed loop
+    still pays whatever the link does while it runs. The budget bounds
+    when a new iteration may START; the final iteration's sleep +
+    reachability check + probe can run past it (on a degraded link,
+    roughly one sleep + 90 s child timeout + one slow probe beyond)."""
+    down, up = probe_link(rng)
+    t_wait = time.monotonic()
+    # budget check counts the upcoming sleep so the cap can't be
+    # overshot by a whole iteration; retries reuse the full probe size
+    # (a smaller payload amortizes fixed per-transfer overhead over
+    # fewer bytes and would not be comparable with the first sample)
+    while (down < threshold_mbps
+           and time.monotonic() - t_wait + sleep_s < wait_budget_s):
+        time.sleep(sleep_s)
+        # the tunnel can wedge outright while we wait; a wedged tunnel
+        # hangs device_put forever, so re-check reachability from a
+        # killable child (same pattern as _ensure_device_reachable)
+        # before probing in-process again
+        try:
+            alive = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=90, capture_output=True).returncode == 0
+        except subprocess.TimeoutExpired:
+            alive = False
+        if not alive:
+            break
+        down, up = probe_link(rng)
+    return down, up, round(time.monotonic() - t_wait, 1)
+
+
 def main():
     _ensure_device_reachable()  # may exec into a CPU-fallback run
     import queue
@@ -143,24 +199,10 @@ def main():
     # run is indistinguishable from a slow-code run. Distinct bytes both
     # ways (see the caching note above). Tunnel-attached runs only: on
     # the CPU fallback (or any local platform) it would time memcpy.
-    link_down = link_up = None
+    link_down = link_up = link_wait = None
     if ("PALLAS_AXON_POOL_IPS" in os.environ
             and _SUFFIX != "_cpu_fallback_tunnel_down"):
-        probe = np.frombuffer(rng.bytes(28_000_000), np.uint8)
-        t0 = time.perf_counter()
-        dev = jax.device_put(probe)
-        jax.block_until_ready(dev)
-        link_down = round(probe.nbytes / 1e6 / (time.perf_counter() - t0), 1)
-        # warm the reverse path first (stream setup is not bandwidth),
-        # then time a payload sized like one batch's [F, D, T] result
-        # (~9.3 MB): 2_325_000 u8 elements widened to f32
-        np.asarray(dev[:1_000_000].astype(np.float32))
-        up = dev[:2_325_000].astype(np.float32) + np.float32(1)
-        jax.block_until_ready(up)
-        t0 = time.perf_counter()
-        np.asarray(up)
-        link_up = round(up.size * 4 / 1e6 / (time.perf_counter() - t0), 1)
-        del probe, dev, up
+        link_down, link_up, link_wait = measure_link(rng)
 
     # Steady state, double-buffered exactly like the real driver
     # (pipeline._run_device_pipeline): a producer thread encodes batch
@@ -205,6 +247,7 @@ def main():
         # null when not tunnel-attached
         "link_down_MBps": link_down,
         "link_up_MBps": link_up,
+        "link_wait_s": link_wait,
     }))
 
 
