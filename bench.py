@@ -35,6 +35,17 @@ ITERS = 5
 _SUFFIX = os.environ.get("BENCH_METRIC_SUFFIX", "")
 
 
+def _tunnel_alive(timeout=90):
+    """One reachability probe from a killable child (a wedged tunnel
+    hangs jax backend init in-process, before any code can time out)."""
+    try:
+        return subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout, capture_output=True).returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def _ensure_device_reachable():
     """The attached-TPU tunnel occasionally wedges, and a wedged tunnel
     hangs the interpreter at backend init — before any code can time out.
@@ -43,7 +54,6 @@ def _ensure_device_reachable():
     as a TPU result."""
     if "PALLAS_AXON_POOL_IPS" not in os.environ:
         return  # not tunnel-attached; let jax pick its platform
-    probe = "import jax; jax.devices()"
     # the tunnel flaps: minutes-long down-windows with brief up-windows
     # between (observed 2026-07-31). Probe on a ~6.5 min wall-clock
     # budget (not a fixed attempt count — a fast-failing probe would
@@ -53,12 +63,8 @@ def _ensure_device_reachable():
     # number when a real TPU run was a minute of patience away.
     deadline = time.monotonic() + 390.0
     while True:
-        try:
-            if subprocess.run([sys.executable, "-c", probe],
-                              timeout=90, capture_output=True).returncode == 0:
-                return
-        except subprocess.TimeoutExpired:
-            pass
+        if _tunnel_alive():
+            return
         if time.monotonic() + 30.0 >= deadline:
             break
         time.sleep(30)
@@ -129,15 +135,8 @@ def measure_link(rng, threshold_mbps=20.0, wait_budget_s=240.0,
         time.sleep(sleep_s)
         # the tunnel can wedge outright while we wait; a wedged tunnel
         # hangs device_put forever, so re-check reachability from a
-        # killable child (same pattern as _ensure_device_reachable)
-        # before probing in-process again
-        try:
-            alive = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=90, capture_output=True).returncode == 0
-        except subprocess.TimeoutExpired:
-            alive = False
-        if not alive:
+        # killable child before probing in-process again
+        if not _tunnel_alive():
             break
         down, up = probe_link(rng)
     return down, up, round(time.monotonic() - t_wait, 1)
@@ -160,7 +159,8 @@ def main():
     rng = np.random.default_rng(0)
     names = factor_names()
     iters, warmup = ITERS, WARMUP
-    if _SUFFIX == "_cpu_fallback_tunnel_down":
+    is_cpu_fallback = _SUFFIX == "_cpu_fallback_tunnel_down"
+    if is_cpu_fallback:
         # CPU fallback specifically (not any externally set suffix): the
         # number is a tunnel-down indicator, not a TPU perf claim — one
         # warmup + two timed batches keeps the round-end run a few
@@ -209,8 +209,7 @@ def main():
     # ways (see the caching note above). Tunnel-attached runs only: on
     # the CPU fallback (or any local platform) it would time memcpy.
     link_down = link_up = link_wait = None
-    if ("PALLAS_AXON_POOL_IPS" in os.environ
-            and _SUFFIX != "_cpu_fallback_tunnel_down"):
+    if "PALLAS_AXON_POOL_IPS" in os.environ and not is_cpu_fallback:
         link_down, link_up, link_wait = measure_link(rng)
 
     # Steady state, double-buffered exactly like the real driver
