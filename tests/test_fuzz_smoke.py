@@ -1,0 +1,30 @@
+"""The differential fuzz harnesses stay runnable and clean on a seed window.
+
+The long-run sweeps live in tools/fuzz/ and are driven out-of-band
+(README there records the cleared seed-run tallies); this smoke keeps the
+harness entry points from rotting and gives CI a slice of randomized
+Pallas-vs-conv coverage beyond test_pallas_rolling's fixed scenario.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_harness(name, lo, hi, timeout=400):
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fuzz", name),
+         str(lo), str(hi)],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    assert out.returncode == 0, out.stderr[-2000:]
+    last = [l for l in out.stdout.splitlines() if l.startswith("DONE")]
+    assert last and ", 0 failures" in last[0], out.stdout[-2000:]
+
+
+def test_fuzz_pallas_seed_window():
+    run_harness("fuzz_pallas.py", 9000, 9006)
