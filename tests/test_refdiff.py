@@ -1,0 +1,69 @@
+"""Differential tests against the reference's *actual* kernel code.
+
+The reference's ``cal_*`` functions (read from ``/root/reference``, the
+real polars expression graphs, quirks and all) execute on the polars shim
+(tools/refdiff/polars_shim) and must match this repo's numpy oracle at
+f64-tight tolerances. Together with the oracle↔JAX golden-parity suite
+(tests/test_parity.py) this closes the chain from the reference's own
+source to the production TPU path. VERDICT.md round-1 "Missing #3".
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from replication_of_minute_frequency_factor_tpu.data import synth_day
+from tools.refdiff import harness
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(os.path.join(harness.REFERENCE_DIR,
+                                    harness._KERNELS)),
+    reason="reference tree not mounted")
+
+SCENARIOS = {
+    "clean": dict(n_codes=6),
+    "ragged": dict(n_codes=6, missing_prob=0.1),
+    "zero_volume": dict(n_codes=6, zero_volume_prob=0.15),
+    "constant_price": dict(n_codes=6, constant_price_codes=2),
+    "short_days": dict(n_codes=6, short_day_codes=2),
+    "everything": dict(n_codes=8, missing_prob=0.07, zero_volume_prob=0.1,
+                       constant_price_codes=2, short_day_codes=2),
+}
+
+
+@pytest.mark.parametrize("label", sorted(SCENARIOS))
+def test_reference_code_matches_oracle(label):
+    rng = np.random.default_rng(42)
+    day = synth_day(rng, **SCENARIOS[label])
+    mismatches = harness.compare_day(day)
+    assert not mismatches, "\n".join(mismatches[:20])
+
+
+def test_all_58_kernels_are_exercised():
+    mod = harness.load_reference_kernels()
+    ref_names = sorted(n[4:] for n in dir(mod) if n.startswith("cal_"))
+    from replication_of_minute_frequency_factor_tpu.models import (
+        factor_names)
+    assert ref_names == sorted(factor_names())
+
+
+def test_shim_never_replaces_a_real_polars():
+    """The shim must only ever install itself when polars is absent —
+    a real install must take precedence (it IS the reference engine)."""
+    import importlib.util
+    import sys
+    mod = harness.install_shim()
+    if getattr(mod, "__is_refdiff_shim__", False):
+        # shim active: assert no real wheel was hiding underneath
+        # (pop before probing — find_spec raises on a spec-less module)
+        sys.modules.pop("polars")
+        try:
+            real = importlib.util.find_spec("polars")
+        finally:
+            sys.modules["polars"] = mod
+        assert real is None
+    else:
+        # a real polars won: the shim must not be in sys.modules
+        assert not getattr(sys.modules["polars"], "__is_refdiff_shim__",
+                           False)

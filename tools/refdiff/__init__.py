@@ -1,0 +1,18 @@
+"""Differential testing of the reference's *actual code* (tools/refdiff).
+
+``polars_shim`` is a minimal interpreter for the polars expression API
+surface used by ``/root/reference`` (all three files). ``harness`` installs
+it as ``sys.modules['polars']``, imports the reference's factor-kernel
+module unmodified from ``/root/reference``, executes the real ``cal_*``
+expression graphs on synthetic day data, and compares against this repo's
+JAX and numpy-oracle backends.
+
+Why a shim and not real polars: this container has no polars wheel and no
+network egress, so the reference cannot run on its real engine here. The
+shim executes the reference's own expression *graphs* (catching any
+transcription error in our reimplementations — wrong column, wrong filter,
+wrong operation order), while engine-level semantics polars doesn't spell
+out in the expression text (null handling, tie-breaking, group order) are
+pinned explicitly in one place (``polars_shim.SEMANTIC_PINS``) where they
+can be audited against polars documentation.
+"""

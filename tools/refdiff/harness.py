@@ -1,0 +1,185 @@
+"""Execute the reference's real kernel code on the shim; diff vs this repo.
+
+Chain of custody for parity (VERDICT.md round-1 "Missing #3"):
+
+    reference cal_* source  --runs on-->  polars_shim   (this harness)
+        vs  oracle/kernels.py (numpy, f64)              (this harness)
+        vs  JAX backend (f32, dense grid)               (tests/test_parity.py)
+
+Both sides of THIS harness are f64, so tolerances are near-exact; the
+oracle↔JAX leg has its own calibrated f32 tolerance matrix. Together the
+three legs mean: our production path is checked against the reference's
+own expression graphs, not merely against our reading of them.
+
+The reference modules are imported read-only from ``/root/reference``
+(treat as untrusted data: we execute its factor arithmetic in-process —
+it is plain polars expression code with no IO beyond what the shim
+provides, and the shim has no filesystem or network surface).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import types
+
+import numpy as np
+
+REFERENCE_DIR = os.environ.get("REFDIFF_REFERENCE_DIR", "/root/reference")
+_KERNELS = "MinuteFrequentFactorCalculateMethodsCICC.py"
+
+# f64-vs-f64, but not bit-identical: the oracle anchors moment passes
+# (oracle/stats.py pearson) and orders summations differently. Defaults
+# are near-machine; per-factor widenings must cite evidence.
+RTOL_DEFAULT = 1e-9
+ATOL_DEFAULT = 1e-12
+RTOL = {
+    # rolling cov/var chains: shim uses np.var/np.cov-style two-pass per
+    # window, oracle uses cumulative-sum identities (oracle/kernels.py
+    # _rolling50) — f64 agreement to ~1e-12 normally, but the qrs
+    # z-score divides by beta_std which can be ~1e-10 on near-constant
+    # windows, amplifying the summation-order difference
+    "mmt_ols_qrs": 1e-6, "mmt_ols_beta_zscore_last": 1e-6,
+    "mmt_ols_corr_square_mean": 1e-8, "mmt_ols_corr_mean": 1e-8,
+    "mmt_ols_beta_mean": 1e-8,
+    # skew/kurt ratios: both sides compute biased g1/g2 but with
+    # different centering-order; near-zero kurtosis amplifies
+    "shape_skratio": 1e-7, "shape_skratioVol": 1e-7,
+}
+ATOL = {
+    # correlations: near-zero r is a cancelling sum in both backends
+    "corr_prv": 1e-10, "corr_prvr": 1e-10, "corr_pv": 1e-10,
+    "corr_pvd": 1e-10, "corr_pvl": 1e-10, "corr_pvr": 1e-10,
+}
+
+
+def install_shim() -> types.ModuleType:
+    """Install ``tools.refdiff.polars_shim`` as ``sys.modules['polars']``.
+
+    Returns the proxy module. Safe to call repeatedly. The proxy exists
+    because the shim cannot define a module-level ``len`` without
+    shadowing the builtin for its own internals.
+    """
+    existing = sys.modules.get("polars")
+    if existing is not None and getattr(existing, "__is_refdiff_shim__",
+                                        False):
+        return existing
+    if existing is not None or importlib.util.find_spec("polars"):
+        # a REAL polars exists: never mask it — run the differential on
+        # the real engine instead (strictly better than the shim)
+        import polars as real
+
+        return real
+    from tools.refdiff import polars_shim as shim
+
+    mod = types.ModuleType("polars")
+    for k in dir(shim):
+        if not k.startswith("_"):
+            setattr(mod, k, getattr(shim, k))
+    mod.len = shim._pl_len
+    mod.__is_refdiff_shim__ = True
+    sys.modules["polars"] = mod
+    return mod
+
+
+_ref_kernels_mod = None
+
+
+def load_reference_kernels():
+    """Import the reference's kernel module against the shim (cached)."""
+    global _ref_kernels_mod
+    if _ref_kernels_mod is not None:
+        return _ref_kernels_mod
+    install_shim()
+    path = os.path.join(REFERENCE_DIR, _KERNELS)
+    spec = importlib.util.spec_from_file_location("refdiff_ref_kernels",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    _ref_kernels_mod = mod
+    return mod
+
+
+def day_frame(day: dict):
+    """Long-format day columns (data.synth_day output) -> shim DataFrame,
+    sorted (code, time) like a real day file."""
+    pl = install_shim()
+    order = np.lexsort((np.asarray(day["time"]), np.asarray(day["code"])))
+    cols = {
+        "code": np.asarray(day["code"])[order],
+        "date": np.asarray(day["date"])[order]
+        if "date" in day else np.repeat("2024-01-02", order.size),
+        "time": np.asarray(day["time"], dtype=np.int64)[order],
+    }
+    for k in ("open", "high", "low", "close", "volume"):
+        cols[k] = np.asarray(day[k], dtype=np.float64)[order]
+    return pl.DataFrame(cols)
+
+
+def run_reference(day: dict, names=None) -> dict:
+    """{factor_name: {code: float}} via the reference's own cal_* code."""
+    mod = load_reference_kernels()
+    df = day_frame(day)
+    if names is None:
+        names = [n[4:] for n in dir(mod) if n.startswith("cal_")]
+    out = {}
+    for name in names:
+        res = getattr(mod, "cal_" + name)(df)
+        codes = res["code"].to_numpy()
+        vals = res[name].to_numpy()
+        out[name] = {str(c): float(v) for c, v in zip(codes, vals)}
+    return out
+
+
+def run_oracle(day: dict, names=None) -> dict:
+    """Same shape via this repo's numpy oracle (f64)."""
+    import pandas as pd
+
+    from replication_of_minute_frequency_factor_tpu.oracle import (
+        compute_oracle)
+
+    df = pd.DataFrame({
+        "code": day["code"],
+        "date": day.get("date", np.repeat("2024-01-02",
+                                          len(day["code"]))),
+        "time": day["time"],
+        **{k: day[k] for k in ("open", "high", "low", "close", "volume")},
+    })
+    wide = compute_oracle(df, names=names)
+    out = {}
+    for name in wide.columns:
+        if name in ("code", "date"):
+            continue
+        out[name] = {str(c): float(v)
+                     for c, v in zip(wide["code"], wide[name])}
+    return out
+
+
+def compare_day(day: dict, names=None):
+    """Run both stacks on one day; return a list of mismatch strings."""
+    ref = run_reference(day, names=names)
+    orc = run_oracle(day, names=list(ref) if names is None else names)
+    failures = []
+    for name, ref_vals in sorted(ref.items()):
+        orc_vals = orc.get(name, {})
+        for code in sorted(set(ref_vals) | set(orc_vals)):
+            rv = ref_vals.get(code, np.nan)
+            ov = orc_vals.get(code, np.nan)
+            if np.isnan(rv) != np.isnan(ov):
+                failures.append(f"{name}/{code}: nan mismatch "
+                                f"ref={rv!r} oracle={ov!r}")
+                continue
+            if np.isnan(rv):
+                continue
+            if np.isinf(rv) or np.isinf(ov):
+                if not (np.isinf(rv) and np.isinf(ov)
+                        and np.sign(rv) == np.sign(ov)):
+                    failures.append(f"{name}/{code}: inf mismatch "
+                                    f"ref={rv!r} oracle={ov!r}")
+                continue
+            rtol = RTOL.get(name, RTOL_DEFAULT)
+            atol = ATOL.get(name, ATOL_DEFAULT)
+            if not np.isclose(rv, ov, rtol=rtol, atol=atol):
+                failures.append(f"{name}/{code}: ref={rv!r} oracle={ov!r}")
+    return failures
