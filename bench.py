@@ -112,8 +112,8 @@ def probe_link(rng, nbytes=28_000_000):
     return down, round(up.size * 4 / 1e6 / (time.perf_counter() - t0), 1)
 
 
-def measure_link(rng, threshold_mbps=20.0, wait_budget_s=240.0,
-                 sleep_s=45.0):
+def measure_link(rng, threshold_mbps=20.0, threshold_up_mbps=10.0,
+                 wait_budget_s=240.0, sleep_s=45.0):
     """Link probe with a bounded wait-for-weather loop.
 
     The tunnel's bandwidth swings >10x hour to hour. If the probe
@@ -130,7 +130,11 @@ def measure_link(rng, threshold_mbps=20.0, wait_budget_s=240.0,
     # overshot by a whole iteration; retries reuse the full probe size
     # (a smaller payload amortizes fixed per-transfer overhead over
     # fewer bytes and would not be comparable with the first sample)
-    while (down < threshold_mbps
+    # gate on BOTH legs: the headline result crosses device->host too,
+    # and the up leg is the one observed degrading worst (3-9 MB/s while
+    # down did 57 MB/s) — gating only on down would never trigger a wait
+    # in exactly the documented bad-weather scenario (ADVICE r1)
+    while ((down < threshold_mbps or up < threshold_up_mbps)
            and time.monotonic() - t_wait + sleep_s < wait_budget_s):
         time.sleep(sleep_s)
         # the tunnel can wedge outright while we wait; a wedged tunnel

@@ -127,6 +127,14 @@ class ExposureTable:
     @classmethod
     def concat(cls, parts: Sequence["ExposureTable"]) -> "ExposureTable":
         keys = list(parts[0].columns)
+        for i, p in enumerate(parts[1:], start=1):
+            if set(p.columns) != set(keys):
+                # schema drift (e.g. a cache written by a different factor
+                # list) must fail loudly, not as a KeyError mid-concat;
+                # column ORDER differences reconcile to part 0's order
+                raise ValueError(
+                    f"ExposureTable.concat: part {i} columns "
+                    f"{sorted(p.columns)} != part 0 columns {sorted(keys)}")
         cols = {k: np.concatenate([np.asarray(p.columns[k]) for p in parts])
                 for k in keys}
         return cls(cols)

@@ -260,3 +260,19 @@ def test_failed_day_retry_semantics(minute_dir, tmp_path, rng):
                            progress=False)
     assert set(map(str, np.unique(t4.columns["date"]))) == {
         "2024-01-02", "2024-01-04"}
+
+
+def test_concat_rejects_schema_drift():
+    a = ExposureTable.empty(["vol_return1min"])
+    b = ExposureTable.empty(["mmt_pm"])
+    with pytest.raises(ValueError, match="columns"):
+        ExposureTable.concat([a, b])
+    # same column set, different order: reconciles to part 0's order
+    c = ExposureTable({"code": np.array([], dtype=object),
+                       "date": np.array([], dtype="datetime64[D]"),
+                       "mmt_pm": np.array([], dtype=np.float32)})
+    d = ExposureTable({"mmt_pm": np.array([], dtype=np.float32),
+                       "code": np.array([], dtype=object),
+                       "date": np.array([], dtype="datetime64[D]")})
+    out = ExposureTable.concat([c, d])
+    assert list(out.columns) == list(c.columns)

@@ -105,12 +105,20 @@ def factors_case(rng, seed):
     pal = compute_factors_jit(bars, mask, names=ROLLING_FACTORS,
                               rolling_impl="pallas")
     # degeneracy gate: lanes whose windowed var_x ever sits at f32 noise
-    # can route the beta fallback differently between backends
-    st = rolling_window_stats(bars[0, :, :, 2], bars[0, :, :, 1],
-                              mask[0], 50, impl="conv")
-    vx = np.where(np.asarray(st["valid"]), np.asarray(st["var_x"]), np.inf)
-    mx = np.asarray(st["mean_x"])
-    degenerate = (vx < 1e-8 * np.maximum(mx * mx, 1e-12)).any(-1)
+    # can route the beta fallback differently between backends. The gate
+    # is computed from the UNION of both backends' stats — a lane where
+    # var_x is exactly 0 under one backend but a hair above the
+    # threshold under the other would otherwise route the fallback
+    # asymmetrically and surface as a spurious failure (ADVICE r1)
+    degenerate = np.zeros(bars.shape[1], dtype=bool)
+    for impl in ("conv", "pallas"):
+        st = rolling_window_stats(bars[0, :, :, 2], bars[0, :, :, 1],
+                                  mask[0], 50, impl=impl)
+        vx = np.where(np.asarray(st["valid"]), np.asarray(st["var_x"]),
+                      np.inf)
+        mx = np.asarray(st["mean_x"])
+        degenerate |= ((vx == 0.0)
+                       | (vx < 1e-8 * np.maximum(mx * mx, 1e-12))).any(-1)
     for k in ROLLING_FACTORS:
         a, b = np.asarray(conv[k])[0], np.asarray(pal[k])[0]
         keep = ~degenerate

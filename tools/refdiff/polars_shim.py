@@ -49,9 +49,15 @@ SEMANTIC_PINS = {
         "participates and propagates (polars treats NaN as a float "
         "value)."),
     "corr_pairwise": (
-        "pl.corr/pl.cov use pairwise-complete observations (rows where "
-        "both sides are non-null); corr with <2 pairs is NaN; matches "
-        "oracle/stats.py pearson."),
+        "pl.corr/pl.cov use pairwise-complete observations: rows where "
+        "both sides are non-null AND (corr only) non-NaN are kept; corr "
+        "with <2 pairs is NaN; matches oracle/stats.py pearson. Real "
+        "polars likely PROPAGATES a NaN input instead — unreachable in "
+        "the reference's usage (every corr input is built from positive "
+        "prices, or zero-volume rows are pre-filtered), so the drop "
+        "semantics are never exercised differently, but an auditor "
+        "should know the shim is oracle-aligned here, not "
+        "polars-verified."),
     "total_order": (
         "top_k/bottom_k/sort/rank use polars' total float order: NaN is "
         "greater than +inf; nulls are dropped by top_k/bottom_k, sorted "
@@ -844,21 +850,24 @@ class _Then:
             taken = np.zeros(height, bool)
             out_v = np.full(height, np.nan)
             out_ok = np.zeros(height, bool)
-            obj = None
             for cs, vs in evs:
                 cs = _expand(cs, height)
                 vs = _expand(vs, height)
-                if vs.v.dtype.kind not in "iuf":
-                    obj = vs.v.dtype
+                if vs.v.dtype.kind not in "iufb":
+                    raise NotImplementedError(
+                        "when/then branches must be numeric; got dtype "
+                        f"{vs.v.dtype} (the reference only branches on "
+                        "numerics)")
                 hit = (~taken) & cs.ok & cs.v.astype(bool)
-                out_v[hit] = vs.v[hit].astype(np.float64) \
-                    if vs.v.dtype.kind in "iuf" else np.nan
+                out_v[hit] = vs.v[hit].astype(np.float64)
                 out_ok[hit] = vs.ok[hit]
                 taken |= hit
             os_ = _expand(os_, height)
+            if os_.v.dtype.kind not in "iufb":
+                raise NotImplementedError(
+                    f"otherwise branch must be numeric; got {os_.v.dtype}")
             rest = ~taken
-            out_v[rest] = os_.v[rest].astype(np.float64) \
-                if os_.v.dtype.kind in "iuf" else np.nan
+            out_v[rest] = os_.v[rest].astype(np.float64)
             out_ok[rest] = os_.ok[rest]
             return Series(out_v, out_ok)
         # polars names the result after the first then-branch
@@ -910,13 +919,16 @@ class DataFrame:
             data = {}
         if isinstance(data, dict):
             cols = {}
-            height = 0
+            height = None
             for k, v in data.items():
                 s = v if isinstance(v, Series) else Series(np.asarray(v))
                 cols[k] = s
+                if height is not None and _shim_len(s) != height:
+                    raise ValueError(  # real polars raises ShapeError
+                        f"column {k!r} length {_shim_len(s)} != {height}")
                 height = _shim_len(s)
             self._cols = cols
-            self._height = height
+            self._height = height or 0
         else:
             raise NotImplementedError(type(data))
 
@@ -1050,6 +1062,10 @@ class GroupBy:
         c = self._df._ctx()
         parts = _partition_indices(c, self._keys)
         key_out = {k: [] for k in self._keys}
+        names = [e._name for e in exprs]
+        if len(set(names)) != len(names):
+            raise ValueError(  # real polars raises DuplicateError
+                f"duplicate agg output names {names}; use .alias()")
         # pre-create expr columns so zero groups still yield the schema
         agg_out = {e._name: [] for e in exprs}
         agg_ok = {e._name: [] for e in exprs}
