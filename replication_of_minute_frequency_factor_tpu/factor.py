@@ -64,7 +64,21 @@ class Factor:
         date parsing + column projection, path from config instead of the
         hardcoded ``D:\\QuantData`` root."""
         path = path or get_config().daily_pv_path
-        return dio.read_daily_pv(path, columns)
+        pv = dio.read_daily_pv(path, columns)
+        if "code" in pv and "date" in pv and len(pv["code"]):
+            # daily data is one row per (code, date) by construction; a
+            # duplicated key would silently compound twice in the
+            # reference but be deduped by the matrix pivots here — make
+            # malformed input loud instead (clean-divergence policy, Q8)
+            key = np.rec.fromarrays(
+                [np.asarray(pv["code"], dtype="U16"),
+                 np.asarray(pv["date"], dtype="datetime64[D]")])
+            if len(np.unique(key)) != len(key):
+                raise ValueError(
+                    f"daily PV data at {path!r} has duplicate "
+                    f"(code, date) rows "
+                    f"({len(key) - len(np.unique(key))} extras)")
+        return pv
 
     # ------------------------------------------------------------------
     # persistence (reference Factor.py:64-90)
@@ -99,11 +113,13 @@ class Factor:
     # ------------------------------------------------------------------
     # evaluation
     # ------------------------------------------------------------------
-    def _exposure_matrix(self):
+    def _exposure_matrix(self, with_present: bool = False):
         exp = self._require_exposure()
         mat, present, dates, codes = frames.long_to_matrix(
             exp["code"], exp["date"], exp[self.factor_name])
         valid = present & np.isfinite(mat)
+        if with_present:
+            return mat, valid, present, dates, codes
         return mat, valid, dates, codes
 
     def coverage(self, plot: bool = True, return_df: bool = False,
@@ -183,76 +199,93 @@ class Factor:
         if weight_param not in (None, "tmc", "cmc"):
             raise ValueError(
                 f"weight_param must be None/'tmc'/'cmc', got {weight_param!r}")
-        mat, valid, dates, codes = self._exposure_matrix()
+        mat, valid, present, dates, codes = self._exposure_matrix(
+            with_present=True)
+        if mat.size == 0:
+            empty = np.empty((0, group_num))
+            return ({"period": dates[:0], "group_return": empty,
+                     "cum_return": empty} if return_df else None)
         labels = np.asarray(
             eval_ops.qcut_labels(np.nan_to_num(mat), valid, group_num))
 
         pv = self._read_daily_pv_data(
             ["code", "date", "pct_change", "tmc", "cmc"], path=daily_pv_path)
-        # date-sort rows so stable group-bys below keep date order within
-        # every (code, period) segment ('last' = latest trading day)
-        dorder = np.argsort(pv["date"], kind="stable")
-        pv = {k: np.asarray(v)[dorder] for k, v in pv.items()}
-        # gather each pv row's same-day group label (align-left on the
-        # exposure grid; rows without exposure get -1)
-        lab_mat = labels.astype(np.float32)
-        ci = np.searchsorted(codes, pv["code"])
-        di = np.searchsorted(dates, pv["date"])
-        ok = (ci < len(codes)) & (di < len(dates))
-        ok &= np.take(codes, np.minimum(ci, len(codes) - 1)) == pv["code"]
-        ok &= np.take(dates, np.minimum(di, len(dates) - 1)) == pv["date"]
-        row_group = np.full(len(pv["code"]), -1.0, np.float32)
-        row_group[ok] = lab_mat[di[ok], ci[ok]]
+        pct_mat, pv_present, _, _ = frames.long_to_matrix(
+            pv["code"], pv["date"], pv["pct_change"], codes=codes,
+            dates=dates, dtype=np.float64)
+        if weight_param is not None:
+            ones = np.ones(len(pv["code"]), np.float64)
+            w_mat, _, _, _ = frames.long_to_matrix(
+                pv["code"], pv["date"],
+                np.asarray(pv.get(weight_param, ones), np.float64),
+                codes=codes, dates=dates, dtype=np.float64)
 
-        period = frames.period_start(pv["date"], frequency)
-        order, seg, n_segs = frames.group_segments(pv["code"], period)
-        per_ret = frames.segment_compound(pv["pct_change"][order], seg, n_segs)
-        last_group = frames.segment_last(row_group[order], seg, n_segs)
-        last_tmc = frames.segment_last(
-            np.asarray(pv.get("tmc", np.ones(len(period))), np.float64)[order],
-            seg, n_segs)
-        last_cmc = frames.segment_last(
-            np.asarray(pv.get("cmc", np.ones(len(period))), np.float64)[order],
-            seg, n_segs)
-        seg_code = frames.segment_last(pv["code"][order], seg, n_segs)
-        seg_period = frames.segment_last(period[order], seg, n_segs)
+        # Faithful align-left period aggregation (Factor.py:280-320,
+        # verified row-for-row by tools/refdiff): the reference's
+        # ``concat(how='align_left')`` keeps the EXPOSURE grid's
+        # (code, date) rows, so a period's compounded return uses the
+        # exposure rows' joined pct_change (pv-missing days compound as
+        # 0), and the positional ``.last()`` picks the last exposure
+        # date of the period — where the group label may be null (NaN
+        # factor) and tmc/cmc may be null (no pv row that day); those
+        # nulls survive into the one-period lag exactly as in the
+        # reference, and the lag steps to the code's previous EXISTING
+        # period row, not blindly one period back.
+        period = frames.period_start(dates, frequency)  # [D], date-sorted
+        pstarts = np.nonzero(np.r_[True, period[1:] != period[:-1]])[0]
+        uperiods = period[pstarts]
+        n_d, n_codes = pct_mat.shape
+        n_p = len(uperiods)
+        contrib = np.where(present & pv_present & np.isfinite(pct_mat),
+                           np.log1p(pct_mat), 0.0)
+        ret_per = np.expm1(np.add.reduceat(contrib, pstarts, axis=0))
+        row_idx = np.where(present, np.arange(n_d)[:, None], -1)
+        last_idx = np.maximum.reduceat(row_idx, pstarts, axis=0)  # [P,T]
+        has_row = last_idx >= 0
+        gather = np.maximum(last_idx, 0)
+        lab_last = np.where(
+            has_row, np.take_along_axis(labels, gather, axis=0), -1)
 
-        # one-period lag per code (lookahead guard, Factor.py:305-314)
-        so = np.lexsort((seg_period, seg_code))
-        starts = np.r_[True, seg_code[so][1:] != seg_code[so][:-1]]
+        # previous existing period row per code (Factor.py:305-314)
+        parange = np.where(has_row, np.arange(n_p)[:, None], -1)
+        prev = np.maximum.accumulate(parange, axis=0)
+        prev = np.vstack([np.full((1, n_codes), -1), prev[:-1]])
+        has_prev = prev >= 0
+        pg = np.maximum(prev, 0)
+        g_lag = np.where(
+            has_prev, np.take_along_axis(lab_last, pg, axis=0), -1)
+        usable = has_row & (g_lag >= 0)
+        if weight_param is not None:
+            w_last = np.where(
+                has_row, np.take_along_axis(w_mat, gather, axis=0), np.nan)
+            w = np.where(
+                has_prev, np.take_along_axis(w_last, pg, axis=0), np.nan)
 
-        def lag(a):
-            s = np.asarray(a)[so]
-            out = np.r_[s[:1], s[:-1]]
-            out = out.astype(np.float64)
-            out[starts] = np.nan
-            return out
+        ret_mat = np.full((n_p, group_num), np.nan)
+        for g in range(group_num):
+            sel = usable & (g_lag == g)
+            any_row = sel.any(axis=1)
+            if weight_param is None:
+                cnt = sel.sum(axis=1)
+                s = np.where(sel, ret_per, 0.0).sum(axis=1)
+                with np.errstate(invalid="ignore"):
+                    ret_mat[:, g] = np.where(any_row, s / np.maximum(cnt, 1),
+                                             np.nan)
+            else:
+                wok = sel & np.isfinite(w)
+                wk = np.where(wok, w, 0.0)
+                num = (np.where(wok, ret_per, 0.0) * wk).sum(axis=1)
+                den = wk.sum(axis=1)
+                # den == 0 -> 0 return (the reference's sum!=0 guard,
+                # Factor.py:265-272); no usable row at all -> no output
+                with np.errstate(invalid="ignore"):
+                    val = np.where(den != 0, num / np.where(den != 0, den,
+                                                            1.0), 0.0)
+                ret_mat[:, g] = np.where(any_row, val, np.nan)
 
-        g_lag = lag(last_group)
-        tmc_lag = lag(last_tmc)
-        cmc_lag = lag(last_cmc)
-        p_sorted = seg_period[so]
-        r_sorted = np.asarray(per_ret)[so]
-
-        usable = np.isfinite(g_lag) & (g_lag >= 0)
-        if weight_param == "tmc":
-            w = tmc_lag
-        elif weight_param == "cmc":
-            w = cmc_lag
-        else:
-            w = np.ones_like(g_lag)
-        key_p = p_sorted[usable]
-        key_g = g_lag[usable].astype(np.int64)
-        o2, seg2, n2 = frames.group_segments(key_p, key_g)
-        gret = frames.segment_weighted_mean(
-            r_sorted[usable][o2], w[usable][o2], seg2, n2)
-        out_p = frames.segment_last(key_p[o2], seg2, n2)
-        out_g = frames.segment_last(key_g[o2], seg2, n2)
-
-        periods = np.unique(out_p)
-        ret_mat = np.full((len(periods), group_num), np.nan)
-        pi = np.searchsorted(periods, out_p)
-        ret_mat[pi, out_g] = gret
+        keep_p = usable.any(axis=1)
+        periods = uperiods[keep_p]
+        ret_mat = ret_mat[keep_p]
         cum = np.cumprod(np.nan_to_num(ret_mat) + 1.0, axis=0) - 1.0
 
         fig = None

@@ -20,13 +20,16 @@ def long_to_matrix(
     value: np.ndarray,
     codes: Optional[np.ndarray] = None,
     dates: Optional[np.ndarray] = None,
+    dtype=np.float32,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Pivot long rows to ``(mat [D,T], present [D,T], dates [D], codes [T])``.
 
     Absent cells are NaN with ``present=False``; duplicate (date, code) rows
     keep the last. ``codes``/``dates`` pin the axes for cross-table
     alignment (the join key of reference Factor.py:163-171 becomes shared
-    axes).
+    axes). ``dtype`` is f32 for device-bound exposures; host-side eval
+    math (group_test's pct/tmc/cmc) passes f64 to match the reference's
+    precision.
     """
     if codes is None:
         codes = np.unique(code)
@@ -37,7 +40,7 @@ def long_to_matrix(
     ok = (ci < len(codes)) & (di < len(dates))
     ok &= np.take(codes, np.minimum(ci, len(codes) - 1)) == code
     ok &= np.take(dates, np.minimum(di, len(dates) - 1)) == date
-    mat = np.full((len(dates), len(codes)), np.nan, np.float32)
+    mat = np.full((len(dates), len(codes)), np.nan, dtype)
     present = np.zeros((len(dates), len(codes)), bool)
     mat[di[ok], ci[ok]] = value[ok]
     present[di[ok], ci[ok]] = True

@@ -252,26 +252,37 @@ def test_group_test_values_match_pandas_oracle(pv_setup, rng):
         breaks = np.quantile(xs, [(i + 1) / k for i in range(k - 1)])
         return np.searchsorted(breaks, xs, side="left")
 
+    # align-left semantics, verified against the reference's actual code
+    # by tools/refdiff: rows are the EXPOSURE rows (pv joined on), the
+    # period 'last' is the last exposure date (cmc null there if no pv
+    # row), and stocks with null weight drop from both weighted sums
     e = exp.copy()
     e["grp"] = -1
     for d, g in e.groupby("date"):
         e.loc[g.index, "grp"] = polars_qcut(
             g["v"].to_numpy(np.float32).astype(np.float64), K)
-    j = df.merge(e[["code", "date", "grp"]], on=["code", "date"],
-                 how="left")
-    j["grp"] = j["grp"].fillna(-1)
+    j = e[["code", "date", "grp"]].merge(
+        df[["code", "date", "pct_change", "cmc"]], on=["code", "date"],
+        how="left")
     j["period"] = frames.period_start(
         j["date"].to_numpy().astype("datetime64[D]"), freq)
     agg = j.sort_values("date").groupby(["code", "period"]).agg(
-        ret=("pct_change", lambda s: np.prod(1 + s) - 1),
+        ret=("pct_change", lambda s: np.prod(1 + s.dropna()) - 1),
         grp=("grp", "last"), cmc=("cmc", "last")).reset_index()
     agg = agg.sort_values(["code", "period"])
     for col in ("grp", "cmc"):
         agg[col] = agg.groupby("code")[col].shift(1)
     agg = agg[agg["grp"].notna() & (agg["grp"] >= 0)]
+
+    def wmean(g):
+        ok = g["cmc"].notna()
+        den = g.loc[ok, "cmc"].sum()
+        if den == 0:
+            return 0.0
+        return float((g.loc[ok, "ret"] * g.loc[ok, "cmc"]).sum() / den)
+
     want = agg.groupby(["period", "grp"]).apply(
-        lambda g: np.average(g["ret"], weights=g["cmc"].to_numpy()),
-        include_groups=False)
+        wmean, include_groups=False)
     assert len(want), "oracle produced no periods — fixture too small"
     periods, rm = got["period"], got["group_return"]
     for (p, gl), wv in want.items():

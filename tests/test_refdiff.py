@@ -67,3 +67,18 @@ def test_shim_never_replaces_a_real_polars():
         # a real polars won: the shim must not be in sys.modules
         assert not getattr(sys.modules["polars"], "__is_refdiff_shim__",
                            False)
+
+
+@pytest.mark.parametrize("weight_param", [None, "tmc", "cmc"])
+def test_reference_eval_matches_repo(tmp_path, weight_param):
+    """ic_test + group_test value parity against the reference's actual
+    Factor.py code (monthly rebalance)."""
+    fails = harness.compare_eval(rng_seed=7, weight_param=weight_param,
+                                 tmp_dir=str(tmp_path))
+    assert not fails, "\n".join(fails[:20])
+
+
+def test_reference_eval_weekly(tmp_path):
+    fails = harness.compare_eval(rng_seed=11, frequency="weekly",
+                                 weight_param="tmc", tmp_dir=str(tmp_path))
+    assert not fails, "\n".join(fails[:20])

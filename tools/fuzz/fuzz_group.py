@@ -58,34 +58,50 @@ for seed in range(lo, hi):
         got = f.group_test(frequency=freq, weight_param=wparam, group_num=K,
                            plot=False, return_df=True,
                            daily_pv_path=pv_path)
-        # ---- oracle ----
-        e = exp.dropna(subset=["v"]).copy()
+        # ---- oracle (align-left semantics, verified against the
+        # reference's actual Factor.py by tools/refdiff: rows are the
+        # EXPOSURE rows; 'last' picks the last exposure date of the
+        # period; null weights drop from both weighted sums) ----
+        e = exp.copy()
         e["date"] = e["date"].to_numpy().astype("datetime64[D]")
-        # per-date polars qcut over the exposure cross-section
+        # per-date polars qcut over the non-NaN exposure cross-section;
+        # NaN exposures keep rows but get a null (-1) label
         e["grp"] = -1
         for d, g in e.groupby("date"):
-            e.loc[g.index, "grp"] = polars_qcut(
-                g["v"].to_numpy(np.float32).astype(np.float64), K)
+            vals = g["v"].to_numpy(np.float32).astype(np.float64)
+            ok = np.isfinite(vals)
+            labs = np.full(len(vals), -1)
+            if ok.any():
+                labs[ok] = polars_qcut(vals[ok], K)
+            e.loc[g.index, "grp"] = labs
         pvo = pv.copy()
         pvo["date"] = pvo["date"].to_numpy().astype("datetime64[D]")
-        j = pvo.merge(e[["code", "date", "grp"]], on=["code", "date"],
-                      how="left")
-        j["grp"] = j["grp"].fillna(-1)
+        j = e[["code", "date", "grp"]].merge(
+            pvo[["code", "date", "pct_change", "tmc", "cmc"]],
+            on=["code", "date"], how="left")
         j["period"] = frames.period_start(
             j["date"].to_numpy().astype("datetime64[D]"), freq)
         agg = j.sort_values("date").groupby(["code", "period"]).agg(
-            ret=("pct_change", lambda s: np.prod(1 + s) - 1),
+            ret=("pct_change", lambda s: np.prod(1 + s.dropna()) - 1),
             grp=("grp", "last"), tmc=("tmc", "last"), cmc=("cmc", "last"),
         ).reset_index()
         agg = agg.sort_values(["code", "period"])
         for col in ("grp", "tmc", "cmc"):
             agg[col] = agg.groupby("code")[col].shift(1)
         agg = agg[agg["grp"].notna() & (agg["grp"] >= 0)]
-        w = np.ones(len(agg)) if wparam is None else agg[wparam].to_numpy()
-        agg["w"] = w
+
+        def wmean(g):
+            if wparam is None:
+                return float(g["ret"].mean())
+            ok = g[wparam].notna()
+            den = g.loc[ok, wparam].sum()
+            if den == 0:
+                return 0.0
+            return float((g.loc[ok, "ret"] * g.loc[ok, wparam]).sum()
+                         / den)
+
         want = agg.groupby(["period", "grp"]).apply(
-            lambda g: np.average(g["ret"], weights=g["w"]),
-            include_groups=False)
+            wmean, include_groups=False)
         # compare
         periods = got["period"]; rm = got["group_return"]
         for (p, gl), wv in want.items():

@@ -156,6 +156,213 @@ def run_oracle(day: dict, names=None) -> dict:
     return out
 
 
+_ref_factor_mod = None
+
+
+def load_reference_factor_module():
+    """Import the reference's Factor.py (evaluation layer) on the shim."""
+    global _ref_factor_mod
+    if _ref_factor_mod is not None:
+        return _ref_factor_mod
+    os.environ.setdefault("MPLBACKEND", "Agg")
+    install_shim()
+    path = os.path.join(REFERENCE_DIR, "Factor.py")
+    spec = importlib.util.spec_from_file_location("refdiff_ref_factor",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    _ref_factor_mod = mod
+    return mod
+
+
+def synth_eval_data(rng, n_codes=18, n_days=90, nan_prob=0.06,
+                    missing_row_prob=0.04, start="2024-01-01"):
+    """Synthetic exposure + daily-PV long tables for the eval differential.
+
+    Weekday dates only; exposure has NaN patches (reference filters them
+    in ic_test, buckets them null in group_test); PV drops some rows
+    (suspended stocks) and includes tmc/cmc weights.
+    """
+    all_days = np.arange(np.datetime64(start, "D"),
+                         np.datetime64(start, "D") + int(n_days * 1.5))
+    dates = all_days[(all_days.astype(int) + 3) % 7 < 5][:n_days]
+    codes = np.array([f"{600000 + i:06d}" for i in range(n_codes)])
+    cc, dd = np.meshgrid(np.arange(n_codes), np.arange(len(dates)),
+                         indexing="ij")
+    code_col = codes[cc.ravel()]
+    date_col = dates[dd.ravel()]
+    n = code_col.size
+    # exposure: f32-representable values so both stacks see identical bits
+    expo_val = rng.normal(0, 1, n).astype(np.float32).astype(np.float64)
+    expo_val[rng.random(n) < nan_prob] = np.nan
+    exposure = {"code": code_col, "date": date_col, "value": expo_val}
+    keep = rng.random(n) >= missing_row_prob
+    pv = {
+        "code": code_col[keep],
+        "date": date_col[keep],
+        "pct_change": np.round(rng.normal(0, 0.02, keep.sum()), 6),
+        "tmc": np.round(np.exp(rng.normal(10, 1, keep.sum())), 2),
+        "cmc": np.round(np.exp(rng.normal(9, 1, keep.sum())), 2),
+    }
+    # a few zero-cap rows exercise the sum==0 -> 0 weight fallback
+    zero = rng.random(keep.sum()) < 0.01
+    pv["tmc"][zero] = 0.0
+    return exposure, pv
+
+
+def run_reference_eval(exposure, pv, factor_name="f", future_days=5,
+                       frequency="monthly", weight_param=None,
+                       group_num=5):
+    """Reference Factor.ic_test + group_test on the shim.
+
+    ``_read_daily_pv_data`` is replaced (its body is a read of a
+    hardcoded Windows path, Factor.py:49); everything from Factor.py:127
+    onward runs verbatim. Returns (stats, ic_rows, group_rows).
+    """
+    pl = install_shim()
+    mod = load_reference_factor_module()
+    expo_df = pl.DataFrame({
+        "code": exposure["code"],
+        "date": exposure["date"].astype("datetime64[D]"),
+        factor_name: exposure["value"],
+    })
+    f = mod.Factor(factor_name, expo_df)
+
+    def fake_read(column_need=None):
+        cols = column_need or list(pv)
+        return pl.DataFrame({c: pv[c] for c in cols})
+
+    orig = mod.Factor._read_daily_pv_data
+    mod.Factor._read_daily_pv_data = staticmethod(fake_read)
+    try:
+        ic_df = f.ic_test(future_days=future_days, plot_out=False,
+                          return_df=True)
+        stats = {"IC": f.IC, "ICIR": f.ICIR, "rank_IC": f.rank_IC,
+                 "rank_ICIR": f.rank_ICIR}
+        group_df = f.group_test(frequency=frequency,
+                                weight_param=weight_param,
+                                group_num=group_num, plot_out=False,
+                                return_df=True)
+    finally:
+        mod.Factor._read_daily_pv_data = orig
+    ic_rows = {np.datetime64(d, "D"): (float(i), float(r))
+               for d, i, r in zip(ic_df["date"].to_numpy(),
+                                  ic_df["IC"].to_numpy(),
+                                  ic_df["rank_IC"].to_numpy())}
+    group_rows = {}
+    labels = group_df["group"].to_numpy()
+    for d, g, r in zip(group_df["date"].to_numpy(), labels,
+                       group_df["pct_change"].to_numpy()):
+        gi = int(str(g).rsplit("_", 1)[1]) - 1
+        group_rows[(np.datetime64(d, "D"), gi)] = float(r)
+    return stats, ic_rows, group_rows
+
+
+_FREQ_REF_TO_REPO = {"weekly": "week", "monthly": "month",
+                     "quarterly": "quarter", "yearly": "year"}
+_EVERY = {"weekly": "1w", "monthly": "1mo", "quarterly": "1q",
+          "yearly": "1y"}
+
+
+def run_repo_eval(exposure, pv, tmp_dir, factor_name="f", future_days=5,
+                  frequency="monthly", weight_param=None, group_num=5):
+    """Same scenario through this repo's Factor (production eval path)."""
+    import pyarrow as pa
+
+    from replication_of_minute_frequency_factor_tpu import Factor
+    from replication_of_minute_frequency_factor_tpu.data.io import (
+        write_parquet_atomic)
+
+    pv_path = os.path.join(tmp_dir, "daily_pv.parquet")
+    write_parquet_atomic(pa.table({
+        "Stkcd": pv["code"],
+        "Trddt": np.datetime_as_string(pv["date"].astype("datetime64[D]")),
+        "ChangeRatio": pv["pct_change"],
+        "Dsmvtll": pv["tmc"],
+        "Dsmvosd": pv["cmc"],
+    }), pv_path)
+    f = Factor(factor_name).set_exposure(exposure["code"],
+                                         exposure["date"],
+                                         exposure["value"])
+    ic = f.ic_test(future_days=future_days, plot=False, return_df=True,
+                   daily_pv_path=pv_path)
+    stats = {"IC": f.IC, "ICIR": f.ICIR, "rank_IC": f.rank_IC,
+             "rank_ICIR": f.rank_ICIR}
+    gt = f.group_test(frequency=_FREQ_REF_TO_REPO[frequency],
+                      weight_param=weight_param, group_num=group_num,
+                      plot=False, return_df=True,
+                      daily_pv_path=pv_path)
+    ic_rows = {np.datetime64(d, "D"): (float(i), float(r))
+               for d, i, r in zip(ic["date"], ic["IC"], ic["rank_IC"])}
+    # repo periods are labeled by period START; the reference labels by
+    # the right edge — map start -> right edge for comparison
+    group_rows = {}
+    from tools.refdiff.polars_shim import _bucket_label
+    for pi, p in enumerate(gt["period"]):
+        right = _bucket_label(np.datetime64(p, "D"),
+                              _EVERY[frequency], "right")
+        for gi in range(group_num):
+            v = gt["group_return"][pi, gi]
+            if np.isfinite(v):
+                group_rows[(right, gi)] = float(v)
+    return stats, ic_rows, group_rows
+
+
+def compare_eval(rng_seed=0, future_days=5, frequency="monthly",
+                 weight_param=None, group_num=5, tmp_dir=None, **synth_kw):
+    """Full eval differential; returns a list of mismatch strings."""
+    import tempfile
+
+    rng = np.random.default_rng(rng_seed)
+    exposure, pv = synth_eval_data(rng, **synth_kw)
+    own_tmp = tmp_dir is None
+    if own_tmp:
+        tmp_ctx = tempfile.TemporaryDirectory()
+        tmp_dir = tmp_ctx.name
+    try:
+        ref_stats, ref_ic, ref_grp = run_reference_eval(
+            exposure, pv, future_days=future_days, frequency=frequency,
+            weight_param=weight_param, group_num=group_num)
+        repo_stats, repo_ic, repo_grp = run_repo_eval(
+            exposure, pv, tmp_dir, future_days=future_days,
+            frequency=frequency, weight_param=weight_param,
+            group_num=group_num)
+    finally:
+        if own_tmp:
+            tmp_ctx.cleanup()
+    failures = []
+    # IC series: repo eval kernels run f32 on device -> ~1e-4 absolute
+    for d in sorted(set(ref_ic) | set(repo_ic)):
+        if d not in ref_ic or d not in repo_ic:
+            failures.append(f"ic date {d}: only in "
+                            f"{'reference' if d in ref_ic else 'repo'}")
+            continue
+        for j, nm in enumerate(("IC", "rank_IC")):
+            a, b = ref_ic[d][j], repo_ic[d][j]
+            if np.isnan(a) != np.isnan(b) or (
+                    not np.isnan(a)
+                    and not np.isclose(a, b, rtol=5e-4, atol=2e-4)):
+                failures.append(f"{nm}@{d}: ref={a!r} repo={b!r}")
+    for k in ("IC", "ICIR", "rank_IC", "rank_ICIR"):
+        a, b = ref_stats[k], repo_stats[k]
+        if a is None or b is None:
+            if a is not None or b is not None:
+                failures.append(f"{k}: ref={a!r} repo={b!r}")
+            continue
+        if not np.isclose(a, b, rtol=1e-3, atol=2e-4):
+            failures.append(f"{k}: ref={a!r} repo={b!r}")
+    # group returns: all-f64 on both sides -> tight
+    for key in sorted(set(ref_grp) | set(repo_grp)):
+        if key not in ref_grp or key not in repo_grp:
+            failures.append(f"group {key}: only in "
+                            f"{'reference' if key in ref_grp else 'repo'}")
+            continue
+        a, b = ref_grp[key], repo_grp[key]
+        if not np.isclose(a, b, rtol=1e-8, atol=1e-10):
+            failures.append(f"group {key}: ref={a!r} repo={b!r}")
+    return failures
+
+
 def compare_day(day: dict, names=None):
     """Run both stacks on one day; return a list of mismatch strings."""
     ref = run_reference(day, names=names)

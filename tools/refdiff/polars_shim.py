@@ -77,6 +77,23 @@ SEMANTIC_PINS = {
                     "running total.",
     "rank": "rank() is method='average', ascending (polars default).",
     "len": "pl.len() counts rows including nulls.",
+    "qcut_nan": (
+        "qcut buckets a NaN value to null (excluded), and computes "
+        "breakpoints from finite values only. UNVERIFIABLE against real "
+        "polars here: under polars' total float order a NaN could "
+        "plausibly land in the TOP bin instead, which would mean the "
+        "reference's group_test (which, unlike its ic_test, never "
+        "filters NaN exposures — Factor.py:280-292) puts NaN-exposure "
+        "stocks into the best-factor bucket. The repo pins the "
+        "exclude-NaN reading (eval_ops.qcut_labels); revisit on real "
+        "polars."),
+    "align_left": (
+        "concat(how='align_left') joins every later frame onto the "
+        "FIRST frame's rows on the automatically-determined common "
+        "columns, output sorted ascending by those columns — so "
+        "group_test's period aggregation runs over the exposure grid's "
+        "(code, date) rows, and '.last()' picks the last EXPOSURE date "
+        "of each period, not the last trading row (quirk Q10)."),
     "constant_window": (
         "var/std/cov/corr anchor the series at its first observation "
         "before the moment pass, so a constant window yields EXACTLY "
@@ -123,6 +140,77 @@ class Series:
     def to_numpy(self):
         """Match polars Series.to_numpy: nulls become NaN for numerics."""
         return self.fl() if self.v.dtype.kind in "iuf" else self.v
+
+    # eager Series API (the reference uses these on extracted columns,
+    # e.g. ic_df['IC'].mean() / .std() / .cum_sum(), Factor.py:187-207)
+    def __array__(self, dtype=None, copy=None):
+        arr = self.to_numpy()
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __iter__(self):
+        return iter(self.to_numpy())
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return Series(self.v[i], self.ok[i])
+        return self.v[i] if self.ok[i] else None
+
+    def mean(self):
+        s = _agg_mean(self)
+        return float(s.v[0]) if s.ok[0] else None
+
+    def std(self, ddof=1):
+        s = _agg_std(self, ddof)
+        return float(s.v[0]) if s.ok[0] else None
+
+    def sum(self):
+        return _agg_sum(self).v[0]
+
+    def cum_sum(self):
+        filled = np.where(self.ok, self.fl(), 0.0)
+        out = np.cumsum(filled)
+        out[~self.ok] = np.nan
+        return Series(out, self.ok.copy())
+
+    def cum_prod(self):
+        filled = np.where(self.ok, self.fl(), 1.0)
+        out = np.cumprod(filled)
+        out[~self.ok] = np.nan
+        return Series(out, self.ok.copy())
+
+    def unique(self):
+        return Series(np.unique(self.v[self.ok]))
+
+    def sort(self, descending=False):
+        vv = np.sort(self.v[self.ok], kind="stable")
+        if descending:
+            vv = vv[::-1]
+        nulls = int((~self.ok).sum())
+        if nulls:
+            return Series(np.concatenate([self.v[~self.ok], vv]),
+                          np.r_[np.zeros(nulls, bool),
+                                np.ones(vv.size, bool)])
+        return Series(vv)
+
+    def __add__(self, other):
+        if isinstance(other, Series):
+            return _binop(self, other, "add")
+        return _binop(self, Series.scalar(other), "add")
+
+    def __sub__(self, other):
+        if isinstance(other, Series):
+            return _binop(self, other, "sub")
+        return _binop(self, Series.scalar(other), "sub")
+
+    def __truediv__(self, other):
+        if isinstance(other, Series):
+            return _binop(self, other, "truediv")
+        return _binop(self, Series.scalar(other), "truediv")
+
+    def __mul__(self, other):
+        if isinstance(other, Series):
+            return _binop(self, other, "mul")
+        return _binop(self, Series.scalar(other), "mul")
 
 
 def _broadcast(a: Series, b: Series):
@@ -716,6 +804,55 @@ class Expr:
             return Series.scalar(int(s.ok.sum()))
         return Expr(ev, self._name)
 
+    def qcut(self, quantiles, labels=None, allow_duplicates=False):
+        """Quantile bucketing (Factor.py:286-290).
+
+        Breakpoints are linear-interpolation quantiles at i/k over the
+        FINITE values; bins are right-closed ``(-inf, q1], (q1, q2],
+        ...``; with ``allow_duplicates`` duplicate breakpoints collapse
+        and the first ``n_bins`` labels are used (PIN — polars' exact
+        label behavior under collapsed bins is not documented; the
+        repo's eval_ops.qcut_labels pins the same reading). Null in ->
+        null out, and NaN ALSO buckets to null — the pinned side of the
+        Q12 ambiguity (see ``SEMANTIC_PINS['qcut_nan']``; the
+        unverified alternative is NaN -> top bin under total order).
+        """
+        if not isinstance(quantiles, int):
+            raise NotImplementedError("only integer qcut supported")
+        k = quantiles
+
+        def ev(c):
+            s = self._ev(c)
+            v = s.fl()
+            val = v[s.ok]
+            out = np.empty(len(s), dtype=object)
+            ok = s.ok.copy()
+            if val.size == 0:
+                return Series(out, np.zeros(len(s), bool))
+            finite = val[~np.isnan(val)]
+            if finite.size == 0:
+                breaks = np.empty(0)
+            else:
+                breaks = np.quantile(finite, np.arange(1, k) / k,
+                                     method="linear")
+            if allow_duplicates:
+                breaks = np.unique(breaks)
+            elif np.unique(breaks).size != breaks.size:
+                raise ValueError("duplicate qcut breakpoints "
+                                 "(allow_duplicates=False)")
+            lab = labels if labels is not None else [
+                f"bin_{i}" for i in range(breaks.size + 1)]
+            if len(lab) < breaks.size + 1:
+                raise ValueError("not enough qcut labels")
+            # right-closed bins: index = first break >= value
+            idx = np.searchsorted(breaks, v, side="left")
+            # PIN (SEMANTIC_PINS['qcut_nan']): NaN buckets to null
+            ok &= ~np.isnan(v)
+            for i in np.nonzero(ok)[0]:
+                out[i] = lab[idx[i]]
+            return Series(out, ok)
+        return Expr(ev, self._name)
+
     # -- window ------------------------------------------------------------
     def over(self, keys, *more):
         key_list = [keys] if isinstance(keys, str) else list(keys)
@@ -785,10 +922,22 @@ def _pl_len():
 
 
 def corr(a, b, method="pearson", **kw):
-    if method != "pearson":
-        raise NotImplementedError(method)
     ea, eb = _to_col(a), _to_col(b)
-    return Expr(lambda c: _corr2(ea._ev(c), eb._ev(c)), "corr")
+    if method == "pearson":
+        return Expr(lambda c: _corr2(ea._ev(c), eb._ev(c)), "corr")
+    if method == "spearman":
+        # rank (average ties) the pairwise-complete pairs, then Pearson
+        def ev(c):
+            sa, sb = ea._ev(c), eb._ev(c)
+            sa, sb = _broadcast(sa, sb)
+            both = sa.ok & sb.ok
+            av, bv = sa.fl()[both], sb.fl()[both]
+            keep = ~(np.isnan(av) | np.isnan(bv))
+            ra = _rank_avg(Series(av[keep]))
+            rb = _rank_avg(Series(bv[keep]))
+            return _corr2(ra, rb)
+        return Expr(ev, "corr")
+    raise NotImplementedError(method)
 
 
 def cov(a, b=None, ddof=1, **kw):
@@ -1014,8 +1163,22 @@ class DataFrame:
     def sort(self, by=None, *more, descending=False):
         keys = [by] if isinstance(by, str) else list(by)
         keys += list(more)
-        arrs = [self._cols[k].v for k in reversed(keys)]
-        order = np.lexsort(arrs)
+        cols = [self._cols[k] for k in keys]
+        if all(s.ok.all() and s.v.dtype.kind != "O" for s in cols):
+            order = np.lexsort([s.v for s in reversed(cols)])
+        else:
+            # null-aware path (nulls first, polars default); object
+            # columns can hold None cells that break lexsort
+            def row_key(i):
+                out = []
+                for s in cols:
+                    if s.ok[i]:
+                        out.append((1, s.v[i]))
+                    else:
+                        out.append((0, 0))
+                return tuple(out)
+            order = sorted(range(self._height), key=row_key)
+            order = np.asarray(order, dtype=np.int64)
         if descending:
             order = order[::-1]
         return DataFrame._from_ctx(self._ctx().take(order))
@@ -1040,6 +1203,12 @@ class DataFrame:
 
     def rolling(self, index_column, period, group_by=None, **kw):
         return Rolling(self, index_column, period, group_by or [])
+
+    def group_by_dynamic(self, index_column, every, label="left",
+                         group_by=None, closed="left", **kw):
+        keys = [] if group_by is None else (
+            [group_by] if isinstance(group_by, str) else list(group_by))
+        return DynamicGroupBy(self, index_column, every, label, keys)
 
     def join(self, other, on, how="inner"):
         on_list = [on] if isinstance(on, str) else list(on)
@@ -1086,14 +1255,8 @@ class GroupBy:
         for k in self._keys:
             cols[k] = Series(np.asarray(key_out[k]))
         for name, vals in agg_out.items():
-            va = np.asarray(vals)
             oka = np.asarray(agg_ok[name], bool)
-            if va.dtype.kind in "iu" and not oka.all():
-                va = va.astype(np.float64)
-            if va.dtype.kind == "f":
-                va = va.copy()
-                va[~oka] = np.nan
-            cols[name] = Series(va, oka)
+            cols[name] = Series(_column_from_cells(vals, oka), oka)
         df._cols = cols
         df._height = len(parts)
         return df
@@ -1163,6 +1326,113 @@ class Rolling:
         return df
 
 
+def _bucket_start(d: np.ndarray, every: str) -> np.ndarray:
+    """Calendar window start per date (polars dynamic-window truncation:
+    weeks start Monday, months/quarters/years at their first day)."""
+    d = d.astype("datetime64[D]")
+    if every == "1d":
+        return d
+    if every == "1w":
+        di = d.astype(np.int64)  # days since 1970-01-01 (a Thursday)
+        return (di - (di + 3) % 7).astype("datetime64[D]")
+    if every == "1mo":
+        return d.astype("datetime64[M]").astype("datetime64[D]")
+    if every == "1q":
+        m = d.astype("datetime64[M]").astype(np.int64)
+        return ((m // 3) * 3).astype("datetime64[M]").astype("datetime64[D]")
+    if every == "1y":
+        return d.astype("datetime64[Y]").astype("datetime64[D]")
+    raise NotImplementedError(f"every={every!r}")
+
+
+def _bucket_label(start: np.datetime64, every: str, label: str):
+    if label == "left":
+        return start
+    if label != "right":
+        raise NotImplementedError(f"label={label!r}")
+    if every == "1d":
+        return start + np.timedelta64(1, "D")
+    if every == "1w":
+        return start + np.timedelta64(7, "D")
+    if every == "1mo":
+        return ((start.astype("datetime64[M]") + 1)
+                .astype("datetime64[D]"))
+    if every == "1q":
+        return ((start.astype("datetime64[M]") + 3)
+                .astype("datetime64[D]"))
+    if every == "1y":
+        return ((start.astype("datetime64[Y]") + 1)
+                .astype("datetime64[D]"))
+    raise NotImplementedError(f"every={every!r}")
+
+
+class DynamicGroupBy:
+    """group_by_dynamic(index, every=..., label=..., group_by=...)
+    (Factor.py:293-304, MinuteFrequentFactorCICC.py:145-178): calendar
+    windows per group; one output row per non-empty window, windows in
+    ascending start order, rows within a window in input order."""
+
+    def __init__(self, df, index_column, every, label, keys):
+        self._df = df
+        self._idx = index_column
+        self._every = every
+        self._label = label
+        self._keys = keys
+
+    def agg(self, *exprs):
+        exprs = _flatten(exprs)
+        c = self._df._ctx()
+        parts = _partition_indices(c, self._keys) if self._keys \
+            else [np.arange(c.height)]
+        key_cols = {k: [] for k in self._keys}
+        idx_vals = []
+        agg_out = {e._name: [] for e in exprs}
+        agg_ok = {e._name: [] for e in exprs}
+        for idx in parts:
+            sub = c.take(idx)
+            d = sub.cols[self._idx]
+            if not d.ok.all():
+                raise ValueError("null in dynamic index column")
+            starts = _bucket_start(d.v, self._every)
+            for b in np.unique(starts):
+                sel = np.nonzero(starts == b)[0]
+                win = sub.take(sel)
+                for k in self._keys:
+                    key_cols[k].append(sub.cols[k].v[0])
+                idx_vals.append(_bucket_label(b, self._every, self._label))
+                for e in exprs:
+                    s = e._ev(win)
+                    if _shim_len(s) != 1:
+                        raise ValueError("dynamic agg must be scalar")
+                    agg_out[e._name].append(s.v[0])
+                    agg_ok[e._name].append(bool(s.ok[0]))
+        df = DataFrame()
+        cols = {}
+        for k in self._keys:
+            cols[k] = Series(np.asarray(key_cols[k]))
+        cols[self._idx] = Series(np.asarray(idx_vals,
+                                            dtype="datetime64[D]"))
+        for name, vals in agg_out.items():
+            oka = np.asarray(agg_ok[name], bool)
+            va = _column_from_cells(vals, oka)
+            cols[name] = Series(va, oka)
+        df._cols = cols
+        df._height = len(idx_vals)
+        return df
+
+
+def _column_from_cells(vals, oka):
+    """Assemble an agg output column from per-group scalar cells,
+    keeping numeric dtype when possible and NaN-ing invalid slots."""
+    va = np.asarray(vals)
+    if va.dtype.kind in "iu" and not oka.all():
+        va = va.astype(np.float64)
+    if va.dtype.kind == "f":
+        va = va.copy()
+        va[~oka] = np.nan
+    return va
+
+
 def _flatten(exprs):
     out = []
     for e in exprs:
@@ -1217,7 +1487,8 @@ def _join(left: DataFrame, right: DataFrame, on, how):
     return df
 
 
-def concat(frames, how="vertical"):
+def concat(items, how="vertical"):
+    frames = list(items)
     if how == "vertical":
         cols = {}
         names = frames[0].columns
@@ -1230,6 +1501,18 @@ def concat(frames, how="vertical"):
         df._cols = cols
         df._height = sum(f.height for f in frames)
         return df
+    if how == "align_left":
+        # PIN (quirk Q10, Factor.py:163-171,280-283): align on the
+        # columns common to every frame, keep only the left-most frame's
+        # key rows (left join), output sorted ascending by the common
+        # columns in the left frame's column order — matching polars'
+        # documented align behavior.
+        common = [c for c in frames[0].columns
+                  if all(c in f.columns for f in frames[1:])]
+        out = frames[0]
+        for f in frames[1:]:
+            out = _join(out, f, common, "left")
+        return out.sort(by=common)
     raise NotImplementedError(f"concat how={how!r}")
 
 
