@@ -71,7 +71,7 @@ class Factor:
             # reference but be deduped by the matrix pivots here — make
             # malformed input loud instead (clean-divergence policy, Q8)
             key = np.rec.fromarrays(
-                [np.asarray(pv["code"], dtype="U16"),
+                [np.asarray(pv["code"]).astype(str),  # exact itemsize
                  np.asarray(pv["date"], dtype="datetime64[D]")])
             if len(np.unique(key)) != len(key):
                 raise ValueError(
@@ -236,9 +236,12 @@ class Factor:
         uperiods = period[pstarts]
         n_d, n_codes = pct_mat.shape
         n_p = len(uperiods)
+        # straight product like the reference's (pct+1).product()-1 —
+        # a log1p/expm1 formulation would NaN on pct <= -1 (delisting-to-
+        # zero or bad rows) where the reference stays finite
         contrib = np.where(present & pv_present & np.isfinite(pct_mat),
-                           np.log1p(pct_mat), 0.0)
-        ret_per = np.expm1(np.add.reduceat(contrib, pstarts, axis=0))
+                           1.0 + pct_mat, 1.0)
+        ret_per = np.multiply.reduceat(contrib, pstarts, axis=0) - 1.0
         row_idx = np.where(present, np.arange(n_d)[:, None], -1)
         last_idx = np.maximum.reduceat(row_idx, pstarts, axis=0)  # [P,T]
         has_row = last_idx >= 0

@@ -195,6 +195,15 @@ class MinFreqFactor(Factor):
             t = int(frequency)
             if t < 1:
                 raise ValueError(f"rolling window must be >= 1 day, got {t}")
+            if method == "o":
+                # pure passthrough rename — NO rolling window and NO
+                # min_samples mask (MinuteFrequentFactorCICC.py:190-198,
+                # verified by tools/refdiff compare_final_exposure); skip
+                # the window machinery entirely
+                out, out_code, out_date = val.copy(), code, date
+                new_name = f"{self.factor_name}_{t}_{method}"
+                return self._finish_final_exposure(out_code, out_date,
+                                                   out, new_name)
             order = np.lexsort((date, code))
             c, v = np.asarray(code, object)[order], val[order]
             grp_start = np.r_[True, c[1:] != c[:-1]]
@@ -233,16 +242,12 @@ class MinFreqFactor(Factor):
             mean = np.where(const_w, v, mean)  # const_w excludes NaN rows
             std0 = np.where(const_w, 0.0, std0)
             with np.errstate(invalid="ignore", divide="ignore"):
-                if method == "o":
-                    res = v.copy()
-                    res[~ok] = np.nan
-                elif method == "m":
-                    res = mean
+                if method == "m":
+                    res = np.where(ok & ~wbad, mean, np.nan)
                 elif method == "z":
-                    res = (v - mean) / std0
+                    res = np.where(ok & ~wbad, (v - mean) / std0, np.nan)
                 else:
-                    res = std0
-            res = np.where(ok & ~wbad, res, np.nan)
+                    res = np.where(ok & ~wbad, std0, np.nan)
             out = np.empty_like(res)
             out[order] = res
             out_code, out_date = code, date
@@ -250,6 +255,11 @@ class MinFreqFactor(Factor):
         else:
             raise ValueError(f"mode must be 'calendar' or 'days', got {mode!r}")
 
+        return self._finish_final_exposure(out_code, out_date, out,
+                                           new_name)
+
+    @staticmethod
+    def _finish_final_exposure(out_code, out_date, out, new_name):
         result = MinFreqFactor(new_name)
         result.set_exposure(out_code, np.asarray(out_date, "datetime64[D]"),
                             np.asarray(out, np.float32))

@@ -266,9 +266,11 @@ def test_group_test_values_match_pandas_oracle(pv_setup, rng):
         how="left")
     j["period"] = frames.period_start(
         j["date"].to_numpy().astype("datetime64[D]"), freq)
+    # positional last (reference .last()); pandas' 'last' skips NaN
+    plast = lambda s: s.iloc[-1] if len(s) else np.nan
     agg = j.sort_values("date").groupby(["code", "period"]).agg(
         ret=("pct_change", lambda s: np.prod(1 + s.dropna()) - 1),
-        grp=("grp", "last"), cmc=("cmc", "last")).reset_index()
+        grp=("grp", plast), cmc=("cmc", plast)).reset_index()
     agg = agg.sort_values(["code", "period"])
     for col in ("grp", "cmc"):
         agg[col] = agg.groupby("code")[col].shift(1)

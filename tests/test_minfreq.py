@@ -157,8 +157,10 @@ def test_final_exposure_days_mode_matches_pandas_rolling(daily_exposure):
         "m": df["rmean"],
         "std": df["rstd"],
         "z": (df["x"] - df["rmean"]) / df["rstd"],
-        # 'o' = the value itself once a full un-poisoned window exists
-        "o": df["x"].where(df["rmean"].notna()),
+        # 'o' is a pure passthrough rename in the reference — no rolling
+        # window at all (MinuteFrequentFactorCICC.py:190-198, verified
+        # against the reference's own code by tools/refdiff)
+        "o": df["x"],
     }
     for method, want in oracles.items():
         out = f.cal_final_exposure(t, method=method, mode="days")

@@ -82,3 +82,19 @@ def test_reference_eval_weekly(tmp_path):
     fails = harness.compare_eval(rng_seed=11, frequency="weekly",
                                  weight_param="tmc", tmp_dir=str(tmp_path))
     assert not fails, "\n".join(fails[:20])
+
+
+def test_reference_final_exposure_matches_repo():
+    """cal_final_exposure parity across all (mode, method, frequency)
+    configs against the reference's actual MinuteFrequentFactorCICC.py."""
+    fails = harness.compare_final_exposure(rng_seed=5, n_days=50)
+    assert not fails, "\n".join(fails[:20])
+
+
+@pytest.mark.parametrize("precompute_days", [0, 3])
+def test_reference_pipeline_matches_repo(tmp_path, precompute_days):
+    """cal_exposure_by_min_data (incl. incremental resume) parity against
+    the reference's actual driver code."""
+    fails = harness.compare_pipeline(str(tmp_path), n_days=5,
+                                     precompute_days=precompute_days)
+    assert not fails, "\n".join(fails[:20])

@@ -81,9 +81,11 @@ for seed in range(lo, hi):
             on=["code", "date"], how="left")
         j["period"] = frames.period_start(
             j["date"].to_numpy().astype("datetime64[D]"), freq)
+        # positional last (reference .last()); pandas' 'last' skips NaN
+        plast = lambda s: s.iloc[-1] if len(s) else np.nan
         agg = j.sort_values("date").groupby(["code", "period"]).agg(
             ret=("pct_change", lambda s: np.prod(1 + s.dropna()) - 1),
-            grp=("grp", "last"), tmc=("tmc", "last"), cmc=("cmc", "last"),
+            grp=("grp", plast), tmc=("tmc", plast), cmc=("cmc", plast),
         ).reset_index()
         agg = agg.sort_values(["code", "period"])
         for col in ("grp", "tmc", "cmc"):

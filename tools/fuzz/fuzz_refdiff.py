@@ -22,6 +22,26 @@ lo, hi = int(sys.argv[1]), int(sys.argv[2])
 for seed in range(lo, hi):
     try:
         rng = np.random.default_rng(seed)
+        if seed >= 200_000:
+            # resampler + pipeline differential: the reference's actual
+            # cal_final_exposure / cal_exposure_by_min_data vs the repo
+            mism = harness.compare_final_exposure(
+                rng_seed=seed, n_codes=int(rng.integers(4, 14)),
+                n_days=int(rng.integers(20, 90)),
+                nan_prob=float(rng.choice([0.0, 0.1, 0.3])))
+            if not mism and seed % 3 == 0:
+                import tempfile
+                with tempfile.TemporaryDirectory() as td:
+                    mism = harness.compare_pipeline(
+                        td, n_days=int(rng.integers(3, 7)),
+                        precompute_days=int(rng.integers(0, 3)),
+                        n_codes=int(rng.integers(4, 10)), seed=seed)
+            if mism:
+                fails.append((seed, mism[:5]))
+                print(f"SEED {seed} FAILED ({len(mism)}):", flush=True)
+                for m in mism[:5]:
+                    print("   ", m, flush=True)
+            continue
         if seed >= 100_000:
             # evaluation-layer differential: the reference's actual
             # ic_test/group_test (Factor.py) vs this repo's Factor

@@ -75,7 +75,10 @@ for seed in range(lo, hi):
                     else:
                         t = freq
                         r = g.rolling(t, min_periods=t)
-                        if method == "o": w = g.where(r.count() >= t)
+                        # 'o' is a passthrough rename in the reference
+                        # (no rolling window; MinuteFrequentFactorCICC.py
+                        # :190-198, verified by tools/refdiff)
+                        if method == "o": w = g
                         elif method == "m": w = r.mean()
                         elif method == "z":
                             sd = r.std(ddof=0)

@@ -210,6 +210,24 @@ def synth_eval_data(rng, n_codes=18, n_days=90, nan_prob=0.06,
     return exposure, pv
 
 
+def _exposure_frame(pl, exposure, factor_name):
+    """Exposure long table as the reference would read it from its own
+    parquet cache: NaN factor values are NULLS there (polars kernels
+    emit null for undefined values), so the repo's NaN maps to null."""
+    from tools.refdiff.polars_shim import Series as ShimSeries
+
+    vals = np.asarray(exposure["value"], np.float64)
+    if getattr(pl, "__is_refdiff_shim__", False):
+        val_col = ShimSeries(vals, np.isfinite(vals))
+    else:  # real polars: None marks null
+        val_col = [None if not np.isfinite(v) else float(v) for v in vals]
+    return pl.DataFrame({
+        "code": exposure["code"],
+        "date": exposure["date"].astype("datetime64[D]"),
+        factor_name: val_col,
+    })
+
+
 def run_reference_eval(exposure, pv, factor_name="f", future_days=5,
                        frequency="monthly", weight_param=None,
                        group_num=5):
@@ -221,12 +239,8 @@ def run_reference_eval(exposure, pv, factor_name="f", future_days=5,
     """
     pl = install_shim()
     mod = load_reference_factor_module()
-    expo_df = pl.DataFrame({
-        "code": exposure["code"],
-        "date": exposure["date"].astype("datetime64[D]"),
-        factor_name: exposure["value"],
-    })
-    f = mod.Factor(factor_name, expo_df)
+    f = mod.Factor(factor_name, _exposure_frame(pl, exposure,
+                                                factor_name))
 
     def fake_read(column_need=None):
         cols = column_need or list(pv)
@@ -360,6 +374,246 @@ def compare_eval(rng_seed=0, future_days=5, frequency="monthly",
         a, b = ref_grp[key], repo_grp[key]
         if not np.isclose(a, b, rtol=1e-8, atol=1e-10):
             failures.append(f"group {key}: ref={a!r} repo={b!r}")
+    return failures
+
+
+class _OsRedirect:
+    """Stand-in for the ``os`` module inside the reference's
+    MinuteFrequentFactorCICC module: its minute-dir and cache-dir paths
+    are hardcoded Windows literals (:64,68), so ``listdir``/``path.join``
+    redirect those two roots to harness-provided directories. Everything
+    else passes through."""
+
+    _KLINE = r"D:\QuantData\KLine_cleaned"
+    _CACHE = r"D:\QuantData\MinuteFreqFactor\CICC Factor"
+
+    class _PathNS:
+        def __init__(self, outer):
+            self._o = outer
+
+        def join(self, a, *rest):
+            a = self._o._map(a)
+            return os.path.join(a, *rest)
+
+        def __getattr__(self, name):
+            return getattr(os.path, name)
+
+    def __init__(self, kline_dir, cache_dir):
+        self._kline = kline_dir
+        self._cache = cache_dir
+        self.path = self._PathNS(self)
+
+    def _map(self, p):
+        if p == self._KLINE:
+            return self._kline
+        if p == self._CACHE:
+            return self._cache
+        return p
+
+    def listdir(self, p):
+        return sorted(os.listdir(self._map(p)))
+
+    def __getattr__(self, name):
+        return getattr(os, name)
+
+
+def load_reference_minfreq_module(kline_dir, cache_dir):
+    """Import the reference's MinuteFrequentFactorCICC.py on the shim.
+
+    ``from Factor import Factor`` resolves to the shim-backed reference
+    Factor module; the hardcoded data roots redirect via _OsRedirect.
+    Re-imported per call because the redirect dirs change per scenario.
+    """
+    install_shim()
+    fmod = load_reference_factor_module()
+    sys.modules["Factor"] = fmod
+    path = os.path.join(REFERENCE_DIR, "MinuteFrequentFactorCICC.py")
+    spec = importlib.util.spec_from_file_location("refdiff_ref_minfreq",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.os = _OsRedirect(kline_dir, cache_dir)
+    return mod
+
+
+def write_day_files(minute_dir, days, n_codes=8, seed=0, **synth_kw):
+    """Synthetic day parquets named YYYYMMDD.parquet (the reference's
+    file-table contract, MinuteFrequentFactorCICC.py:69-77)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(minute_dir, exist_ok=True)
+    from replication_of_minute_frequency_factor_tpu.data.synthetic import (
+        synth_day)
+    for i, date in enumerate(days):
+        rng = np.random.default_rng(seed + i)
+        day = synth_day(rng, n_codes=n_codes, date=str(date), **synth_kw)
+        n = len(day["code"])
+        t = pa.table({
+            "code": pa.array(list(day["code"]), pa.string()),
+            "date": pa.array([np.datetime64(date, "D").item()] * n,
+                             pa.date32()),
+            "time": pa.array(np.asarray(day["time"], np.int64)),
+            **{k: pa.array(np.asarray(day[k], np.float64))
+               for k in ("open", "high", "low", "close", "volume")},
+        })
+        fname = str(date).replace("-", "") + ".parquet"
+        pq.write_table(t, os.path.join(minute_dir, fname))
+
+
+def run_reference_pipeline(kline_dir, cache_dir, factor_name,
+                           path=None):
+    """Reference cal_exposure_by_min_data (incremental resume included)
+    -> {(code, date): value} and the raw row count."""
+    mod = load_reference_minfreq_module(kline_dir, cache_dir)
+    kmod = load_reference_kernels()
+    f = mod.MinFreqFactor(factor_name)
+    f.cal_exposure_by_min_data(getattr(kmod, "cal_" + factor_name),
+                               path=path, n_jobs=1)
+    out = f.factor_exposure
+    codes = out["code"].to_numpy()
+    dates = out["date"].to_numpy().astype("datetime64[D]")
+    vals = out[factor_name].to_numpy()
+    return {(str(c), d): float(v)
+            for c, d, v in zip(codes, dates, vals)}, len(codes)
+
+
+def run_repo_pipeline(minute_dir, factor_name, cache_path=None):
+    """Same scenario through this repo's MinFreqFactor.
+
+    ``cache_path`` must always be explicit: ``path=None`` would resolve
+    the GLOBAL ``Config.factor_dir`` cache, leaking state between
+    scenarios (this exact leak produced phantom dates in an early fuzz
+    run of this harness).
+    """
+    from replication_of_minute_frequency_factor_tpu import MinFreqFactor
+    from replication_of_minute_frequency_factor_tpu.config import Config
+
+    if cache_path is None:
+        cache_path = os.path.join(minute_dir,
+                                  f"_refdiff_{factor_name}.parquet")
+    cfg = Config(minute_dir=minute_dir, days_per_batch=4,
+                 factor_dir=os.path.dirname(cache_path))
+    f = MinFreqFactor(factor_name)
+    f.cal_exposure_by_min_data(path=cache_path, minute_dir=minute_dir,
+                               cfg=cfg, progress=False)
+    exp = f.factor_exposure
+    return {(str(c), np.datetime64(d, "D")): float(v)
+            for c, d, v in zip(exp["code"], exp["date"],
+                               exp[factor_name])}, len(exp["code"])
+
+
+def compare_pipeline(tmp_dir, factor_name="vol_return1min", n_days=5,
+                     n_codes=8, precompute_days=0, seed=0, **synth_kw):
+    """Pipeline differential: day files + optional pre-seeded cache ->
+    reference incremental driver vs repo driver."""
+    kline = os.path.join(tmp_dir, "kline")
+    ref_cache_dir = os.path.join(tmp_dir, "ref_cache")
+    os.makedirs(ref_cache_dir, exist_ok=True)
+    all_days = np.arange(np.datetime64("2024-03-04"),
+                         np.datetime64("2024-03-04") + n_days * 2)
+    days = [d for d in all_days if (d.astype(int) + 3) % 7 < 5][:n_days]
+    write_day_files(kline, days, n_codes=n_codes, seed=seed, **synth_kw)
+
+    repo_cache = None
+    if precompute_days:
+        # seed both caches from the repo's first-pass on the early days
+        early = os.path.join(tmp_dir, "early")
+        write_day_files(early, days[:precompute_days], n_codes=n_codes,
+                        seed=seed, **synth_kw)
+        from replication_of_minute_frequency_factor_tpu import MinFreqFactor
+        from replication_of_minute_frequency_factor_tpu.config import (
+            Config)
+        f0 = MinFreqFactor(factor_name)
+        f0.cal_exposure_by_min_data(
+            minute_dir=early, cfg=Config(minute_dir=early),
+            progress=False)
+        repo_cache = os.path.join(tmp_dir, f"{factor_name}.parquet")
+        f0.to_parquet(repo_cache)
+        import shutil
+        shutil.copy(repo_cache,
+                    os.path.join(ref_cache_dir,
+                                 f"{factor_name}.parquet"))
+
+    ref_rows, ref_n = run_reference_pipeline(kline, ref_cache_dir,
+                                             factor_name)
+    repo_rows, repo_n = run_repo_pipeline(kline, factor_name,
+                                          cache_path=repo_cache)
+    failures = []
+    if ref_n != len(ref_rows):
+        failures.append(f"reference emitted duplicate rows "
+                        f"({ref_n} vs {len(ref_rows)})")
+    for key in sorted(set(ref_rows) | set(repo_rows)):
+        if key not in ref_rows or key not in repo_rows:
+            failures.append(
+                f"{key}: only in "
+                f"{'reference' if key in ref_rows else 'repo'}")
+            continue
+        a, b = ref_rows[key], repo_rows[key]
+        if np.isnan(a) != np.isnan(b):
+            failures.append(f"{key}: nan mismatch ref={a!r} repo={b!r}")
+        elif not np.isnan(a) and not np.isclose(a, b, rtol=2e-3,
+                                                atol=1e-6):
+            failures.append(f"{key}: ref={a!r} repo={b!r}")
+    return failures
+
+
+def compare_final_exposure(rng_seed=0, n_codes=10, n_days=60,
+                           nan_prob=0.1):
+    """cal_final_exposure differential across every (mode, method,
+    frequency) config (reference MinuteFrequentFactorCICC.py:114-245)."""
+    pl = install_shim()
+    rng = np.random.default_rng(rng_seed)
+    exposure, _ = synth_eval_data(rng, n_codes=n_codes, n_days=n_days,
+                                  nan_prob=nan_prob)
+    name = "f"
+    mod = load_reference_minfreq_module("/nonexistent", "/nonexistent")
+    rf = mod.MinFreqFactor(name, _exposure_frame(pl, exposure, name))
+    from replication_of_minute_frequency_factor_tpu import MinFreqFactor
+    pf = MinFreqFactor(name).set_exposure(
+        exposure["code"], exposure["date"], exposure["value"])
+
+    _freq_map = {"weekly": "week", "monthly": "month"}
+    failures = []
+    configs = ([("calendar", f, m) for f in ("weekly", "monthly")
+                for m in ("o", "m", "z", "std")]
+               + [("days", t, m) for t in (3, 5)
+                  for m in ("o", "m", "z", "std")])
+    for mode, freq, method in configs:
+        tag = f"{mode}/{freq}/{method}"
+        ref_df = rf.cal_final_exposure(frequency=freq, method=method,
+                                       mode=mode)
+        repo_freq = _freq_map.get(freq, freq)
+        res = pf.cal_final_exposure(frequency=repo_freq, method=method,
+                                    mode=mode)
+        out_name = (f"{freq}_{name}_{method}" if mode == "calendar"
+                    else f"{name}_{freq}_{method}")
+        repo_name = (f"{repo_freq}_{name}_{method}"
+                     if mode == "calendar" else out_name)
+        rcodes = ref_df["code"].to_numpy()
+        rdates = ref_df["date"].to_numpy().astype("datetime64[D]")
+        rvals = ref_df[out_name].to_numpy()
+        ref_rows = {(str(c), d): float(v)
+                    for c, d, v in zip(rcodes, rdates, rvals)}
+        rex = res.factor_exposure
+        repo_rows = {(str(c), np.datetime64(d, "D")): float(v)
+                     for c, d, v in zip(rex["code"], rex["date"],
+                                        rex[repo_name])}
+        for key in sorted(set(ref_rows) | set(repo_rows)):
+            if key not in ref_rows or key not in repo_rows:
+                failures.append(
+                    f"{tag} {key}: only in "
+                    f"{'reference' if key in ref_rows else 'repo'}")
+                continue
+            a, b = ref_rows[key], repo_rows[key]
+            if np.isnan(a) != np.isnan(b):
+                failures.append(f"{tag} {key}: nan mismatch "
+                                f"ref={a!r} repo={b!r}")
+            # repo output is stored f32 (set_exposure) — compare at f32
+            # precision against the reference's f64
+            elif not np.isnan(a) and not np.isclose(a, b, rtol=5e-6,
+                                                    atol=1e-6):
+                failures.append(f"{tag} {key}: ref={a!r} repo={b!r}")
     return failures
 
 

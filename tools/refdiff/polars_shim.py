@@ -181,6 +181,14 @@ class Series:
     def unique(self):
         return Series(np.unique(self.v[self.ok]))
 
+    def max(self):
+        v = self.v[self.ok]
+        return v.max() if v.size else None
+
+    def min(self):
+        v = self.v[self.ok]
+        return v.min() if v.size else None
+
     def sort(self, descending=False):
         vv = np.sort(self.v[self.ok], kind="stable")
         if descending:
@@ -700,14 +708,16 @@ class Expr:
             for i in range(n):
                 win = v[max(0, i - w + 1):i + 1]
                 winok = s.ok[max(0, i - w + 1):i + 1]
-                if win.size < mn:
-                    continue
                 vv = win[winok]  # nulls skipped inside the window
+                # polars min_samples counts NON-NULL values in the
+                # window, not slots (verified indirectly: the repo's
+                # NaN-window nulling matches only under this reading)
+                if vv.size < mn:
+                    continue
                 if kind == "sum":
-                    out[i], ok[i] = (vv.sum() if vv.size else 0.0), True
+                    out[i], ok[i] = vv.sum(), True
                 elif kind == "mean":
-                    if vv.size:
-                        out[i], ok[i] = vv.mean(), True
+                    out[i], ok[i] = vv.mean(), True
                 elif kind == "std":
                     if vv.size - ddof > 0:
                         out[i], ok[i] = np.std(vv, ddof=ddof), True
@@ -804,6 +814,10 @@ class Expr:
             return Series.scalar(int(s.ok.sum()))
         return Expr(ev, self._name)
 
+    @property
+    def str(self):
+        return _StrNS(self)
+
     def qcut(self, quantiles, labels=None, allow_duplicates=False):
         """Quantile bucketing (Factor.py:286-290).
 
@@ -885,6 +899,43 @@ class Expr:
                 out_v = np.empty(0)
             return Series(out_v, out_ok)
         return Expr(ev, self._name)
+
+
+class _StrNS:
+    """``Expr.str`` namespace — the slice/parse ops the reference's
+    day-filename indexing uses (MinuteFrequentFactorCICC.py:74-77)."""
+
+    def __init__(self, expr):
+        self._e = expr
+
+    def head(self, n):
+        e = self._e
+
+        def ev(c):
+            s = e._ev(c)
+            vals = np.asarray([x[:n] if isinstance(x, str) else x
+                               for x in s.v], dtype=object)
+            return Series(vals, s.ok.copy())
+        return Expr(ev, e._name)
+
+    def to_date(self, format="%Y-%m-%d"):
+        import datetime as _dt
+        e = self._e
+
+        def ev(c):
+            s = e._ev(c)
+            out = np.zeros(len(s.v), dtype="datetime64[D]")
+            ok = s.ok.copy()
+            for i, x in enumerate(s.v):
+                if not ok[i]:
+                    continue
+                try:
+                    out[i] = np.datetime64(
+                        _dt.datetime.strptime(str(x), format).date())
+                except ValueError:
+                    raise  # real polars raises ComputeError on bad input
+            return Series(out, ok)
+        return Expr(ev, e._name)
 
 
 class _Col(Expr):
@@ -1063,9 +1114,15 @@ def _partition_indices(c: Ctx, keys):
 
 
 class DataFrame:
-    def __init__(self, data=None):
+    def __init__(self, data=None, schema=None):
+        # ``schema`` (MinuteFrequentFactorCICC.py:72) is accepted and
+        # only sanity-checked: the shim infers dtypes from the values
         if data is None:
             data = {}
+        if schema is not None and isinstance(data, dict):
+            unknown = set(schema) - set(data)
+            if unknown:
+                raise ValueError(f"schema names {unknown} not in data")
         if isinstance(data, dict):
             cols = {}
             height = None
@@ -1087,6 +1144,13 @@ class DataFrame:
         df = DataFrame()
         df._cols = ctx.cols
         df._height = ctx.height
+        return df
+
+    @staticmethod
+    def _raw(cols: dict) -> "DataFrame":
+        df = DataFrame()
+        df._cols = cols
+        df._height = _shim_len(next(iter(cols.values()))) if cols else 0
         return df
 
     def _ctx(self) -> Ctx:
@@ -1204,8 +1268,23 @@ class DataFrame:
     def rolling(self, index_column, period, group_by=None, **kw):
         return Rolling(self, index_column, period, group_by or [])
 
-    def group_by_dynamic(self, index_column, every, label="left",
+    def group_by_dynamic(self, index_column=None, *, every, label="left",
                          group_by=None, closed="left", **kw):
+        if index_column is None:
+            # PIN (quirk Q13): cal_final_exposure calls group_by_dynamic
+            # with NO index_column (MinuteFrequentFactorCICC.py:145,155,
+            # 165,178) — modern polars rejects that call outright
+            # (index_column is required), so the reference's calendar
+            # mode cannot have run as written on a current engine. The
+            # shim infers the frame's unique datetime column, the only
+            # reading under which the method works at all; the repo's
+            # resampler implements the same reading.
+            dt_cols = [k for k, s in self._cols.items()
+                       if s.v.dtype.kind == "M"]
+            if len(dt_cols) != 1:
+                raise ValueError(
+                    f"cannot infer dynamic index column from {dt_cols}")
+            index_column = dt_cols[0]
         keys = [] if group_by is None else (
             [group_by] if isinstance(group_by, str) else list(group_by))
         return DynamicGroupBy(self, index_column, every, label, keys)
@@ -1214,8 +1293,22 @@ class DataFrame:
         on_list = [on] if isinstance(on, str) else list(on)
         return _join(self, other, on_list, how)
 
-    def write_parquet(self, *a, **kw):
-        raise NotImplementedError("shim does not write parquet")
+    def write_parquet(self, path, **kw):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        arrays = {}
+        for k, s in self._cols.items():
+            if s.v.dtype.kind == "M":
+                arrays[k] = pa.array(s.v.astype("datetime64[D]"),
+                                     mask=~s.ok)
+            elif s.v.dtype.kind in "iufb":
+                arrays[k] = pa.array(s.v, mask=~s.ok)
+            else:
+                arrays[k] = pa.array(
+                    [str(x) if o else None for x, o in zip(s.v, s.ok)],
+                    type=pa.string())
+        pq.write_table(pa.table(arrays), path)
 
 
 LazyFrame = DataFrame
@@ -1516,12 +1609,37 @@ def concat(items, how="vertical"):
     raise NotImplementedError(f"concat how={how!r}")
 
 
-def read_parquet(*a, **kw):
-    raise NotImplementedError("shim has no parquet IO")
+def read_parquet(path, **kw):
+    """Parquet -> shim DataFrame via pyarrow, preserving null masks
+    (polars nulls round-trip; NaN stays a value)."""
+    import pyarrow.parquet as pq
+
+    t = pq.read_table(path)
+    cols = {}
+    for name in t.column_names:
+        col = t.column(name)
+        arr = col.to_numpy(zero_copy_only=False)
+        ok = ~np.asarray(col.is_null().to_numpy(zero_copy_only=False))
+        if arr.dtype.kind == "M":
+            arr = arr.astype("datetime64[D]")
+        elif arr.dtype.kind == "O":
+            first = next((x for x in arr if x is not None), None)
+            import datetime as _dt
+            if isinstance(first, _dt.date):
+                vals = np.zeros(len(arr), dtype="datetime64[D]")
+                for i, x in enumerate(arr):
+                    if ok[i]:
+                        vals[i] = np.datetime64(x)
+                arr = vals
+            else:
+                arr = np.asarray([x if x is not None else "" for x in arr],
+                                 dtype=object)
+        cols[name] = Series(arr, ok)
+    return DataFrame._raw(cols)
 
 
-def scan_parquet(*a, **kw):
-    raise NotImplementedError("shim has no parquet IO")
+def scan_parquet(path, **kw):
+    return read_parquet(path, **kw)
 
 
 # dtypes (identity objects; only compared by ``is`` / equality)
