@@ -243,11 +243,12 @@ class MinFreqFactor(Factor):
             std0 = np.where(const_w, 0.0, std0)
             with np.errstate(invalid="ignore", divide="ignore"):
                 if method == "m":
-                    res = np.where(ok & ~wbad, mean, np.nan)
+                    res = mean
                 elif method == "z":
-                    res = np.where(ok & ~wbad, (v - mean) / std0, np.nan)
+                    res = (v - mean) / std0
                 else:
-                    res = np.where(ok & ~wbad, std0, np.nan)
+                    res = std0
+            res = np.where(ok & ~wbad, res, np.nan)
             out = np.empty_like(res)
             out[order] = res
             out_code, out_date = code, date

@@ -87,7 +87,10 @@ def test_reference_eval_weekly(tmp_path):
 def test_reference_final_exposure_matches_repo():
     """cal_final_exposure parity across all (mode, method, frequency)
     configs against the reference's actual MinuteFrequentFactorCICC.py."""
-    fails = harness.compare_final_exposure(rng_seed=5, n_days=50)
+    try:
+        fails = harness.compare_final_exposure(rng_seed=5, n_days=50)
+    except harness.RefdiffUnsupported as e:
+        pytest.skip(str(e))
     assert not fails, "\n".join(fails[:20])
 
 
@@ -95,6 +98,9 @@ def test_reference_final_exposure_matches_repo():
 def test_reference_pipeline_matches_repo(tmp_path, precompute_days):
     """cal_exposure_by_min_data (incl. incremental resume) parity against
     the reference's actual driver code."""
-    fails = harness.compare_pipeline(str(tmp_path), n_days=5,
-                                     precompute_days=precompute_days)
+    try:
+        fails = harness.compare_pipeline(str(tmp_path), n_days=5,
+                                         precompute_days=precompute_days)
+    except harness.RefdiffUnsupported as e:
+        pytest.skip(str(e))
     assert not fails, "\n".join(fails[:20])

@@ -377,6 +377,25 @@ def compare_eval(rng_seed=0, future_days=5, frequency="monthly",
     return failures
 
 
+class RefdiffUnsupported(RuntimeError):
+    """Raised when a differential cannot run in this environment (e.g.
+    the reference's own code is invalid on real polars — quirk Q13)."""
+
+
+def _require_shim():
+    """The minfreq differentials need the SHIM specifically: the
+    reference's calendar-mode ``group_by_dynamic`` call omits the
+    required ``index_column`` (quirk Q13), which modern real polars
+    rejects with TypeError before any comparison can happen."""
+    pl = install_shim()
+    if not getattr(pl, "__is_refdiff_shim__", False):
+        raise RefdiffUnsupported(
+            "reference MinuteFrequentFactorCICC code cannot run on real "
+            "polars (quirk Q13: group_by_dynamic without index_column); "
+            "these differentials require the shim")
+    return pl
+
+
 class _OsRedirect:
     """Stand-in for the ``os`` module inside the reference's
     MinuteFrequentFactorCICC module: its minute-dir and cache-dir paths
@@ -424,7 +443,7 @@ def load_reference_minfreq_module(kline_dir, cache_dir):
     Factor module; the hardcoded data roots redirect via _OsRedirect.
     Re-imported per call because the redirect dirs change per scenario.
     """
-    install_shim()
+    _require_shim()
     fmod = load_reference_factor_module()
     sys.modules["Factor"] = fmod
     path = os.path.join(REFERENCE_DIR, "MinuteFrequentFactorCICC.py")
@@ -543,6 +562,9 @@ def compare_pipeline(tmp_dir, factor_name="vol_return1min", n_days=5,
     if ref_n != len(ref_rows):
         failures.append(f"reference emitted duplicate rows "
                         f"({ref_n} vs {len(ref_rows)})")
+    if repo_n != len(repo_rows):
+        failures.append(f"repo emitted duplicate rows "
+                        f"({repo_n} vs {len(repo_rows)})")
     for key in sorted(set(ref_rows) | set(repo_rows)):
         if key not in ref_rows or key not in repo_rows:
             failures.append(

@@ -927,13 +927,11 @@ class _StrNS:
             out = np.zeros(len(s.v), dtype="datetime64[D]")
             ok = s.ok.copy()
             for i, x in enumerate(s.v):
-                if not ok[i]:
-                    continue
-                try:
+                if ok[i]:
+                    # a bad input raises ValueError (real polars raises
+                    # its ComputeError; both abort the query loudly)
                     out[i] = np.datetime64(
                         _dt.datetime.strptime(str(x), format).date())
-                except ValueError:
-                    raise  # real polars raises ComputeError on bad input
             return Series(out, ok)
         return Expr(ev, e._name)
 
