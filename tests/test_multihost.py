@@ -35,15 +35,30 @@ def test_two_process_global_mesh(tmp_path):
         [sys.executable, CHILD, str(i), str(port), str(tmp_path)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True) for i in range(2)]
-    outs = []
+    outs = ["", ""]
+    timed_out = False
     try:
-        for p in procs:
-            out, _ = p.communicate(timeout=280)
-            outs.append(out)
+        for i, p in enumerate(procs):
+            outs[i], _ = p.communicate(timeout=280)
+    except subprocess.TimeoutExpired:
+        # one child died early -> the other hangs in the distributed-init
+        # barrier; kill BOTH, then drain pipes so the crashed child's
+        # traceback reaches the failure message
+        timed_out = True
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
+        for i, p in enumerate(procs):
+            if not outs[i]:
+                try:
+                    outs[i], _ = p.communicate(timeout=30)
+                except subprocess.TimeoutExpired:
+                    outs[i] = "<no output drained>"
+    assert not timed_out, (
+        "multihost children timed out; outputs:\n"
+        f"--- process 0 ---\n{outs[0][-2000:]}\n"
+        f"--- process 1 ---\n{outs[1][-2000:]}")
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {i} rc={p.returncode}\n" \
             + out[-2000:]

@@ -526,6 +526,7 @@ def compare_pipeline(tmp_dir, factor_name="vol_return1min", n_days=5,
                      n_codes=8, precompute_days=0, seed=0, **synth_kw):
     """Pipeline differential: day files + optional pre-seeded cache ->
     reference incremental driver vs repo driver."""
+    _require_shim()  # fail in ms, before the expensive repo precompute
     kline = os.path.join(tmp_dir, "kline")
     ref_cache_dir = os.path.join(tmp_dir, "ref_cache")
     os.makedirs(ref_cache_dir, exist_ok=True)
@@ -584,7 +585,7 @@ def compare_final_exposure(rng_seed=0, n_codes=10, n_days=60,
                            nan_prob=0.1):
     """cal_final_exposure differential across every (mode, method,
     frequency) config (reference MinuteFrequentFactorCICC.py:114-245)."""
-    pl = install_shim()
+    pl = _require_shim()
     rng = np.random.default_rng(rng_seed)
     exposure, _ = synth_eval_data(rng, n_codes=n_codes, n_days=n_days,
                                   nan_prob=nan_prob)
