@@ -249,6 +249,7 @@ def run_reference_eval(exposure, pv, factor_name="f", future_days=5,
     orig = mod.Factor._read_daily_pv_data
     mod.Factor._read_daily_pv_data = staticmethod(fake_read)
     try:
+        cov_df = f.coverage(plot_out=False, return_df=True)
         ic_df = f.ic_test(future_days=future_days, plot_out=False,
                           return_df=True)
         stats = {"IC": f.IC, "ICIR": f.ICIR, "rank_IC": f.rank_IC,
@@ -263,13 +264,18 @@ def run_reference_eval(exposure, pv, factor_name="f", future_days=5,
                for d, i, r in zip(ic_df["date"].to_numpy(),
                                   ic_df["IC"].to_numpy(),
                                   ic_df["rank_IC"].to_numpy())}
+    # coverage: dates whose whole cross-section is NaN are absent from
+    # the reference's output (the filter drops their rows pre-group_by)
+    cov_rows = {np.datetime64(d, "D"): int(v)
+                for d, v in zip(cov_df["date"].to_numpy(),
+                                cov_df[factor_name].to_numpy())}
     group_rows = {}
     labels = group_df["group"].to_numpy()
     for d, g, r in zip(group_df["date"].to_numpy(), labels,
                        group_df["pct_change"].to_numpy()):
         gi = int(str(g).rsplit("_", 1)[1]) - 1
         group_rows[(np.datetime64(d, "D"), gi)] = float(r)
-    return stats, ic_rows, group_rows
+    return stats, ic_rows, group_rows, cov_rows
 
 
 _FREQ_REF_TO_REPO = {"weekly": "week", "monthly": "month",
@@ -298,6 +304,9 @@ def run_repo_eval(exposure, pv, tmp_dir, factor_name="f", future_days=5,
     f = Factor(factor_name).set_exposure(exposure["code"],
                                          exposure["date"],
                                          exposure["value"])
+    cov = f.coverage(plot=False, return_df=True)
+    cov_rows = {np.datetime64(d, "D"): int(v)
+                for d, v in zip(cov["date"], cov["coverage"]) if v > 0}
     ic = f.ic_test(future_days=future_days, plot=False, return_df=True,
                    daily_pv_path=pv_path)
     stats = {"IC": f.IC, "ICIR": f.ICIR, "rank_IC": f.rank_IC,
@@ -319,7 +328,7 @@ def run_repo_eval(exposure, pv, tmp_dir, factor_name="f", future_days=5,
             v = gt["group_return"][pi, gi]
             if np.isfinite(v):
                 group_rows[(right, gi)] = float(v)
-    return stats, ic_rows, group_rows
+    return stats, ic_rows, group_rows, cov_rows
 
 
 def compare_eval(rng_seed=0, future_days=5, frequency="monthly",
@@ -334,10 +343,10 @@ def compare_eval(rng_seed=0, future_days=5, frequency="monthly",
         tmp_ctx = tempfile.TemporaryDirectory()
         tmp_dir = tmp_ctx.name
     try:
-        ref_stats, ref_ic, ref_grp = run_reference_eval(
+        ref_stats, ref_ic, ref_grp, ref_cov = run_reference_eval(
             exposure, pv, future_days=future_days, frequency=frequency,
             weight_param=weight_param, group_num=group_num)
-        repo_stats, repo_ic, repo_grp = run_repo_eval(
+        repo_stats, repo_ic, repo_grp, repo_cov = run_repo_eval(
             exposure, pv, tmp_dir, future_days=future_days,
             frequency=frequency, weight_param=weight_param,
             group_num=group_num)
@@ -345,6 +354,9 @@ def compare_eval(rng_seed=0, future_days=5, frequency="monthly",
         if own_tmp:
             tmp_ctx.cleanup()
     failures = []
+    if ref_cov != repo_cov:
+        extra = set(ref_cov.items()) ^ set(repo_cov.items())
+        failures.append(f"coverage mismatch on {sorted(extra)[:6]}")
     # IC series: repo eval kernels run f32 on device -> ~1e-4 absolute
     for d in sorted(set(ref_ic) | set(repo_ic)):
         if d not in ref_ic or d not in repo_ic:
