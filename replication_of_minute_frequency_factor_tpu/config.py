@@ -24,7 +24,9 @@ class Config:
     factor_dir: str = "data/factors"
 
     # --- execution ---
-    #: 'jax' (TPU/XLA fused kernels) or 'numpy' (polars-semantics CPU oracle)
+    #: 'jax' (TPU/XLA fused kernels), 'numpy' (polars-semantics CPU
+    #: oracle), or 'polars' (the REFERENCE'S OWN kernel code on real
+    #: polars or the audited shim — slow, correctness/differential use)
     backend: str = "jax"
     # NOTE deliberately no bf16 knob: bar tensors stay f32 on device. The
     # wire format (int tick-deltas + lot volume) already beats bf16 on

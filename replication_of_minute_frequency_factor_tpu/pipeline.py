@@ -375,6 +375,76 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
         materialize(pending)
 
 
+_refdiff_harness = None
+
+
+def _load_refdiff_harness():
+    """Load tools/refdiff/harness.py by explicit file path — immune to
+    any unrelated third-party module named 'tools' on sys.path, and no
+    lasting sys.path mutation. The package import path is preferred when
+    it already resolves to the repo's own tools tree."""
+    global _refdiff_harness
+    if _refdiff_harness is not None:
+        return _refdiff_harness
+    import importlib.util
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "tools", "refdiff", "harness.py")
+    if not os.path.exists(path):
+        raise RuntimeError(
+            "backend='polars' executes the reference's own kernels via "
+            "tools/refdiff, which needs a repo checkout (the tools/ "
+            "tree is not part of the installed package); use "
+            "backend='numpy' for reference semantics without it")
+    import sys
+    import types
+
+    existing = sys.modules.get("tools")
+    ours = os.path.join(root, "tools")
+    if existing is not None and ours not in list(
+            getattr(existing, "__path__", [])):
+        raise RuntimeError(
+            "backend='polars' could not import tools.refdiff: an "
+            "unrelated module named 'tools' is already loaded "
+            f"(from {getattr(existing, '__file__', existing)!r}); run "
+            "with the repo's tools/ tree importable")
+    if existing is None:
+        # register the repo's tools/ as a package WITHOUT touching
+        # sys.path, so the harness's own lazy `from tools.refdiff
+        # import ...` calls resolve deterministically
+        pkg = types.ModuleType("tools")
+        pkg.__path__ = [ours]
+        sys.modules["tools"] = pkg
+    from tools.refdiff import harness
+
+    if not os.path.samefile(os.path.abspath(harness.__file__), path):
+        raise RuntimeError(
+            f"tools.refdiff resolved to {harness.__file__!r}, not the "
+            f"repo's {path!r}")
+    _refdiff_harness = harness
+    return harness
+
+
+def _reference_polars_rows(day: Dict[str, np.ndarray], date,
+                           names: Sequence[str]) -> Dict[str, np.ndarray]:
+    """One day through the reference's ACTUAL cal_* code (polars or the
+    audited shim), widened to the day's full code list with NaN for
+    absent groups — the same wide contract as the oracle path."""
+    harness = _load_refdiff_harness()
+    ref = harness.run_reference(dict(day), names=list(names))
+    codes = np.unique(np.asarray(day["code"]).astype(str))
+    cols: Dict[str, np.ndarray] = {
+        "code": codes.astype(object),
+        "date": np.full(len(codes), date, "datetime64[D]"),
+    }
+    for n in names:
+        vals = ref.get(n, {})
+        cols[n] = np.asarray([vals.get(str(c), np.nan) for c in codes],
+                             np.float32)
+    return cols
+
+
 def compute_exposures(
     minute_dir: Optional[str] = None,
     names: Optional[Sequence[str]] = None,
@@ -391,6 +461,11 @@ def compute_exposures(
     * ``fault_hook(date)`` is the fault-injection test hook (SURVEY.md §5).
     """
     cfg = cfg or get_config()
+    if cfg.backend not in ("jax", "numpy", "polars"):
+        # a typo'd backend must not silently run the device pipeline —
+        # a numpy-vs-'Polars' differential would then vacuously pass
+        raise ValueError(
+            f"backend must be 'jax'/'numpy'/'polars', got {cfg.backend!r}")
     apply_compilation_cache(cfg)
     minute_dir = minute_dir or cfg.minute_dir
     names = tuple(names) if names is not None else factor_names()
@@ -470,6 +545,26 @@ def compute_exposures(
                     for n in names:
                         cols[n] = wide[n].to_numpy(np.float32)
                     parts.append(ExposureTable(cols))
+        elif cfg.backend == "polars":
+            # reference-code path: the REAL cal_* expression graphs from
+            # /root/reference execute on real polars when installed, else
+            # on the audited interpreter shim (tools/refdiff). Slow and
+            # single-threaded — a correctness/differential backend, not a
+            # production one (SURVEY.md §7's ``backend='polars'``
+            # dispatch). Most likely backend to hit day-level kernel
+            # errors (it executes foreign code), so per-day isolation
+            # applies here exactly as in the device pipeline.
+            path_of = {str(d): p for d, p in files}
+            for batch in read_batches():
+                for date, d in batch:
+                    try:
+                        parts.append(ExposureTable(
+                            _reference_polars_rows(d, date, names)))
+                    except Exception as e:  # noqa: BLE001 — per-day
+                        failures.record(str(date),
+                                        path_of.get(str(date), ""), e)
+                        logger.warning("skipping day %s (polars "
+                                       "backend): %s", date, e)
         else:
             _run_device_pipeline(read_batches(), names, cfg, timer, parts)
     finally:
