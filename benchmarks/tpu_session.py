@@ -75,6 +75,13 @@ def step_ladder():
         timeout=1800)
 
 
+def step_sweep():
+    """Optional DAYS_PER_BATCH sweep (benchmarks/sweep_batch.py) — run
+    when the window allows; not in the default step list."""
+    return _run_json_lines([sys.executable, "benchmarks/sweep_batch.py"],
+                           timeout=1800)
+
+
 def step_pallas_vs_conv():
     """On-chip timing + agreement for the rolling-moment kernel backends.
 
@@ -186,7 +193,8 @@ def main():
         return 1
 
     steps = {"headline": step_headline, "ladder": step_ladder,
-             "pallas": step_pallas_vs_conv, "spot": step_graph_spotcheck}
+             "pallas": step_pallas_vs_conv, "spot": step_graph_spotcheck,
+             "sweep": step_sweep}
     want = [s.strip() for s in args.steps.split(",") if s.strip()]
     for name in want:
         print(f"--- step: {name}", flush=True)

@@ -44,6 +44,11 @@ def _add_compute(sub: "argparse._SubParsersAction") -> None:
     p.add_argument("--fixed-quirks", action="store_true",
                    help="use mathematically-intended definitions instead "
                    "of replicating reference quirks Q1-Q4")
+    p.add_argument("--backend", choices=("jax", "numpy", "polars"),
+                   default=None,
+                   help="execution backend: jax (device), numpy "
+                        "(f64 oracle), polars (the reference's own "
+                        "kernels; slow, differential use)")
     p.add_argument("--rolling-impl", choices=("conv", "pallas"),
                    default=None)
     p.add_argument("--profile-dir", default=None,
@@ -98,6 +103,8 @@ def cmd_compute(args: argparse.Namespace) -> int:
               "(see list-factors)", file=sys.stderr)
         return 2
     cfg = Config.from_env()  # honor MFF_* like every other entry point
+    if args.backend is not None:
+        cfg.backend = args.backend
     if args.days_per_batch is not None:
         cfg.days_per_batch = args.days_per_batch
     if args.mesh_tickers is not None:

@@ -379,14 +379,14 @@ _refdiff_harness = None
 
 
 def _load_refdiff_harness():
-    """Load tools/refdiff/harness.py by explicit file path — immune to
-    any unrelated third-party module named 'tools' on sys.path, and no
-    lasting sys.path mutation. The package import path is preferred when
-    it already resolves to the repo's own tools tree."""
+    """Import tools.refdiff.harness deterministically: when no 'tools'
+    module is loaded, the repo's tools/ directory is registered as a
+    package in sys.modules by explicit path (no sys.path mutation); an
+    unrelated pre-existing 'tools' module raises a clear error, and the
+    resolved harness file is asserted to be the repo's own."""
     global _refdiff_harness
     if _refdiff_harness is not None:
         return _refdiff_harness
-    import importlib.util
     import os
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -466,6 +466,14 @@ def compute_exposures(
         # a numpy-vs-'Polars' differential would then vacuously pass
         raise ValueError(
             f"backend must be 'jax'/'numpy'/'polars', got {cfg.backend!r}")
+    if cfg.backend != "jax" and not cfg.replicate_quirks:
+        # the oracle and the reference's own code can only produce the
+        # quirked values; silently caching them as 'fixed' would poison
+        # a later fixed-quirks comparison
+        raise ValueError(
+            "replicate_quirks=False (--fixed-quirks) exists only on the "
+            "jax backend; the numpy/polars backends reproduce the "
+            "reference's quirked semantics by construction")
     apply_compilation_cache(cfg)
     minute_dir = minute_dir or cfg.minute_dir
     names = tuple(names) if names is not None else factor_names()
@@ -554,6 +562,11 @@ def compute_exposures(
             # dispatch). Most likely backend to hit day-level kernel
             # errors (it executes foreign code), so per-day isolation
             # applies here exactly as in the device pipeline.
+            # resolve the harness and reference module ONCE, before the
+            # day loop: a missing tools/ tree or reference checkout is a
+            # setup error that must raise, not be recorded N times as
+            # per-day 'failures' yielding a vacuous empty success
+            _load_refdiff_harness().load_reference_kernels()
             path_of = {str(d): p for d, p in files}
             for batch in read_batches():
                 for date, d in batch:
