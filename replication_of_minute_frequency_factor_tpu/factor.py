@@ -32,7 +32,7 @@ logger = get_logger(__name__)
 class Factor:
     """Holds one factor's long-format exposure and evaluates it."""
 
-    def __init__(self, factor_name: str):
+    def __init__(self, factor_name: str, factor_exposure=None):
         self.factor_name = factor_name
         #: dict(code=[N] str, date=[N] datetime64[D], <factor_name>=[N] f32)
         self.factor_exposure: Optional[Dict[str, np.ndarray]] = None
@@ -40,6 +40,12 @@ class Factor:
         self.ICIR: Optional[float] = None
         self.rank_IC: Optional[float] = None
         self.rank_ICIR: Optional[float] = None
+        if factor_exposure is not None:
+            # the reference's second positional (Factor.py:8): any
+            # mapping with code/date/<factor_name> columns
+            self.set_exposure(factor_exposure["code"],
+                              factor_exposure["date"],
+                              factor_exposure[factor_name])
 
     # ------------------------------------------------------------------
     # data access
@@ -123,8 +129,14 @@ class Factor:
         return mat, valid, dates, codes
 
     def coverage(self, plot: bool = True, return_df: bool = False,
-                 save_path: Optional[str] = None):
-        """Per-date usable-exposure counts (reference Factor.py:92-125)."""
+                 save_path: Optional[str] = None,
+                 plot_out: Optional[bool] = None):
+        """Per-date usable-exposure counts (reference Factor.py:92-125).
+
+        ``plot_out`` is the reference's spelling of ``plot`` (accepted so
+        reference call sites port verbatim)."""
+        if plot_out is not None:
+            plot = plot_out
         _, valid, dates, _ = self._exposure_matrix()
         counts = np.asarray(eval_ops.coverage_counts(valid))
         fig = None
@@ -137,13 +149,28 @@ class Factor:
 
     def ic_test(self, future_days: int = 5, plot: bool = True,
                 return_df: bool = False, save_path: Optional[str] = None,
-                daily_pv_path: Optional[str] = None):
+                daily_pv_path: Optional[str] = None,
+                plot_out: Optional[bool] = None,
+                plot_variable: str = "IC"):
         """Pearson/Spearman IC vs. the future ``future_days``-day return
         (reference Factor.py:127-229).
 
         Sets ``IC/ICIR/rank_IC/rank_ICIR``; ICIR uses sample std (ddof=1)
-        of the per-date IC series.
+        of the per-date IC series. ``plot_out`` is the reference's
+        spelling of ``plot``; ``plot_variable`` ('IC' or 'rank_IC')
+        selects the plotted series (Factor.py:131,191-226).
+
+        Compatibility is KEYWORD-level: the reference's positional order
+        is ``(future_days, plot_out, plot_variable, return_df)`` and
+        differs from this signature after the first argument — port
+        positional reference call sites to keywords (docs/MIGRATION.md).
         """
+        if plot_out is not None:
+            plot = plot_out
+        if plot_variable not in ("IC", "rank_IC"):
+            raise ValueError(
+                f"plot_variable must be 'IC' or 'rank_IC', "
+                f"got {plot_variable!r}")
         mat, valid, dates, codes = self._exposure_matrix()
         pv = self._read_daily_pv_data(["code", "date", "pct_change"],
                                       path=daily_pv_path)
@@ -174,9 +201,16 @@ class Factor:
                  "rank_IC": self.rank_IC, "rank_ICIR": self.rank_ICIR}
         fig = None
         if plot and len(ic_k):
-            fig = plotting.plot_ic(dates_k, ic_k, self.factor_name,
-                                   stats={"IC": self.IC, "ICIR": self.ICIR},
-                                   save_path=save_path)
+            if plot_variable == "rank_IC":
+                series = rank_k
+                pstats = {"rank_IC": self.rank_IC,
+                          "rank_ICIR": self.rank_ICIR}
+            else:
+                series = ic_k
+                pstats = {"IC": self.IC, "ICIR": self.ICIR}
+            fig = plotting.plot_ic(dates_k, series, self.factor_name,
+                                   stats=pstats, save_path=save_path,
+                                   label=plot_variable)
         if return_df:
             return {"date": dates_k, "IC": ic_k, "rank_IC": rank_k}
         return stats if fig is None else fig
@@ -185,7 +219,8 @@ class Factor:
                    weight_param: Optional[str] = None, group_num: int = 5,
                    plot: bool = True, return_df: bool = False,
                    save_path: Optional[str] = None,
-                   daily_pv_path: Optional[str] = None):
+                   daily_pv_path: Optional[str] = None,
+                   plot_out: Optional[bool] = None):
         """Decile backtest (reference Factor.py:231-350).
 
         Per-date quantile buckets -> calendar resample (week/month/quarter/
@@ -196,6 +231,8 @@ class Factor:
         Bad ``frequency``/``weight_param`` raise ``ValueError`` (the
         reference crashed with ``NameError`` — quirk Q8, fixed).
         """
+        if plot_out is not None:  # the reference's spelling of ``plot``
+            plot = plot_out
         if weight_param not in (None, "tmc", "cmc"):
             raise ValueError(
                 f"weight_param must be None/'tmc'/'cmc', got {weight_param!r}")

@@ -28,8 +28,8 @@ AGG_METHODS = ("o", "m", "z", "std")
 class MinFreqFactor(Factor):
     """One minute-frequency factor: compute, cache, resample, evaluate."""
 
-    def __init__(self, factor_name: str):
-        super().__init__(factor_name)
+    def __init__(self, factor_name: str, factor_exposure=None):
+        super().__init__(factor_name, factor_exposure)
 
     # ------------------------------------------------------------------
     # cache resolution (reference :27-48)
@@ -106,6 +106,7 @@ class MinFreqFactor(Factor):
         method: str = "o",
         mode: str = "calendar",
         stock_pool: str = "full",
+        pool: Optional[str] = None,
     ) -> "MinFreqFactor":
         """Resample the daily exposure along the date axis, per code.
 
@@ -128,6 +129,8 @@ class MinFreqFactor(Factor):
         dropped before resampling. Without a configured membership file
         the reference's error is kept.
         """
+        if pool is not None:  # the reference's spelling of stock_pool
+            stock_pool = pool
         if method not in AGG_METHODS:
             raise ValueError(f"method must be one of {AGG_METHODS}")
         exp = self._require_exposure()

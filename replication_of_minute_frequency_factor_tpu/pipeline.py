@@ -402,8 +402,15 @@ def _load_refdiff_harness():
 
     existing = sys.modules.get("tools")
     ours = os.path.join(root, "tools")
-    if existing is not None and ours not in list(
-            getattr(existing, "__path__", [])):
+
+    def _same(p_):
+        try:
+            return os.path.samefile(p_, ours)  # symlink/normalization safe
+        except OSError:
+            return False
+
+    if existing is not None and not any(
+            _same(p_) for p_ in getattr(existing, "__path__", [])):
         raise RuntimeError(
             "backend='polars' could not import tools.refdiff: an "
             "unrelated module named 'tools' is already loaded "

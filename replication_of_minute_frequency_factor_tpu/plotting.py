@@ -37,17 +37,19 @@ def plot_coverage(dates, counts, factor_name: str,
 
 
 def plot_ic(dates, ic, factor_name: str, stats: Optional[dict] = None,
-            save_path: Optional[str] = None):
-    """Per-date IC bars (left axis) + cumulative IC line (right axis)."""
+            save_path: Optional[str] = None, label: str = "IC"):
+    """Per-date IC bars (left axis) + cumulative line (right axis);
+    ``label`` switches the series name (the reference's ``plot_variable``
+    knob, Factor.py:131,196-208 — 'IC' or 'rank_IC')."""
     d = np.asarray(dates, "datetime64[D]").astype("datetime64[ns]")
     fig, ax = plt.subplots(figsize=(12, 4))
-    ax.bar(d, ic, width=1.0, color="#4C72B0", label="IC")
-    ax.set_ylabel("IC")
+    ax.bar(d, ic, width=1.0, color="#4C72B0", label=label)
+    ax.set_ylabel(label)
     ax2 = ax.twinx()
     ax2.plot(d, np.cumsum(np.nan_to_num(ic)), color="#C44E52",
-             label="cumulative IC")
-    ax2.set_ylabel("cumulative IC")
-    title = f"{factor_name} IC"
+             label=f"cumulative {label}")
+    ax2.set_ylabel(f"cumulative {label}")
+    title = f"{factor_name} {label}"
     if stats:
         title += "  " + "  ".join(f"{k}={v:.4f}" for k, v in stats.items())
     ax.set_title(title)
