@@ -26,6 +26,14 @@ import numpy as np
 
 sys.path.insert(0, ".")  # repo root (for bench.py when run from checkout)
 
+# persistent XLA cache (when configured): the fused graphs here are the
+# same executables bench.py compiles — pay the ~20-40s TPU compile once
+# per tunnel window, not once per script
+from replication_of_minute_frequency_factor_tpu.config import (  # noqa: E402
+    apply_compilation_cache, get_config)
+
+apply_compilation_cache(get_config())
+
 
 def _bars(rng, n_days, n_tickers):
     import bench

@@ -7,6 +7,9 @@ import bench
 from replication_of_minute_frequency_factor_tpu.data import wire
 from replication_of_minute_frequency_factor_tpu.pipeline import compute_packed_prepared
 from replication_of_minute_frequency_factor_tpu.models.registry import factor_names
+from replication_of_minute_frequency_factor_tpu.config import apply_compilation_cache, get_config
+
+apply_compilation_cache(get_config())  # persistent XLA cache when configured
 
 names = factor_names()
 for D in (8, 16, 32):

@@ -46,6 +46,7 @@ def _run_json_lines(cmd, timeout):
     """Run a child; parse every stdout line that is a JSON object."""
     t0 = time.monotonic()
     try:
+        # children inherit MFF_COMPILATION_CACHE_DIR set in main()
         p = subprocess.run(cmd, cwd=REPO, timeout=timeout,
                            capture_output=True, text=True)
     except subprocess.TimeoutExpired as e:
@@ -192,6 +193,11 @@ def main():
         print(json.dumps(session))
         return 1
 
+    os.environ.setdefault("MFF_COMPILATION_CACHE_DIR",
+                          os.path.join(REPO, ".xla_cache"))
+    from replication_of_minute_frequency_factor_tpu.config import (
+        apply_compilation_cache, get_config)
+    apply_compilation_cache(get_config())
     steps = {"headline": step_headline, "ladder": step_ladder,
              "pallas": step_pallas_vs_conv, "spot": step_graph_spotcheck,
              "sweep": step_sweep}
