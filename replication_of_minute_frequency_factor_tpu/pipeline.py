@@ -232,8 +232,15 @@ def _grid_batch(day_data: List[Tuple[np.datetime64, Dict[str, np.ndarray]]],
     return (np.stack(bars_l), np.stack(mask_l), codes, np.stack(present_l))
 
 
+#: consecutive failed batches before the device pipeline gives up (the
+#: per-batch retry makes each of these TWO device attempts)
+_CIRCUIT_BREAKER = 3
+
+
 def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
-                         parts: List["ExposureTable"]) -> None:
+                         parts: List["ExposureTable"],
+                         failures: Optional["FailureReport"] = None,
+                         path_of: Optional[Dict[str, str]] = None) -> None:
     """Double-buffered device pipeline (replaces the reference's joblib
     fan-out, SURVEY.md §7 L2): a reader thread prepares batch i+1
     (grid + validate + wire-encode) while the device computes batch i;
@@ -243,7 +250,19 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
     With ``cfg.mesh_shape`` set, batches shard along the tickers axis of
     a ``(days, tickers)`` mesh over all local devices — factor compute is
     collective-free, so this is pure data parallelism; XLA keeps the
-    per-factor outputs sharded until the host gather."""
+    per-factor outputs sharded until the host gather.
+
+    Elasticity (SURVEY.md §5 failure detection, extended to the batch
+    level for the TPU substrate, whose observed failure mode is a
+    transient transport/device error mid-run): a batch that fails on
+    device is retried ONCE; host-prep (grid/encode) failures are
+    recorded without retry (they are near-always deterministic). Either
+    way the days land in ``failures`` and the run continues, and
+    ``_CIRCUIT_BREAKER`` consecutive dead batches abort (a wedged device
+    or systemically broken host path would otherwise grind through
+    every remaining batch); completed batches always survive an abort
+    (the consumer flushes its in-flight batch before raising and the
+    caller saves a resume-safe partial cache)."""
     import queue
     import threading
 
@@ -266,44 +285,76 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
                          NamedSharding(mesh, mask_spec()))
 
     q: "queue.Queue" = queue.Queue(maxsize=2)
+    stop = threading.Event()  # set on consumer abort; unblocks producer
     wire_floor: dict = {}  # widen-only dtype state across this run's batches
+
+    def _qput(item) -> bool:
+        """Bounded put that gives up when the consumer aborted —
+        otherwise a breaker abort would leave the daemon producer
+        blocked on a full queue forever, pinning the multi-MB encoded
+        batches it holds."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.5)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _record_batch_failure(dates, exc):
+        if failures is None:
+            raise exc
+        for d in dates:
+            failures.record(str(d),
+                            (path_of or {}).get(str(d), ""), exc)
 
     def produce():
         try:
             for batch in batches:
-                with timer("grid"):
-                    bars, mask, codes, present = _grid_batch(
-                        batch, shard_mult=n_shards)
-                if cfg.debug_validate:
-                    from .utils.debug import validate_batch
-                    validate_batch(bars, mask)
-                w = None
-                if cfg.wire_transfer:
-                    with timer("wire_encode"):
-                        w = wire.encode(bars, mask, floor=wire_floor)
-                if mesh is None:
-                    # single-device: pack HERE so the multi-MB host
-                    # concatenate overlaps device compute; ship one
-                    # (buf, spec, kind) triple through the queue
-                    with timer("pack"):
-                        if w is not None:
-                            w = wire.pack_arrays(w.arrays) + ("wire",)
-                        else:
-                            w = wire.pack_arrays(
-                                (bars, np.asarray(mask).view(np.uint8))
-                            ) + ("raw",)
-                    bars = mask = None
-                elif w is not None:
-                    # the raw grid is only a fallback for unrepresentable
-                    # batches; don't keep ~4 uncompressed copies alive in
-                    # the queue + in-flight slots
-                    bars = mask = None
                 dates = [d for d, _ in batch]
-                q.put(("batch", (dates, codes, present, w, bars, mask)))
+                try:
+                    with timer("grid"):
+                        bars, mask, codes, present = _grid_batch(
+                            batch, shard_mult=n_shards)
+                    if cfg.debug_validate:
+                        from .utils.debug import validate_batch
+                        validate_batch(bars, mask)
+                    w = None
+                    if cfg.wire_transfer:
+                        with timer("wire_encode"):
+                            w = wire.encode(bars, mask, floor=wire_floor)
+                    if mesh is None:
+                        # single-device: pack HERE so the multi-MB host
+                        # concatenate overlaps device compute; ship one
+                        # (buf, spec, kind) triple through the queue
+                        with timer("pack"):
+                            if w is not None:
+                                w = wire.pack_arrays(w.arrays) + ("wire",)
+                            else:
+                                w = wire.pack_arrays(
+                                    (bars,
+                                     np.asarray(mask).view(np.uint8))
+                                ) + ("raw",)
+                        bars = mask = None
+                    elif w is not None:
+                        # the raw grid is only a fallback for
+                        # unrepresentable batches; don't keep ~4
+                        # uncompressed copies alive in the queue +
+                        # in-flight slots
+                        bars = mask = None
+                except Exception as e:  # noqa: BLE001 — batch isolation
+                    logger.warning("host prep failed for batch %s: %s",
+                                   dates, e)
+                    if not _qput(("hostfail", (dates, e))):
+                        return
+                    continue
+                if not _qput(("batch",
+                              (dates, codes, present, w, bars, mask))):
+                    return
         except BaseException as e:  # surface in the consumer thread
-            q.put(("error", e))
+            _qput(("error", e))
             return
-        q.put(("done", None))
+        _qput(("done", None))
 
     threading.Thread(target=produce, daemon=True).start()
 
@@ -352,27 +403,117 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
             else:  # stacked [F, D, T] from the packed path
                 stacked = np.asarray(out)
                 out = {n: stacked[j] for j, n in enumerate(names)}
+        # build ALL day tables before touching parts: a mid-loop failure
+        # followed by the whole-batch retry must not leave day 1's rows
+        # appended twice (duplicate (code, date) rows in the cache)
+        batch_parts = []
         for i, date in enumerate(dates):
             sel = present[i]
             cols = {"code": codes[sel].astype(object),
                     "date": np.full(int(sel.sum()), date, "datetime64[D]")}
             for n in names:
                 cols[n] = out[n][i, sel].astype(np.float32)
-            parts.append(ExposureTable(cols))
+            batch_parts.append(ExposureTable(cols))
+        parts.extend(batch_parts)
 
-    pending = None
-    while True:
-        kind, payload = q.get()
-        if kind == "error":
-            raise payload
-        if kind == "done":
-            break
-        launched = launch(payload)
+    consecutive = 0
+
+    def _count_failure(dates, exc):
+        """Single home for the record/count/breaker policy — both the
+        settle path and the launch path go through here."""
+        nonlocal consecutive
+        _record_batch_failure(dates, exc)
+        consecutive += 1
+        if consecutive >= _CIRCUIT_BREAKER:
+            raise RuntimeError(
+                f"device pipeline: {consecutive} consecutive batches "
+                "failed — device/transport looks dead; aborting "
+                "(completed batches are preserved and the cache resume "
+                "will pick up from here)") from exc
+
+    def settle(payload, launched, retried=False):
+        """materialize; on failure re-run the whole batch once, then
+        record its days as failures and trip the breaker if the device
+        looks dead."""
+        nonlocal consecutive
+        try:
+            materialize(launched)
+            consecutive = 0
+            return
+        except Exception as e:  # noqa: BLE001 — batch isolation
+            if not retried:
+                logger.warning("batch %s failed on device (%s); "
+                               "retrying once", payload[0], e)
+                try:
+                    relaunched = launch(payload)
+                except Exception as e2:  # noqa: BLE001
+                    _count_failure(payload[0], e2)
+                else:
+                    settle(payload, relaunched, retried=True)
+                return
+            _count_failure(payload[0], e)
+
+    pending = None  # (payload, launched)
+
+    def flush_pending():
+        """Materialize the in-flight batch NOW — called whenever the
+        pipelined ordering is about to break (a later batch failed, or
+        we are about to raise), so a healthy completed batch can never
+        be dropped on the floor by a neighbour's failure."""
+        nonlocal pending
         if pending is not None:
-            materialize(pending)
-        pending = launched
-    if pending is not None:
-        materialize(pending)
+            p_, l_ = pending
+            pending = None
+            settle(p_, l_)
+
+    try:
+        while True:
+            kind, payload = q.get()
+            if kind == "error":
+                try:
+                    flush_pending()
+                finally:
+                    raise payload
+            if kind == "done":
+                break
+            if kind == "hostfail":
+                # host-prep failures get no retry (they are almost always
+                # deterministic — bad file, encode bug) but DO count
+                # toward the breaker: a systemic host problem must abort,
+                # not grind through the file list recording every day
+                dates, e = payload
+                flush_pending()
+                _count_failure(dates, e)
+                continue
+            try:
+                launched = launch(payload)
+            except Exception as e:  # noqa: BLE001 — batch isolation
+                logger.warning("batch %s failed at launch (%s); "
+                               "retrying once", payload[0], e)
+                try:
+                    launched = launch(payload)
+                except Exception as e2:  # noqa: BLE001
+                    # settle the independent in-flight batch BEFORE
+                    # counting this failure (its success must not reset
+                    # the counter, and its data must survive whatever we
+                    # raise next)
+                    flush_pending()
+                    _count_failure(payload[0], e2)
+                    continue
+            if pending is not None:
+                settle(*pending)
+            pending = (payload, launched)
+        flush_pending()
+    except BaseException:
+        # unblock and drain the producer so an abort can't leak the
+        # daemon thread + the multi-MB batches it holds
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        raise
 
 
 _refdiff_harness = None
@@ -586,7 +727,19 @@ def compute_exposures(
                         logger.warning("skipping day %s (polars "
                                        "backend): %s", date, e)
         else:
-            _run_device_pipeline(read_batches(), names, cfg, timer, parts)
+            _run_device_pipeline(
+                read_batches(), names, cfg, timer, parts,
+                failures=failures,
+                path_of={str(d): p for d, p in files})
+    except Exception as e:  # noqa: BLE001 — crash-consistent save below
+        # preserve every completed batch before re-raising: parts hold
+        # whole days only, so the cache written below is resume-safe and
+        # the next run continues past it (elastic recovery, SURVEY §5)
+        fatal = e
+        logger.error("pipeline aborted (%s); saving %d completed parts "
+                     "before re-raising", e, len(parts))
+    else:
+        fatal = None
     finally:
         if profiling:
             jax.profiler.stop_trace()
@@ -618,4 +771,6 @@ def compute_exposures(
             ledger = cache_path + ".failures.json"
             if os.path.exists(ledger):
                 os.remove(ledger)
+    if fatal is not None:
+        raise fatal
     return result

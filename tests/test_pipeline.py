@@ -40,7 +40,8 @@ def minute_dir(tmp_path, rng):
 
 
 def _cfg(**kw):
-    return Config(days_per_batch=2, **kw)
+    kw.setdefault("days_per_batch", 2)
+    return Config(**kw)
 
 
 def test_day_file_listing_and_date_parse(minute_dir):
@@ -295,3 +296,70 @@ def test_polars_backend_matches_numpy_backend(minute_dir, tmp_path):
         np.testing.assert_array_equal(np.isnan(a), np.isnan(b))
         f = ~np.isnan(a)
         np.testing.assert_allclose(a[f], b[f], rtol=1e-5, atol=1e-7)
+
+
+class _Flaky:
+    """Wraps compute_packed_prepared; fails the first ``fail_first``
+    calls, then passes through."""
+
+    def __init__(self, real, fail_first=0):
+        self.real = real
+        self.fail_first = fail_first
+        self.calls = 0
+
+    def __call__(self, *a, **kw):
+        self.calls += 1
+        if self.calls <= self.fail_first:
+            raise RuntimeError(f"injected transport failure #{self.calls}")
+        return self.real(*a, **kw)
+
+
+def test_transient_device_failure_is_retried(minute_dir, tmp_path,
+                                             monkeypatch):
+    from replication_of_minute_frequency_factor_tpu import pipeline as pl
+    flaky = _Flaky(pl.compute_packed_prepared, fail_first=1)
+    monkeypatch.setattr(pl, "compute_packed_prepared", flaky)
+    t = compute_exposures(minute_dir, ["vol_return1min"],
+                          cache_path=str(tmp_path / "c.parquet"),
+                          cfg=_cfg(), progress=False)
+    # one retry, zero lost days
+    assert len(t.failures) == 0
+    assert len(np.unique(t.columns["date"])) == 3
+    assert flaky.calls >= 2
+
+
+def test_dead_device_trips_circuit_breaker_and_saves_progress(
+        minute_dir, tmp_path, monkeypatch):
+    from replication_of_minute_frequency_factor_tpu import pipeline as pl
+    flaky = _Flaky(pl.compute_packed_prepared, fail_first=10 ** 9)
+    monkeypatch.setattr(pl, "compute_packed_prepared", flaky)
+    cache = str(tmp_path / "c.parquet")
+    with pytest.raises(RuntimeError, match="consecutive"):
+        compute_exposures(minute_dir, ["vol_return1min"],
+                          cache_path=cache,
+                          cfg=_cfg(days_per_batch=1), progress=False)
+    # the failure ledger still lands next to the cache (crash-consistent)
+    import os
+    assert os.path.exists(cache + ".failures.json")
+
+
+def test_single_bad_batch_is_skipped_not_fatal(minute_dir, tmp_path,
+                                               monkeypatch):
+    from replication_of_minute_frequency_factor_tpu import pipeline as pl
+    real = pl.compute_packed_prepared
+
+    calls = {"n": 0}
+
+    def fail_second_batch(*a, **kw):
+        calls["n"] += 1
+        # batch 2 fails on BOTH attempts (launch + retry)
+        if calls["n"] in (2, 3):
+            raise RuntimeError("injected persistent failure")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(pl, "compute_packed_prepared", fail_second_batch)
+    t = compute_exposures(minute_dir, ["vol_return1min"],
+                          cache_path=str(tmp_path / "c.parquet"),
+                          cfg=_cfg(days_per_batch=1), progress=False)
+    assert len(t.failures) == 1  # exactly the injected batch's day
+    assert len(np.unique(t.columns["date"])) == 2
