@@ -6,9 +6,29 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspa
 import numpy as np
 import pyarrow as pa, pyarrow.parquet as pq
 from replication_of_minute_frequency_factor_tpu.data.synthetic import synth_day
+from replication_of_minute_frequency_factor_tpu import pipeline as _pl
 from replication_of_minute_frequency_factor_tpu.pipeline import (
     compute_exposures, ExposureTable)
 from replication_of_minute_frequency_factor_tpu.config import Config
+
+_REAL_CPP = _pl.compute_packed_prepared
+
+
+class _DeviceChaos:
+    """Randomized transient device-failure injector for the batch-level
+    elasticity machinery: each compute_packed_prepared call fails with
+    probability p, at most ``max_fails`` total."""
+
+    def __init__(self, rng, p, max_fails):
+        self.rng = rng
+        self.p = p
+        self.left = max_fails
+
+    def __call__(self, *a, **kw):
+        if self.left > 0 and self.rng.random() < self.p:
+            self.left -= 1
+            raise RuntimeError("chaos: injected device failure")
+        return _REAL_CPP(*a, **kw)
 
 def write_day(d, rng, date_str, n_codes):
     cols = synth_day(rng, n_codes=n_codes, date=date_str, missing_prob=0.05)
@@ -42,8 +62,19 @@ for seed in range(lo, hi):
         def hook(date):
             if bad_day is not None and date == bad_day:
                 raise RuntimeError("injected")
-        t1 = compute_exposures(kline, NAMES, cache_path=cache, cfg=cfg,
-                               progress=False, fault_hook=hook)
+        # device chaos: ONE transient failure rides the batch retry and
+        # must be fully invisible (two could land on the same batch's
+        # launch + retry and legitimately fail the day, so the exact
+        # day-set assertions below only hold for a single injection)
+        if rng.random() < 0.5:
+            _pl.compute_packed_prepared = _DeviceChaos(
+                np.random.default_rng(seed + 7),
+                p=float(rng.choice([0.2, 0.5])), max_fails=1)
+        try:
+            t1 = compute_exposures(kline, NAMES, cache_path=cache, cfg=cfg,
+                                   progress=False, fault_hook=hook)
+        finally:
+            _pl.compute_packed_prepared = _REAL_CPP
         days1 = set(map(str, t1.columns["date"]))
         want1 = set(all_days[:n1]) - ({str(bad_day)} if bad_day is not None
                                       else set())
