@@ -184,6 +184,9 @@ def main():
 
     session = {"started_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                             time.gmtime()),
+               # stamped by tunnel_watch so a capture that raced CPU-heavy
+               # work is identifiable in the artifact itself (1-core host)
+               "host_quiet": os.environ.get("TPU_SESSION_HOST_QUIET"),
                "steps": {}}
     if not args.skip_probe and not _probe():
         session["steps"]["probe"] = {"ok": False,

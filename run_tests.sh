@@ -6,12 +6,19 @@
 # With no arguments, also exercises the driver entry points
 # (__graft_entry__.py) on an 8-device virtual CPU mesh after the suite.
 set -e
+# Hold a CPU-busy sentinel for the whole run so benchmarks/tunnel_watch.py
+# never launches a timed TPU session while the suite saturates the 1-core
+# host (per-pid file; watcher sweeps it if this script dies).
+mkdir -p .cpu_busy.d
+echo "run_tests.sh $*" > ".cpu_busy.d/$$"
+trap 'rm -f ".cpu_busy.d/$$"' EXIT INT TERM
 run() {
     env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu "$@"
 }
 if [ "$#" -gt 0 ]; then
-    exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
-        python -m pytest -q "$@"
+    # no exec: the EXIT trap must outlive pytest to drop the sentinel
+    run python -m pytest -q "$@"
+    exit $?
 fi
 run python -m pytest tests/ -q
 run env XLA_FLAGS=--xla_force_host_platform_device_count=8 \

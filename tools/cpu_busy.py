@@ -1,0 +1,76 @@
+"""Per-pid CPU-busy sentinel, the Python twin of tools/with_cpu_busy.sh.
+
+CPU-heavy entry points (fuzz harnesses, the test runner) hold a pid file
+under ``.cpu_busy.d/`` while they run; ``benchmarks/tunnel_watch.py``
+waits for all LIVE owners to finish before launching a timed TPU
+session on this 1-core host. Dead owners' files are ignored (and swept)
+by the watcher, so a crash can't wedge the watch.
+
+Usage::
+
+    from tools.cpu_busy import cpu_busy
+    with cpu_busy("fuzz_refdiff eval"):
+        ...
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUSY_DIR = os.path.join(REPO, ".cpu_busy.d")
+
+
+@contextlib.contextmanager
+def cpu_busy(label=""):
+    os.makedirs(BUSY_DIR, exist_ok=True)
+    path = os.path.join(BUSY_DIR, str(os.getpid()))
+    with open(path, "w") as fh:
+        fh.write(label)
+    try:
+        yield
+    finally:
+        with contextlib.suppress(OSError):
+            os.remove(path)
+
+
+def mark_busy(label=""):
+    """Create this process's sentinel now, removed at interpreter exit —
+    for flat scripts (the fuzz harnesses) where a ``with`` block around
+    the whole file would be noise. Crash-safe: a dead pid's file is
+    swept by live_owners()."""
+    import atexit
+
+    os.makedirs(BUSY_DIR, exist_ok=True)
+    path = os.path.join(BUSY_DIR, str(os.getpid()))
+    with open(path, "w") as fh:
+        fh.write(label)
+
+    def _cleanup():
+        with contextlib.suppress(OSError):
+            os.remove(path)
+
+    atexit.register(_cleanup)
+
+
+def live_owners():
+    """Pids of live sentinel holders; sweeps files of dead pids."""
+    try:
+        names = os.listdir(BUSY_DIR)
+    except OSError:
+        return []
+    live = []
+    for name in names:
+        try:
+            pid = int(name)
+        except ValueError:
+            continue
+        try:
+            os.kill(pid, 0)
+        except OSError:
+            with contextlib.suppress(OSError):
+                os.remove(os.path.join(BUSY_DIR, name))
+            continue
+        live.append(pid)
+    return live

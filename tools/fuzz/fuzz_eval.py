@@ -3,6 +3,10 @@ forward_returns vs naive, across random shapes/sparsity/ties."""
 import sys
 import os
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from tools.cpu_busy import mark_busy  # noqa: E402
+
+mark_busy('fuzz_eval')  # gate timed TPU sessions off this 1-core host
 import numpy as np, pandas as pd, scipy.stats
 from replication_of_minute_frequency_factor_tpu import eval_ops, frames
 

@@ -3,6 +3,10 @@ unknown codes, duplicates (last-write-wins), sub-minute stamps."""
 import sys
 import os
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from tools.cpu_busy import mark_busy  # noqa: E402
+
+mark_busy('fuzz_grid')  # gate timed TPU sessions off this 1-core host
 import numpy as np
 from replication_of_minute_frequency_factor_tpu.data.minute import grid_day
 from replication_of_minute_frequency_factor_tpu import sessions

@@ -4,6 +4,10 @@ return + last group/caps -> 1-period lag per code -> weighted group
 means -> cumprod."""
 import sys, os, tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from tools.cpu_busy import mark_busy  # noqa: E402
+
+mark_busy('fuzz_group')  # gate timed TPU sessions off this 1-core host
 import numpy as np, pandas as pd
 import pyarrow as pa, pyarrow.parquet as pq
 from replication_of_minute_frequency_factor_tpu import Factor

@@ -2,6 +2,10 @@
 ragged panels (NaNs, disjoint codes, short histories, ties)."""
 import sys, os, tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from tools.cpu_busy import mark_busy  # noqa: E402
+
+mark_busy('fuzz_l3')  # gate timed TPU sessions off this 1-core host
 import numpy as np, pandas as pd, scipy.stats
 import pyarrow as pa, pyarrow.parquet as pq
 from replication_of_minute_frequency_factor_tpu import Factor

@@ -28,6 +28,10 @@ os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))))
 
+from tools.cpu_busy import mark_busy  # noqa: E402
+
+mark_busy('fuzz_pallas')  # gate timed TPU sessions off this 1-core host
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -3,6 +3,10 @@ import sys, traceback
 import os
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, _REPO); sys.path.insert(0, os.path.join(_REPO, 'tests'))
+
+from tools.cpu_busy import mark_busy  # noqa: E402
+
+mark_busy('fuzz_parity')  # gate timed TPU sessions off this 1-core host
 import numpy as np
 import test_parity as tp
 from replication_of_minute_frequency_factor_tpu.data.synthetic import synth_day

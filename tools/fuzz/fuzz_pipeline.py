@@ -3,6 +3,10 @@ batch sizes — incremental resume must be exact and failure isolation
 complete."""
 import sys, os, tempfile, shutil
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from tools.cpu_busy import mark_busy  # noqa: E402
+
+mark_busy('fuzz_pipeline')  # gate timed TPU sessions off this 1-core host
 import numpy as np
 import pyarrow as pa, pyarrow.parquet as pq
 from replication_of_minute_frequency_factor_tpu.data.synthetic import synth_day

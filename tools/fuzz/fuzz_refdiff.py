@@ -11,6 +11,10 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 sys.path.insert(0, _REPO)
 
+from tools.cpu_busy import mark_busy  # noqa: E402
+
+mark_busy('fuzz_refdiff')  # gate timed TPU sessions off this 1-core host
+
 import numpy as np  # noqa: E402
 
 from replication_of_minute_frequency_factor_tpu.data.synthetic import (  # noqa: E402

@@ -3,6 +3,10 @@ combos, random sparsity and series lengths."""
 import sys
 import os
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from tools.cpu_busy import mark_busy  # noqa: E402
+
+mark_busy('fuzz_resample')  # gate timed TPU sessions off this 1-core host
 import numpy as np, pandas as pd
 from replication_of_minute_frequency_factor_tpu import MinFreqFactor
 

@@ -12,6 +12,10 @@ many seeds, which neither escape hatch can mask."""
 import sys
 import os
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from tools.cpu_busy import mark_busy  # noqa: E402
+
+mark_busy('fuzz_search')  # gate timed TPU sessions off this 1-core host
 import numpy as np
 from replication_of_minute_frequency_factor_tpu import search
 

@@ -3,6 +3,10 @@ across random batch shapes, price scales, and pathologies."""
 import sys
 import os
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from tools.cpu_busy import mark_busy  # noqa: E402
+
+mark_busy('fuzz_wire')  # gate timed TPU sessions off this 1-core host
 import numpy as np
 from replication_of_minute_frequency_factor_tpu.data import wire
 

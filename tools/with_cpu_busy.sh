@@ -1,0 +1,16 @@
+#!/bin/sh
+# Run a CPU-heavy command while holding a per-pid sentinel under
+# .cpu_busy.d/ so benchmarks/tunnel_watch.py delays a TPU bench launch
+# until the single host core is quiet (bench-measurement hygiene: never
+# time TPU runs with fuzzers live). Per-pid files make concurrent
+# invocations safe: each removes only its own sentinel on exit, and the
+# watcher checks pid liveness so a crashed owner can't wedge the watch.
+# Usage: tools/with_cpu_busy.sh <cmd> [args...]
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+DIR="$REPO/.cpu_busy.d"
+mkdir -p "$DIR"
+SENTINEL="$DIR/$$"
+echo "$*" > "$SENTINEL"
+trap 'rm -f "$SENTINEL"' EXIT INT TERM
+"$@"
