@@ -185,8 +185,11 @@ def main():
     session = {"started_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                             time.gmtime()),
                # stamped by tunnel_watch so a capture that raced CPU-heavy
-               # work is identifiable in the artifact itself (1-core host)
-               "host_quiet": os.environ.get("TPU_SESSION_HOST_QUIET"),
+               # work is identifiable in the artifact itself (1-core host);
+               # real JSON bool/null so `if session["host_quiet"]` works
+               "host_quiet": (
+                   None if "TPU_SESSION_HOST_QUIET" not in os.environ
+                   else os.environ["TPU_SESSION_HOST_QUIET"] == "True"),
                "steps": {}}
     if not args.skip_probe and not _probe():
         session["steps"]["probe"] = {"ok": False,

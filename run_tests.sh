@@ -8,10 +8,12 @@
 set -e
 # Hold a CPU-busy sentinel for the whole run so benchmarks/tunnel_watch.py
 # never launches a timed TPU session while the suite saturates the 1-core
-# host (per-pid file; watcher sweeps it if this script dies).
-mkdir -p .cpu_busy.d
-echo "run_tests.sh $*" > ".cpu_busy.d/$$"
-trap 'rm -f ".cpu_busy.d/$$"' EXIT INT TERM
+# host (per-pid file; watcher sweeps it if this script dies). Anchored to
+# this script's directory, not the cwd — the watcher scans the repo root.
+BUSY_DIR="$(cd "$(dirname "$0")" && pwd)/.cpu_busy.d"
+mkdir -p "$BUSY_DIR"
+echo "run_tests.sh $*" > "$BUSY_DIR/$$"
+trap 'rm -f "$BUSY_DIR/$$"' EXIT INT TERM
 run() {
     env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu "$@"
 }

@@ -49,24 +49,51 @@ def test_all_58_kernels_are_exercised():
 
 
 def test_shim_never_replaces_a_real_polars():
-    """The shim must only ever install itself when polars is absent —
-    a real install must take precedence (it IS the reference engine)."""
+    """The shim must only ever be chosen when polars is absent — a real
+    install must take precedence (it IS the reference engine)."""
     import importlib.util
-    import sys
     mod = harness.install_shim()
     if getattr(mod, "__is_refdiff_shim__", False):
-        # shim active: assert no real wheel was hiding underneath
-        # (pop before probing — find_spec raises on a spec-less module)
-        sys.modules.pop("polars")
-        try:
-            real = importlib.util.find_spec("polars")
-        finally:
-            sys.modules["polars"] = mod
-        assert real is None
+        assert importlib.util.find_spec("polars") is None
     else:
-        # a real polars won: the shim must not be in sys.modules
-        assert not getattr(sys.modules["polars"], "__is_refdiff_shim__",
-                           False)
+        assert not getattr(mod, "__is_refdiff_shim__", False)
+
+
+def test_sys_modules_not_left_mutated():
+    """Reference exec installs 'polars'/'Factor' only for the exec's
+    duration; afterwards a genuine `import polars` must not silently
+    resolve to refdiff internals (ADVICE r2)."""
+    import importlib.util
+    import sys
+    harness.load_reference_kernels()
+    harness.load_reference_factor_module()
+    for name in ("polars", "Factor"):
+        mod = sys.modules.get(name)
+        assert mod is None or not getattr(mod, "__is_refdiff_shim__",
+                                          False), name
+    assert "Factor" not in sys.modules
+    if importlib.util.find_spec("polars") is None:
+        assert "polars" not in sys.modules
+
+
+def test_reference_exec_is_hash_pinned(tmp_path, monkeypatch):
+    """A reference file whose bytes differ from the audited snapshot
+    must fail closed before exec (ADVICE r2 medium: the file runs
+    in-process, so provenance is the containment)."""
+    src = os.path.join(harness.REFERENCE_DIR, "Factor.py")
+    tampered = tmp_path / "Factor.py"
+    tampered.write_bytes(open(src, "rb").read() + b"\n# tampered\n")
+    monkeypatch.setattr(harness, "REFERENCE_DIR", str(tmp_path))
+    with pytest.raises(RuntimeError, match="unpinned reference file"):
+        harness._verified_reference_path("Factor.py")
+    # explicit opt-out accepts the risk
+    monkeypatch.setenv("REFDIFF_ALLOW_UNPINNED", "1")
+    assert harness._verified_reference_path("Factor.py") == str(tampered)
+    # the pristine snapshot passes
+    monkeypatch.setattr(harness, "REFERENCE_DIR",
+                        os.path.dirname(src))
+    monkeypatch.delenv("REFDIFF_ALLOW_UNPINNED")
+    assert harness._verified_reference_path("Factor.py") == src
 
 
 @pytest.mark.parametrize("weight_param", [None, "tmc", "cmc"])

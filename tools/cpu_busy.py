@@ -42,16 +42,9 @@ def mark_busy(label=""):
     swept by live_owners()."""
     import atexit
 
-    os.makedirs(BUSY_DIR, exist_ok=True)
-    path = os.path.join(BUSY_DIR, str(os.getpid()))
-    with open(path, "w") as fh:
-        fh.write(label)
-
-    def _cleanup():
-        with contextlib.suppress(OSError):
-            os.remove(path)
-
-    atexit.register(_cleanup)
+    cm = cpu_busy(label)
+    cm.__enter__()
+    atexit.register(cm.__exit__, None, None, None)
 
 
 def live_owners():

@@ -1,11 +1,11 @@
 """Differential testing of the reference's *actual code* (tools/refdiff).
 
 ``polars_shim`` is a minimal interpreter for the polars expression API
-surface used by ``/root/reference`` (all three files). ``harness`` installs
-it as ``sys.modules['polars']``, imports the reference's factor-kernel
-module unmodified from ``/root/reference``, executes the real ``cal_*``
-expression graphs on synthetic day data, and compares against this repo's
-JAX and numpy-oracle backends.
+surface used by ``/root/reference`` (all three files). ``harness`` makes
+it resolvable as ``polars`` only for the duration of each reference
+``exec_module`` (hash-pinned to the audited snapshot), executes the real
+``cal_*`` expression graphs on synthetic day data, and compares against
+this repo's JAX and numpy-oracle backends.
 
 Why a shim and not real polars: this container has no polars wheel and no
 network egress, so the reference cannot run on its real engine here. The

@@ -11,14 +11,24 @@ oracle↔JAX leg has its own calibrated f32 tolerance matrix. Together the
 three legs mean: our production path is checked against the reference's
 own expression graphs, not merely against our reading of them.
 
-The reference modules are imported read-only from ``/root/reference``
-(treat as untrusted data: we execute its factor arithmetic in-process —
-it is plain polars expression code with no IO beyond what the shim
-provides, and the shim has no filesystem or network surface).
+Containment (ADVICE r2, medium): the reference modules EXECUTE
+IN-PROCESS with full interpreter access — module import runs arbitrary
+top-level code, and nothing about the shim constrains what the executed
+modules themselves can do. The mitigation is provenance, not a sandbox:
+before any ``exec_module`` the target file's SHA-256 is checked against
+the pinned hash of the audited snapshot (``_REFERENCE_SHA256``), so the
+only code that can run is the exact bytes reviewed in SURVEY.md — a
+tampered or swapped reference tree fails closed. To run a deliberately
+different snapshot (e.g. a future refresh), re-audit it and update the
+pins, or set ``REFDIFF_ALLOW_UNPINNED=1`` to accept the risk
+explicitly. This applies to the production ``--backend polars`` path
+too (pipeline._load_refdiff_harness routes through these loaders).
 """
 
 from __future__ import annotations
 
+import contextlib
+import hashlib
 import importlib.util
 import os
 import sys
@@ -28,6 +38,57 @@ import numpy as np
 
 REFERENCE_DIR = os.environ.get("REFDIFF_REFERENCE_DIR", "/root/reference")
 _KERNELS = "MinuteFrequentFactorCalculateMethodsCICC.py"
+
+# SHA-256 of the audited reference snapshot (2025-10-24). exec of any
+# file that does not match fails closed — see module docstring.
+_REFERENCE_SHA256 = {
+    "Factor.py":
+        "ccfa843b81a3aa2ebe8a8716306c467737fb0124969b045905b5f74ea4fff997",
+    "MinuteFrequentFactorCICC.py":
+        "543a9242b42d41342acadc3044b291181947c7d21e857edc8b59b3b694e026fb",
+    _KERNELS:
+        "d242416203f1c42a3a315a9a28fd8bf3142fda444aed87a5a4bbe203ad328f52",
+}
+
+
+def _verified_reference_path(filename):
+    """Resolve ``filename`` under REFERENCE_DIR, refusing to hand out a
+    path whose content does not hash to the audited pin (the file will
+    be exec'd in-process; provenance is the containment)."""
+    path = os.path.join(REFERENCE_DIR, filename)
+    with open(path, "rb") as fh:
+        digest = hashlib.sha256(fh.read()).hexdigest()
+    if digest != _REFERENCE_SHA256.get(filename):
+        if os.environ.get("REFDIFF_ALLOW_UNPINNED") == "1":
+            return path
+        raise RuntimeError(
+            f"refusing to execute unpinned reference file {path}: "
+            f"sha256 {digest} != audited pin "
+            f"{_REFERENCE_SHA256.get(filename)}; re-audit the snapshot "
+            "and update harness._REFERENCE_SHA256, or set "
+            "REFDIFF_ALLOW_UNPINNED=1 to accept the risk")
+    return path
+
+
+@contextlib.contextmanager
+def _modules_installed(**mods):
+    """Temporarily install ``sys.modules`` entries for the duration of a
+    reference ``exec_module`` (its top-level ``import polars`` /
+    ``from Factor import Factor`` must resolve to the shim-backed
+    modules), restoring the previous state afterwards so a later genuine
+    ``import polars`` or ``import Factor`` in this process cannot
+    silently resolve to refdiff internals (ADVICE r2, low)."""
+    missing = object()
+    prior = {name: sys.modules.get(name, missing) for name in mods}
+    sys.modules.update(mods)
+    try:
+        yield
+    finally:
+        for name, old in prior.items():
+            if old is missing:
+                sys.modules.pop(name, None)
+            else:
+                sys.modules[name] = old
 
 # f64-vs-f64, but not bit-identical: the oracle anchors moment passes
 # (oracle/stats.py pearson) and orders summations differently. Defaults
@@ -54,22 +115,31 @@ ATOL = {
 }
 
 
-def install_shim() -> types.ModuleType:
-    """Install ``tools.refdiff.polars_shim`` as ``sys.modules['polars']``.
+_shim_proxy = None
 
-    Returns the proxy module. Safe to call repeatedly. The proxy exists
+
+def install_shim() -> types.ModuleType:
+    """Return the polars module the differentials run on: a REAL polars
+    if one is importable (strictly better than the shim), else a proxy
+    around ``tools.refdiff.polars_shim``.
+
+    Despite the historical name this does NOT mutate ``sys.modules``:
+    callers use the returned module directly, and the reference
+    ``exec_module`` sites install it only for the duration of the exec
+    via ``_modules_installed`` (ADVICE r2, low). The proxy exists
     because the shim cannot define a module-level ``len`` without
     shadowing the builtin for its own internals.
     """
+    global _shim_proxy
+    if _shim_proxy is not None:
+        return _shim_proxy
     existing = sys.modules.get("polars")
-    if existing is not None and getattr(existing, "__is_refdiff_shim__",
-                                        False):
-        return existing
-    if existing is not None or importlib.util.find_spec("polars"):
-        # a REAL polars exists: never mask it — run the differential on
-        # the real engine instead (strictly better than the shim)
+    if (existing is not None
+            and not getattr(existing, "__is_refdiff_shim__", False)) \
+            or importlib.util.find_spec("polars"):
         import polars as real
 
+        _shim_proxy = real
         return real
     from tools.refdiff import polars_shim as shim
 
@@ -79,7 +149,7 @@ def install_shim() -> types.ModuleType:
             setattr(mod, k, getattr(shim, k))
     mod.len = shim._pl_len
     mod.__is_refdiff_shim__ = True
-    sys.modules["polars"] = mod
+    _shim_proxy = mod
     return mod
 
 
@@ -91,12 +161,12 @@ def load_reference_kernels():
     global _ref_kernels_mod
     if _ref_kernels_mod is not None:
         return _ref_kernels_mod
-    install_shim()
-    path = os.path.join(REFERENCE_DIR, _KERNELS)
+    path = _verified_reference_path(_KERNELS)
     spec = importlib.util.spec_from_file_location("refdiff_ref_kernels",
                                                   path)
     mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    with _modules_installed(polars=install_shim()):
+        spec.loader.exec_module(mod)
     _ref_kernels_mod = mod
     return mod
 
@@ -165,12 +235,12 @@ def load_reference_factor_module():
     if _ref_factor_mod is not None:
         return _ref_factor_mod
     os.environ.setdefault("MPLBACKEND", "Agg")
-    install_shim()
-    path = os.path.join(REFERENCE_DIR, "Factor.py")
+    path = _verified_reference_path("Factor.py")
     spec = importlib.util.spec_from_file_location("refdiff_ref_factor",
                                                   path)
     mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    with _modules_installed(polars=install_shim()):
+        spec.loader.exec_module(mod)
     _ref_factor_mod = mod
     return mod
 
@@ -457,12 +527,12 @@ def load_reference_minfreq_module(kline_dir, cache_dir):
     """
     _require_shim()
     fmod = load_reference_factor_module()
-    sys.modules["Factor"] = fmod
-    path = os.path.join(REFERENCE_DIR, "MinuteFrequentFactorCICC.py")
+    path = _verified_reference_path("MinuteFrequentFactorCICC.py")
     spec = importlib.util.spec_from_file_location("refdiff_ref_minfreq",
                                                   path)
     mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    with _modules_installed(polars=install_shim(), Factor=fmod):
+        spec.loader.exec_module(mod)
     mod.os = _OsRedirect(kline_dir, cache_dir)
     return mod
 

@@ -4,8 +4,9 @@ The reference (`Factor.py`, `MinuteFrequentFactorCICC.py`,
 `MinuteFrequentFactorCalculateMethodsCICC.py`) is pure polars. This
 container has no polars and no way to install it, so this module
 implements the exact expression-API subset those files use, backed by
-numpy (f64), and gets installed as ``sys.modules['polars']`` by
-``tools.refdiff.harness`` before the reference modules are imported.
+numpy (f64), and is made resolvable as ``polars`` by
+``tools.refdiff.harness`` only while a reference module is being
+imported (the ``sys.modules`` entry is restored right after exec).
 The reference's own expression graphs then execute unmodified — a true
 differential against our reimplementations' *structure* (columns,
 filters, operation order, quirks Q1-Q7 of SURVEY.md §2.5).
