@@ -35,8 +35,7 @@ def ic_series(exposure, fwd_ret, valid):
     return ic, rank_ic
 
 
-@functools.partial(jax.jit, static_argnames=("group_num",))
-def qcut_labels(exposure, valid, group_num: int):
+def qcut_labels(exposure, valid, group_num: int, nan_lanes=None):
     """Per-date quantile-bucket labels 0..group_num-1 (NaN-safe).
 
     Matches polars ``qcut(group_num, allow_duplicates=True)`` over each date
@@ -44,7 +43,24 @@ def qcut_labels(exposure, valid, group_num: int):
     of that date's valid exposures; duplicate edges collapse (a value never
     lands in an empty duplicate bucket because ``searchsorted`` on the
     sorted edge list is right-continuous). Invalid lanes get -1.
+
+    ``nan_lanes`` marks lanes whose exposure is a value-NaN (present but
+    not finite). Under the default ``pins.READINGS['qcut_nan'] ==
+    'exclude'`` reading they stay -1 (excluded, like the shim's
+    NaN->null); under the alternative ``'top_bin'`` reading they join
+    the last bucket, polars' total-float-order possibility the
+    reference's unfiltered group_test would expose (Factor.py:280-292).
     """
+    from replication_of_minute_frequency_factor_tpu import pins
+
+    lab = _qcut_labels_jit(exposure, valid, group_num)
+    if nan_lanes is not None and pins.reading("qcut_nan") == "top_bin":
+        lab = jnp.where(jnp.asarray(nan_lanes), group_num - 1, lab)
+    return lab
+
+
+@functools.partial(jax.jit, static_argnames=("group_num",))
+def _qcut_labels_jit(exposure, valid, group_num: int):
     qs = jnp.linspace(0.0, 1.0, group_num + 1)[1:-1]
 
     def one_date(x, m):

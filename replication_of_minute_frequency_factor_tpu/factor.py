@@ -243,7 +243,10 @@ class Factor:
             return ({"period": dates[:0], "group_return": empty,
                      "cum_return": empty} if return_df else None)
         labels = np.asarray(
-            eval_ops.qcut_labels(np.nan_to_num(mat), valid, group_num))
+            eval_ops.qcut_labels(np.nan_to_num(mat), valid, group_num,
+                                 # value-NaN only: +/-inf exposures are
+                                 # NOT NaN-bucketed under total order
+                                 nan_lanes=present & np.isnan(mat)))
 
         pv = self._read_daily_pv_data(
             ["code", "date", "pct_change", "tmc", "cmc"], path=daily_pv_path)

@@ -85,10 +85,19 @@ def masked_corr(x, y, mask):
     f64 oracle) instead of rounding noise posing as signal. (An all-invalid
     row anchors to NaN, but the final ``n > 1`` gate forces NaN there
     anyway.)
+
+    The anchor is the production side of the ``constant_window`` pin
+    (pins.py): under the alternative ``"noise"`` reading it is skipped at
+    trace time, letting raw accumulation noise decide degenerate lanes —
+    inherently substrate-dependent, exactly like real polars' two-pass
+    variance (``pins.pinned`` clears jit caches so the flip retraces).
     """
+    from replication_of_minute_frequency_factor_tpu import pins
+
     n = count(mask)
-    x = x - masked_first(x, mask)[..., None]
-    y = y - masked_first(y, mask)[..., None]
+    if pins.reading("constant_window") == "degenerate":
+        x = x - masked_first(x, mask)[..., None]
+        y = y - masked_first(y, mask)[..., None]
     mx = masked_mean(x, mask)
     my = masked_mean(y, mask)
     dx = jnp.where(mask, x - mx[..., None], 0.0)

@@ -61,12 +61,17 @@ def rolling_window_stats(x, y, mask, window: int = 50,
     ``impl``: ``'conv'`` (XLA, default) or ``'pallas'`` (the VMEM-resident
     fused kernel, ops/pallas_rolling.py); None reads ``Config.rolling_impl``.
     """
+    from replication_of_minute_frequency_factor_tpu import pins
+
     if impl is None:
         from ..config import get_config
         impl = get_config().rolling_impl
+    degenerate = pins.reading("constant_window") == "degenerate"
     if impl == "pallas":
-        from .pallas_rolling import rolling_window_stats_pallas
-        return rolling_window_stats_pallas(x, y, mask, window)
+        if degenerate:
+            from .pallas_rolling import rolling_window_stats_pallas
+            return rolling_window_stats_pallas(x, y, mask, window)
+        impl = "conv"  # the pallas kernel implements only the default pin
     m = mask.astype(x.dtype)
     xm = jnp.where(mask, x, 0.0)
     ym = jnp.where(mask, y, 0.0)
@@ -86,10 +91,19 @@ def rolling_window_stats(x, y, mask, window: int = 50,
     # A valid window has all `window` bars present (module docstring), so
     # rolled-in lanes can only pollute windows already marked invalid and
     # need no masking.
-    cx = masked_mean(x, mask)
-    cy = masked_mean(y, mask)
-    xc = jnp.where(mask, x - cx[..., None], 0.0)
-    yc = jnp.where(mask, y - cy[..., None], 0.0)
+    # Day-mean centring doubles as the production side of the
+    # constant_window pin: a constant window centres to exact zeros ->
+    # exactly-zero var/cov (the "degenerate" reading). Under "noise" the
+    # centring is skipped and raw f32 accumulation decides, like real
+    # polars' raw two-pass variance would in f64.
+    if degenerate:
+        cx = masked_mean(x, mask)
+        cy = masked_mean(y, mask)
+        xc = jnp.where(mask, x - cx[..., None], 0.0)
+        yc = jnp.where(mask, y - cy[..., None], 0.0)
+    else:
+        xc = jnp.where(mask, x, 0.0)
+        yc = jnp.where(mask, y, 0.0)
     inv_w = 1.0 / window
     mu_x = _windowed_sum(xc, window) * inv_w
     mu_y = _windowed_sum(yc, window) * inv_w

@@ -127,9 +127,14 @@ def _rolling50(g: Group):
     the one O(n*window) pass."""
     if g._rolling_cache is not None:
         return g._rolling_cache
+    from replication_of_minute_frequency_factor_tpu import pins
+
     slots = S.time_to_slot(g.time)
-    xa = g.low.astype(np.float64) - np.float64(g.low[0])
-    ya = g.high.astype(np.float64) - np.float64(g.high[0])
+    xa = g.low.astype(np.float64)
+    ya = g.high.astype(np.float64)
+    if pins.reading("constant_window") == "degenerate":
+        xa = xa - np.float64(g.low[0])
+        ya = ya - np.float64(g.high[0])
     out = {k: [] for k in ("cov", "var_x", "var_y", "mean_x", "mean_y")}
     for i in range(g.n):
         lo = np.searchsorted(slots, slots[i] - 49)

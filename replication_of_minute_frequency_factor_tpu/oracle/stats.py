@@ -47,14 +47,19 @@ def pearson(a: np.ndarray, b: np.ndarray) -> float:
     Series are anchored to their first observation before the moment pass
     (shift-invariant): a constant series then has *exactly* zero variance
     and yields NaN, instead of letting f64 summation noise pose as signal.
-    The JAX backend anchors identically (ops/masked.py)."""
+    The JAX backend anchors identically (ops/masked.py). Under the
+    alternative ``pins.READINGS['constant_window'] == 'noise'`` reading
+    the anchor is skipped (see pins.py)."""
+    from replication_of_minute_frequency_factor_tpu import pins
+
     a = np.asarray(a, dtype=np.float64)
     b = np.asarray(b, dtype=np.float64)
     ok = ~(np.isnan(a) | np.isnan(b))
     a, b = a[ok], b[ok]
     if a.size < 2:
         return np.nan
-    a, b = a - a[0], b - b[0]
+    if pins.reading("constant_window") == "degenerate":
+        a, b = a - a[0], b - b[0]
     da, db = a - a.mean(), b - b.mean()
     with np.errstate(divide="ignore", invalid="ignore"):
         return float((da * db).sum() / np.sqrt((da * da).sum() * (db * db).sum()))

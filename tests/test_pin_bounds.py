@@ -1,0 +1,187 @@
+"""Blast-radius bounds for the two UNVERIFIABLE semantic pins.
+
+No polars wheel and no network exist in this container (VERDICT r2), so
+two behaviors of the reference's engine cannot be observed:
+
+* ``constant_window`` — does a constant (limit-locked) price window
+  produce exactly-zero variance, sending the reference's
+  ``when(var_x*var_y != 0)`` guards down the degenerate branch
+  (/root/reference/MinuteFrequentFactorCalculateMethodsCICC.py:130-141)?
+* ``qcut_nan`` — does group_test's qcut put a value-NaN exposure in the
+  null bucket or the TOP bin (the reference never filters NaN there,
+  /root/reference/Factor.py:280-292)?
+
+Both readings are now implemented (shim ``PIN_READINGS``, repo
+``pins.READINGS``). These tests run the full reference differential
+under EACH reading and pin the exact blast radius: which outputs change,
+which provably cannot, and that the repo tracks the reference under the
+alternative reading too — so if a real-polars run ever contradicts a
+default, the fix is a one-line flip, with consequences already known.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from replication_of_minute_frequency_factor_tpu import pins
+from replication_of_minute_frequency_factor_tpu.data import synth_day
+from tools.refdiff import harness, polars_shim
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(os.path.join(harness.REFERENCE_DIR,
+                                    harness._KERNELS)),
+    reason="reference tree not mounted")
+
+# The rolling-regression family is where the guards live; the corr_*
+# family shares the anchored-correlation helper. Nothing else may move.
+CONSTANT_WINDOW_ALLOWED = {
+    "mmt_ols_qrs", "mmt_ols_corr_square_mean", "mmt_ols_corr_mean",
+    "mmt_ols_beta_mean", "mmt_ols_beta_zscore_last",
+    "corr_prv", "corr_prvr", "corr_pv", "corr_pvd", "corr_pvl",
+    "corr_pvr",
+}
+
+
+def _diff_cells(a, b, tol=1e-9):
+    """{factor: {code: (va, vb)}} where the two runs disagree beyond
+    f64 noise or in NaN-status."""
+    out = {}
+    for name in sorted(set(a) | set(b)):
+        av, bv = a.get(name, {}), b.get(name, {})
+        for code in sorted(set(av) | set(bv)):
+            x, y = av.get(code, np.nan), bv.get(code, np.nan)
+            if np.isnan(x) != np.isnan(y):
+                out.setdefault(name, {})[code] = (x, y)
+            elif not np.isnan(x) and not np.isclose(x, y, rtol=tol,
+                                                    atol=tol):
+                out.setdefault(name, {})[code] = (x, y)
+    return out
+
+
+def test_constant_window_blast_radius():
+    """Flip only the constant_window reading; on a day with limit-locked
+    stocks, exactly the rolling/corr families may move — and do."""
+    rng = np.random.default_rng(17)
+    day = synth_day(rng, n_codes=8, constant_price_codes=3)
+    base = harness.run_reference(day)
+    with polars_shim.pin_reading(constant_window="noise"):
+        alt = harness.run_reference(day)
+    changed = _diff_cells(base, alt)
+    assert set(changed) <= CONSTANT_WINDOW_ALLOWED, sorted(changed)
+    # the pin must actually bite on this day (a vacuously-empty diff
+    # would mean the scenario no longer exercises the guards)
+    assert any(n.startswith("mmt_ols") for n in changed), sorted(changed)
+    # Blast magnitude on the regression family is O(1) factor values
+    # (degenerate 0.0 vs noise ~1.0 corr_square means), not 1e-12 dust —
+    # exactly why the pin matters.
+    worst = {n: max(abs((0.0 if np.isnan(x) else x)
+                        - (0.0 if np.isnan(y) else y))
+                    for x, y in cells.values())
+             for n, cells in changed.items()}
+    assert max(worst.values()) > 1e-3, worst
+
+
+def test_constant_window_flip_is_coherent():
+    """Under the alternative reading, shim and oracle still agree cell
+    for cell: the repo can adopt either reading with one flip each."""
+    rng = np.random.default_rng(18)
+    day = synth_day(rng, n_codes=6, constant_price_codes=2)
+    with polars_shim.pin_reading(constant_window="noise"), \
+            pins.pinned(constant_window="noise"):
+        fails = harness.compare_day(day)
+    assert not fails, "\n".join(fails[:20])
+
+
+def _nan_eval_scenario(seed=23):
+    rng = np.random.default_rng(seed)
+    return harness.synth_eval_data(rng, n_codes=16, n_days=70,
+                                   nan_prob=0.15)
+
+
+def test_qcut_nan_blast_radius():
+    """Flip only the qcut_nan reading on value-NaN exposures: ic_test
+    and coverage are invariant (they filter NaN, Factor.py:100-102,
+    167-169); only group_test rows may move."""
+    exposure, pv = _nan_eval_scenario()
+    base = harness.run_reference_eval(exposure, pv, nan_as_value=True)
+    with polars_shim.pin_reading(qcut_nan="top_bin"):
+        alt = harness.run_reference_eval(exposure, pv, nan_as_value=True)
+    b_stats, b_ic, b_grp, b_cov = base
+    a_stats, a_ic, a_grp, a_cov = alt
+    assert b_cov == a_cov
+    assert b_ic.keys() == a_ic.keys()
+    for d in b_ic:
+        np.testing.assert_allclose(b_ic[d], a_ic[d], rtol=0, atol=0)
+    # group_test must actually move: NaN-exposure stocks join the top
+    # bucket under the alternative reading
+    moved = [k for k in set(b_grp) & set(a_grp)
+             if not np.isclose(b_grp[k], a_grp[k], rtol=1e-12,
+                               atol=1e-12)]
+    only_top = {k[1] for k in moved} | {k[1] for k in set(b_grp)
+                                        ^ set(a_grp)}
+    assert moved or (set(b_grp) ^ set(a_grp)), \
+        "qcut_nan flip produced no group_test difference"
+    # all movement is in the top bucket's rows (index group_num-1 == 4)
+    assert only_top <= {4}, sorted(only_top)
+
+
+@pytest.mark.parametrize("reading", ["exclude", "top_bin"])
+def test_qcut_nan_repo_tracks_reference_under_both_readings(
+        tmp_path, reading):
+    """The full eval differential passes under EITHER reading when shim
+    and repo flip together — the repo's flip point is pins.READINGS."""
+    with polars_shim.pin_reading(qcut_nan=reading), \
+            pins.pinned(qcut_nan=reading):
+        fails = harness.compare_eval(rng_seed=23, nan_as_value=True,
+                                     tmp_dir=str(tmp_path),
+                                     n_codes=16, n_days=70,
+                                     nan_prob=0.15)
+    assert not fails, "\n".join(fails[:20])
+
+
+def test_default_readings_unchanged():
+    """The audited defaults stay what SEMANTIC_PINS documents; the shim
+    consults the same single registry."""
+    assert pins.READINGS == {"constant_window": "degenerate",
+                             "qcut_nan": "exclude"}
+    assert polars_shim._pin_reading("constant_window") == "degenerate"
+    with pins.pinned(qcut_nan="top_bin"):
+        assert polars_shim._pin_reading("qcut_nan") == "top_bin"
+    with pytest.raises(ValueError):
+        pins.pinned(constant_window="degnerate")  # typo'd reading
+
+
+def test_production_jax_flip_is_live():
+    """The constant_window pin governs the PRODUCTION kernels too: under
+    the noise reading a limit-locked series stops producing the
+    degenerate NaN/zero, and pins.pinned retraces cached jits. (Bitwise
+    oracle agreement is impossible under noise by construction — the
+    noise is substrate-dependent, which is the pin's entire point — so
+    liveness of the flip is the sound production-side check.)"""
+    import jax
+    import jax.numpy as jnp
+
+    from replication_of_minute_frequency_factor_tpu import ops
+
+    f = jax.jit(lambda x, y, m: ops.masked_corr(x, y, m))
+    # 0.1 is inexact in binary; its f32 running mean cannot be exact, so
+    # the unanchored moment pass carries genuine accumulation noise
+    x = jnp.full((1, 240), 0.1, jnp.float32)
+    y = jnp.linspace(0.0, 1.0, 240, dtype=jnp.float32)[None, :]
+    m = jnp.ones((1, 240), bool)
+    assert np.isnan(float(f(x, y, m)[0]))          # degenerate: exact 0 var
+    with pins.pinned(constant_window="noise"):
+        assert not np.isnan(float(f(x, y, m)[0]))  # noise decides
+    assert np.isnan(float(f(x, y, m)[0]))          # caches cleared back
+
+    from replication_of_minute_frequency_factor_tpu.ops.rolling import (
+        rolling_window_stats)
+    g = jax.jit(lambda a, b, mm: rolling_window_stats(a, b, mm, 50,
+                                                      impl="conv"))
+    st = g(x, x, m)
+    assert float(jnp.max(jnp.where(st["valid"], st["var_x"], 0.0))) == 0.0
+    with pins.pinned(constant_window="noise"):
+        st = g(x, x, m)
+        assert float(jnp.max(jnp.where(st["valid"], st["var_x"],
+                                       0.0))) > 0.0

@@ -56,7 +56,9 @@ def test_shim_never_replaces_a_real_polars():
     if getattr(mod, "__is_refdiff_shim__", False):
         assert importlib.util.find_spec("polars") is None
     else:
-        assert not getattr(mod, "__is_refdiff_shim__", False)
+        # a real polars won: it must actually be the importable wheel
+        assert importlib.util.find_spec("polars") is not None
+        assert mod.__name__ == "polars"
 
 
 def test_sys_modules_not_left_mutated():
@@ -85,15 +87,21 @@ def test_reference_exec_is_hash_pinned(tmp_path, monkeypatch):
     tampered.write_bytes(open(src, "rb").read() + b"\n# tampered\n")
     monkeypatch.setattr(harness, "REFERENCE_DIR", str(tmp_path))
     with pytest.raises(RuntimeError, match="unpinned reference file"):
-        harness._verified_reference_path("Factor.py")
+        harness._verified_reference_source("Factor.py")
     # explicit opt-out accepts the risk
     monkeypatch.setenv("REFDIFF_ALLOW_UNPINNED", "1")
-    assert harness._verified_reference_path("Factor.py") == str(tampered)
-    # the pristine snapshot passes
+    path, source = harness._verified_reference_source("Factor.py")
+    assert path == str(tampered) and source.endswith(b"# tampered\n")
+    # the pristine snapshot passes, and the returned bytes ARE the
+    # audited bytes (hash-and-exec share one read — no TOCTOU window)
     monkeypatch.setattr(harness, "REFERENCE_DIR",
                         os.path.dirname(src))
     monkeypatch.delenv("REFDIFF_ALLOW_UNPINNED")
-    assert harness._verified_reference_path("Factor.py") == src
+    path, source = harness._verified_reference_source("Factor.py")
+    assert path == src
+    import hashlib
+    assert (hashlib.sha256(source).hexdigest()
+            == harness._REFERENCE_SHA256["Factor.py"])
 
 
 @pytest.mark.parametrize("weight_param", [None, "tmc", "cmc"])

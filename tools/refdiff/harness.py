@@ -51,23 +51,38 @@ _REFERENCE_SHA256 = {
 }
 
 
-def _verified_reference_path(filename):
-    """Resolve ``filename`` under REFERENCE_DIR, refusing to hand out a
-    path whose content does not hash to the audited pin (the file will
-    be exec'd in-process; provenance is the containment)."""
+def _verified_reference_source(filename):
+    """Read ``filename`` under REFERENCE_DIR ONCE and hash exactly the
+    bytes that will be executed (hash-then-reread would reopen the
+    TOCTOU window the pin exists to close). Returns (path, source)."""
     path = os.path.join(REFERENCE_DIR, filename)
     with open(path, "rb") as fh:
-        digest = hashlib.sha256(fh.read()).hexdigest()
+        source = fh.read()
+    digest = hashlib.sha256(source).hexdigest()
     if digest != _REFERENCE_SHA256.get(filename):
         if os.environ.get("REFDIFF_ALLOW_UNPINNED") == "1":
-            return path
+            return path, source
         raise RuntimeError(
             f"refusing to execute unpinned reference file {path}: "
             f"sha256 {digest} != audited pin "
             f"{_REFERENCE_SHA256.get(filename)}; re-audit the snapshot "
             "and update harness._REFERENCE_SHA256, or set "
             "REFDIFF_ALLOW_UNPINNED=1 to accept the risk")
-    return path
+    return path, source
+
+
+def _exec_reference_module(filename, modname, extra_modules=None):
+    """Compile + exec the verified bytes of a reference file as a module,
+    with the shim (and any ``extra_modules``) resolvable only for the
+    exec's duration."""
+    path, source = _verified_reference_source(filename)
+    mod = types.ModuleType(modname)
+    mod.__file__ = path
+    code = compile(source, path, "exec")
+    with _modules_installed(polars=install_shim(),
+                            **(extra_modules or {})):
+        exec(code, mod.__dict__)
+    return mod
 
 
 @contextlib.contextmanager
@@ -161,14 +176,9 @@ def load_reference_kernels():
     global _ref_kernels_mod
     if _ref_kernels_mod is not None:
         return _ref_kernels_mod
-    path = _verified_reference_path(_KERNELS)
-    spec = importlib.util.spec_from_file_location("refdiff_ref_kernels",
-                                                  path)
-    mod = importlib.util.module_from_spec(spec)
-    with _modules_installed(polars=install_shim()):
-        spec.loader.exec_module(mod)
-    _ref_kernels_mod = mod
-    return mod
+    _ref_kernels_mod = _exec_reference_module(_KERNELS,
+                                              "refdiff_ref_kernels")
+    return _ref_kernels_mod
 
 
 def day_frame(day: dict):
@@ -235,14 +245,9 @@ def load_reference_factor_module():
     if _ref_factor_mod is not None:
         return _ref_factor_mod
     os.environ.setdefault("MPLBACKEND", "Agg")
-    path = _verified_reference_path("Factor.py")
-    spec = importlib.util.spec_from_file_location("refdiff_ref_factor",
-                                                  path)
-    mod = importlib.util.module_from_spec(spec)
-    with _modules_installed(polars=install_shim()):
-        spec.loader.exec_module(mod)
-    _ref_factor_mod = mod
-    return mod
+    _ref_factor_mod = _exec_reference_module("Factor.py",
+                                             "refdiff_ref_factor")
+    return _ref_factor_mod
 
 
 def synth_eval_data(rng, n_codes=18, n_days=90, nan_prob=0.06,
@@ -280,15 +285,24 @@ def synth_eval_data(rng, n_codes=18, n_days=90, nan_prob=0.06,
     return exposure, pv
 
 
-def _exposure_frame(pl, exposure, factor_name):
-    """Exposure long table as the reference would read it from its own
-    parquet cache: NaN factor values are NULLS there (polars kernels
-    emit null for undefined values), so the repo's NaN maps to null."""
+def _exposure_frame(pl, exposure, factor_name, nan_as_value=False):
+    """Exposure long table as the reference would hold it.
+
+    Default: NaN factor values are NULLS (what its parquet cache holds
+    when kernels emit null for undefined values). ``nan_as_value=True``
+    keeps them as float NaN with a true validity bit — the provenance
+    polars arithmetic like 0/0 produces, and the scenario where the
+    ``qcut_nan`` pin ambiguity is actually reachable in group_test
+    (see SEMANTIC_PINS; tests/test_pin_bounds.py)."""
     from tools.refdiff.polars_shim import Series as ShimSeries
 
     vals = np.asarray(exposure["value"], np.float64)
     if getattr(pl, "__is_refdiff_shim__", False):
-        val_col = ShimSeries(vals, np.isfinite(vals))
+        validity = (np.ones(vals.size, bool) if nan_as_value
+                    else np.isfinite(vals))
+        val_col = ShimSeries(vals, validity)
+    elif nan_as_value:  # real polars: NaN is an ordinary float value
+        val_col = [float(v) for v in vals]
     else:  # real polars: None marks null
         val_col = [None if not np.isfinite(v) else float(v) for v in vals]
     return pl.DataFrame({
@@ -300,7 +314,7 @@ def _exposure_frame(pl, exposure, factor_name):
 
 def run_reference_eval(exposure, pv, factor_name="f", future_days=5,
                        frequency="monthly", weight_param=None,
-                       group_num=5):
+                       group_num=5, nan_as_value=False):
     """Reference Factor.ic_test + group_test on the shim.
 
     ``_read_daily_pv_data`` is replaced (its body is a read of a
@@ -310,7 +324,8 @@ def run_reference_eval(exposure, pv, factor_name="f", future_days=5,
     pl = install_shim()
     mod = load_reference_factor_module()
     f = mod.Factor(factor_name, _exposure_frame(pl, exposure,
-                                                factor_name))
+                                                factor_name,
+                                                nan_as_value))
 
     def fake_read(column_need=None):
         cols = column_need or list(pv)
@@ -402,7 +417,8 @@ def run_repo_eval(exposure, pv, tmp_dir, factor_name="f", future_days=5,
 
 
 def compare_eval(rng_seed=0, future_days=5, frequency="monthly",
-                 weight_param=None, group_num=5, tmp_dir=None, **synth_kw):
+                 weight_param=None, group_num=5, tmp_dir=None,
+                 nan_as_value=False, **synth_kw):
     """Full eval differential; returns a list of mismatch strings."""
     import tempfile
 
@@ -415,7 +431,8 @@ def compare_eval(rng_seed=0, future_days=5, frequency="monthly",
     try:
         ref_stats, ref_ic, ref_grp, ref_cov = run_reference_eval(
             exposure, pv, future_days=future_days, frequency=frequency,
-            weight_param=weight_param, group_num=group_num)
+            weight_param=weight_param, group_num=group_num,
+            nan_as_value=nan_as_value)
         repo_stats, repo_ic, repo_grp, repo_cov = run_repo_eval(
             exposure, pv, tmp_dir, future_days=future_days,
             frequency=frequency, weight_param=weight_param,
@@ -527,12 +544,9 @@ def load_reference_minfreq_module(kline_dir, cache_dir):
     """
     _require_shim()
     fmod = load_reference_factor_module()
-    path = _verified_reference_path("MinuteFrequentFactorCICC.py")
-    spec = importlib.util.spec_from_file_location("refdiff_ref_minfreq",
-                                                  path)
-    mod = importlib.util.module_from_spec(spec)
-    with _modules_installed(polars=install_shim(), Factor=fmod):
-        spec.loader.exec_module(mod)
+    mod = _exec_reference_module("MinuteFrequentFactorCICC.py",
+                                 "refdiff_ref_minfreq",
+                                 extra_modules={"Factor": fmod})
     mod.os = _OsRedirect(kline_dir, cache_dir)
     return mod
 

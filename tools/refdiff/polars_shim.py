@@ -111,6 +111,30 @@ SEMANTIC_PINS = {
         "available."),
 }
 
+# The two pins flagged UNVERIFIABLE above are parameterized so their
+# blast radius can be measured instead of trusted (VERDICT r2 #3): the
+# differential runs under BOTH readings and tests/test_pin_bounds.py
+# records exactly which outputs change. The single authoritative
+# registry is replication_of_minute_frequency_factor_tpu/pins.py — the
+# shim consults it lazily so shim and repo can never drift apart; flip
+# THERE if a real-polars run ever contradicts a default.
+
+
+def _pin_reading(name):
+    from replication_of_minute_frequency_factor_tpu import pins
+
+    return pins.reading(name)
+
+
+def pin_reading(**overrides):
+    """Context manager: temporarily select alternative pin readings
+    (``with pin_reading(constant_window="noise"): ...``). Delegates to
+    the one registry in the repo's ``pins.pinned`` — readings validated
+    there, jit caches cleared there."""
+    from replication_of_minute_frequency_factor_tpu import pins
+
+    return pins.pinned(**overrides)
+
 
 # --------------------------------------------------------------------------
 # Series: values + validity
@@ -308,7 +332,11 @@ def _anchor(v: np.ndarray) -> np.ndarray:
     a constant window is bit-level data-dependent (its two-pass variance
     yields exact 0 only when the mean rounds exactly); we pin the
     degenerate reading repo-wide (oracle/stats.py anchors identically).
+    Under the alternative ``pins.READINGS['constant_window'] == 'noise'``
+    reading this is the identity, exposing raw two-pass rounding.
     """
+    if _pin_reading("constant_window") == "noise":
+        return v
     return v - v[0] if v.size else v
 
 
@@ -861,8 +889,13 @@ class Expr:
                 raise ValueError("not enough qcut labels")
             # right-closed bins: index = first break >= value
             idx = np.searchsorted(breaks, v, side="left")
-            # PIN (SEMANTIC_PINS['qcut_nan']): NaN buckets to null
-            ok &= ~np.isnan(v)
+            if _pin_reading("qcut_nan") == "top_bin":
+                # alternative reading: NaN sorts above +inf (polars
+                # total order) -> last bucket; stays non-null
+                idx = np.where(np.isnan(v), breaks.size, idx)
+            else:
+                # PIN (SEMANTIC_PINS['qcut_nan']): NaN buckets to null
+                ok &= ~np.isnan(v)
             for i in np.nonzero(ok)[0]:
                 out[i] = lab[idx[i]]
             return Series(out, ok)
