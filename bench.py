@@ -13,6 +13,7 @@ one polars pass per factor per day-file on all CPU cores.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import subprocess
@@ -76,6 +77,13 @@ def _ensure_device_reachable():
     # benchmarks/ladder.py, which would re-emit its earlier configs)
     os.execve(sys.executable,
               [sys.executable, os.path.abspath(__file__)], env)
+
+
+class _NullTimer:
+    """No-op stand-in for utils.tracing.Timer in the hot loop."""
+
+    def __call__(self, name):
+        return contextlib.nullcontext()
 
 
 def make_batch(rng, n_days=DAYS_PER_BATCH, n_tickers=N_TICKERS):
@@ -181,15 +189,19 @@ def main():
 
     use_wire = wire.encode(bars[:1], mask[:1]) is not None
 
-    def encode_pack(b, m):
+    def encode_pack(b, m, t=None):
         """Host half of a step: wire-encode (C++, GIL released) + pack
         into the single transfer buffer; raw-f32 fallback when the wire
-        format can't represent the batch."""
-        if use_wire:
-            w = wire.encode(b, m)
+        format can't represent the batch. ``t`` (a Timer) attributes the
+        two stages for the diagnostic pass — same code path either way,
+        so the breakdown can never drift from what the timed loop runs."""
+        ctx = t if t is not None else _NullTimer()
+        with ctx("wire_encode"):
+            w = wire.encode(b, m) if use_wire else None
+        with ctx("pack"):
             if w is not None:
                 return wire.pack_arrays(w.arrays) + ("wire",)
-        return wire.pack_arrays((b, m.view(np.uint8))) + ("raw",)
+            return wire.pack_arrays((b, m.view(np.uint8))) + ("raw",)
 
     def launch(item):
         """Device half: ONE buffer over the wire -> fused on-device unpack
@@ -247,6 +259,28 @@ def main():
         np.asarray(o)
     per_batch = (time.perf_counter() - t0) / iters
     full_year = per_batch * (TRADING_DAYS_PER_YEAR / DAYS_PER_BATCH)
+
+    # Stage attribution for the CPU fallback (VERDICT r2 #7): a 600 s
+    # fallback number should decompose into host-side (synth/encode/
+    # pack) vs XLA-CPU compute vs result readback, so it reads as a
+    # diagnostic rather than a mystery. Measured serially on one batch
+    # AFTER the timed loop; skipped on TPU runs (an up-window's seconds
+    # are too precious for a redundant serial pass).
+    stages = None
+    if is_cpu_fallback:
+        from replication_of_minute_frequency_factor_tpu.utils.tracing \
+            import Timer
+        t = Timer()
+        with t("synth_batch"):
+            b, m = make_batch(np.random.default_rng(99))
+        item = encode_pack(b, m, t)  # times wire_encode + pack
+        with t("device_compute"):
+            out = launch(item)
+            jax.block_until_ready(out)
+        with t("result_to_host"):
+            np.asarray(out)
+        stages = {k: round(v, 3) for k, v in t.totals().items()}
+
     target = 60.0
     print(json.dumps({
         "metric": "cicc58_5000tickers_1yr_wall" + _SUFFIX,
@@ -260,6 +294,9 @@ def main():
         "link_down_MBps": link_down,
         "link_up_MBps": link_up,
         "link_wait_s": link_wait,
+        # per-batch stage seconds, fallback runs only (null on TPU):
+        # full-year cost of a stage ~= value * 30.5 batches
+        "stages": stages,
     }))
 
 

@@ -53,6 +53,11 @@ def _add_compute(sub: "argparse._SubParsersAction") -> None:
                    default=None)
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace here")
+    p.add_argument("--retry-failed", action="store_true",
+                   help="also recompute the days in <cache>.failures.json "
+                        "(a plain rerun only resumes past the cached max "
+                        "date, so previously-failed days stay lost "
+                        "without this)")
     p.add_argument("--quiet", action="store_true")
 
 
@@ -119,7 +124,8 @@ def cmd_compute(args: argparse.Namespace) -> int:
         cfg.profile_dir = args.profile_dir
     table = compute_exposures(args.minute_dir, names,
                               cache_path=args.cache, cfg=cfg,
-                              progress=not args.quiet)  # saves the cache
+                              progress=not args.quiet,
+                              retry_failed=args.retry_failed)  # saves cache
     n_days = len(set(map(str, table.columns["date"])))
     print(json.dumps({
         "rows": len(table), "days": n_days,

@@ -57,8 +57,15 @@ class MinFreqFactor(Factor):
         cfg: Optional[Config] = None,
         progress: bool = True,
         fault_hook=None,
+        retry_failed: bool = False,
     ) -> "MinFreqFactor":
         """Compute this factor for every day file, resuming incrementally.
+
+        The resume rule is the reference's: only day files NEWER than the
+        cached max date recompute, so a day that failed mid-run while
+        later days completed is never retried by a plain rerun — pass
+        ``retry_failed=True`` to also recompute the days recorded in
+        ``<cache>.failures.json``.
 
         ``calculate_method`` is a registered kernel name (defaults to
         ``factor_name``) or an ad-hoc kernel ``fn(ctx) -> [..., T]`` —
@@ -91,7 +98,8 @@ class MinFreqFactor(Factor):
         cache_path = self._resolve_path(path)
         table = compute_exposures(
             minute_dir=minute_dir, names=(name,), cache_path=cache_path,
-            cfg=cfg, progress=progress, fault_hook=fault_hook)
+            cfg=cfg, progress=progress, fault_hook=fault_hook,
+            retry_failed=retry_failed)
         self.failures = getattr(table, "failures", None)
         self.set_exposure(table.columns["code"], table.columns["date"],
                           table.columns[name])

@@ -600,10 +600,19 @@ def compute_exposures(
     cfg: Optional[Config] = None,
     progress: bool = True,
     fault_hook: Optional[Callable[[np.datetime64], None]] = None,
+    retry_failed: bool = False,
 ) -> ExposureTable:
     """Compute factor exposures for every day file, incrementally.
 
-    * resumes past ``cache_path``'s max cached date (reference :79-81);
+    * resumes past ``cache_path``'s max cached date (reference :79-81).
+      NOTE the scope of that resume rule: a day that FAILED mid-run while
+      later days completed lies BEFORE the advanced max date, so a plain
+      re-run never retries it — it stays lost (exactly like the
+      reference, whose driver has the same filter). Failed days are not
+      silent, though: they land in the ``.failures`` ledger
+      (``<cache_path>.failures.json``), and ``retry_failed=True``
+      (CLI ``--retry-failed``) re-lists precisely those days from the
+      ledger and recomputes them alongside any new days;
     * a failing day is logged into the returned table's
       ``.failures`` report and skipped (reference :17-25);
     * ``fault_hook(date)`` is the fault-injection test hook (SURVEY.md §5).
@@ -638,9 +647,53 @@ def compute_exposures(
                     cache_path, missing)
                 cached = None
 
-    files = dio.list_day_files(minute_dir)
+    all_files = dio.list_day_files(minute_dir)
+    files = all_files
     if cached is not None and cached.max_date is not None:
         files = [(d, p) for d, p in files if d > cached.max_date]
+    prior_ledger: List[dict] = []
+    if cache_path is not None:
+        import json as _json
+        import os as _os
+        ledger_path = cache_path + ".failures.json"
+        if _os.path.exists(ledger_path):
+            try:
+                with open(ledger_path) as fh:
+                    raw = _json.load(fh)
+                if isinstance(raw, list):
+                    prior_ledger = [r for r in raw if isinstance(r, dict)]
+                    if len(prior_ledger) != len(raw):
+                        logger.warning("failure ledger %s has %d "
+                                       "malformed entries (ignored)",
+                                       ledger_path,
+                                       len(raw) - len(prior_ledger))
+                else:
+                    logger.warning("failure ledger %s is not a list; "
+                                   "ignoring it", ledger_path)
+            except (OSError, ValueError) as e:
+                logger.warning("unreadable failure ledger %s: %s",
+                               ledger_path, e)
+    if retry_failed and cache_path is not None:
+        # Re-list the ledger's failed days (they sit at or before the
+        # cached max date, which the resume filter above skips forever).
+        retry_keys = {rec.get("key") for rec in prior_ledger}
+        retry_keys.discard(None)
+        if retry_keys:
+            have = {str(d) for d, _ in files}
+            extra = [(d, p) for d, p in all_files
+                     if str(d) in retry_keys and str(d) not in have]
+            missing = retry_keys - {str(d) for d, _ in all_files}
+            if missing:
+                logger.warning("ledger days %s no longer exist in %s",
+                               sorted(missing), minute_dir)
+            if extra:
+                logger.info("retrying %d ledger days: %s", len(extra),
+                            [str(d) for d, _ in extra])
+                files = sorted(files + extra)
+                # NOTE: any good cached rows a stale ledger day may hold
+                # are dropped at MERGE time, only if the day actually
+                # produced fresh rows — dropping up front would regress
+                # the cache if the retry fails or the run aborts first
 
     failures = FailureReport()
     timer = Timer()
@@ -751,6 +804,16 @@ def compute_exposures(
     if cached is not None and len(cached):
         keep = ["code", "date", *names]
         cached.columns = {k: cached.columns[k] for k in keep}
+        if len(new):
+            # fresh rows win over cached rows for the same day (only
+            # reachable when a stale ledger listed a day the cache also
+            # holds and --retry-failed recomputed it); whole-day grain,
+            # so a date-level drop is exact
+            new_dates = np.unique(new.columns["date"])
+            keep_rows = ~np.isin(cached.columns["date"], new_dates)
+            if not keep_rows.all():
+                cached.columns = {k: v[keep_rows]
+                                  for k, v in cached.columns.items()}
         result = ExposureTable.concat([cached, new]).sort()
     else:
         result = new
@@ -764,9 +827,20 @@ def compute_exposures(
     if cache_path is not None and len(result):
         result.save(cache_path)
     if cache_path is not None:
-        if failures:
-            failures.save(cache_path + ".failures.json")
-        else:  # don't leave a stale ledger from an earlier run
+        # Ledger persistence rule: a prior entry drops off only when the
+        # day is RESOLVED this run — it produced fresh rows (recovered)
+        # or re-entered ``failures`` (failed again, fresh error). Days a
+        # run merely listed but never reached (circuit-breaker abort,
+        # crash) keep their entries; erasing them would strand the day
+        # forever, since the resume filter skips everything at or before
+        # the cached max date.
+        resolved = (set(map(str, new.columns["date"]))
+                    | set(failures.keys()))
+        carried = [rec for rec in prior_ledger
+                   if rec.get("key") not in resolved]
+        if failures or carried:
+            failures.save(cache_path + ".failures.json", carried=carried)
+        else:  # nothing lost anywhere: drop the ledger
             import os
             ledger = cache_path + ".failures.json"
             if os.path.exists(ledger):

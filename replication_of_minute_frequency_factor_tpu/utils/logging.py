@@ -66,11 +66,17 @@ class FailureReport:
         lines += [f"  {f.key} ({f.source}): {f.error}" for f in self.failures]
         return "\n".join(lines)
 
-    def save(self, path: str) -> None:
+    def save(self, path: str, carried=()) -> None:
         """Write the ledger as JSON (one record per failed day) so a
-        skipped day is inspectable after the run, not just a log line."""
+        skipped day is inspectable after the run, not just a log line.
+
+        ``carried`` are prior-ledger records (dicts) for days this run
+        did NOT reattempt — they are still lost and must stay on the
+        ledger, or a later clean run would erase the only pointer
+        ``--retry-failed`` has to them."""
         import json
         with open(path, "w") as fh:
-            json.dump([{"key": f.key, "source": f.source, "error": f.error,
-                        "trace": f.trace} for f in self.failures], fh,
-                      indent=1)
+            json.dump(list(carried)
+                      + [{"key": f.key, "source": f.source,
+                          "error": f.error, "trace": f.trace}
+                         for f in self.failures], fh, indent=1)

@@ -124,9 +124,17 @@ def test_failed_day_is_skipped_and_reported(minute_dir, tmp_path):
     with open(cache + ".failures.json") as fh:
         rec = json.load(fh)
     assert rec[0]["key"] == str(bad) and "injected fault" in rec[0]["error"]
-    # a clean rerun clears the stale ledger
+    # a clean rerun does NOT reattempt the lost mid-history day (resume
+    # filters past the cache max), so the ledger carries forward — it is
+    # --retry-failed's only pointer to the day
     compute_exposures(minute_dir, NAMES, cfg=_cfg(), progress=False,
                       cache_path=cache)
+    with open(cache + ".failures.json") as fh:
+        assert [r["key"] for r in json.load(fh)] == [str(bad)]
+    # retry_failed recovers it; only then is the ledger cleared
+    t2 = compute_exposures(minute_dir, NAMES, cfg=_cfg(), progress=False,
+                           cache_path=cache, retry_failed=True)
+    assert str(bad) in set(map(str, t2.columns["date"]))
     assert not os.path.exists(cache + ".failures.json")
 
 
@@ -261,6 +269,56 @@ def test_failed_day_retry_semantics(minute_dir, tmp_path, rng):
                            progress=False)
     assert set(map(str, np.unique(t4.columns["date"]))) == {
         "2024-01-02", "2024-01-04"}
+
+    # the clean rerun must NOT erase the ledger: the lost day was not
+    # reattempted, and the ledger is --retry-failed's only pointer to it
+    import json
+    import os
+    with open(cache2 + ".failures.json") as fh:
+        assert [r["key"] for r in json.load(fh)] == ["2024-01-03"]
+
+    # retry_failed re-lists the ledger day and recovers it
+    t5 = compute_exposures(minute_dir, NAMES, cache_path=cache2,
+                           cfg=_cfg(), progress=False, retry_failed=True)
+    assert set(map(str, np.unique(t5.columns["date"]))) == {
+        "2024-01-02", "2024-01-03", "2024-01-04"}
+    assert not t5.failures
+    # everything recovered -> ledger gone; a further retry is a no-op
+    assert not os.path.exists(cache2 + ".failures.json")
+    t6 = compute_exposures(minute_dir, NAMES, cache_path=cache2,
+                           cfg=_cfg(), progress=False, retry_failed=True)
+    assert len(t6) == len(t5)
+
+
+def test_ledger_entry_survives_until_resolved(minute_dir, tmp_path):
+    """A ledger day the run cannot resolve (its file vanished, or the
+    run aborted before reaching it) must KEEP its entry — dropping it
+    would strand the day forever behind the resume filter. Malformed
+    ledgers are tolerated, not fatal."""
+    import json
+    cache = str(tmp_path / "f.parquet")
+    compute_exposures(minute_dir, NAMES, cache_path=cache, cfg=_cfg(),
+                      progress=False)
+    ledger = cache + ".failures.json"
+    phantom = [{"key": "2023-12-29", "source": "gone.parquet",
+                "error": "RuntimeError: old failure", "trace": ""}]
+    with open(ledger, "w") as fh:
+        json.dump(phantom, fh)
+    # retry run: the day's file no longer exists -> unresolved -> carried
+    t = compute_exposures(minute_dir, NAMES, cache_path=cache,
+                          cfg=_cfg(), progress=False, retry_failed=True)
+    assert not t.failures
+    with open(ledger) as fh:
+        assert [r["key"] for r in json.load(fh)] == ["2023-12-29"]
+    # malformed ledger: ignored with a warning, never a crash; the
+    # malformed content is replaced by this run's (empty) truth only if
+    # nothing is lost — here the bad entry is unparseable, so it drops
+    with open(ledger, "w") as fh:
+        fh.write('["2023-12-29"]')  # list of strings, not records
+    t = compute_exposures(minute_dir, NAMES, cache_path=cache,
+                          cfg=_cfg(), progress=False, retry_failed=True)
+    assert not t.failures
+    assert not os.path.exists(ledger)
 
 
 def test_concat_rejects_schema_drift():

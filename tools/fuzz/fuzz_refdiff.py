@@ -53,13 +53,16 @@ for seed in range(lo, hi):
                 rng_seed=seed,
                 future_days=int(rng.integers(1, 8)),
                 frequency=str(rng.choice(
-                    ["weekly", "monthly", "quarterly"])),
+                    ["weekly", "monthly", "quarterly", "yearly"])),
                 weight_param=rng.choice([None, "tmc", "cmc"]),
                 group_num=int(rng.integers(3, 8)),
-                n_codes=int(rng.integers(8, 25)),
-                n_days=int(rng.integers(40, 140)),
-                nan_prob=float(rng.choice([0.0, 0.05, 0.2])),
+                n_codes=int(rng.integers(5, 35)),
+                n_days=int(rng.integers(30, 220)),
+                nan_prob=float(rng.choice([0.0, 0.05, 0.2, 0.4])),
                 missing_row_prob=float(rng.choice([0.0, 0.05, 0.15])),
+                # rotate NaN provenance: null (parquet cache) vs value-
+                # NaN (polars 0/0 arithmetic) — the qcut_nan pin scenario
+                nan_as_value=bool(rng.integers(0, 2)),
             )
             if mism:
                 fails.append((seed, mism[:5]))

@@ -1,0 +1,24 @@
+#!/bin/sh
+# Refdiff fuzz campaign in short chunks (VERDICT r2 #4: ~10x the eval
+# and resampler/pipeline differential coverage). Each chunk runs under
+# the CPU-busy sentinel and stays well under tunnel_watch's 900 s
+# quiet-wait bound, so a tunnel-up window never has to choose between
+# waiting forever and timing a bench against a live fuzzer: the current
+# chunk drains, the sentinel drops, the capture fires on a quiet host.
+# Usage: tools/fuzz/run_refdiff_campaign.sh LO HI CHUNK LOG
+set -u
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+LO="$1"; HI="$2"; CHUNK="$3"; LOG="$4"
+lo="$LO"
+while [ "$lo" -lt "$HI" ]; do
+    hi=$((lo + CHUNK))
+    [ "$hi" -gt "$HI" ] && hi="$HI"
+    echo "=== chunk $lo..$hi $(date -u +%FT%TZ)" >> "$LOG"
+    "$REPO/tools/with_cpu_busy.sh" \
+        env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python "$REPO/tools/fuzz/fuzz_refdiff.py" "$lo" "$hi" \
+        >> "$LOG" 2>&1
+    lo="$hi"
+    sleep 20  # sentinel-free gap: lets a waiting tunnel capture start
+done
+echo "=== campaign $LO..$HI done $(date -u +%FT%TZ)" >> "$LOG"
