@@ -44,6 +44,29 @@ def make_days(rng):
     return bars.astype(np.float32), mask, fwd
 
 
+def recover_upratio(bars, mask):
+    """Plant a vol_upRatio-shaped signal (the reference's conditional-
+    volatility factor: std(ret | ret > 0) / std(ret),
+    MinuteFrequentFactorCalculateMethodsCICC.py:563-588) as the forward
+    return and let the GA on the ratio-of-aggregates skeleton recover a
+    reference-class expression — the round-3 genome extensions (value
+    masks + aggregators) make this family expressible at all."""
+    o = bars[..., 0].astype(np.float64)
+    c = bars[..., 3].astype(np.float64)
+    ret = np.where(mask, (c - o) / o, np.nan)
+    with np.errstate(invalid="ignore"):
+        num = np.nanstd(np.where(ret > 0, ret, np.nan), axis=-1, ddof=1)
+        den = np.nanstd(ret, axis=-1, ddof=1)
+    signal = num / den
+    fwd = np.nan_to_num(
+        signal - np.nanmean(signal, axis=-1, keepdims=True))
+    fwd_valid = np.isfinite(signal)
+    res = search.evolve(bars, mask, fwd.astype(np.float32), fwd_valid,
+                        pop=384, generations=8, seed=3,
+                        skeleton=search.RICH_SKELETON, device_batch=384)
+    return res
+
+
 def main(seed: int = 0) -> None:
     rng = np.random.default_rng(seed)
     bars, mask, fwd = make_days(rng)
@@ -57,6 +80,13 @@ def main(seed: int = 0) -> None:
           np.round(result.history, 3).tolist())
     print("best program:", search.describe(result.genome))
     assert result.fitness > 0.05, "search failed to find any signal"
+
+    print("\n-- planted vol_upRatio recovery (RICH_SKELETON) --")
+    res = recover_upratio(bars, mask)
+    print(f"best |IC| = {res.fitness:.3f}")
+    print("recovered:", search.describe(res.genome,
+                                        search.RICH_SKELETON))
+    assert res.fitness > 0.8, "failed to recover the planted factor"
 
 
 if __name__ == "__main__":

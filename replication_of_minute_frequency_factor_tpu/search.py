@@ -7,20 +7,34 @@ is data, not Python code, so 10k candidates batch onto the MXU instead of
 10k interpreter passes.
 
 Representation: every candidate shares a fixed postfix *skeleton* (a static
-sequence of PUSH/UNARY/BINARY slots, so stack discipline is valid by
-construction and the interpreter is a trace-time Python loop — no
-data-dependent control flow). A genome assigns each slot a choice:
+sequence of typed slots, so stack discipline is valid by construction and
+the interpreter is a trace-time Python loop — no data-dependent control
+flow). Stack entries are (series, validity-mask) pairs over the 240-minute
+axis; a genome assigns each slot a choice:
 
   PUSH   -> which per-bar feature series to push (open/.../volume, intrabar
-            return, volume share, hl-range, tod ramp)
+            return, volume share, hl-range, tod ramp), with the day mask
   UNARY  -> identity / neg / abs / log1p|x| / zscore over valid bars /
-            lag-1 / cumsum
-  BINARY -> + / - / * / protected divide / min / max
+            lag-1 / cumsum / delta-1 / rolling mean (5, 30) / rolling
+            std (5, 30) — windowed ops run masked over the minute axis
+  BINARY -> + / - / * / protected divide / min / max / rolling corr (30);
+            the result mask is the operands' intersection
+  MASK   -> restrict the validity mask: AM session / PM session / first 30
+            minutes / last 30 minutes (the reference's time sentinels,
+            e.g. MinuteFrequentFactorCalculateMethodsCICC.py:18,770) /
+            positive values / negative values (its conditional-volatility
+            split, :537-560)
+  AGG    -> reduce the series to a per-(day, ticker) scalar — mean / std /
+            sum / last / max / min — pushed back as a constant series so
+            aggregates compose through BINARY (ratio-of-stds factors like
+            vol_upRatio, :563-588)
 
 The factor value per (candidate, day, ticker) is the masked mean of the
-final series; fitness is |mean per-date cross-sectional Pearson IC| against
-caller-supplied forward returns. Selection/mutation/crossover run host-side
-on the int genome matrix (cheap); only evaluation touches the device.
+final entry under its own mask (a no-op repeat for AGG-terminated
+programs); fitness is |mean per-date cross-sectional Pearson IC| against
+caller-supplied forward returns. Selection/mutation/crossover run
+host-side on the int genome matrix (cheap); only evaluation touches the
+device.
 """
 
 from __future__ import annotations
@@ -34,13 +48,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from .data.minute import F_CLOSE, F_HIGH, F_LOW, F_OPEN, F_VOLUME
-from .ops import masked_corr, masked_mean, masked_std
+from .ops import (masked_corr, masked_last, masked_max, masked_mean,
+                  masked_min, masked_std, masked_sum)
 
 # slot kinds
-PUSH, UNARY, BINARY = 0, 1, 2
+PUSH, UNARY, BINARY, MASK, AGG = 0, 1, 2, 3, 4
 
 #: default skeleton: (((f u) (f u) b u) ((f) (f) b) b u) — depth-3 tree,
-#: 6 feature leaves worth of mixing, 14 slots
+#: 6 feature leaves worth of mixing, 15 slots (round-2 compatible)
 DEFAULT_SKELETON: Tuple[int, ...] = (
     PUSH, UNARY, PUSH, UNARY, BINARY, UNARY,
     PUSH, PUSH, BINARY,
@@ -49,9 +64,28 @@ DEFAULT_SKELETON: Tuple[int, ...] = (
     BINARY, UNARY,
 )
 
+#: ratio-of-aggregates skeleton: agg(mask(u(f))) ⊘ agg(u(f)) — the shape
+#: of the reference's conditional-volatility family (vol_upRatio ==
+#: std(ret | ret > 0) / std(ret), MinuteFrequentFactorCalculate
+#: MethodsCICC.py:563-588), reachable by the genome as
+#: (ret, id, pos, std, ret, id, std, /)
+RICH_SKELETON: Tuple[int, ...] = (
+    PUSH, UNARY, MASK, AGG,
+    PUSH, UNARY, AGG,
+    BINARY,
+)
+
 N_FEATURES = 9
-N_UNARY = 7
-N_BINARY = 6
+N_UNARY = 12
+N_BINARY = 7
+N_MASK = 6
+N_AGG = 6
+
+_KIND_SIZES = {PUSH: N_FEATURES, UNARY: N_UNARY, BINARY: N_BINARY,
+               MASK: N_MASK, AGG: N_AGG}
+
+#: rolling windows baked into the unary/binary op tables
+ROLL_FAST, ROLL_SLOW = 5, 30
 
 
 def _features(bars, mask):
@@ -71,6 +105,61 @@ def _features(bars, mask):
     return jnp.stack([o, h, l, c, v, ret, vshare, hlr, tod])
 
 
+def _windowed_sum(x, w):
+    """Trailing-window sum over the minute axis (window w, causal)."""
+    cs = jnp.cumsum(x, axis=-1)
+    return cs - jnp.concatenate(
+        [jnp.zeros_like(cs[..., :w]), cs[..., :-w]], axis=-1)
+
+
+def rolling_mean(x, m, w):
+    """Masked trailing mean over ``w`` minute slots; 0 where the window
+    holds no valid bars (mask is unchanged — windowed ops smooth the
+    series, they do not invalidate lanes)."""
+    s = _windowed_sum(jnp.where(m, x, 0.0), w)
+    n = _windowed_sum(m.astype(x.dtype), w)
+    return jnp.where(n > 0, s / jnp.maximum(n, 1.0), 0.0)
+
+
+def rolling_std(x, m, w):
+    """Masked trailing std (ddof=0) over ``w`` slots; 0 where the window
+    holds no valid bars.
+
+    The series is centred on its day mean first (shift invariance):
+    one-pass E[x^2]-E[x]^2 in f32 on raw ~10-CNY prices cancels
+    catastrophically (x^2 ~ 100 vs 1e-3-scale deviations), the same
+    reason ops/rolling.py centres its windows."""
+    xc = jnp.where(m, x - masked_mean(x, m)[..., None], 0.0)
+    n = _windowed_sum(m.astype(x.dtype), w)
+    nn = jnp.maximum(n, 1.0)
+    mu = _windowed_sum(xc, w) / nn
+    m2 = _windowed_sum(xc * xc, w) / nn
+    return jnp.sqrt(jnp.maximum(m2 - mu * mu, 0.0))
+
+
+def rolling_corr(a, b, m, w):
+    """Masked trailing Pearson over ``w`` slots; 0 where degenerate
+    (either variance 0, or fewer than 2 valid bars in the window).
+    Day-mean centring as in rolling_std (correlation is shift-invariant;
+    raw one-pass moments cancel catastrophically in f32)."""
+    ac = jnp.where(m, a - masked_mean(a, m)[..., None], 0.0)
+    bc = jnp.where(m, b - masked_mean(b, m)[..., None], 0.0)
+    n = _windowed_sum(m.astype(a.dtype), w)
+    nn = jnp.maximum(n, 1.0)
+    sa = _windowed_sum(ac, w) / nn
+    sb = _windowed_sum(bc, w) / nn
+    sab = _windowed_sum(ac * bc, w) / nn
+    saa = _windowed_sum(ac * ac, w) / nn
+    sbb = _windowed_sum(bc * bc, w) / nn
+    cov = sab - sa * sb
+    va = jnp.maximum(saa - sa * sa, 0.0)
+    vb = jnp.maximum(sbb - sb * sb, 0.0)
+    denom = jnp.sqrt(va * vb)
+    ok = (denom > 0) & (n > 1.5)
+    r = jnp.where(ok, cov / jnp.where(ok, denom, 1.0), 0.0)
+    return jnp.clip(r, -1.0, 1.0)  # f32 noise can push an exact fit past 1
+
+
 def _apply_unary(k, x, mask):
     z_mu = masked_mean(x, mask)
     z_sd = masked_std(x, mask)
@@ -85,11 +174,16 @@ def _apply_unary(k, x, mask):
         z,
         lag,
         jnp.cumsum(jnp.where(mask, x, 0.0), axis=-1),
+        x - lag,
+        rolling_mean(x, mask, ROLL_FAST),
+        rolling_mean(x, mask, ROLL_SLOW),
+        rolling_std(x, mask, ROLL_FAST),
+        rolling_std(x, mask, ROLL_SLOW),
     ]
     return jnp.select([k == i for i in range(N_UNARY)], branches, x)
 
 
-def _apply_binary(k, a, b):
+def _apply_binary(k, a, b, mask):
     eps = 1e-6
     branches = [
         a + b,
@@ -98,8 +192,47 @@ def _apply_binary(k, a, b):
         a / jnp.where(jnp.abs(b) > eps, b, jnp.where(b >= 0, eps, -eps)),
         jnp.minimum(a, b),
         jnp.maximum(a, b),
+        rolling_corr(a, b, mask, ROLL_SLOW),
     ]
     return jnp.select([k == i for i in range(N_BINARY)], branches, a)
+
+
+def _slot_index(mask):
+    """Minute-slot index [0, 240) broadcast to the mask's shape."""
+    return jnp.broadcast_to(jnp.arange(mask.shape[-1]), mask.shape)
+
+
+def _apply_mask(k, x, mask):
+    """Mask-restriction primitives; values pass through untouched.
+
+    Slots mirror the reference's hard-coded time sentinels (AM/PM split
+    at 11:30, first/last half hour) and its conditional value splits
+    (positive/negative returns)."""
+    slot = _slot_index(mask)
+    branches = [
+        mask & (slot < 120),            # AM session
+        mask & (slot >= 120),           # PM session
+        mask & (slot < 30),             # first 30 minutes
+        mask & (slot >= mask.shape[-1] - 30),  # last 30 minutes
+        mask & (x > 0),                 # positive values
+        mask & (x < 0),                 # negative values
+    ]
+    return jnp.select([k == i for i in range(N_MASK)], branches, mask)
+
+
+def _apply_agg(k, x, mask):
+    """Reduce to a per-(day, ticker) scalar; NaN where no valid bars
+    (masked_* semantics), so a halted ticker stays NaN end to end."""
+    branches = [
+        masked_mean(x, mask),
+        masked_std(x, mask),
+        masked_sum(x, mask),
+        masked_last(x, mask),
+        masked_max(x, mask),
+        masked_min(x, mask),
+    ]
+    return jnp.select([k == i for i in range(N_AGG)], branches,
+                      branches[0])
 
 
 def eval_programs(genomes, bars, mask,
@@ -108,32 +241,52 @@ def eval_programs(genomes, bars, mask,
 
     genomes: int32 ``[P, L]``; bars ``[D, T, 240, 5]``; mask ``[D, T, 240]``.
     Returns factor values ``[P, D, T]`` (masked mean of each candidate's
-    final series; NaN where a ticker has no bars).
+    final series under its own final mask; NaN where that mask is empty —
+    halted tickers, or a MASK chain that filtered everything out).
     """
     feats = _features(bars, mask)  # [F, D, T, 240]
 
     def one(genome):
-        stack = []
+        stack = []  # entries: (series [D, T, 240], mask [D, T, 240])
         for slot, kind in enumerate(skeleton):
             g = genome[slot]
             if kind == PUSH:
-                stack.append(jnp.take(feats, g, axis=0))
+                stack.append((jnp.take(feats, g, axis=0), mask))
             elif kind == UNARY:
-                stack.append(_apply_unary(g, stack.pop(), mask))
+                x, m = stack.pop()
+                stack.append((_apply_unary(g, x, m), m))
+            elif kind == BINARY:
+                xb, mb = stack.pop()
+                xa, ma = stack.pop()
+                m = ma & mb
+                stack.append((_apply_binary(g, xa, xb, m), m))
+            elif kind == MASK:
+                x, m = stack.pop()
+                stack.append((x, _apply_mask(g, x, m)))
+            elif kind == AGG:
+                x, m = stack.pop()
+                s = _apply_agg(g, x, m)  # [D, T]
+                # push back as a constant series under the DAY mask so
+                # aggregates compose through BINARY with real series
+                stack.append((jnp.broadcast_to(s[..., None], mask.shape),
+                              mask))
             else:
-                b = stack.pop()
-                a = stack.pop()
-                stack.append(_apply_binary(g, a, b))
+                raise ValueError(f"unknown slot kind {kind}")
         assert len(stack) == 1, "malformed skeleton"
-        return masked_mean(stack[0], mask)  # [D, T]
+        x, m = stack[0]
+        return masked_mean(x, m)  # [D, T]
 
     return jax.vmap(one)(genomes)
 
 
-#: auto-chunk budget: per-candidate stack temporaries are ``[D, T, 240]``
-#: and the interpreter keeps ~8 of them alive, so cap each vmapped chunk
-#: at this many f32 elements per temporary (128M = 512 MB -> ~4 GB live)
-_CHUNK_ELEMS = 128 * 1024 * 1024
+#: auto-chunk budget: per-candidate stack temporaries are ``[D, T, 240]``;
+#: ``jnp.select`` materialises EVERY branch of a slot's op table, and the
+#: round-3 tables are wider (12 unary incl. 4 rolling ops with their
+#: cumsum/count intermediates, 7 binary incl. rolling corr's ~10), so
+#: budget for ~30 live temporaries instead of round-2's ~8: cap each
+#: vmapped chunk at this many f32 elements per temporary
+#: (32M = 128 MB -> ~4 GB live worst-case on a 16 GB chip)
+_CHUNK_ELEMS = 32 * 1024 * 1024
 
 
 def auto_chunk(mask_shape) -> int:
@@ -181,9 +334,7 @@ def fitness(genomes, bars, mask, fwd_ret, fwd_valid,
 
 
 def _gene_bounds(skeleton):
-    return np.array([
-        {PUSH: N_FEATURES, UNARY: N_UNARY, BINARY: N_BINARY}[k]
-        for k in skeleton], np.int32)
+    return np.array([_KIND_SIZES[k] for k in skeleton], np.int32)
 
 
 @dataclasses.dataclass
@@ -245,21 +396,34 @@ def evolve(bars, mask, fwd_ret, fwd_valid,
                         history=np.asarray(history))
 
 
+FEAT_NAMES = ["open", "high", "low", "close", "vol", "ret", "vshare",
+              "hlr", "tod"]
+UNARY_NAMES = ["id", "neg", "abs", "log1p", "z", "lag1", "cumsum",
+               "delta1", f"rmean{ROLL_FAST}", f"rmean{ROLL_SLOW}",
+               f"rstd{ROLL_FAST}", f"rstd{ROLL_SLOW}"]
+BINARY_NAMES = ["+", "-", "*", "/", "min", "max", f"rcorr{ROLL_SLOW}"]
+MASK_NAMES = ["am", "pm", "first30", "last30", "pos", "neg"]
+AGG_NAMES = ["mean", "std", "sum", "last", "max", "min"]
+
+
 def describe(genome, skeleton=DEFAULT_SKELETON) -> str:
     """Human-readable postfix rendering of a genome."""
-    feats = ["open", "high", "low", "close", "vol", "ret", "vshare",
-             "hlr", "tod"]
-    una = ["id", "neg", "abs", "log1p", "z", "lag1", "cumsum"]
-    bina = ["+", "-", "*", "/", "min", "max"]
     stack = []
     for slot, kind in enumerate(skeleton):
         g = int(genome[slot])
         if kind == PUSH:
-            stack.append(feats[g])
+            stack.append(FEAT_NAMES[g])
         elif kind == UNARY:
-            stack.append(f"{una[g]}({stack.pop()})")
-        else:
+            stack.append(f"{UNARY_NAMES[g]}({stack.pop()})")
+        elif kind == BINARY:
             b = stack.pop()
             a = stack.pop()
-            stack.append(f"({a} {bina[g]} {b})")
+            if BINARY_NAMES[g].startswith("rcorr"):
+                stack.append(f"{BINARY_NAMES[g]}({a}, {b})")
+            else:
+                stack.append(f"({a} {BINARY_NAMES[g]} {b})")
+        elif kind == MASK:
+            stack.append(f"{stack.pop()}[{MASK_NAMES[g]}]")
+        elif kind == AGG:
+            stack.append(f"{AGG_NAMES[g]}({stack.pop()})")
     return f"mean({stack[0]})"

@@ -87,10 +87,11 @@ def test_auto_chunk_derivation():
     chunk = search.auto_chunk(ladder_shape)
     assert chunk == search._CHUNK_ELEMS // (1000 * 240)
     assert chunk < 10_000  # the OOM config takes the chunked path
-    # ~8 live [chunk, D, T, 240] f32 temporaries must fit a 16 GB chip
-    assert chunk * 1000 * 240 * 4 * 8 < 16e9
+    # ~30 live [chunk, D, T, 240] f32 temporaries (the round-3 op tables
+    # under jnp.select's materialise-all-branches) must fit a 16 GB chip
+    assert chunk * 1000 * 240 * 4 * 30 < 16e9
     # tiny day tensors stay unchunked; degenerate shapes never hit 0
-    assert search.auto_chunk((3, 40, 240)) > 4000
+    assert search.auto_chunk((3, 40, 240)) > 1000
     assert search.auto_chunk((244, 5000, 240)) == 1
 
 
@@ -104,3 +105,148 @@ def test_fitness_auto_chunk_executes(day_batch, rng):
     explicit = np.asarray(search.fitness(pop, bars, mask, fwd, fwd_valid,
                                          chunk=64))
     np.testing.assert_allclose(auto, explicit, rtol=1e-5, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# round-3 genome extensions: windowed ops, time/value masks, aggregators
+# (VERDICT r2 #6 — the fixed-skeleton genome could not express most of
+# the reference's factor families)
+
+
+def _np_windowed(x, m, w, stat):
+    """Independent trailing-window oracle, f64."""
+    D, T, L = x.shape
+    out = np.zeros((D, T, L))
+    for i in range(L):
+        lo = max(0, i - w + 1)
+        xs = x[..., lo:i + 1]
+        ms = m[..., lo:i + 1]
+        n = ms.sum(-1)
+        s = np.where(ms, xs, 0.0).sum(-1)
+        if stat == "mean":
+            out[..., i] = np.where(n > 0, s / np.maximum(n, 1), 0.0)
+        elif stat == "std":
+            mu = s / np.maximum(n, 1)
+            m2 = np.where(ms, xs * xs, 0.0).sum(-1) / np.maximum(n, 1)
+            out[..., i] = np.where(
+                n > 0, np.sqrt(np.maximum(m2 - mu * mu, 0.0)), 0.0)
+    return out
+
+
+def test_rolling_unary_ops_match_numpy(day_batch):
+    bars, mask = day_batch
+    x = bars[..., 3].astype(np.float64)  # close
+    for k, (w, stat) in {8: (search.ROLL_FAST, "mean"),
+                         9: (search.ROLL_SLOW, "mean"),
+                         10: (search.ROLL_FAST, "std"),
+                         11: (search.ROLL_SLOW, "std")}.items():
+        got = np.asarray(search._apply_unary(
+            np.int32(k), x.astype(np.float32), mask))
+        want = _np_windowed(x, mask, w, stat)
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_rolling_corr_matches_numpy(day_batch):
+    bars, mask = day_batch
+    a = bars[..., 3].astype(np.float64)
+    b = bars[..., 4].astype(np.float64)
+    got = np.asarray(search.rolling_corr(
+        a.astype(np.float32), b.astype(np.float32), mask,
+        search.ROLL_SLOW))
+    # independent windowed pearson
+    D, T, L = a.shape
+    w = search.ROLL_SLOW
+    want = np.zeros((D, T, L))
+    for i in range(L):
+        lo = max(0, i - w + 1)
+        ms = mask[..., lo:i + 1]
+        n = ms.sum(-1)
+        for d in range(D):
+            for t in range(T):
+                if n[d, t] < 2:
+                    continue
+                av = a[d, t, lo:i + 1][ms[d, t]]
+                bv = b[d, t, lo:i + 1][ms[d, t]]
+                da = av - av.mean()
+                db = bv - bv.mean()
+                den = np.sqrt((da * da).mean() * (db * db).mean())
+                if den > 0:
+                    want[d, t, i] = (da * db).mean() / den
+    # f32 cumsum-vs-two-pass noise on near-constant windows: compare
+    # where the result is away from the degenerate gate
+    far = np.abs(want) > 1e-3
+    np.testing.assert_allclose(got[far], want[far], rtol=0.05, atol=5e-3)
+    assert np.all(np.abs(got) <= 1.0 + 1e-5)
+
+
+def test_mask_primitives(day_batch):
+    bars, mask = day_batch
+    ret = (bars[..., 3] - bars[..., 0]) / bars[..., 0]
+    for k, want in {
+        0: mask & (np.arange(240) < 120),
+        1: mask & (np.arange(240) >= 120),
+        2: mask & (np.arange(240) < 30),
+        3: mask & (np.arange(240) >= 210),
+        4: mask & (ret > 0),
+        5: mask & (ret < 0),
+    }.items():
+        got = np.asarray(search._apply_mask(np.int32(k), ret, mask))
+        np.testing.assert_array_equal(got, want, err_msg=f"mask op {k}")
+
+
+def test_agg_primitives_and_composition(day_batch):
+    """AGG reduces under the entry mask and composes through BINARY:
+    the vol_upRatio shape std(ret|ret>0)/std(ret) evaluates correctly."""
+    bars, mask = day_batch
+    o = bars[..., 0].astype(np.float64)
+    c = bars[..., 3].astype(np.float64)
+    ret = (c - o) / o
+    # genome on RICH_SKELETON: (ret, id, pos, std, ret, id, std, /)
+    genome = np.array([[5, 0, 4, 1, 5, 0, 1, 3]], np.int32)
+    got = np.asarray(search.eval_programs(
+        genome, bars, mask, search.RICH_SKELETON))[0]
+
+    def np_std1(v):
+        return np.std(v, ddof=1) if v.size >= 2 else np.nan
+
+    D, T = mask.shape[:2]
+    want = np.full((D, T), np.nan)
+    for d in range(D):
+        for t in range(T):
+            r = ret[d, t][mask[d, t]]
+            up = r[r > 0]
+            den = np_std1(r)
+            num = np_std1(up)
+            if np.isfinite(den) and den > 1e-6 and np.isfinite(num):
+                want[d, t] = num / den
+    ok = np.isfinite(want)
+    assert ok.any()
+    np.testing.assert_allclose(got[ok], want[ok], rtol=2e-3, atol=1e-5)
+    # describe renders the same program readably
+    s = search.describe(genome[0], search.RICH_SKELETON)
+    assert s == "mean((std(id(ret)[pos]) / std(id(ret))))"
+
+
+def test_rich_skeleton_recovers_planted_upratio(day_batch, rng):
+    """Plant a vol_upRatio-shaped forward return; the GA on the
+    ratio-of-aggregates skeleton must find a high-IC program
+    (VERDICT r2 #6's named recovery demonstration, in-test form)."""
+    bars, mask = day_batch
+    o = bars[..., 0].astype(np.float64)
+    c = bars[..., 3].astype(np.float64)
+    ret = np.where(mask, (c - o) / o, np.nan)
+    with np.errstate(invalid="ignore"):
+        up = np.where(ret > 0, ret, np.nan)
+        num = np.nanstd(up, axis=-1, ddof=1)
+        den = np.nanstd(ret, axis=-1, ddof=1)
+    signal = num / den
+    fwd = np.nan_to_num(signal - np.nanmean(signal, axis=-1,
+                                            keepdims=True))
+    fwd_valid = np.isfinite(signal)
+
+    res = search.evolve(bars.astype(np.float32), mask,
+                        fwd.astype(np.float32), fwd_valid,
+                        pop=384, generations=8, seed=3,
+                        skeleton=search.RICH_SKELETON, device_batch=384)
+    assert res.fitness > 0.8, search.describe(res.genome,
+                                              search.RICH_SKELETON)

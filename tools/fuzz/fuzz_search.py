@@ -56,6 +56,54 @@ def np_masked_std(x, m, ddof=1):
                                 np.float32(np.nan))).astype(np.float32)
 
 
+def np_windowed_sum(x, w):
+    cs = np.cumsum(x, axis=-1, dtype=np.float32)
+    shifted = np.concatenate(
+        [np.zeros_like(cs[..., :w]), cs[..., :-w]], axis=-1)
+    return cs - shifted
+
+
+def np_rolling_mean(x, m, w):
+    s = np_windowed_sum(np.where(m, x, np.float32(0.0)), w)
+    n = np_windowed_sum(m.astype(np.float32), w)
+    return np.where(n > 0, s / np.maximum(n, np.float32(1.0)),
+                    np.float32(0.0)).astype(np.float32)
+
+
+def np_rolling_std(x, m, w):
+    xc = np.where(m, x - np_masked_mean(x, m)[..., None],
+                  np.float32(0.0)).astype(np.float32)
+    n = np_windowed_sum(m.astype(np.float32), w)
+    nn = np.maximum(n, np.float32(1.0))
+    mu = np_windowed_sum(xc, w) / nn
+    m2 = np_windowed_sum(xc * xc, w) / nn
+    return np.sqrt(np.maximum(m2 - mu * mu,
+                              np.float32(0.0))).astype(np.float32)
+
+
+def np_rolling_corr(a, b, m, w):
+    ac = np.where(m, a - np_masked_mean(a, m)[..., None],
+                  np.float32(0.0)).astype(np.float32)
+    bc = np.where(m, b - np_masked_mean(b, m)[..., None],
+                  np.float32(0.0)).astype(np.float32)
+    n = np_windowed_sum(m.astype(np.float32), w)
+    nn = np.maximum(n, np.float32(1.0))
+    sa = np_windowed_sum(ac, w) / nn
+    sb = np_windowed_sum(bc, w) / nn
+    sab = np_windowed_sum(ac * bc, w) / nn
+    saa = np_windowed_sum(ac * ac, w) / nn
+    sbb = np_windowed_sum(bc * bc, w) / nn
+    cov = sab - sa * sb
+    va = np.maximum(saa - sa * sa, np.float32(0.0))
+    vb = np.maximum(sbb - sb * sb, np.float32(0.0))
+    denom = np.sqrt(va * vb)
+    ok = (denom > 0) & (n > 1.5)
+    with np.errstate(invalid="ignore", divide="ignore"):
+        r = np.where(ok, cov / np.where(ok, denom, np.float32(1.0)),
+                     np.float32(0.0))
+    return np.clip(r, -1.0, 1.0).astype(np.float32)
+
+
 def np_unary(k, x, m, flag=None):
     mu = np_masked_mean(x, m)
     sd = np_masked_std(x, m)
@@ -78,7 +126,12 @@ def np_unary(k, x, m, flag=None):
     return [x, -x, np.abs(x), np.log1p(np.abs(x)).astype(np.float32),
             z.astype(np.float32), lag,
             np.cumsum(np.where(m, x, np.float32(0.0)), axis=-1,
-                      dtype=np.float32)][k]
+                      dtype=np.float32),
+            (x - lag).astype(np.float32),
+            np_rolling_mean(x, m, search.ROLL_FAST),
+            np_rolling_mean(x, m, search.ROLL_SLOW),
+            np_rolling_std(x, m, search.ROLL_FAST),
+            np_rolling_std(x, m, search.ROLL_SLOW)][k]
 
 
 def np_binary(k, a, b, m=None, flag=None, scale=None):
@@ -100,7 +153,34 @@ def np_binary(k, a, b, m=None, flag=None, scale=None):
                 (a / np.where(np.abs(b) > eps, b,
                               np.where(b >= 0, eps,
                                        -eps))).astype(np.float32),
-                np.minimum(a, b), np.maximum(a, b)][k]
+                np.minimum(a, b), np.maximum(a, b),
+                np_rolling_corr(a, b, m, search.ROLL_SLOW)][k]
+
+
+def np_mask(k, x, m):
+    slot = np.arange(m.shape[-1])
+    return [m & (slot < 120), m & (slot >= 120), m & (slot < 30),
+            m & (slot >= m.shape[-1] - 30),
+            m & (x > 0), m & (x < 0)][k]
+
+
+def np_agg(k, x, m):
+    """Masked scalar aggregators, f32, mirroring ops.masked semantics
+    (empty mean/std/last/max/min -> NaN; empty sum -> 0; std n<2 NaN)."""
+    n = m.sum(-1)
+    with np.errstate(invalid="ignore"):
+        mean = np_masked_mean(x, m)
+        std = np_masked_std(x, m)
+        ssum = np.where(m, x, np.float32(0.0)).sum(-1, dtype=np.float32)
+        idx_last = np.where(
+            m.any(-1), m.shape[-1] - 1 - np.argmax(m[..., ::-1], -1), 0)
+        last = np.where(m.any(-1),
+                        np.take_along_axis(x, idx_last[..., None],
+                                           -1)[..., 0], np.nan)
+        mx = np.where(n > 0, np.where(m, x, -np.inf).max(-1), np.nan)
+        mn = np.where(n > 0, np.where(m, x, np.inf).min(-1), np.nan)
+    return [mean, std, ssum.astype(np.float32), last.astype(np.float32),
+            mx.astype(np.float32), mn.astype(np.float32)][k]
 
 
 _EPS32 = np.float64(np.finfo(np.float32).eps)
@@ -122,7 +202,7 @@ def np_eval(genome, bars, mask, skeleton):
     divide-by-zscore chains, conditioning the flat 2e-3 bound cannot
     see). Propagation runs in f64 on the f32 values, lanewise."""
     feats = np_features(bars, mask)
-    stack = []
+    stack = []  # entries: (x [D,T,240] f32, m [D,T,240] bool)
     errs = []   # per-slot f64 [D, T, 240] disagreement bounds
     scale = np.zeros(mask.shape[:-1], np.float64)
     degenerate = np.zeros(mask.shape[:-1], bool)
@@ -137,27 +217,43 @@ def np_eval(genome, bars, mask, skeleton):
     def a64(x):
         return np.abs(x.astype(np.float64))
 
-    n_valid = np.maximum(mask.sum(-1), 1)
+    def wsum64(x, w):
+        cs = np.cumsum(x, axis=-1, dtype=np.float64)
+        sh = np.concatenate([np.zeros_like(cs[..., :w]), cs[..., :-w]],
+                            axis=-1)
+        return cs - sh
+
+    def roll_err(x, m, ex, r, w):
+        """Shared bound for the cumsum-implemented rolling ops: upstream
+        error averages through the window; the cumsum trick adds reorder
+        noise proportional to the running l1 mass (same structure as the
+        plain-cumsum rule)."""
+        n = np.maximum(wsum64(m.astype(np.float64), w), 1.0)
+        xc64 = np.where(m, a64(x), 0.0)
+        l1 = np.cumsum(xc64, axis=-1)  # running mass bound for cs noise
+        return (wsum64(np.where(m, ex, 0.0), w) / n
+                + _EPS32 * 8.0 * l1 / n + _EPS32 * a64(r))
 
     with np.errstate(invalid="ignore", divide="ignore", over="ignore"):
         for slot, kind in enumerate(skeleton):
             g = int(genome[slot])
             if kind == search.PUSH:
                 x = see(feats[g])
-                stack.append(x)
+                stack.append((x, mask))
                 errs.append(4 * _EPS32 * a64(x))
             elif kind == search.UNARY:
-                x = stack.pop()
+                x, m = stack.pop()
                 ex = errs.pop()
-                r = see(np_unary(g, x, mask, flag=degenerate))
+                r = see(np_unary(g, x, m, flag=degenerate))
                 if g == 3:    # log1p|x|: derivative 1/(1+|x|) contracts
                     er = ex / (1.0 + a64(x)) + _EPS32 * a64(r)
                 elif g == 4:  # zscore: (x-mu)/sd amplifies by 1/sd
-                    mu = np_masked_mean(x, mask).astype(np.float64)
-                    sd = np_masked_std(x, mask).astype(np.float64)
-                    xm = np.where(mask, a64(x), 0.0)
-                    e_mu = (np.where(mask, ex, 0.0).sum(-1)
-                            + _EPS32 * xm.sum(-1)) / n_valid
+                    mu = np_masked_mean(x, m).astype(np.float64)
+                    sd = np_masked_std(x, m).astype(np.float64)
+                    xm = np.where(m, a64(x), 0.0)
+                    nv = np.maximum(m.sum(-1), 1)
+                    e_mu = (np.where(m, ex, 0.0).sum(-1)
+                            + _EPS32 * xm.sum(-1)) / nv
                     e_sd = e_mu  # same cancellation structure
                     sd_f = np.where(sd > 0, sd, 1.0)[..., None]
                     er = ((ex + e_mu[..., None]
@@ -167,19 +263,41 @@ def np_eval(genome, bars, mask, skeleton):
                     er = np.concatenate([ex[..., :1], ex[..., :-1]], -1)
                 elif g == 6:  # cumsum: errors accumulate + reorder noise
                     r64 = np.nan_to_num(a64(r))
-                    er = (np.cumsum(np.where(mask, ex, 0.0), -1)
+                    er = (np.cumsum(np.where(m, ex, 0.0), -1)
                           + _EPS32 * np.maximum.accumulate(r64, -1)
                           * np.arange(1, r.shape[-1] + 1))
+                elif g == 7:  # delta1 = x - lag1
+                    er = (ex
+                          + np.concatenate([ex[..., :1], ex[..., :-1]],
+                                           -1) + _EPS32 * a64(r))
+                elif g in (8, 9):   # rolling mean
+                    w = (search.ROLL_FAST if g == 8 else search.ROLL_SLOW)
+                    er = roll_err(x, m, ex, r, w)
+                elif g in (10, 11):  # rolling std: sqrt conditioning
+                    w = (search.ROLL_FAST if g == 10
+                         else search.ROLL_SLOW)
+                    # m2's bound, then |Δsqrt| <= Δm2/(2 sqrt(m2)) with a
+                    # sqrt(Δm2) floor where m2 is within its own noise
+                    sc = np.where(m, a64(x - np_masked_mean(x, m)
+                                         [..., None]), 0.0).max(-1)
+                    e_m2 = (2.0 * sc[..., None] * roll_err(x, m, ex, r, w)
+                            + _EPS32 * 8.0 * sc[..., None] ** 2)
+                    r64 = a64(r)
+                    er = np.where(r64 > np.sqrt(e_m2),
+                                  e_m2 / np.maximum(2.0 * r64, 1e-300),
+                                  np.sqrt(e_m2))
                 else:         # id / neg / abs
                     er = ex + _EPS32 * a64(r)
-                stack.append(r)
-                errs.append(er)
-            else:
-                b = stack.pop()
-                a = stack.pop()
+                stack.append((r, m))
+                errs.append(np.nan_to_num(er, nan=np.inf, posinf=np.inf,
+                                          neginf=np.inf))
+            elif kind == search.BINARY:
+                b, mb = stack.pop()
+                a, ma = stack.pop()
+                m = ma & mb
                 eb = errs.pop()
                 ea = errs.pop()
-                r = see(np_binary(g, a, b, mask, flag=degenerate,
+                r = see(np_binary(g, a, b, m, flag=degenerate,
                                   scale=scale))
                 if g == 2:    # mul
                     er = a64(a) * eb + a64(b) * ea + _EPS32 * a64(r)
@@ -189,21 +307,97 @@ def np_eval(genome, bars, mask, skeleton):
                     er = (ea + a64(r) * eb) / babs + _EPS32 * a64(r)
                     # divisor within its own noise of the gate/zero:
                     # branch and sign are implementation-dependent
-                    near = mask & (a64(b) <= gate + eb)
+                    near = m & (a64(b) <= gate + eb)
                     degenerate |= near.any(axis=-1)
                 elif g in (4, 5):  # min/max: flips stay within ea+eb
                     er = ea + eb
+                elif g == 6:  # rolling corr: bounded, denominator-gated
+                    w = search.ROLL_SLOW
+                    # lanes where ANY window's denominator sits within
+                    # upstream noise of 0 flip the ok-gate between
+                    # implementations -> incomparable
+                    ac = np.where(m, a - np_masked_mean(a, m)[..., None],
+                                  np.float32(0.0))
+                    bc = np.where(m, b - np_masked_mean(b, m)[..., None],
+                                  np.float32(0.0))
+                    n_w = wsum64(m.astype(np.float64), w)
+                    nn = np.maximum(n_w, 1.0)
+                    va = np.maximum(
+                        wsum64(np.where(m, a64(ac) ** 2, 0.0), w) / nn
+                        - (wsum64(np.where(m, ac.astype(np.float64), 0.0),
+                                  w) / nn) ** 2, 0.0)
+                    vb = np.maximum(
+                        wsum64(np.where(m, a64(bc) ** 2, 0.0), w) / nn
+                        - (wsum64(np.where(m, bc.astype(np.float64), 0.0),
+                                  w) / nn) ** 2, 0.0)
+                    denom = np.sqrt(va * vb)
+                    sc_a = np.where(m, a64(ac), 0.0).max(-1)[..., None]
+                    sc_b = np.where(m, a64(bc), 0.0).max(-1)[..., None]
+                    e_den = (2.0 * sc_a * sc_b + sc_a ** 2 + sc_b ** 2) \
+                        * _EPS32 * 16.0 \
+                        + 2.0 * (sc_a * roll_err(b, m, eb, r, w)
+                                 + sc_b * roll_err(a, m, ea, r, w))
+                    flip = m & (n_w > 1.5) & (denom <= e_den)
+                    degenerate |= flip.any(axis=-1)
+                    den_f = np.maximum(denom, 1e-300)
+                    er = np.where(
+                        denom > 0,
+                        (sc_b * ea + sc_a * eb) * 4.0 / den_f
+                        + _EPS32 * 64.0, 0.0)
                 else:         # add / sub
                     er = ea + eb + _EPS32 * a64(r)
-                stack.append(r)
+                stack.append((r, m))
                 # NaN error (from inf-inf etc.) means "unbounded"; keep
                 # real infs as inf too so e_fin goes non-finite and the
                 # comparison falls back to the flat scale bound
                 errs.append(np.nan_to_num(er, nan=np.inf, posinf=np.inf,
                                           neginf=np.inf))
-        e_fin = np.where(mask, errs[0], 0.0).sum(-1) / n_valid \
+            elif kind == search.MASK:
+                x, m = stack.pop()
+                ex = errs[-1]
+                if g in (4, 5):
+                    # pos/neg: a value within its own noise of 0 flips
+                    # membership between implementations
+                    near = m & (a64(x) <= ex)
+                    degenerate |= near.any(axis=-1)
+                stack.append((x, np_mask(g, x, m)))
+            elif kind == search.AGG:
+                x, m = stack.pop()
+                ex = errs.pop()
+                s = np_agg(g, x, m)  # [D, T]
+                nv = np.maximum(m.sum(-1), 1)
+                xm = np.where(m, a64(x), 0.0)
+                e_mu = (np.where(m, ex, 0.0).sum(-1)
+                        + _EPS32 * xm.sum(-1)) / nv
+                if g == 0:      # mean
+                    e_s = e_mu
+                elif g == 1:    # std: same cancellation structure as mu;
+                    e_s = 2.0 * e_mu + _EPS32 * xm.max(-1)
+                    # near-zero std under noise: value fine (both ~0)
+                elif g == 2:    # sum
+                    e_s = (np.where(m, ex, 0.0).sum(-1)
+                           + _EPS32 * xm.sum(-1))
+                elif g == 3:    # last: same index both sides (mask equal)
+                    idx = np.where(
+                        m.any(-1),
+                        m.shape[-1] - 1 - np.argmax(m[..., ::-1], -1), 0)
+                    e_s = np.take_along_axis(ex, idx[..., None],
+                                             -1)[..., 0]
+                else:           # max / min: tie flips stay within max ex
+                    e_s = np.where(m, ex, 0.0).max(-1)
+                r = np.broadcast_to(
+                    s[..., None].astype(np.float32), mask.shape)
+                stack.append((r, mask))
+                errs.append(np.nan_to_num(
+                    np.broadcast_to(e_s[..., None], mask.shape).copy(),
+                    nan=np.inf, posinf=np.inf, neginf=np.inf))
+            else:
+                raise AssertionError(f"unknown kind {kind}")
+        x_fin, m_fin = stack[0]
+        n_fin = np.maximum(m_fin.sum(-1), 1)
+        e_fin = np.where(m_fin, errs[0], 0.0).sum(-1) / n_fin \
             + _EPS32 * scale
-    return np_masked_mean(stack[0], mask), scale, degenerate, e_fin
+    return np_masked_mean(x_fin, m_fin), scale, degenerate, e_fin
 
 
 fails = []
@@ -224,13 +418,16 @@ for seed in range(lo, hi):
     if rng.random() < 0.3:
         mask[:, 0] = False  # halted ticker -> NaN factor
     P = int(rng.integers(1, 24))
-    genomes = search.random_population(rng, P)
-    got = np.asarray(search.eval_programs(
-        genomes, bars, mask, search.DEFAULT_SKELETON))
+    # rotate skeletons: the round-2 default (PUSH/UNARY/BINARY only) and
+    # the round-3 ratio-of-aggregates shape (MASK + AGG kinds)
+    skel = (search.RICH_SKELETON if rng.random() < 0.4
+            else search.DEFAULT_SKELETON)
+    genomes = search.random_population(rng, P, skel)
+    got = np.asarray(search.eval_programs(genomes, bars, mask, skel))
     try:
         for p in range(P):
             want, scale, degen, e_fin = np_eval(genomes[p], bars, mask,
-                                                search.DEFAULT_SKELETON)
+                                                skel)
             cmp_ok = ~degen
             assert (np.isnan(got[p][cmp_ok]) == np.isnan(want[cmp_ok])).all(), \
                 (seed, p, got[p], want)

@@ -10,15 +10,21 @@ set -u
 REPO="$(cd "$(dirname "$0")/../.." && pwd)"
 LO="$1"; HI="$2"; CHUNK="$3"; LOG="$4"
 lo="$LO"
+status=0
 while [ "$lo" -lt "$HI" ]; do
     hi=$((lo + CHUNK))
     [ "$hi" -gt "$HI" ] && hi="$HI"
     echo "=== chunk $lo..$hi $(date -u +%FT%TZ)" >> "$LOG"
-    "$REPO/tools/with_cpu_busy.sh" \
+    if ! "$REPO/tools/with_cpu_busy.sh" \
         env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
         python "$REPO/tools/fuzz/fuzz_refdiff.py" "$lo" "$hi" \
-        >> "$LOG" 2>&1
+        >> "$LOG" 2>&1; then
+        echo "=== chunk $lo..$hi FAILED" >> "$LOG"
+        status=1
+    fi
     lo="$hi"
     sleep 20  # sentinel-free gap: lets a waiting tunnel capture start
 done
-echo "=== campaign $LO..$HI done $(date -u +%FT%TZ)" >> "$LOG"
+echo "=== campaign $LO..$HI done status=$status $(date -u +%FT%TZ)" \
+    >> "$LOG"
+exit "$status"
