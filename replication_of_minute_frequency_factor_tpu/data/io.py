@@ -87,7 +87,15 @@ MINUTE_COLUMNS = ("code", "time", "open", "high", "low", "close", "volume")
 
 
 def read_minute_day(path: str) -> Dict[str, np.ndarray]:
-    return read_columns(path, MINUTE_COLUMNS)
+    """One day file's columns; integer stock codes are zero-padded to the
+    6-char string form, matching read_daily_pv — CSMAR exports carry
+    codes as either, and without one normalization an int-coded minute
+    file would join the daily PV table ('000002') as '2', silently
+    producing an empty evaluation."""
+    out = read_columns(path, MINUTE_COLUMNS)
+    if out["code"].dtype.kind in "iu":
+        out["code"] = np.char.zfill(out["code"].astype(str), 6)
+    return out
 
 
 def write_parquet_atomic(table: pa.Table, path: str) -> None:

@@ -1,0 +1,112 @@
+"""Build tests/golden/ — a tiny hand-crafted day file + daily-PV table
+exercising the messy edges of the CSMAR export contract in one place
+(VERDICT r2 #8): integer stock codes (vs the PV table's zero-padded
+strings), an 11:30 bar (the reference's trade-minute formula would alias
+it onto 13:00 — our loader must DROP it, sessions.py), sub-minute and
+pre-open timestamps, zero-volume bars, a limit-locked (constant-price)
+stock, a halted stock whose only row is off-grid, and compact-``YYYYMMDD``
+date strings in the PV file.
+
+Deterministic: re-running reproduces byte-identical content modulo
+parquet metadata; the committed fixture is authoritative, this script is
+its provenance. Run:  python tools/make_golden_fixture.py
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "tests", "golden")
+
+# Four stocks, int-coded in the minute file:
+#   2      -> "000002"  normal stock, full-ish day with quirks
+#   600519 -> "600519"  limit-locked: constant price all day, volume>0
+#   300750 -> "300750"  sparse day: AM bars only + one zero-volume bar
+#   999999 -> "999999"  halted: single row at an off-grid time (dropped
+#                       entirely -> all-invalid grid row -> NaN factors)
+DAY = "20240102"
+
+
+def minute_rows():
+    rows = []  # (code:int, time:int, open, high, low, close, volume)
+
+    # -- 000002: whole AM + PM grid, with deliberate contract edges
+    t_am = [93000000 + m * 100000 for m in range(0, 60)]       # 09:30-10:29
+    t_am += [103000000 + m * 100000 for m in range(0, 60)]     # 10:30-11:29
+    t_pm = [130000000 + m * 100000 for m in range(0, 60)]      # 13:00-13:59
+    t_pm += [140000000 + m * 100000 for m in range(0, 60)]     # 14:00-14:59
+    px = 10.00
+    for i, t in enumerate(t_am + t_pm):
+        o = round(px, 2)
+        c = round(px + (0.01 if i % 3 == 0 else -0.01 if i % 3 == 1
+                        else 0.0), 2)
+        rows.append((2, t, o, max(o, c) + 0.01, min(o, c) - 0.01, c,
+                     100.0 * (i % 7 + 1)))
+        px = c
+    # contract edges, all of which the loader must DROP:
+    rows.append((2, 113000000, 9.0, 9.0, 9.0, 9.0, 1e6))   # 11:30 bar
+    rows.append((2, 93000500, 9.0, 9.0, 9.0, 9.0, 1e6))    # sub-minute
+    rows.append((2, 91500000, 9.0, 9.0, 9.0, 9.0, 1e6))    # pre-open auction
+    rows.append((2, 150000000, 9.0, 9.0, 9.0, 9.0, 1e6))   # 15:00 close
+    # duplicate (code, slot): LAST wins — first 09:31 row is overwritten
+    rows.append((2, 93100000, 7.77, 7.77, 7.77, 7.77, 777.0))
+
+    # -- 600519: limit-locked at 1700.00 the whole day (var == 0 paths)
+    for t in t_am + t_pm:
+        rows.append((600519, t, 1700.00, 1700.00, 1700.00, 1700.00,
+                     200.0))
+
+    # -- 300750: AM only, sparse (every 7th minute), one zero-volume bar
+    for m in range(0, 120, 7):
+        t = t_am[m]
+        v = 0.0 if m == 14 else 300.0
+        rows.append((300750, t, 400.0 + m * 0.1, 400.2 + m * 0.1,
+                     399.8 + m * 0.1, 400.1 + m * 0.1, v))
+
+    # -- 999999: halted; its one row is off-grid (12:00) -> dropped
+    rows.append((999999, 120000000, 50.0, 50.0, 50.0, 50.0, 0.0))
+    return rows
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    rows = minute_rows()
+    # duplicate-slot rule is last-WRITE-wins: keep the overriding 09:31
+    # row physically after the original (writers append corrections)
+    cols = list(zip(*rows))
+    minute = pa.table({
+        "code": pa.array(cols[0], pa.int32()),      # INT codes on purpose
+        "time": pa.array(cols[1], pa.int64()),
+        "open": pa.array(cols[2], pa.float64()),
+        "high": pa.array(cols[3], pa.float64()),
+        "low": pa.array(cols[4], pa.float64()),
+        "close": pa.array(cols[5], pa.float64()),
+        "volume": pa.array(cols[6], pa.float64()),
+    })
+    pq.write_table(minute, os.path.join(OUT, f"{DAY}_cleaned.parquet"))
+
+    # daily PV: CSMAR column spellings, int codes, compact YYYYMMDD
+    # dates-as-strings; covers the evaluation join for two trading days
+    codes = [2, 600519, 300750, 999999]
+    days = ["20240102", "20240103"]
+    pv = pa.table({
+        "Stkcd": pa.array([c for _ in days for c in codes], pa.int32()),
+        "Trddt": pa.array([d for d in days for _ in codes], pa.string()),
+        "ChangeRatio": pa.array(
+            [0.001, 0.0, -0.002, 0.0, 0.004, 0.0, 0.01, 0.0],
+            pa.float64()),
+        "Dsmvtll": pa.array([1e6, 2e7, 5e6, 1e5] * 2, pa.float64()),
+        "Dsmvosd": pa.array([8e5, 1.5e7, 4e6, 9e4] * 2, pa.float64()),
+    })
+    pq.write_table(pv, os.path.join(OUT, "daily_pv.parquet"))
+    print(f"wrote {OUT}: {minute.num_rows} minute rows, "
+          f"{pv.num_rows} pv rows")
+
+
+if __name__ == "__main__":
+    main()
