@@ -132,25 +132,31 @@ def config4(rng):
 
 def config5(rng, scale=1.0):
     """Symbolic search: one fitness call over a 10k-candidate population
-    (the hot loop of search.evolve). At this scale fitness auto-chunks
-    the population through an internal lax.map (~559-candidate chunks on
-    this day shape) so its HBM temporaries fit the chip — the timing is
-    the sequential chunked pass, not a single 10k vmap."""
+    (the hot loop of search.evolve). Fitness auto-chunks the population
+    through an internal lax.map so its HBM temporaries fit the chip —
+    the timing is the sequential chunked pass, not a single 10k vmap.
+    Round 3: timed on BOTH skeletons — the round-2 arithmetic default
+    and the richer ratio-of-aggregates grammar (rolling ops, time/value
+    masks, aggregators), which is what real handbook-factor mining
+    exercises."""
     from replication_of_minute_frequency_factor_tpu import search
 
     pop_n = max(64, int(10_000 * scale))
     bars, mask = _bars(rng, n_days=1, n_tickers=max(50, int(1000 * scale)))
     fwd = rng.normal(0, 0.02, bars.shape[:2]).astype(np.float32)  # [D, T]
     fwd_valid = np.ones_like(fwd, bool)
-    pop = search.random_population(rng, pop_n)
-
-    jax.block_until_ready(search.fitness(pop, bars, mask, fwd, fwd_valid))
-    t0 = time.perf_counter()
-    for _ in range(3):
-        jax.block_until_ready(
-            search.fitness(pop, bars, mask, fwd, fwd_valid))
-    _emit("cfg5_symbolic_search_candidates",
-          (time.perf_counter() - t0) / 3, population=pop_n)
+    for tag, skel in (("", search.DEFAULT_SKELETON),
+                      ("_rich", search.RICH_SKELETON)):
+        pop = search.random_population(rng, pop_n, skel)
+        jax.block_until_ready(search.fitness(pop, bars, mask, fwd,
+                                             fwd_valid, skeleton=skel))
+        t0 = time.perf_counter()
+        for _ in range(3):
+            jax.block_until_ready(
+                search.fitness(pop, bars, mask, fwd, fwd_valid,
+                               skeleton=skel))
+        _emit(f"cfg5_symbolic_search_candidates{tag}",
+              (time.perf_counter() - t0) / 3, population=pop_n)
 
 
 def config3():

@@ -15,7 +15,10 @@ def test_ladder_smoke():
          "--scale", "0.02"],
         capture_output=True, text=True, timeout=500, check=True, env=env)
     lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
-    assert len(lines) == 3  # cfg1 oracle, cfg1 jit, cfg5
+    # cfg1 oracle, cfg1 jit, cfg5 default skeleton, cfg5 rich skeleton
+    assert len(lines) == 4
+    metrics = [json.loads(l)["metric"] for l in lines]
+    assert "cfg5_symbolic_search_candidates_rich" in metrics
     for line in lines:
         rec = json.loads(line)
         assert rec["value"] > 0 and rec["unit"] == "s"
