@@ -101,7 +101,11 @@ def main():
     ap.add_argument("--max-hours", type=float, default=10.5)
     ap.add_argument("--interval", type=float, default=150.0,
                     help="sleep between probes while down (s)")
-    ap.add_argument("--steps", default="headline,ladder,pallas,spot")
+    # sweep (DAYS_PER_BATCH tuning) runs LAST: valuable when the window
+    # lasts, and a window that closes mid-sweep has already banked the
+    # four core steps (retries then re-run only the sweep)
+    ap.add_argument("--steps",
+                    default="headline,ladder,pallas,spot,sweep")
     args = ap.parse_args()
 
     want = [s.strip() for s in args.steps.split(",") if s.strip()]

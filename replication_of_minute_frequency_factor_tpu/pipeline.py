@@ -593,6 +593,50 @@ def _reference_polars_rows(day: Dict[str, np.ndarray], date,
     return cols
 
 
+def _topup_missing_factors(cached, missing, all_files, minute_dir,
+                           cache_path, cfg, progress, fault_hook):
+    """Column top-up when a cache lacks some requested factors.
+
+    The round-2 behavior threw the whole cache away ("recomputing all
+    days") — adding one factor to a 58-factor cache re-ran everything.
+    Instead, compute ONLY the missing factors over the cached days and
+    merge them in column-wise. Both runs grid the same day files, so the
+    (code, date) row sets must match exactly; if they don't (a day file
+    changed on disk, or a top-up day failed), fall back to the old
+    full-recompute path for correctness. Returns the merged cache, or
+    None for the fallback.
+    """
+    max_d = cached.max_date
+    overlap = [(d, p) for d, p in all_files
+               if max_d is not None and d <= max_d]
+    if not overlap:
+        logger.warning(
+            "cache %s lacks factors %s and no day files at or before its "
+            "max date remain in %s; recomputing all days", cache_path,
+            missing, minute_dir)
+        return None
+    logger.info("cache %s lacks factors %s; topping up %d cached days",
+                cache_path, missing, len(overlap))
+    topup = compute_exposures(
+        minute_dir=minute_dir, names=missing, cache_path=None, cfg=cfg,
+        progress=progress, fault_hook=fault_hook,
+        _files_override=overlap)
+    key_c = np.char.add(np.char.add(cached.columns["date"].astype(str),
+                                    "|"),
+                        cached.columns["code"].astype(str))
+    key_t = np.char.add(np.char.add(topup.columns["date"].astype(str),
+                                    "|"),
+                        topup.columns["code"].astype(str))
+    if key_c.shape != key_t.shape or not (key_c == key_t).all():
+        logger.warning(
+            "top-up rows differ from cache %s (day files changed or a "
+            "top-up day failed); recomputing all days", cache_path)
+        return None
+    for n in missing:
+        cached.columns[n] = topup.columns[n]
+    return cached
+
+
 def compute_exposures(
     minute_dir: Optional[str] = None,
     names: Optional[Sequence[str]] = None,
@@ -601,9 +645,16 @@ def compute_exposures(
     progress: bool = True,
     fault_hook: Optional[Callable[[np.datetime64], None]] = None,
     retry_failed: bool = False,
+    _files_override: Optional[Sequence] = None,
 ) -> ExposureTable:
     """Compute factor exposures for every day file, incrementally.
 
+    * the multi-factor cache at ``cache_path`` only ever GROWS factors:
+      requesting factors it lacks tops up just those columns over the
+      cached days (full recompute only if the day files no longer align),
+      and requesting a subset computes the union for new days rather
+      than pruning the cache on save. The returned table carries the
+      union; select the columns you asked for;
     * resumes past ``cache_path``'s max cached date (reference :79-81).
       NOTE the scope of that resume rule: a day that FAILED mid-run while
       later days completed lies BEFORE the advanced max date, so a plain
@@ -635,6 +686,9 @@ def compute_exposures(
     minute_dir = minute_dir or cfg.minute_dir
     names = tuple(names) if names is not None else factor_names()
 
+    all_files = (list(_files_override) if _files_override is not None
+                 else dio.list_day_files(minute_dir))
+
     cached = None
     if cache_path is not None:
         import os
@@ -642,12 +696,22 @@ def compute_exposures(
             cached = ExposureTable.load(cache_path)
             missing = [n for n in names if n not in cached.factor_names]
             if missing:
-                logger.warning(
-                    "cache %s lacks factors %s; recomputing all days",
-                    cache_path, missing)
-                cached = None
+                cached = _topup_missing_factors(
+                    cached, missing, all_files, minute_dir, cache_path,
+                    cfg, progress, fault_hook)
+            if cached is not None:
+                # The persisted cache's factor set only GROWS: a subset
+                # request must never prune and overwrite a wider cache
+                # (adding --factors new_one to a 58-factor cache would
+                # otherwise destroy the other 58 columns at save time).
+                # New days therefore compute the UNION — near-free on
+                # the fused device graph, which evaluates every factor
+                # in one pass anyway.
+                extra = [n for n in cached.factor_names
+                         if n not in names]
+                if extra:
+                    names = tuple(names) + tuple(extra)
 
-    all_files = dio.list_day_files(minute_dir)
     files = all_files
     if cached is not None and cached.max_date is not None:
         files = [(d, p) for d, p in files if d > cached.max_date]

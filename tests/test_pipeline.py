@@ -421,3 +421,92 @@ def test_single_bad_batch_is_skipped_not_fatal(minute_dir, tmp_path,
                           cfg=_cfg(days_per_batch=1), progress=False)
     assert len(t.failures) == 1  # exactly the injected batch's day
     assert len(np.unique(t.columns["date"])) == 2
+
+
+def test_cache_topup_computes_only_missing_factors(minute_dir, tmp_path,
+                                                   caplog):
+    """Adding a factor to an existing multi-factor cache tops up the new
+    column over cached days instead of recomputing everything; values
+    equal a from-scratch run, and new days still append incrementally."""
+    import logging
+    cache = str(tmp_path / "f.parquet")
+    two = ["vol_return1min", "mmt_pm"]
+    three = two + ["liq_openvol"]
+    compute_exposures(minute_dir, two, cache_path=cache, cfg=_cfg(),
+                      progress=False)
+    with caplog.at_level(logging.INFO):
+        got = compute_exposures(minute_dir, three, cache_path=cache,
+                                cfg=_cfg(), progress=False)
+    assert any("topping up" in r.message for r in caplog.records)
+    assert not any("recomputing all days" in r.message
+                   for r in caplog.records)
+    fresh = compute_exposures(minute_dir, three,
+                              cache_path=str(tmp_path / "g.parquet"),
+                              cfg=_cfg(), progress=False)
+    assert len(got) == len(fresh)
+    np.testing.assert_array_equal(got.columns["code"],
+                                  fresh.columns["code"])
+    for n in three:
+        a, b = got.columns[n], fresh.columns[n]
+        np.testing.assert_array_equal(np.isnan(a), np.isnan(b))
+        f = ~np.isnan(a)
+        np.testing.assert_allclose(a[f], b[f], rtol=1e-6)
+    # and the merged cache file itself now carries all three columns
+    reread = compute_exposures(minute_dir, three, cache_path=cache,
+                               cfg=_cfg(), progress=False)
+    assert set(three) <= set(reread.factor_names)
+
+
+def test_cache_topup_falls_back_when_day_files_changed(minute_dir,
+                                                       tmp_path, caplog):
+    """If the cached rows can't be aligned with a top-up pass (a day
+    file vanished), the old full-recompute fallback still applies."""
+    import logging
+    import shutil
+    md2 = str(tmp_path / "kline2")
+    shutil.copytree(minute_dir, md2)
+    cache = str(tmp_path / "f.parquet")
+    compute_exposures(md2, ["vol_return1min"], cache_path=cache,
+                      cfg=_cfg(minute_dir=md2), progress=False)
+    # remove the FIRST day: cached rows for it can no longer align
+    first = sorted(os.listdir(md2))[0]
+    os.remove(os.path.join(md2, first))
+    with caplog.at_level(logging.WARNING):
+        got = compute_exposures(md2, ["vol_return1min", "mmt_pm"],
+                                cache_path=cache,
+                                cfg=_cfg(minute_dir=md2), progress=False)
+    assert any("recomputing all days" in r.message
+               for r in caplog.records)
+    # result covers exactly the surviving day files
+    fresh = compute_exposures(md2, ["vol_return1min", "mmt_pm"],
+                              cache_path=str(tmp_path / "h.parquet"),
+                              cfg=_cfg(minute_dir=md2), progress=False)
+    assert len(got) == len(fresh)
+
+
+def test_subset_request_never_shrinks_the_cache(minute_dir, tmp_path, rng):
+    """A --factors subset against a wider cache must not prune and
+    overwrite it: the persisted factor set only grows, and new days
+    carry values for every cached factor (the fused graph computes the
+    union in one pass)."""
+    cache = str(tmp_path / "f.parquet")
+    wide = ["vol_return1min", "mmt_pm", "liq_openvol"]
+    compute_exposures(minute_dir, wide, cache_path=cache, cfg=_cfg(),
+                      progress=False)
+    # pure cache read of ONE factor: cache stays 3-wide
+    t = compute_exposures(minute_dir, ["mmt_pm"], cache_path=cache,
+                          cfg=_cfg(), progress=False)
+    assert set(wide) <= set(ExposureTable.load(cache).factor_names)
+    assert set(wide) <= set(t.factor_names)  # union returned to caller
+    # subset request + a NEW day: the new day lands with ALL columns
+    _write_day(minute_dir, rng, "2024-01-05")
+    compute_exposures(minute_dir, ["mmt_pm"], cache_path=cache,
+                      cfg=_cfg(), progress=False)
+    reread = ExposureTable.load(cache)
+    assert set(wide) <= set(reread.factor_names)
+    new_rows = reread.columns["date"] == np.datetime64("2024-01-05")
+    assert new_rows.any()
+    # the unrequested factor has REAL values on the new day, not a
+    # silent all-NaN hole
+    assert np.isfinite(
+        reread.columns["liq_openvol"][new_rows].astype(float)).any()
