@@ -565,3 +565,58 @@ def test_comparator_detects_injected_distortion(rng, monkeypatch,
                      "mutated", noisy=True)
     finally:
         jax.clear_caches()
+
+
+def test_fixed_variants_compute_the_intended_math(rng):
+    """replicate_quirks=False must not just DIVERGE from the quirk (the
+    alias test above) — it must equal the mathematically-intended
+    definition. Hand numpy oracles on a clean full day: bottom-20 volume
+    threshold (Q1), top-50 share sum (Q3), and cov^2/(var_x*var_y)
+    rolling correlation-square (Q4, the form the reference itself uses
+    at :212)."""
+    day = synth_day(rng, n_codes=5)  # full 240-bar days, no missing
+    g = grid_day(day["code"], day["time"], day["open"], day["high"],
+                 day["low"], day["close"], day["volume"])
+    fixed = {k: np.asarray(v) for k, v in compute_factors_jit(
+        g.bars, g.mask,
+        names=("mmt_bottom20VolumeRet", "doc_vol50_ratio",
+               "mmt_ols_corr_square_mean"),
+        replicate_quirks=False).items()}
+
+    o = g.bars[..., 0].astype(np.float64)
+    h = g.bars[..., 1].astype(np.float64)
+    l = g.bars[..., 2].astype(np.float64)
+    c = g.bars[..., 3].astype(np.float64)
+    v = g.bars[..., 4].astype(np.float64)
+    for t in range(len(g.codes)):
+        # Q1 fixed: bars with volume <= 20th-smallest volume
+        thr = np.sort(v[t])[19]
+        sel = v[t] <= thr
+        want = np.prod(c[t][sel] / o[t][sel]) - 1.0
+        # the product of ~20 near-1 ratios minus 1 cancels to ~1e-6;
+        # f32 accumulation noise is ~1e-7 absolute on the ~1.0 product
+        np.testing.assert_allclose(fixed["mmt_bottom20VolumeRet"][t],
+                                   want, rtol=1e-4, atol=5e-7)
+        # Q3 fixed: sum of the 50 largest volume shares
+        shares = v[t] / v[t].sum()
+        want = np.sort(shares)[-50:].sum()
+        np.testing.assert_allclose(fixed["doc_vol50_ratio"][t], want,
+                                   rtol=1e-4)
+        # Q4 fixed: mean over 50-bar windows of cov^2/(var_x var_y),
+        # windows with zero var product dropped (same guard as quirk)
+    slots = np.arange(240)
+    for t in range(len(g.codes)):
+        x = l[t] - l[t][0]
+        y = h[t] - h[t][0]
+        vals = []
+        for i in range(49, 240):
+            lo = i - 49
+            xw, yw = x[lo:i + 1], y[lo:i + 1]
+            cov = ((xw - xw.mean()) * (yw - yw.mean())).mean()
+            vx, vy = xw.var(), yw.var()
+            if vx * vy != 0.0:
+                vals.append(cov * cov / (vx * vy))
+        want = np.mean(vals) if vals else np.nan
+        np.testing.assert_allclose(fixed["mmt_ols_corr_square_mean"][t],
+                                   want, rtol=5e-3)
+    del slots
