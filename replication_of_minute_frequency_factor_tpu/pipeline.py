@@ -255,9 +255,12 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
     Elasticity (SURVEY.md §5 failure detection, extended to the batch
     level for the TPU substrate, whose observed failure mode is a
     transient transport/device error mid-run): a batch that fails on
-    device is retried ONCE; host-prep (grid/encode) failures are
-    recorded without retry (they are near-always deterministic). Either
-    way the days land in ``failures`` and the run continues, and
+    device is retried ONCE; if the retry also fails — or host prep
+    (grid/encode) fails, which is near-always deterministic — multi-day
+    batches are ISOLATED per day (fresh host prep from disk, one launch
+    per day), so a single poisoned day cannot take its batch-mates
+    down: only the days that fail alone land in ``failures``. The run
+    continues either way, and
     ``_CIRCUIT_BREAKER`` consecutive dead batches abort (a wedged device
     or systemically broken host path would otherwise grind through
     every remaining batch); completed batches always survive an abort
@@ -308,48 +311,54 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
             failures.record(str(d),
                             (path_of or {}).get(str(d), ""), exc)
 
+    def prep(batch):
+        """Host half for one batch of (date, day-columns) pairs: grid +
+        validate + wire-encode + (single-device) pack into the launch
+        payload. Shared by the producer thread and by per-day isolation
+        on the consumer (widen-only ``wire_floor`` updates are monotonic,
+        so the cross-thread sharing is benign). Raises on failure."""
+        dates = [d for d, _ in batch]
+        with timer("grid"):
+            bars, mask, codes, present = _grid_batch(
+                batch, shard_mult=n_shards)
+        if cfg.debug_validate:
+            from .utils.debug import validate_batch
+            validate_batch(bars, mask)
+        w = None
+        if cfg.wire_transfer:
+            with timer("wire_encode"):
+                w = wire.encode(bars, mask, floor=wire_floor)
+        if mesh is None:
+            # single-device: pack HERE so the multi-MB host concatenate
+            # overlaps device compute; ship one (buf, spec, kind) triple
+            with timer("pack"):
+                if w is not None:
+                    w = wire.pack_arrays(w.arrays) + ("wire",)
+                else:
+                    w = wire.pack_arrays(
+                        (bars, np.asarray(mask).view(np.uint8))
+                    ) + ("raw",)
+            bars = mask = None
+        elif w is not None:
+            # the raw grid is only a fallback for unrepresentable
+            # batches; don't keep ~4 uncompressed copies alive in the
+            # queue + in-flight slots
+            bars = mask = None
+        return (dates, codes, present, w, bars, mask)
+
     def produce():
         try:
             for batch in batches:
                 dates = [d for d, _ in batch]
                 try:
-                    with timer("grid"):
-                        bars, mask, codes, present = _grid_batch(
-                            batch, shard_mult=n_shards)
-                    if cfg.debug_validate:
-                        from .utils.debug import validate_batch
-                        validate_batch(bars, mask)
-                    w = None
-                    if cfg.wire_transfer:
-                        with timer("wire_encode"):
-                            w = wire.encode(bars, mask, floor=wire_floor)
-                    if mesh is None:
-                        # single-device: pack HERE so the multi-MB host
-                        # concatenate overlaps device compute; ship one
-                        # (buf, spec, kind) triple through the queue
-                        with timer("pack"):
-                            if w is not None:
-                                w = wire.pack_arrays(w.arrays) + ("wire",)
-                            else:
-                                w = wire.pack_arrays(
-                                    (bars,
-                                     np.asarray(mask).view(np.uint8))
-                                ) + ("raw",)
-                        bars = mask = None
-                    elif w is not None:
-                        # the raw grid is only a fallback for
-                        # unrepresentable batches; don't keep ~4
-                        # uncompressed copies alive in the queue +
-                        # in-flight slots
-                        bars = mask = None
+                    payload = prep(batch)
                 except Exception as e:  # noqa: BLE001 — batch isolation
                     logger.warning("host prep failed for batch %s: %s",
                                    dates, e)
                     if not _qput(("hostfail", (dates, e))):
                         return
                     continue
-                if not _qput(("batch",
-                              (dates, codes, present, w, bars, mask))):
+                if not _qput(("batch", payload)):
                     return
         except BaseException as e:  # surface in the consumer thread
             _qput(("error", e))
@@ -418,11 +427,8 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
 
     consecutive = 0
 
-    def _count_failure(dates, exc):
-        """Single home for the record/count/breaker policy — both the
-        settle path and the launch path go through here."""
+    def _bump_breaker(exc):
         nonlocal consecutive
-        _record_batch_failure(dates, exc)
         consecutive += 1
         if consecutive >= _CIRCUIT_BREAKER:
             raise RuntimeError(
@@ -430,6 +436,57 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
                 "failed — device/transport looks dead; aborting "
                 "(completed batches are preserved and the cache resume "
                 "will pick up from here)") from exc
+
+    def _count_failure(dates, exc):
+        """Record-and-bump for failures with nothing to isolate
+        (single-day batches, and callers running without a ledger)."""
+        _record_batch_failure(dates, exc)
+        _bump_breaker(exc)
+
+    #: stop soloing after this many consecutive day-launch failures
+    #: inside one isolation pass: against a dead device every solo
+    #: launch just hangs out its timeout, so after two the remaining
+    #: days are recorded unattempted (recoverable via --retry-failed)
+    #: and the breaker decides the run's fate
+    _ISOLATION_GIVEUP = 2
+
+    def _isolate_batch(dates, exc):
+        """A batch failed beyond its one retry (or failed host prep):
+        re-run each day ALONE with fresh host prep from disk, so one
+        poisoned day cannot take its batch-mates down with it — only
+        the days that fail individually are recorded. Single-day
+        batches have nothing to isolate and record directly.
+
+        Breaker policy: EVERY isolation event bumps the breaker, even
+        when all days recover solo — isolation costs 2+N launches, so a
+        transport that fails every multi-day batch but passes days solo
+        must still trip the breaker after _CIRCUIT_BREAKER batches
+        rather than grind the whole file list; only a cleanly settled
+        batch resets the count."""
+        if failures is None:
+            raise exc
+        if len(dates) <= 1:
+            _count_failure(dates, exc)
+            return
+        logger.warning("batch %s failed beyond retry (%s); isolating "
+                       "per day", dates, exc)
+        solo_fails = 0
+        for d in dates:
+            path = (path_of or {}).get(str(d), "")
+            if solo_fails >= _ISOLATION_GIVEUP:
+                failures.record(str(d), path, exc)
+                continue
+            try:
+                with timer("io"):
+                    day = dio.read_minute_day(path)
+                if len(day["code"]) == 0:
+                    raise ValueError("empty day file")
+                materialize(launch(prep([(d, day)])))
+            except Exception as e2:  # noqa: BLE001 — per-day isolation
+                logger.warning("day %s failed in isolation: %s", d, e2)
+                failures.record(str(d), path, e2)
+                solo_fails += 1
+        _bump_breaker(exc)
 
     def settle(payload, launched, retried=False):
         """materialize; on failure re-run the whole batch once, then
@@ -447,11 +504,11 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
                 try:
                     relaunched = launch(payload)
                 except Exception as e2:  # noqa: BLE001
-                    _count_failure(payload[0], e2)
+                    _isolate_batch(payload[0], e2)
                 else:
                     settle(payload, relaunched, retried=True)
                 return
-            _count_failure(payload[0], e)
+            _isolate_batch(payload[0], e)
 
     pending = None  # (payload, launched)
 
@@ -477,13 +534,16 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
             if kind == "done":
                 break
             if kind == "hostfail":
-                # host-prep failures get no retry (they are almost always
-                # deterministic — bad file, encode bug) but DO count
-                # toward the breaker: a systemic host problem must abort,
-                # not grind through the file list recording every day
+                # host-prep failures get no same-shape retry (they are
+                # almost always deterministic — bad file, encode bug),
+                # but multi-day batches still isolate per day so one bad
+                # day's grid/encode failure cannot record its innocent
+                # batch-mates; failures count toward the breaker either
+                # way (a systemic host problem must abort, not grind
+                # through the file list recording every day)
                 dates, e = payload
                 flush_pending()
-                _count_failure(dates, e)
+                _isolate_batch(dates, e)
                 continue
             try:
                 launched = launch(payload)
@@ -498,7 +558,7 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
                     # the counter, and its data must survive whatever we
                     # raise next)
                     flush_pending()
-                    _count_failure(payload[0], e2)
+                    _isolate_batch(payload[0], e2)
                     continue
             if pending is not None:
                 settle(*pending)

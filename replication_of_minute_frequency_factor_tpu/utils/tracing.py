@@ -8,12 +8,16 @@
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Dict, List
 
 
 class Timer:
-    """Accumulating named stage timer.
+    """Accumulating named stage timer. Thread-safe: the pipeline's
+    producer thread and the consumer's per-day isolation path time the
+    same stage names concurrently, and an unlocked read-modify-write
+    would drop increments.
 
     >>> t = Timer()
     >>> with t("io"): ...
@@ -23,6 +27,7 @@ class Timer:
     def __init__(self):
         self._totals: Dict[str, float] = {}
         self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def __call__(self, name: str):
@@ -31,8 +36,9 @@ class Timer:
             yield
         finally:
             dt = time.perf_counter() - t0
-            self._totals[name] = self._totals.get(name, 0.0) + dt
-            self._counts[name] = self._counts.get(name, 0) + 1
+            with self._lock:
+                self._totals[name] = self._totals.get(name, 0.0) + dt
+                self._counts[name] = self._counts.get(name, 0) + 1
 
     def totals(self) -> Dict[str, float]:
         return dict(self._totals)

@@ -604,7 +604,6 @@ def test_fixed_variants_compute_the_intended_math(rng):
                                    rtol=1e-4)
         # Q4 fixed: mean over 50-bar windows of cov^2/(var_x var_y),
         # windows with zero var product dropped (same guard as quirk)
-    slots = np.arange(240)
     for t in range(len(g.codes)):
         x = l[t] - l[t][0]
         y = h[t] - h[t][0]
@@ -619,4 +618,3 @@ def test_fixed_variants_compute_the_intended_math(rng):
         want = np.mean(vals) if vals else np.nan
         np.testing.assert_allclose(fixed["mmt_ols_corr_square_mean"][t],
                                    want, rtol=5e-3)
-    del slots

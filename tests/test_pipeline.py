@@ -510,3 +510,124 @@ def test_subset_request_never_shrinks_the_cache(minute_dir, tmp_path, rng):
     # silent all-NaN hole
     assert np.isfinite(
         reread.columns["liq_openvol"][new_rows].astype(float)).any()
+
+
+def test_poisoned_day_is_isolated_from_its_batch(minute_dir, tmp_path,
+                                                 monkeypatch):
+    """A 3-day batch whose device compute fails twice must not record
+    all 3 days: per-day isolation re-runs each alone and only the day
+    that fails individually is lost. Call sequence: 1 = batch launch,
+    2 = batch retry, 3/4/5 = per-day isolation (day 2 poisoned)."""
+    from replication_of_minute_frequency_factor_tpu import pipeline as pl
+    real = pl.compute_packed_prepared
+    calls = {"n": 0}
+
+    def poisoned(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] in (1, 2, 4):
+            raise RuntimeError("injected device failure")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(pl, "compute_packed_prepared", poisoned)
+    t = compute_exposures(minute_dir, ["vol_return1min"],
+                          cache_path=str(tmp_path / "c.parquet"),
+                          cfg=_cfg(days_per_batch=3), progress=False)
+    assert calls["n"] == 5
+    assert t.failures.keys() == ["2024-01-03"]
+    assert set(map(str, np.unique(t.columns["date"]))) == {
+        "2024-01-02", "2024-01-04"}
+
+
+def test_transient_batch_failure_isolates_to_zero_losses(
+        minute_dir, tmp_path, monkeypatch):
+    """If every day passes alone after the batch failed twice (a purely
+    transient interaction), nothing is recorded and the breaker resets."""
+    from replication_of_minute_frequency_factor_tpu import pipeline as pl
+    real = pl.compute_packed_prepared
+    calls = {"n": 0}
+
+    def flaky_twice(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] in (1, 2):
+            raise RuntimeError("transient")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(pl, "compute_packed_prepared", flaky_twice)
+    t = compute_exposures(minute_dir, ["vol_return1min"],
+                          cache_path=str(tmp_path / "c.parquet"),
+                          cfg=_cfg(days_per_batch=3), progress=False)
+    assert not t.failures
+    assert len(np.unique(t.columns["date"])) == 3
+
+
+def test_hostfail_batch_isolates_innocent_days(minute_dir, tmp_path,
+                                               monkeypatch):
+    """A multi-day batch whose host prep (grid) fails isolates per day
+    too: the deterministic bad day fails alone, batch-mates survive."""
+    from replication_of_minute_frequency_factor_tpu import pipeline as pl
+    real_grid = pl._grid_batch
+
+    def bad_grid(day_data, shard_mult=1):
+        if any(str(d) == "2024-01-03" for d, _ in day_data):
+            raise RuntimeError("injected grid failure")
+        return real_grid(day_data, shard_mult=shard_mult)
+
+    monkeypatch.setattr(pl, "_grid_batch", bad_grid)
+    t = compute_exposures(minute_dir, ["vol_return1min"],
+                          cache_path=str(tmp_path / "c.parquet"),
+                          cfg=_cfg(days_per_batch=3), progress=False)
+    assert t.failures.keys() == ["2024-01-03"]
+    assert set(map(str, np.unique(t.columns["date"]))) == {
+        "2024-01-02", "2024-01-04"}
+
+
+def test_repeated_isolation_still_trips_the_breaker(minute_dir, tmp_path,
+                                                    monkeypatch, rng):
+    """A transport that fails every multi-day batch but passes days solo
+    must NOT grind the whole file list at 2+N launches per batch: each
+    isolation event counts toward the circuit breaker."""
+    for ds in ("2024-01-05", "2024-01-08", "2024-01-09"):
+        _write_day(minute_dir, rng, ds, missing_prob=0.05)  # 6 days total
+    from replication_of_minute_frequency_factor_tpu import pipeline as pl
+    real = pl.compute_packed_prepared
+    calls = {"n": 0, "cycle": 0}
+
+    def per_batch_flaky(*a, **kw):
+        # per 2-day batch: launch fail, retry fail, two solo passes
+        calls["cycle"] = calls["cycle"] % 4 + 1
+        calls["n"] += 1
+        if calls["cycle"] in (1, 2):
+            raise RuntimeError("flaky transport")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(pl, "compute_packed_prepared", per_batch_flaky)
+    with pytest.raises(RuntimeError, match="consecutive"):
+        compute_exposures(minute_dir, ["vol_return1min"],
+                          cache_path=str(tmp_path / "c.parquet"),
+                          cfg=_cfg(days_per_batch=2), progress=False)
+    # the solo-recovered days were preserved before the abort
+    t = ExposureTable.load(str(tmp_path / "c.parquet"))
+    assert len(np.unique(t.columns["date"])) >= 4
+
+
+def test_isolation_gives_up_against_a_dead_device(minute_dir, tmp_path,
+                                                  monkeypatch):
+    """When the first two solo launches also fail, the rest of the batch
+    is recorded WITHOUT more launches (each would just hang out its
+    timeout on a dead device); --retry-failed can recover them later."""
+    from replication_of_minute_frequency_factor_tpu import pipeline as pl
+    calls = {"n": 0}
+
+    def dead(*a, **kw):
+        calls["n"] += 1
+        raise RuntimeError("dead device")
+
+    monkeypatch.setattr(pl, "compute_packed_prepared", dead)
+    t = compute_exposures(minute_dir, ["vol_return1min"],
+                          cache_path=str(tmp_path / "c.parquet"),
+                          cfg=_cfg(days_per_batch=3), progress=False)
+    # 2 batch attempts + 2 solo attempts, then give-up: day 3 recorded
+    # with zero further launches
+    assert calls["n"] == 4
+    assert sorted(t.failures.keys()) == ["2024-01-02", "2024-01-03",
+                                         "2024-01-04"]
