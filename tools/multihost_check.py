@@ -85,13 +85,13 @@ def main():
             np.testing.assert_allclose(got[f], w[f], rtol=2e-5, atol=1e-6,
                                        err_msg=n)
 
-    psum_ok = False
+    psum_ok = eval_ok = False
     if gloo:
         # cross-host collective round trip: psum over the tickers axis
         from functools import partial
 
         import jax.numpy as jnp
-        from jax.sharding import PartitionSpec as P
+        from jax.sharding import NamedSharding, PartitionSpec as P
         from jax.experimental.shard_map import shard_map
 
         @partial(shard_map, mesh=mesh, in_specs=P("days", "tickers"),
@@ -104,10 +104,56 @@ def main():
         np.testing.assert_allclose(got[:, 0], x.sum(-1), rtol=1e-6)
         psum_ok = True
 
+        # Distributed EVALUATION across hosts: the production per-date
+        # cross-sectional helpers (psum-moment Pearson IC; all_gather
+        # ranks -> Spearman) on a [dates, tickers] exposure sharded over
+        # both processes, against a locally recomputed reference — the
+        # SURVEY §5 "distributed communication backend" exercised at
+        # process level, not just a bare psum.
+        from replication_of_minute_frequency_factor_tpu.parallel import (
+            collectives)
+
+        rng = np.random.default_rng(11)
+        expo = np.asarray(ref["vol_return1min"], np.float32)  # [D, T]
+        fwd = rng.normal(0, 0.02, expo.shape).astype(np.float32)
+        valid = np.isfinite(expo)
+        spec = NamedSharding(mesh, P(None, "tickers"))
+
+        def gput(a):
+            # every process holds the full (deterministic) value; jax
+            # assembles the global array from each process's shards
+            return jax.make_array_from_callback(
+                a.shape, spec, lambda idx: a[idx])
+
+        ge = gput(np.where(valid, expo, 0.0).astype(np.float32))
+        gf = gput(fwd)
+        gm = gput(valid)
+        ic = np.asarray(jax.block_until_ready(
+            collectives.xs_pearson(mesh, ge, gf, gm)))
+        ranks = collectives.xs_rank(mesh, ge, gm)
+        rx = collectives.xs_rank(mesh, gf, gm)
+        rank_ic = np.asarray(jax.block_until_ready(
+            collectives.xs_pearson(
+                mesh, jnp.where(gm, ranks, 0.0),
+                jnp.where(gm, rx, 0.0), gm)))
+
+        # local single-process reference via the eval kernels
+        from replication_of_minute_frequency_factor_tpu import eval_ops
+        want_ic, want_rank_ic = (
+            np.asarray(v) for v in eval_ops.ic_series(
+                np.where(valid, expo, 0.0), fwd, valid))
+        np.testing.assert_allclose(ic, want_ic, rtol=5e-5, atol=1e-5)
+        np.testing.assert_allclose(rank_ic, want_rank_ic, rtol=5e-5,
+                                   atol=1e-5)
+        eval_ok = True
+
     with open(os.path.join(outdir, f"ok{pid}"), "w") as fh:
-        fh.write(f"devices=8 psum={'yes' if psum_ok else 'skipped'}")
+        fh.write(f"devices=8 psum={'yes' if psum_ok else 'skipped'} "
+                 f"eval={'yes' if eval_ok else 'skipped'}")
     print(f"process {pid}: ok (psum "
-          f"{'executed' if psum_ok else 'skipped — no cpu collectives'})")
+          f"{'executed' if psum_ok else 'skipped — no cpu collectives'}; "
+          f"distributed eval "
+          f"{'executed' if eval_ok else 'skipped'})")
 
 
 if __name__ == "__main__":
