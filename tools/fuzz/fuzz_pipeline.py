@@ -46,10 +46,50 @@ def write_day(d, rng, date_str, n_codes):
 fails = []
 lo, hi = int(sys.argv[1]), int(sys.argv[2])
 NAMES = ("vol_return1min", "mmt_pm", "doc_kurt")
+_REAL_GRID = _pl._grid_batch
+
 for seed in range(lo, hi):
     rng = np.random.default_rng(seed)
     td = tempfile.mkdtemp()
     try:
+        if seed >= 10_000:
+            # --- per-day isolation invariant (round 3): ONE persistently
+            # poisoned day (host prep fails deterministically) must cost
+            # exactly itself whatever the batch geometry; after healing,
+            # --retry-failed recovers it from the ledger.
+            kline = os.path.join(td, "kline"); os.mkdir(kline)
+            n_codes = int(rng.integers(3, 8))
+            n_days = int(rng.integers(2, 9))
+            days = sorted(str(np.datetime64("2024-02-01") + int(i))
+                          for i in rng.choice(40, n_days, replace=False))
+            for ds in days:
+                write_day(kline, rng, ds, n_codes)
+            poison = days[int(rng.integers(0, n_days))]
+            cache = os.path.join(td, "cache.parquet")
+            cfg = Config(days_per_batch=int(rng.integers(1, 5)))
+
+            def bad_grid(day_data, shard_mult=1):
+                if any(str(d) == poison for d, _ in day_data):
+                    raise RuntimeError("poisoned day")
+                return _REAL_GRID(day_data, shard_mult=shard_mult)
+
+            _pl._grid_batch = bad_grid
+            try:
+                t1 = compute_exposures(kline, NAMES, cache_path=cache,
+                                       cfg=cfg, progress=False)
+            finally:
+                _pl._grid_batch = _REAL_GRID
+            assert t1.failures.keys() == [poison], (
+                t1.failures.keys(), poison)
+            assert set(map(str, t1.columns["date"])) == \
+                set(days) - {poison}
+            t2 = compute_exposures(kline, NAMES, cache_path=cache,
+                                   cfg=cfg, progress=False,
+                                   retry_failed=True)
+            assert set(map(str, t2.columns["date"])) == set(days)
+            assert not t2.failures
+            assert not os.path.exists(cache + ".failures.json")
+            continue
         kline = os.path.join(td, "kline"); os.mkdir(kline)
         n_codes = int(rng.integers(3, 10))
         n1 = int(rng.integers(1, 8)); n2 = int(rng.integers(1, 6))
