@@ -25,6 +25,7 @@ from .collectives import (
     xs_masked_mean,
     xs_masked_std,
     xs_pearson,
+    xs_qcut,
     xs_rank,
 )
 
@@ -39,5 +40,6 @@ __all__ = [
     "xs_masked_mean",
     "xs_masked_std",
     "xs_pearson",
+    "xs_qcut",
     "xs_rank",
 ]

@@ -144,6 +144,26 @@ def xs_rank_local(x, mask, axis_name=TICKERS_AXIS):
         r, idx * x.shape[-1], x.shape[-1], axis=-1)
 
 
+def xs_qcut_local(x, mask, group_num: int, axis_name=TICKERS_AXIS):
+    """Per-date quantile-bucket labels over a SHARDED cross-section
+    (group_test's qcut, Factor.py:284-292, under tickers-axis sharding —
+    SURVEY.md §7 hard-part 5).
+
+    Same shape as ranking: all_gather the tiny [rows, T] cross-section
+    (5000 f32 = 20 KB/date), run the production single-device qcut core
+    on the gathered matrix — REUSED, not reimplemented, so sharded and
+    local labels cannot drift — and slice this shard's lanes back out.
+    """
+    from .. import eval_ops
+
+    full_x = jax.lax.all_gather(x, axis_name, axis=-1, tiled=True)
+    full_m = jax.lax.all_gather(mask, axis_name, axis=-1, tiled=True)
+    lab = eval_ops._qcut_labels_jit(full_x, full_m, group_num)
+    idx = jax.lax.axis_index(axis_name)
+    return jax.lax.dynamic_slice_in_dim(
+        lab, idx * x.shape[-1], x.shape[-1], axis=-1)
+
+
 # --------------------------------------------------------------------------
 # shard_map wrappers for [dates, tickers] matrices
 # --------------------------------------------------------------------------
@@ -196,6 +216,16 @@ xs_masked_mean = _xs_wrap(_mean_body)
 xs_masked_std = _xs_wrap(_std_body)
 xs_pearson = _xs_wrap(_pearson_body)
 xs_rank = _xs_wrap(_rank_body)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "group_num"))
+def xs_qcut(mesh: Mesh, x, m, group_num: int = 5):
+    """Sharded per-date quantile-bucket labels (see xs_qcut_local)."""
+    spec = P(None, TICKERS_AXIS)
+    fn = shard_map(
+        lambda a, b: xs_qcut_local(a, b, group_num),
+        mesh=mesh, in_specs=(spec, spec), out_specs=spec)
+    return fn(x, m)
 
 
 # --------------------------------------------------------------------------

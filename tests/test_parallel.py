@@ -61,6 +61,22 @@ def test_xs_rank_matches_local(xs_data):
     assert np.isnan(r[~m]).all()
 
 
+def test_xs_qcut_matches_local(xs_data):
+    """Sharded quantile bucketing (group_test's qcut over a sharded
+    tickers axis) must equal the single-device labels exactly — it
+    reuses the production qcut core on the gathered cross-section."""
+    from replication_of_minute_frequency_factor_tpu import eval_ops
+    from replication_of_minute_frequency_factor_tpu.parallel import (
+        xs_qcut)
+
+    x, _, m = xs_data
+    tick_mesh = make_mesh((1, 8))
+    for k in (3, 5, 10):
+        lab = np.asarray(xs_qcut(tick_mesh, x, m, group_num=k))
+        ref = np.asarray(eval_ops._qcut_labels_jit(x, m, k))
+        np.testing.assert_array_equal(lab, ref, err_msg=f"k={k}")
+
+
 def test_sharded_factors_match_single_device(mesh):
     rng = np.random.default_rng(3)
     days = []

@@ -39,7 +39,7 @@ from replication_of_minute_frequency_factor_tpu.models.registry import (  # noqa
     compute_factors_jit, factor_names)
 from replication_of_minute_frequency_factor_tpu.parallel import (  # noqa: E402
     make_mesh, shard_day_batch, sharded_compute_factors,
-    xs_masked_mean, xs_masked_std, xs_pearson, xs_rank)
+    xs_masked_mean, xs_masked_std, xs_pearson, xs_qcut, xs_rank)
 
 MESH_POOL = ((1, 8), (2, 4), (4, 2), (1, 4), (2, 2), (1, 2), (1, 1))
 XS_D_POOL = (1, 3, 6)
@@ -108,6 +108,8 @@ def xs_case(rng, seed):
     std = np.asarray(xs_masked_std(mesh, xp, mp))
     ic = np.asarray(xs_pearson(mesh, xp, yp, mp))
     rk = np.asarray(xs_rank(mesh, xp, mp))[:, :n_t]
+    k = int(rng.choice([3, 5, 10]))
+    qc = np.asarray(xs_qcut(mesh, xp, mp, group_num=k))[:, :n_t]
 
     xc = np.where(m, x, 0.0).astype(np.float32)
     yc = np.where(m, y, 0.0).astype(np.float32)
@@ -115,6 +117,11 @@ def xs_case(rng, seed):
     ref_std = np.asarray(ops.masked_std(xc, m))
     ref_ic = np.asarray(ops.masked_corr(xc, yc, m))
     ref_rk = np.asarray(ops.rank_average(xc, m))
+    from replication_of_minute_frequency_factor_tpu import eval_ops
+    # reference on the same padded matrix the sharded call saw: what the
+    # fuzz exercises is the gather/slice shard roundtrip, not the core
+    # (xs_qcut reuses _qcut_labels_jit by design)
+    ref_qc = np.asarray(eval_ops._qcut_labels_jit(xp, mp, k))[:, :n_t]
 
     np.testing.assert_allclose(mean, ref_mean, rtol=2e-4, atol=1e-5,
                                equal_nan=True, err_msg=f"{seed} mean")
@@ -137,6 +144,7 @@ def xs_case(rng, seed):
                                err_msg=f"{seed} ic")
     np.testing.assert_allclose(rk[m], ref_rk[m], rtol=1e-6,
                                err_msg=f"{seed} rank")
+    np.testing.assert_array_equal(qc, ref_qc, err_msg=f"{seed} qcut k={k}")
 
 
 def factor_case(rng, seed):
