@@ -250,3 +250,27 @@ def test_rich_skeleton_recovers_planted_upratio(day_batch, rng):
                         skeleton=search.RICH_SKELETON, device_batch=384)
     assert res.fitness > 0.8, search.describe(res.genome,
                                               search.RICH_SKELETON)
+
+
+def test_time_mask_factor_recovery(day_batch, rng):
+    """A trade_tailRatio-shaped signal (last-30-minute volume share,
+    reference MinuteFrequentFactorCalculateMethodsCICC.py:1280-1306) is
+    recoverable through the MASK primitive on a 3-slot skeleton — the
+    session masks pull their weight in search, not just in evaluation."""
+    bars, mask = day_batch
+    v = bars[..., 4].astype(np.float64)
+    tail = mask & (np.arange(240) >= 210)
+    signal = (np.where(tail, v, 0.0).sum(-1)
+              / np.maximum(np.where(mask, v, 0.0).sum(-1), 1.0))
+    fwd = (signal - signal.mean(-1, keepdims=True)).astype(np.float32)
+    fwd_valid = np.isfinite(signal)
+
+    skel = (search.PUSH, search.MASK, search.AGG)
+    res = search.evolve(bars.astype(np.float32), mask, fwd, fwd_valid,
+                        pop=128, generations=6, seed=5, skeleton=skel,
+                        device_batch=128)
+    assert res.fitness > 0.95, search.describe(res.genome, skel)
+    # the recovered program actually uses a time mask (pos/neg value
+    # masks cannot reproduce a pure session-window share this well)
+    desc = search.describe(res.genome, skel)
+    assert any(t in desc for t in ("last30", "first30", "am", "pm")), desc
