@@ -105,7 +105,7 @@ def main():
     # lasts, and a window that closes mid-sweep has already banked the
     # four core steps (retries then re-run only the sweep)
     ap.add_argument("--steps",
-                    default="headline,ladder,pallas,spot,sweep")
+                    default="headline,ladder,rolling,spot,sweep")
     args = ap.parse_args()
 
     want = [s.strip() for s in args.steps.split(",") if s.strip()]
