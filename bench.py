@@ -36,12 +36,20 @@ ITERS = 5
 _SUFFIX = os.environ.get("BENCH_METRIC_SUFFIX", "")
 
 
-def _tunnel_alive(timeout=90):
+def _tunnel_alive(timeout=90, require_tpu=False):
     """One reachability probe from a killable child (a wedged tunnel
-    hangs jax backend init in-process, before any code can time out)."""
+    hangs jax backend init in-process, before any code can time out).
+
+    ``require_tpu`` additionally asserts a non-CPU platform in the
+    child: if the axon backend fails FAST instead of wedging, jax falls
+    back to CPU with a warning and bare ``jax.devices()`` succeeds — a
+    BENCH_REQUIRE_TPU run would then time a CPU run under the
+    un-suffixed TPU metric name."""
+    code = ("import jax; d = jax.devices(); "
+            + ("assert d[0].platform != 'cpu'" if require_tpu else "pass"))
     try:
         return subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [sys.executable, "-c", code],
             timeout=timeout, capture_output=True).returncode == 0
     except subprocess.TimeoutExpired:
         return False
@@ -62,13 +70,21 @@ def _ensure_device_reachable():
     # rides out a typical window before settling for the labeled CPU
     # fallback; that patience is cheap next to recording a fallback
     # number when a real TPU run was a minute of patience away.
+    require_tpu = bool(os.environ.get("BENCH_REQUIRE_TPU"))
     deadline = time.monotonic() + 390.0
     while True:
-        if _tunnel_alive():
+        if _tunnel_alive(require_tpu=require_tpu):
             return
         if time.monotonic() + 30.0 >= deadline:
             break
         time.sleep(30)
+    if require_tpu:
+        # session-capture mode (benchmarks/tpu_session.py): a CPU
+        # fallback must fail loudly, never print a metric line — the
+        # session would otherwise bank it as a green headline step
+        print("# BENCH_REQUIRE_TPU set and tunnel unreachable; aborting "
+              "instead of CPU fallback", file=sys.stderr, flush=True)
+        sys.exit(17)
     env = {k: v for k, v in os.environ.items()
            if k != "PALLAS_AXON_POOL_IPS"}
     env["JAX_PLATFORMS"] = "cpu"
@@ -176,6 +192,14 @@ def _wait_host_quiet(max_wait_s=600.0):
 
 def main():
     _ensure_device_reachable()  # may exec into a CPU-fallback run
+    if os.environ.get("BENCH_REQUIRE_TPU") \
+            and jax.devices()[0].platform == "cpu":
+        # the probe child saw a TPU but THIS process resolved to CPU
+        # (e.g. backend failed fast after the probe): refuse to print a
+        # TPU-named metric from a CPU run
+        print("# BENCH_REQUIRE_TPU set but jax resolved to CPU; aborting",
+              file=sys.stderr, flush=True)
+        sys.exit(17)
     _wait_host_quiet()
     import queue
     import threading

@@ -43,13 +43,13 @@ def _probe(timeout=90):
         return False
 
 
-def _run_json_lines(cmd, timeout):
+def _run_json_lines(cmd, timeout, env=None):
     """Run a child; parse every stdout line that is a JSON object."""
     t0 = time.monotonic()
     try:
         # children inherit MFF_COMPILATION_CACHE_DIR set in main()
         p = subprocess.run(cmd, cwd=REPO, timeout=timeout,
-                           capture_output=True, text=True)
+                           capture_output=True, text=True, env=env)
     except subprocess.TimeoutExpired as e:
         return {"ok": False, "error": f"timeout {timeout}s",
                 "tail": str(e.stdout or "")[-1500:]}
@@ -67,8 +67,38 @@ def _run_json_lines(cmd, timeout):
             else (p.stdout + p.stderr)[-1500:]}
 
 
+def _run_one_step_child(name, timeout=1500):
+    """Run a step's in-process body in a killable child.
+
+    The child re-invokes this script with ``--one-step NAME``, which
+    executes the body and prints its result dict as the final JSON
+    line; _run_json_lines' line parsing picks it up. On timeout the
+    parent records a failed step instead of hanging the whole session
+    (and with it the watcher's retry loop) on a wedged backend init.
+    """
+    r = _run_json_lines(
+        [sys.executable, os.path.abspath(__file__), "--one-step", name],
+        timeout=timeout)
+    # unwrap: the child's last JSON line IS the step result
+    for rec in reversed(r.get("results") or []):
+        if isinstance(rec, dict) and "ok" in rec:
+            rec.setdefault("seconds", r.get("seconds"))
+            return rec
+    return r  # child died before printing a result (timeout/crash)
+
+
 def step_headline():
-    return _run_json_lines([sys.executable, "bench.py"], timeout=1800)
+    # BENCH_REQUIRE_TPU: inside a session the CPU fallback must be a
+    # step FAILURE, not a green result — a retry fire that raced a
+    # tunnel drop would otherwise bank a _cpu_fallback number as the
+    # "headline" step and no later fire would ever replace it.
+    r = _run_json_lines([sys.executable, "bench.py"], timeout=1800,
+                        env=dict(os.environ, BENCH_REQUIRE_TPU="1"))
+    if r.get("ok") and any("_cpu_fallback" in str(rec.get("metric", ""))
+                           for rec in r.get("results") or []):
+        r["ok"] = False
+        r["error"] = "bench printed a CPU-fallback metric"
+    return r
 
 
 def step_ladder():
@@ -88,9 +118,15 @@ def step_rolling():
     """On-chip timing of the rolling-moment conv kernel (the mmt_ols_*
     hot op) plus an f64-oracle agreement check on a sample of windows.
 
-    Runs in-process (we already know the tunnel is up). Shapes mirror
-    the production use: [tickers, 240] minute panels.
+    Body runs in a killable child via --one-step (a tunnel that drops
+    mid-session hangs jax backend init before any in-process code can
+    time out — observed 2026-08-01, a 3 h watcher backstop was the only
+    recovery). Shapes mirror the production use: [tickers, 240] panels.
     """
+    return _run_one_step_child("rolling")
+
+
+def _rolling_body():
     import jax
     import numpy as np
 
@@ -153,7 +189,12 @@ def step_graph_spotcheck():
     the parity suite's FULL comparator protocol (tolerance matrix,
     doc_pdf tie acceptance, degenerate-beta skips) — a hand-rolled
     comparison here would false-alarm on cells the suite deliberately
-    accepts and burn the tunnel window."""
+    accepts and burn the tunnel window. Body in a killable child (see
+    step_rolling)."""
+    return _run_one_step_child("spot")
+
+
+def _spot_body():
     import time as _t
 
     import jax
@@ -184,7 +225,43 @@ def main():
         REPO, "benchmarks", "TPU_SESSION.json"))
     ap.add_argument("--skip-probe", action="store_true")
     ap.add_argument("--steps", default="headline,ladder,rolling,spot")
+    ap.add_argument("--one-step", default=None,
+                    help="internal: run one step's body in-process and "
+                         "print its result dict as the final JSON line "
+                         "(the parent wraps this in a killable child)")
+    ap.add_argument("--max-carry-age-hours", type=float, default=12.0,
+                    help="only carry green steps over from a prior "
+                         "artifact younger than this (~one round)")
     args = ap.parse_args()
+
+    if args.one_step:
+        # the cache is applied via in-process jax.config.update, so the
+        # inherited MFF_COMPILATION_CACHE_DIR env var alone does nothing
+        # — without this call the spot body re-pays the ~20-40 s
+        # 58-graph compile inside every tunnel up-window
+        from replication_of_minute_frequency_factor_tpu.config import (
+            apply_compilation_cache, get_config)
+        os.environ.setdefault("MFF_COMPILATION_CACHE_DIR",
+                              os.path.join(REPO, ".xla_cache"))
+        apply_compilation_cache(get_config())
+        body = {"rolling": _rolling_body, "spot": _spot_body}[args.one_step]
+        result = body()
+        # same race step_headline guards against: the pre-step probe saw
+        # a TPU, the backend then failed FAST (not wedged) and jax fell
+        # back to CPU with only a warning — rolling would time CPU and
+        # spot would compare the CPU oracle against itself. A green
+        # carried-over step is never re-run, so this must fail here.
+        # TPU_SESSION_ALLOW_CPU is the local-testing escape hatch.
+        if result.get("ok") \
+                and not os.environ.get("TPU_SESSION_ALLOW_CPU"):
+            import jax
+            if jax.devices()[0].platform == "cpu":
+                result = {"ok": False,
+                          "error": "jax resolved to CPU inside the "
+                                   "one-step child; refusing to bank a "
+                                   "non-TPU result", "had": result}
+        print(json.dumps(result), flush=True)
+        return 0
 
     session = {"started_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                             time.gmtime()),
@@ -195,10 +272,37 @@ def main():
                    None if "TPU_SESSION_HOST_QUIET" not in os.environ
                    else os.environ["TPU_SESSION_HOST_QUIET"] == "True"),
                "steps": {}}
+    # Carry green steps over from a previous fire: a retry window runs
+    # only the pending steps, and writing a fresh artifact would DROP
+    # the banked results (and make tunnel_watch._pending_steps re-burn
+    # them next fire). Failed entries are not carried — they re-run.
+    # Age-bounded PER STEP (default 12 h ≈ one round) on the step's own
+    # captured_utc stamp: the artifact is committed, so without the
+    # bound a NEXT round's first fire would carry last round's green
+    # steps, skip everything, and bank stale numbers without a single
+    # new hardware execution. (The bound deliberately ignores the
+    # artifact-level started_utc, which every fire — including
+    # probe-fail fires — rewrites; aging against it would let a chain
+    # of rewrites keep stale steps alive forever.)
+    def _age_hours(stamp):
+        try:
+            t = time.mktime(time.strptime(stamp, "%Y-%m-%dT%H:%M:%SZ"))
+        except (TypeError, ValueError, OverflowError):
+            return float("inf")
+        return (time.mktime(time.gmtime()) - t) / 3600.0
+    try:
+        with open(args.out) as fh:
+            prior = json.load(fh)
+        for k, v in prior.get("steps", {}).items():
+            if v.get("ok") and (_age_hours(v.get("captured_utc"))
+                                <= args.max_carry_age_hours):
+                session["steps"][k] = v
+    except (OSError, json.JSONDecodeError, ValueError):
+        pass
     if not args.skip_probe and not _probe():
         session["steps"]["probe"] = {"ok": False,
                                      "error": "tunnel unreachable"}
-        with open(args.out, "w") as fh:  # never leave a stale artifact
+        with open(args.out, "w") as fh:  # keeps carried-over green steps
             json.dump(session, fh, indent=1)
         print(json.dumps(session))
         return 1
@@ -213,6 +317,21 @@ def main():
              "sweep": step_sweep}
     want = [s.strip() for s in args.steps.split(",") if s.strip()]
     for name in want:
+        if session["steps"].get(name, {}).get("ok"):
+            print(f"--- step: {name} (already green, carried over)",
+                  flush=True)
+            continue
+        # Re-probe before every step: the tunnel drops mid-session
+        # (observed 2026-08-01: up-window closed between headline and
+        # ladder), and failing the step in 90 s beats burning the
+        # child's full timeout against a dead link.
+        if not args.skip_probe and not _probe():
+            session["steps"][name] = {
+                "ok": False, "error": "tunnel unreachable at step start"}
+            with open(args.out, "w") as fh:
+                json.dump(session, fh, indent=1)
+            print(json.dumps({name: False}), flush=True)
+            continue
         print(f"--- step: {name}", flush=True)
         try:
             session["steps"][name] = steps[name]()
@@ -221,6 +340,9 @@ def main():
             session["steps"][name] = {
                 "ok": False, "error": f"{type(e).__name__}: {e}",
                 "trace": traceback.format_exc()[-1500:]}
+        # per-step freshness stamp — what the carry-over bound ages
+        session["steps"][name]["captured_utc"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         with open(args.out, "w") as fh:  # persist after EVERY step
             json.dump(session, fh, indent=1)
         print(json.dumps({name: session["steps"][name].get("ok")}),
