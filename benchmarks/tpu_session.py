@@ -67,6 +67,49 @@ def _run_json_lines(cmd, timeout, env=None):
             else (p.stdout + p.stderr)[-1500:]}
 
 
+def carry_green_steps(artifact_path, max_age_hours, now=None):
+    """Green steps from a prior session artifact, age-bounded per step.
+
+    A retry window runs only the pending steps, and writing a fresh
+    artifact would DROP the banked results (and make
+    tunnel_watch._pending_steps re-burn them next fire). Failed entries
+    are not carried — they re-run. The bound (default ~one round) is on
+    each step's own ``captured_utc`` stamp: the artifact is committed,
+    so without it a NEXT round's first fire would carry last round's
+    green steps, skip everything, and bank stale numbers without a
+    single new hardware execution. It deliberately ignores the
+    artifact-level ``started_utc``, which every fire — including
+    probe-fail fires — rewrites; aging against that would let a chain
+    of rewrites keep stale steps alive forever. A step with no stamp is
+    treated as infinitely old.
+
+    ``now`` (epoch seconds) is injectable for tests. Stamps are UTC, so
+    they parse via calendar.timegm — time.mktime would interpret the
+    struct in LOCAL time and skew every age by the UTC offset on a
+    non-UTC machine.
+    """
+    import calendar
+    if now is None:
+        now = time.time()
+
+    def _age_hours(stamp):
+        try:
+            t = calendar.timegm(time.strptime(stamp,
+                                              "%Y-%m-%dT%H:%M:%SZ"))
+        except (TypeError, ValueError, OverflowError):
+            return float("inf")
+        return (now - t) / 3600.0
+
+    try:
+        with open(artifact_path) as fh:
+            prior = json.load(fh)
+        return {k: v for k, v in prior.get("steps", {}).items()
+                if v.get("ok")
+                and _age_hours(v.get("captured_utc")) <= max_age_hours}
+    except (OSError, json.JSONDecodeError, ValueError, AttributeError):
+        return {}
+
+
 def _run_one_step_child(name, timeout=1500):
     """Run a step's in-process body in a killable child.
 
@@ -272,33 +315,8 @@ def main():
                    None if "TPU_SESSION_HOST_QUIET" not in os.environ
                    else os.environ["TPU_SESSION_HOST_QUIET"] == "True"),
                "steps": {}}
-    # Carry green steps over from a previous fire: a retry window runs
-    # only the pending steps, and writing a fresh artifact would DROP
-    # the banked results (and make tunnel_watch._pending_steps re-burn
-    # them next fire). Failed entries are not carried — they re-run.
-    # Age-bounded PER STEP (default 12 h ≈ one round) on the step's own
-    # captured_utc stamp: the artifact is committed, so without the
-    # bound a NEXT round's first fire would carry last round's green
-    # steps, skip everything, and bank stale numbers without a single
-    # new hardware execution. (The bound deliberately ignores the
-    # artifact-level started_utc, which every fire — including
-    # probe-fail fires — rewrites; aging against it would let a chain
-    # of rewrites keep stale steps alive forever.)
-    def _age_hours(stamp):
-        try:
-            t = time.mktime(time.strptime(stamp, "%Y-%m-%dT%H:%M:%SZ"))
-        except (TypeError, ValueError, OverflowError):
-            return float("inf")
-        return (time.mktime(time.gmtime()) - t) / 3600.0
-    try:
-        with open(args.out) as fh:
-            prior = json.load(fh)
-        for k, v in prior.get("steps", {}).items():
-            if v.get("ok") and (_age_hours(v.get("captured_utc"))
-                                <= args.max_carry_age_hours):
-                session["steps"][k] = v
-    except (OSError, json.JSONDecodeError, ValueError):
-        pass
+    session["steps"].update(
+        carry_green_steps(args.out, args.max_carry_age_hours))
     if not args.skip_probe and not _probe():
         session["steps"]["probe"] = {"ok": False,
                                      "error": "tunnel unreachable"}

@@ -1,0 +1,123 @@
+"""Carry-over semantics of the one-shot TPU session capture.
+
+The session artifact (``benchmarks/TPU_SESSION.json``) is committed and
+banked across tunnel up-windows, so the carry/retry logic is
+load-bearing: a bug here either re-burns a precious window on an
+already-green step or — worse — lets a new round skip hardware entirely
+by carrying stale green steps forward. Pure-python tests; no jax.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name, rel):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, rel))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def tpu_session():
+    return _load("_tpu_session_under_test", "benchmarks/tpu_session.py")
+
+
+@pytest.fixture(scope="module")
+def tunnel_watch():
+    return _load("_tunnel_watch_under_test", "benchmarks/tunnel_watch.py")
+
+
+NOW = 1_800_000_000.0  # arbitrary fixed epoch for injectable clocks
+
+
+def _write(tmp_path, steps):
+    p = tmp_path / "sess.json"
+    p.write_text(json.dumps(
+        {"started_utc": "2026-08-01T00:00:00Z", "steps": steps}))
+    return str(p)
+
+
+def _stamp(hours_before):
+    import time
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                         time.gmtime(NOW - hours_before * 3600))
+
+
+def test_fresh_green_step_carries(tpu_session, tmp_path):
+    art = _write(tmp_path, {"headline": {
+        "ok": True, "captured_utc": _stamp(1)}})
+    got = tpu_session.carry_green_steps(art, 12.0, now=NOW)
+    assert "headline" in got
+
+
+def test_stale_green_step_drops(tpu_session, tmp_path):
+    art = _write(tmp_path, {"headline": {
+        "ok": True, "captured_utc": _stamp(20)}})
+    assert tpu_session.carry_green_steps(art, 12.0, now=NOW) == {}
+
+
+def test_unstamped_green_step_drops(tpu_session, tmp_path):
+    # a step written by pre-stamp code is infinitely old by definition
+    art = _write(tmp_path, {"headline": {"ok": True}})
+    assert tpu_session.carry_green_steps(art, 12.0, now=NOW) == {}
+
+
+def test_failed_step_never_carries(tpu_session, tmp_path):
+    art = _write(tmp_path, {"ladder": {
+        "ok": False, "captured_utc": _stamp(0.1)}})
+    assert tpu_session.carry_green_steps(art, 12.0, now=NOW) == {}
+
+
+def test_missing_or_garbage_artifact(tpu_session, tmp_path):
+    assert tpu_session.carry_green_steps(
+        str(tmp_path / "nope.json"), 12.0, now=NOW) == {}
+    p = tmp_path / "garbage.json"
+    p.write_text("not json{")
+    assert tpu_session.carry_green_steps(str(p), 12.0, now=NOW) == {}
+    p.write_text(json.dumps({"steps": "not-a-dict"}))
+    assert tpu_session.carry_green_steps(str(p), 12.0, now=NOW) == {}
+
+
+def test_mixed_artifact_carries_only_fresh_green(tpu_session, tmp_path):
+    art = _write(tmp_path, {
+        "headline": {"ok": True, "captured_utc": _stamp(2)},
+        "sweep": {"ok": True, "captured_utc": _stamp(30)},
+        "ladder": {"ok": False, "captured_utc": _stamp(2)},
+        "probe": {"ok": False, "error": "tunnel unreachable"},
+    })
+    got = tpu_session.carry_green_steps(art, 12.0, now=NOW)
+    assert set(got) == {"headline"}
+
+
+def test_pending_steps_skips_carried_green(tunnel_watch, tmp_path,
+                                           monkeypatch):
+    """The watcher's retry fire must re-run only non-green steps, in
+    the original priority order."""
+    art = tmp_path / "sess.json"
+    art.write_text(json.dumps({"steps": {
+        "headline": {"ok": True},
+        "ladder": {"ok": False},
+    }}))
+    monkeypatch.setattr(tunnel_watch, "SESSION_JSON", str(art))
+    want = ["headline", "sweep", "rolling", "spot", "ladder"]
+    assert tunnel_watch._pending_steps(want) == [
+        "sweep", "rolling", "spot", "ladder"]
+
+
+def test_pending_steps_all_green_reruns_everything(tunnel_watch,
+                                                   tmp_path,
+                                                   monkeypatch):
+    """All-green artifact: the watcher treats the fire as a fresh full
+    run (`or want` fallback) rather than firing an empty step list."""
+    art = tmp_path / "sess.json"
+    art.write_text(json.dumps({"steps": {"headline": {"ok": True}}}))
+    monkeypatch.setattr(tunnel_watch, "SESSION_JSON", str(art))
+    assert tunnel_watch._pending_steps(["headline"]) == ["headline"]
