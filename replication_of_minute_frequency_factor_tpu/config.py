@@ -42,12 +42,8 @@ class Config:
     #: debug sanitizer: validate day tensors (finite prices, high>=low,
     #: volume>=0 on valid lanes) before compute; raises DayDataError
     debug_validate: bool = False
-    #: rolling-moment backend for the mmt_ols_* family. 'conv' (XLA) is
-    #: the only backend: a Pallas VMEM-resident kernel existed through
-    #: round 3 but was removed without ever running on hardware (the
-    #: tunnel stayed wedged through every capture window; see
-    #: docs/ROADMAP.md "Pallas decision" — the code lives in git history
-    #: before 2026-08-01 if a future chip makes it worth resurrecting)
+    #: rolling-moment backend for the mmt_ols_* family: 'conv' (XLA) or
+    #: 'pallas' (fused VMEM-resident kernel, ops/pallas_rolling.py)
     rolling_impl: str = "conv"
     #: index-pool membership parquet enabling cal_final_exposure's
     #: stock_pool= (data/io.py read_stock_pool); None keeps the

@@ -1,0 +1,137 @@
+"""Pallas TPU kernel for the rolling 50-bar moment family.
+
+The ``mmt_ols_*`` kernels need, per minute slot, trailing-window count,
+means, covariance and variances of (low, high) — the hottest compute in
+the 58-factor graph. The XLA formulation (ops/rolling.py) is precise but
+memory-bound: the exact two-pass moments run a 50-iteration roll loop,
+each iteration streaming three ``[N, 240]`` arrays through HBM (~50x6
+array passes per batch).
+
+This kernel keeps one row-block of the day tensor resident in VMEM and
+does everything locally:
+
+  * windowed counts/sums as banded matmuls — a ``[240, 240]`` constant
+    lower-banded ones matrix on the MXU replaces the 1-wide convolution
+    (conv with channel=1 maps poorly onto the 128x128 systolic array);
+  * the exact two-pass deviation loop (``sum_j (x[m-j] - mu_w[m])^2``)
+    as an in-VMEM ``fori_loop`` over lane rotations — no HBM round-trips
+    between iterations.
+
+Numerics are identical to the XLA path by construction: same banded-sum
+windowing (HIGHEST-precision dots), same day-mean centring, same
+two-pass deviation accumulation, so the conv-vs-pallas parity test pins
+them to ~1 ulp.
+
+Disabled by default (``Config.rolling_impl = 'conv'``) until profiled
+faster on real hardware; tests run the interpreter.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces; absent on some CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    _VMEM = None
+
+N_SLOTS = 240
+_BLOCK_ROWS = 256
+
+
+def _banded(window: int, n: int = N_SLOTS) -> np.ndarray:
+    """A[s, m] = 1 iff slot s lies in m's trailing window (m-W, m]."""
+    s = np.arange(n)[:, None]
+    m = np.arange(n)[None, :]
+    return ((s <= m) & (s > m - window)).astype(np.float32)
+
+
+def _kernel(a_ref, x_ref, y_ref, m_ref,
+            cnt_ref, mx_ref, my_ref, cov_ref, vx_ref, vy_ref,
+            *, window: int):
+    a = a_ref[...]
+    m = m_ref[...]
+    x = x_ref[...] * m
+    y = y_ref[...] * m
+
+    dot = functools.partial(
+        jax.lax.dot_general,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)
+
+    inv_w = 1.0 / window
+    cnt_ref[...] = dot(m, a)
+    mx_ref[...] = dot(x, a) * inv_w
+    my_ref[...] = dot(y, a) * inv_w
+
+    # day-mean centring (keeps magnitudes small; see ops/rolling.py)
+    n_day = jnp.maximum(jnp.sum(m, axis=-1, keepdims=True), 1.0)
+    xc = (x - jnp.sum(x, axis=-1, keepdims=True) / n_day) * m
+    yc = (y - jnp.sum(y, axis=-1, keepdims=True) / n_day) * m
+    mu_x = dot(xc, a) * inv_w
+    mu_y = dot(yc, a) * inv_w
+
+    def body(j, acc):
+        s_xx, s_yy, s_xy = acc
+        d = jnp.roll(xc, j, axis=-1) - mu_x
+        e = jnp.roll(yc, j, axis=-1) - mu_y
+        return (s_xx + d * d, s_yy + e * e, s_xy + d * e)
+
+    zero = jnp.zeros_like(mu_x)
+    s_xx, s_yy, s_xy = jax.lax.fori_loop(0, window, body, (zero, zero, zero))
+    cov_ref[...] = s_xy * inv_w
+    vx_ref[...] = jnp.maximum(s_xx * inv_w, 0.0)
+    vy_ref[...] = jnp.maximum(s_yy * inv_w, 0.0)
+
+
+def rolling_window_stats_pallas(
+        x, y, mask, window: int = 50,
+        interpret: Optional[bool] = None) -> Dict[str, jnp.ndarray]:
+    """Drop-in for :func:`ops.rolling.rolling_window_stats` (same contract:
+    stats are garbage outside ``valid`` lanes and must be masked)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    lead = x.shape[:-1]
+    n = int(np.prod(lead)) if lead else 1
+    xf = jnp.reshape(x.astype(jnp.float32), (n, N_SLOTS))
+    yf = jnp.reshape(y.astype(jnp.float32), (n, N_SLOTS))
+    mf = jnp.reshape(mask.astype(jnp.float32), (n, N_SLOTS))
+    pad = (-n) % _BLOCK_ROWS
+    if pad:
+        xf, yf, mf = (jnp.pad(v, ((0, pad), (0, 0))) for v in (xf, yf, mf))
+    rows = n + pad
+    a = jnp.asarray(_banded(window))
+
+    row_spec = pl.BlockSpec((_BLOCK_ROWS, N_SLOTS), lambda i: (i, 0),
+                            **({} if _VMEM is None
+                               else {"memory_space": _VMEM}))
+    a_spec = pl.BlockSpec((N_SLOTS, N_SLOTS), lambda i: (0, 0),
+                          **({} if _VMEM is None
+                             else {"memory_space": _VMEM}))
+    shape = jax.ShapeDtypeStruct((rows, N_SLOTS), jnp.float32)
+    outs = pl.pallas_call(
+        functools.partial(_kernel, window=window),
+        grid=(rows // _BLOCK_ROWS,),
+        in_specs=[a_spec, row_spec, row_spec, row_spec],
+        out_specs=[row_spec] * 6,
+        out_shape=[shape] * 6,
+        interpret=interpret,
+    )(a, xf, yf, mf)
+    cnt, mean_x, mean_y, cov, var_x, var_y = (
+        jnp.reshape(o[:n], lead + (N_SLOTS,)) for o in outs)
+    return {
+        "valid": cnt > window - 0.5,
+        "mean_x": mean_x,
+        "mean_y": mean_y,
+        "cov": cov,
+        "var_x": var_x,
+        "var_y": var_y,
+    }

@@ -58,20 +58,23 @@ def rolling_window_stats(x, y, mask, window: int = 50,
     Stats are only meaningful where ``valid``; other lanes are garbage and
     must be masked by the caller.
 
-    ``impl``: ``'conv'`` (the XLA formulation — the only backend; a
-    Pallas kernel was removed in round 3, see Config.rolling_impl);
-    None reads ``Config.rolling_impl``.
+    ``impl``: ``'conv'`` (XLA, default) or ``'pallas'`` (the VMEM-resident
+    fused kernel, ops/pallas_rolling.py); None reads ``Config.rolling_impl``.
     """
     from replication_of_minute_frequency_factor_tpu import pins
 
     if impl is None:
         from ..config import get_config
         impl = get_config().rolling_impl
+    if impl not in ("conv", "pallas"):
+        raise ValueError(f"unknown rolling_impl {impl!r}; "
+                         "expected 'conv' or 'pallas'")
     degenerate = pins.reading("constant_window") == "degenerate"
-    if impl != "conv":
-        # 'pallas' was removed in round 3 without ever running on
-        # hardware (tunnel wedged through every window; ROADMAP.md)
-        raise ValueError(f"unknown rolling_impl {impl!r}; only 'conv'")
+    if impl == "pallas":
+        if degenerate:
+            from .pallas_rolling import rolling_window_stats_pallas
+            return rolling_window_stats_pallas(x, y, mask, window)
+        impl = "conv"  # the pallas kernel implements only the default pin
     m = mask.astype(x.dtype)
     xm = jnp.where(mask, x, 0.0)
     ym = jnp.where(mask, y, 0.0)

@@ -1,8 +1,9 @@
 """The differential fuzz harnesses stay runnable and clean on a seed window.
 
 The long-run sweeps live in tools/fuzz/ and are driven out-of-band
-(README there records the cleared seed-run tallies); this smoke keeps
-the harness entry points from rotting.
+(README there records the cleared seed-run tallies); this smoke keeps the
+harness entry points from rotting and gives CI a slice of randomized
+Pallas-vs-conv coverage beyond test_pallas_rolling's fixed scenario.
 """
 
 import os
@@ -22,8 +23,8 @@ def run_harness(name, lo, hi, timeout=400):
              str(lo), str(hi)],
             capture_output=True, text=True, timeout=timeout, env=env)
     except subprocess.TimeoutExpired:
-        # distinguish a slow host (jit compiles on 1 CPU core can brush
-        # the budget) from a harness bug
+        # distinguish a slow host (two jit compiles + interpret-mode
+        # Pallas on 1 CPU core can brush the budget) from a harness bug
         raise AssertionError(
             f"{name} [{lo},{hi}) exceeded the {timeout}s smoke budget — "
             f"harness slowness, not a differential failure; raise the "
@@ -31,6 +32,10 @@ def run_harness(name, lo, hi, timeout=400):
     assert out.returncode == 0, out.stderr[-2000:]
     last = [l for l in out.stdout.splitlines() if l.startswith("DONE")]
     assert last and ", 0 failures" in last[0], out.stdout[-2000:]
+
+
+def test_fuzz_pallas_seed_window():
+    run_harness("fuzz_pallas.py", 9000, 9006)
 
 
 def test_fuzz_refdiff_seed_window():

@@ -1,0 +1,157 @@
+"""Pallas-vs-conv differential fuzz for the rolling-moment backend.
+
+The seam under test is ops/pallas_rolling.py: the Pallas TPU kernel for
+the rolling 50-bar moment family (run here in interpret mode, which
+executes the same kernel logic with the same blocking/masking structure)
+against the XLA conv formulation (ops/rolling.py) that the parity suite
+has already pinned to the f64 oracle. Two tiers per seed:
+
+* moments: rolling_window_stats{,_pallas} on random (rows, 240) low/high
+  grids — random row counts (block-edge handling), window sizes, masks
+  with <window tails, all-masked rows, constant series (var=0), and
+  tick-rounded ties.
+* factors: the five mmt_ols_* kernels end to end via compute_factors_jit
+  with rolling_impl="pallas" vs "conv" on synthetic day batches.
+
+Comparator: `valid` must match exactly; moments compare where valid with
+f32-summation-order tolerance. Factor outputs must agree on finite
+pattern and value except where the window family is degenerate (rolling
+var_x below f32 noise => the beta fallback branch can route differently
+between backends); those lanes are skipped the same way the parity
+comparator's measure-zero channels are.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from tools.cpu_busy import mark_busy  # noqa: E402
+
+mark_busy('fuzz_pallas')  # gate timed TPU sessions off this 1-core host
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from replication_of_minute_frequency_factor_tpu.models.registry import (  # noqa: E402
+    compute_factors_jit, factor_names)
+from replication_of_minute_frequency_factor_tpu.ops.pallas_rolling import (  # noqa: E402
+    rolling_window_stats_pallas)
+from replication_of_minute_frequency_factor_tpu.ops.rolling import (  # noqa: E402
+    rolling_window_stats)
+
+# derived from the registry so a new rolling-family factor is
+# covered automatically instead of silently skipped
+ROLLING_FACTORS = tuple(n for n in factor_names()
+                        if n.startswith("mmt_ols_"))
+assert len(ROLLING_FACTORS) >= 5, ROLLING_FACTORS
+ROW_POOL = (1, 2, 3, 5, 8, 9)
+WINDOW_POOL = (10, 50, 50, 50, 120)   # reference uses 50; weight it
+FACTOR_T_POOL = (7, 16, 23)
+
+
+def make_grids(rng, rows):
+    shape = (rows, 240)
+    low = 10.0 * np.exp(np.cumsum(rng.normal(0, 1e-3, shape), -1))
+    high = low * (1 + np.abs(rng.normal(0, 5e-4, shape)))
+    mask = rng.random(shape) > rng.choice([0.0, 0.1, 0.5])
+    if rng.random() < 0.4 and rows > 1:
+        r = int(rng.integers(rows))
+        mask[r] = False
+        mask[r, :int(rng.integers(0, 70))] = True   # sub-window tail
+    if rng.random() < 0.2:
+        mask[int(rng.integers(rows))] = False        # fully masked row
+    if rng.random() < 0.3 and rows > 1:
+        low[int(rng.integers(rows))] = 10.0          # constant series
+    if rng.random() < 0.4:
+        low = np.round(low, 2)                       # tick ties
+        high = np.round(high, 2)
+    return low.astype(np.float32), high.astype(np.float32), mask
+
+
+def moments_case(rng, seed):
+    rows = ROW_POOL[int(rng.integers(len(ROW_POOL)))]
+    window = WINDOW_POOL[int(rng.integers(len(WINDOW_POOL)))]
+    low, high, mask = make_grids(rng, rows)
+    a = rolling_window_stats(low, high, mask, window, impl="conv")
+    b = rolling_window_stats_pallas(low, high, mask, window,
+                                    interpret=True)
+    va, vb = np.asarray(a["valid"]), np.asarray(b["valid"])
+    np.testing.assert_array_equal(va, vb, err_msg=f"{seed} valid")
+    for k in ("mean_x", "mean_y", "cov", "var_x", "var_y"):
+        np.testing.assert_allclose(
+            np.asarray(a[k])[va], np.asarray(b[k])[va],
+            rtol=3e-5, atol=1e-9, err_msg=f"{seed} {k} w={window}")
+
+
+def factors_case(rng, seed):
+    n_t = FACTOR_T_POOL[int(rng.integers(len(FACTOR_T_POOL)))]
+    s = (1, n_t, 240)
+    close = 10.0 * np.exp(np.cumsum(rng.normal(0, 1e-3, s), -1))
+    open_ = close * (1 + rng.normal(0, 1e-4, s))
+    high = np.maximum(open_, close) * (1 + np.abs(rng.normal(0, 2e-4, s)))
+    low = np.minimum(open_, close) * (1 - np.abs(rng.normal(0, 2e-4, s)))
+    volume = (rng.integers(0, 500, s) * 100).astype(float)
+    bars = np.stack([open_, high, low, close, volume], -1).astype(np.float32)
+    if rng.random() < 0.4:
+        bars[..., :4] = np.round(bars[..., :4], 2)
+    mask = rng.random(s) > rng.choice([0.0, 0.05, 0.5])
+    if rng.random() < 0.3:
+        t = int(rng.integers(n_t))
+        mask[:, t] = False
+        mask[:, t, :int(rng.integers(1, 60))] = True
+
+    conv = compute_factors_jit(bars, mask, names=ROLLING_FACTORS,
+                               rolling_impl="conv")
+    pal = compute_factors_jit(bars, mask, names=ROLLING_FACTORS,
+                              rolling_impl="pallas")
+    # degeneracy gate: lanes whose windowed var_x ever sits at f32 noise
+    # can route the beta fallback differently between backends. The gate
+    # is computed from the UNION of both backends' stats — a lane where
+    # var_x is exactly 0 under one backend but a hair above the
+    # threshold under the other would otherwise route the fallback
+    # asymmetrically and surface as a spurious failure (ADVICE r1)
+    degenerate = np.zeros(bars.shape[1], dtype=bool)
+    for impl in ("conv", "pallas"):
+        st = rolling_window_stats(bars[0, :, :, 2], bars[0, :, :, 1],
+                                  mask[0], 50, impl=impl)
+        vx = np.where(np.asarray(st["valid"]), np.asarray(st["var_x"]),
+                      np.inf)
+        mx = np.asarray(st["mean_x"])
+        degenerate |= ((vx == 0.0)
+                       | (vx < 1e-8 * np.maximum(mx * mx, 1e-12))).any(-1)
+    for k in ROLLING_FACTORS:
+        a, b = np.asarray(conv[k])[0], np.asarray(pal[k])[0]
+        keep = ~degenerate
+        same_finite = np.isfinite(a) == np.isfinite(b)
+        assert same_finite[keep].all(), (
+            seed, k, "finite pattern", np.argwhere(~same_finite)[:4])
+        f = np.isfinite(a) & np.isfinite(b) & keep
+        np.testing.assert_allclose(a[f], b[f], rtol=5e-4, atol=1e-6,
+                                   err_msg=f"{seed} {k}")
+
+
+def main():
+    lo, hi = int(sys.argv[1]), int(sys.argv[2])
+    fails = []
+    for seed in range(lo, hi):
+        rng = np.random.default_rng(seed)
+        try:
+            moments_case(rng, seed)
+            if rng.random() < 0.5:
+                factors_case(rng, seed)
+        except AssertionError as e:
+            fails.append(seed)
+            print(f"SEED {seed}: {str(e)[:300]}", flush=True)
+        if (seed - lo + 1) % 25 == 0:
+            print(f"...{seed-lo+1} done, {len(fails)} failures", flush=True)
+    print(f"DONE {hi-lo} seeds, {len(fails)} failures: {fails}")
+
+
+if __name__ == "__main__":
+    main()
