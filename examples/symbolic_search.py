@@ -67,6 +67,38 @@ def recover_upratio(bars, mask):
     return res
 
 
+def recover_gap_reversal(bars, mask):
+    """Plant an overnight-gap reversal (next-day return ∝ −gap, a
+    classic cross-day microstructure signal) and let the GA discover
+    the round-3 cross-day genome feature. Inexpressible before the
+    `gap`/`prev_ret`/`vprev` features: every older feature sees one
+    day in isolation."""
+    o = bars[..., 0].astype(np.float64)
+    c = bars[..., 3].astype(np.float64)
+    # mask-aware first open / last close per (day, ticker)
+    first_idx = np.argmax(mask, axis=-1)
+    last_idx = mask.shape[-1] - 1 - np.argmax(mask[..., ::-1], axis=-1)
+    day_open = np.take_along_axis(o, first_idx[..., None], -1)[..., 0]
+    day_close = np.take_along_axis(c, last_idx[..., None], -1)[..., 0]
+    any_valid = mask.any(-1)
+    day_open = np.where(any_valid, day_open, np.nan)
+    day_close = np.where(any_valid, day_close, np.nan)
+    prev_close = np.concatenate(
+        [np.full_like(day_close[:1], np.nan), day_close[:-1]], axis=0)
+    gap = day_open / prev_close - 1.0
+    import warnings
+    with warnings.catch_warnings():
+        # day 0 has no previous close -> all-NaN row -> benign
+        # "Mean of empty slice"; that day is excluded via fwd_valid
+        warnings.simplefilter("ignore", RuntimeWarning)
+        signal = -(gap - np.nanmean(gap, axis=-1, keepdims=True))
+    fwd_valid = np.isfinite(signal)
+    fwd = np.nan_to_num(signal).astype(np.float32)
+    return search.evolve(bars, mask, fwd, fwd_valid,
+                         pop=256, generations=6, seed=7,
+                         device_batch=256)
+
+
 def main(seed: int = 0) -> None:
     rng = np.random.default_rng(seed)
     bars, mask, fwd = make_days(rng)
@@ -87,6 +119,12 @@ def main(seed: int = 0) -> None:
     print("recovered:", search.describe(res.genome,
                                         search.RICH_SKELETON))
     assert res.fitness > 0.8, "failed to recover the planted factor"
+
+    print("\n-- planted overnight-gap reversal recovery (cross-day) --")
+    res = recover_gap_reversal(bars, mask)
+    print(f"best |IC| = {res.fitness:.3f}")
+    print("recovered:", search.describe(res.genome))
+    assert res.fitness > 0.8, "failed to recover the cross-day factor"
 
 
 if __name__ == "__main__":

@@ -13,7 +13,10 @@ flow). Stack entries are (series, validity-mask) pairs over the 240-minute
 axis; a genome assigns each slot a choice:
 
   PUSH   -> which per-bar feature series to push (open/.../volume, intrabar
-            return, volume share, hl-range, tod ramp), with the day mask
+            return, volume share, hl-range, tod ramp; cross-day state:
+            overnight gap, prev-day return, volume over prev-day total —
+            NaN on day 0, like pct_change().over('code')'s first row),
+            with the day mask
   UNARY  -> identity / neg / abs / log1p|x| / zscore over valid bars /
             lag-1 / cumsum / delta-1 / rolling mean (5, 30) / rolling
             std (5, 30) — windowed ops run masked over the minute axis
@@ -48,8 +51,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .data.minute import F_CLOSE, F_HIGH, F_LOW, F_OPEN, F_VOLUME
-from .ops import (masked_corr, masked_last, masked_max, masked_mean,
-                  masked_min, masked_std, masked_sum)
+from .ops import (masked_corr, masked_first, masked_last, masked_max,
+                  masked_mean, masked_min, masked_std, masked_sum)
 
 # slot kinds
 PUSH, UNARY, BINARY, MASK, AGG = 0, 1, 2, 3, 4
@@ -75,7 +78,7 @@ RICH_SKELETON: Tuple[int, ...] = (
     BINARY,
 )
 
-N_FEATURES = 9
+N_FEATURES = 12
 N_UNARY = 12
 N_BINARY = 7
 N_MASK = 6
@@ -88,8 +91,25 @@ _KIND_SIZES = {PUSH: N_FEATURES, UNARY: N_UNARY, BINARY: N_BINARY,
 ROLL_FAST, ROLL_SLOW = 5, 30
 
 
+def _prev_day(x):
+    """Shift a per-(day, ticker) aggregate to the NEXT day along the
+    leading (trading-day) axis; day 0 gets NaN — the cross-day analogue
+    of the reference's null-on-first-row ``pct_change().over('code')``
+    (MinuteFrequentFactorCalculateMethodsCICC.py:746)."""
+    return jnp.concatenate(
+        [jnp.full_like(x[:1], jnp.nan), x[:-1]], axis=0)
+
+
 def _features(bars, mask):
-    """Feature bank ``[F, ..., 240]`` of per-bar series."""
+    """Feature bank ``[F, D, T, 240]`` of per-bar series.
+
+    The leading bars axis is the trading-day axis (consecutive days,
+    sorted): the three cross-day features (overnight gap, previous-day
+    intraday return, volume relative to the previous day's total) shift
+    per-day aggregates along it. Day 0 — and any (day, ticker) whose
+    previous day has no valid bars — carries NaN there, which the
+    fitness path already treats as invalid.
+    """
     o = bars[..., F_OPEN]
     h = bars[..., F_HIGH]
     l = bars[..., F_LOW]
@@ -102,7 +122,27 @@ def _features(bars, mask):
     hlr = (h - l) / jnp.where(jnp.abs(l) > eps, l, 1.0)
     tod = jnp.broadcast_to(jnp.linspace(-1.0, 1.0, bars.shape[-2]),
                            mask.shape)
-    return jnp.stack([o, h, l, c, v, ret, vshare, hlr, tod])
+    # cross-day state ([D, T] aggregates, broadcast back to the bar axis)
+    day_open = masked_first(o, mask)
+    day_close = masked_last(c, mask)
+    prev_close = _prev_day(day_close)
+    gap = jnp.where(jnp.abs(prev_close) > eps,
+                    day_open / prev_close - 1.0, jnp.nan)
+    prev_ret = _prev_day(jnp.where(jnp.abs(day_open) > eps,
+                                   day_close / day_open - 1.0, jnp.nan))
+    # NaN (not 0) when the previous day has no valid bars, so a fully
+    # halted prev day makes vprev invalid like gap/prev_ret — 0 would
+    # turn vprev into today's RAW volume, an out-of-distribution value
+    # the GA could exploit
+    prev_vol = _prev_day(jnp.where(
+        jnp.any(mask, axis=-1),
+        jnp.sum(jnp.where(mask, v, 0.0), axis=-1), jnp.nan))
+    vprev = v / jnp.maximum(prev_vol[..., None], 1.0)
+    series = jnp.broadcast_to
+    return jnp.stack([o, h, l, c, v, ret, vshare, hlr, tod,
+                      series(gap[..., None], mask.shape),
+                      series(prev_ret[..., None], mask.shape),
+                      vprev])
 
 
 def _windowed_sum(x, w):
@@ -157,7 +197,12 @@ def rolling_corr(a, b, m, w):
     denom = jnp.sqrt(va * vb)
     ok = (denom > 0) & (n > 1.5)
     r = jnp.where(ok, cov / jnp.where(ok, denom, 1.0), 0.0)
-    return jnp.clip(r, -1.0, 1.0)  # f32 noise can push an exact fit past 1
+    r = jnp.clip(r, -1.0, 1.0)  # f32 noise can push an exact fit past 1
+    # NaN inputs (cross-day features on day 0 / halted-prev-day lanes)
+    # make cov/denom NaN, which the ok gate would otherwise launder to a
+    # finite 0 — the one op family where NaN wouldn't propagate, letting
+    # undefined cross-day lanes re-enter the fitness IC as valid
+    return jnp.where(jnp.isnan(cov) | jnp.isnan(denom), jnp.nan, r)
 
 
 def _apply_unary(k, x, mask):
@@ -397,7 +442,7 @@ def evolve(bars, mask, fwd_ret, fwd_valid,
 
 
 FEAT_NAMES = ["open", "high", "low", "close", "vol", "ret", "vshare",
-              "hlr", "tod"]
+              "hlr", "tod", "gap", "prev_ret", "vprev"]
 UNARY_NAMES = ["id", "neg", "abs", "log1p", "z", "lag1", "cumsum",
                "delta1", f"rmean{ROLL_FAST}", f"rmean{ROLL_SLOW}",
                f"rstd{ROLL_FAST}", f"rstd{ROLL_SLOW}"]

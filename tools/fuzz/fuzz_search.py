@@ -34,7 +34,46 @@ def np_features(bars, mask):
     tod = np.broadcast_to(
         np.linspace(-1.0, 1.0, bars.shape[-2]).astype(np.float32),
         mask.shape)
-    feats = np.stack([o, h, l, c, v, ret, vshare, hlr, tod])
+
+    # cross-day state: per-(day, ticker) aggregates over valid bars,
+    # shifted to the next day along the leading axis (NaN on day 0)
+    def first_valid(x):
+        idx = np.argmax(mask, axis=-1)
+        val = np.take_along_axis(x, idx[..., None], axis=-1)[..., 0]
+        return np.where(mask.any(-1), val, np.float32(np.nan))
+
+    def last_valid(x):
+        idx = mask.shape[-1] - 1 - np.argmax(mask[..., ::-1], axis=-1)
+        val = np.take_along_axis(x, idx[..., None], axis=-1)[..., 0]
+        return np.where(mask.any(-1), val, np.float32(np.nan))
+
+    def prev_day(a):
+        return np.concatenate(
+            [np.full_like(a[:1], np.nan), a[:-1]], axis=0)
+
+    day_open = first_valid(o)
+    prev_close = prev_day(last_valid(c))
+    with np.errstate(invalid="ignore", divide="ignore"):
+        gap = np.where(np.abs(prev_close) > eps,
+                       day_open / prev_close - np.float32(1.0),
+                       np.float32(np.nan)).astype(np.float32)
+        prev_ret = prev_day(np.where(
+            np.abs(day_open) > eps,
+            last_valid(c) / day_open - np.float32(1.0),
+            np.float32(np.nan))).astype(np.float32)
+        # NaN when the previous day has no valid bars (mirrors search
+        # _features: 0 would make vprev today's raw volume)
+        prev_vol = prev_day(np.where(
+            mask.any(-1),
+            np.sum(np.where(mask, v, np.float32(0.0)), axis=-1,
+                   dtype=np.float32), np.float32(np.nan)))
+        vprev = (v / np.maximum(prev_vol[..., None],
+                                np.float32(1.0))).astype(np.float32)
+    bcast = np.broadcast_to
+    feats = np.stack([o, h, l, c, v, ret, vshare, hlr, tod,
+                      bcast(gap[..., None], mask.shape),
+                      bcast(prev_ret[..., None], mask.shape),
+                      vprev])
     assert feats.dtype == np.float32  # a single f64 input would promote all
     return feats
 
@@ -101,7 +140,11 @@ def np_rolling_corr(a, b, m, w):
     with np.errstate(invalid="ignore", divide="ignore"):
         r = np.where(ok, cov / np.where(ok, denom, np.float32(1.0)),
                      np.float32(0.0))
-    return np.clip(r, -1.0, 1.0).astype(np.float32)
+    r = np.clip(r, -1.0, 1.0).astype(np.float32)
+    # mirror search.rolling_corr: NaN inputs (cross-day features) must
+    # propagate, not launder to 0 through the ok gate
+    return np.where(np.isnan(cov) | np.isnan(denom),
+                    np.float32(np.nan), r)
 
 
 def np_unary(k, x, m, flag=None):
@@ -404,7 +447,11 @@ fails = []
 lo, hi = int(sys.argv[1]), int(sys.argv[2])
 for seed in range(lo, hi):
     rng = np.random.default_rng(seed)
-    D = int(rng.integers(1, 3))
+    # D up to 4: the cross-day features (gap/prev_ret/vprev) need
+    # multi-day shift chains — D=1 leaves them all-NaN and D=2 gives
+    # exactly one real day, so the shift/empty-day paths would
+    # otherwise go unexercised
+    D = int(rng.integers(1, 5))
     T = int(rng.integers(2, 8))
     shape = (D, T, 240)
     close = 10.0 * np.exp(np.cumsum(
@@ -417,6 +464,11 @@ for seed in range(lo, hi):
     mask = rng.random(shape) > rng.choice([0.0, 0.1, 0.6])
     if rng.random() < 0.3:
         mask[:, 0] = False  # halted ticker -> NaN factor
+    if rng.random() < 0.3 and D > 1:
+        # single-DAY halt: exercises the 'previous day halted, current
+        # day alive' cross-day branch (gap/prev_ret NaN via empty
+        # masked_first/last, vprev NaN via the prev_vol guard)
+        mask[int(rng.integers(0, D)), int(rng.integers(0, T))] = False
     P = int(rng.integers(1, 24))
     # rotate skeletons: the round-2 default (PUSH/UNARY/BINARY only) and
     # the round-3 ratio-of-aggregates shape (MASK + AGG kinds)
