@@ -156,6 +156,18 @@ def step_sweep():
                            timeout=1800)
 
 
+def step_link():
+    """Transfer diagnostics (benchmarks/transfer_probe.py --json):
+    dedupe check, both-direction bandwidth, and the per-transfer
+    latency floor — the number that decides whether the headline's
+    unaccounted ~3.7 s/batch is dispatch latency (bigger batches fix
+    it) or mid-loop bandwidth sag (they don't). ~1 min; cheapest
+    first-class evidence a short window can bank."""
+    return _run_json_lines(
+        [sys.executable, "benchmarks/transfer_probe.py", "28", "--json"],
+        timeout=600)
+
+
 def step_pallas_vs_conv():
     """On-chip timing + agreement for the rolling-moment kernel backends
     (conv vs pallas — the Pallas path's first-ever hardware run), plus an
@@ -373,7 +385,8 @@ def main():
              # "rolling" is the historical name for the same step (the
              # running watcher and prior artifacts use it)
              "pallas": step_pallas_vs_conv, "rolling": step_pallas_vs_conv,
-             "spot": step_graph_spotcheck, "sweep": step_sweep}
+             "spot": step_graph_spotcheck, "sweep": step_sweep,
+             "link": step_link}
     want = [s.strip() for s in args.steps.split(",") if s.strip()]
     for name in want:
         if session["steps"].get(name, {}).get("ok"):

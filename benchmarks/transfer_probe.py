@@ -16,15 +16,26 @@ practice and the upstream leg is what the pipeline's result
 materialization pays (~9 MB of [F, D, T] per batch; see the
 copy_to_host_async overlap in pipeline._run_device_pipeline).
 
-Run on the TPU:  python benchmarks/transfer_probe.py [size_mb]
+Since the 2026-08-01 headline (146 s where bandwidth+compute accounts
+for ~1.1 s of each 4.8 s batch) it also measures the PER-TRANSFER
+LATENCY floor: tiny-payload round trips each way. If the floor is
+seconds-scale, the pipeline is dispatch-latency-bound and larger
+DAYS_PER_BATCH amortizes it linearly; if it is milliseconds, the gap is
+mid-loop bandwidth degradation instead (the probe's bandwidth was
+measured immediately before the loop, not during).
+
+Run on the TPU:  python benchmarks/transfer_probe.py [size_mb] [--json]
 """
+import json
 import sys
 import time
 
 import jax
 import numpy as np
 
-SIZE_MB = float(sys.argv[1]) if len(sys.argv) > 1 else 28.0
+JSON_MODE = "--json" in sys.argv
+_pos = [a for a in sys.argv[1:] if not a.startswith("-")]
+SIZE_MB = float(_pos[0]) if _pos else 28.0
 N = 6
 
 
@@ -84,6 +95,37 @@ def main():
           f"device->host rate" if updown < 1 else
           f"up/down asymmetry: device->host is {updown:.2f}x the "
           f"host->device rate")
+
+    # ---- per-transfer latency floor: 4 KB round trips each way ----
+    tiny = rng.integers(0, 256, 4096, dtype=np.uint8)
+    lat_put, lat_get = [], []
+    for i in range(N):
+        t0 = time.perf_counter()
+        d = jax.device_put(tiny + np.uint8(i))  # distinct bytes
+        jax.block_until_ready(d)
+        lat_put.append(time.perf_counter() - t0)
+        d2 = d + np.uint8(1)
+        jax.block_until_ready(d2)
+        t0 = time.perf_counter()
+        np.asarray(d2)
+        lat_get.append(time.perf_counter() - t0)
+    print(f"latency floor    : put(4KB) min {min(lat_put)*1e3:.1f}ms  "
+          f"get(4KB) min {min(lat_get)*1e3:.1f}ms")
+
+    if JSON_MODE:
+        med = lambda ts: sorted(ts)[len(ts) // 2]  # noqa: E731
+        print(json.dumps({
+            "size_mb": SIZE_MB,
+            "same_fresh_ratio": round(min(same) / min(fresh), 3),
+            # bench.py convention: down = host->device (the `fresh`
+            # put timings), up = device->host (the asarray timings)
+            "down_MBps_min": round(SIZE_MB / (min(fresh) * 1e3) * 1e3, 1),
+            "up_MBps_min": round(SIZE_MB / (min(down) * 1e3) * 1e3, 1),
+            "lat_put_ms_min": round(min(lat_put) * 1e3, 1),
+            "lat_put_ms_med": round(med(lat_put) * 1e3, 1),
+            "lat_get_ms_min": round(min(lat_get) * 1e3, 1),
+            "lat_get_ms_med": round(med(lat_get) * 1e3, 1),
+        }))
 
 
 if __name__ == "__main__":
