@@ -180,13 +180,14 @@ def _wait_host_quiet(max_wait_s=600.0):
         from tools.cpu_busy import live_owners
     except ImportError:  # not running from a repo checkout
         return True
+    me = os.getpid()
     t0 = time.monotonic()
-    owners = live_owners()
+    owners = [p for p in live_owners() if p != me]
     while owners and time.monotonic() - t0 < max_wait_s:
         print(f"# waiting for CPU-busy pids {owners} before timing",
               file=sys.stderr, flush=True)
         time.sleep(15)
-        owners = live_owners()
+        owners = [p for p in live_owners() if p != me]
     return not owners
 
 
@@ -201,6 +202,17 @@ def main():
               file=sys.stderr, flush=True)
         sys.exit(17)
     _wait_host_quiet()
+    try:
+        from tools.cpu_busy import mark_busy
+    except ImportError:  # not running from a repo checkout
+        pass
+    else:
+        # hold the sentinel for the rest of the run: the tunnel watcher
+        # must not fire a capture session mid-bench (two TPU workloads
+        # over one tunnel + one host core would corrupt both timings) —
+        # notably the driver's round-end bench run, which the watcher
+        # can outlive
+        mark_busy("bench headline")
     import queue
     import threading
 

@@ -13,13 +13,16 @@ apply_compilation_cache(get_config())  # persistent XLA cache when configured
 
 names = factor_names()
 # D=8 is what the headline itself measures (bench.py) — no need to
-# re-time it here. The large end matters most: the 2026-08-01 headline
-# showed ~4.8 s/batch against ~0.7 s of bandwidth+compute at probe
-# rates, i.e. the pipeline looks per-dispatch-latency-bound over the
-# tunnel, and latency amortizes linearly with batch size. 61 days =
+# re-time it here, and D=16 is dominated either way (if latency-bound,
+# 32/61 amortize more; if bandwidth-bound, all D are equal), so two
+# points keep the sweep inside a short window (each D pays its own
+# ~40 s TPU compile). The large end matters most: the 2026-08-01
+# headline showed ~4.8 s/batch against ~0.7 s of bandwidth+compute at
+# probe rates, i.e. the pipeline looks per-dispatch-latency-bound over
+# the tunnel, and latency amortizes linearly with batch size. 61 days =
 # exactly 4 batches per trading year (244/61); decoded grid at D=61 is
 # ~1.5 GB f32 in HBM — comfortable on a 16 GB chip.
-for D in (16, 32, 61):
+for D in (32, 61):
     rng = np.random.default_rng(0)
     ITERS = max(3, 32 // D)  # amortize over >= 32 days per config
     # distinct bytes every iteration (incl. warmup) so transfer-path
