@@ -109,6 +109,18 @@ def carry_green_steps(artifact_path, max_age_hours, now=None):
         return {}
 
 
+def drop_conv_only_rolling(steps):
+    """Content check for carried rolling-step entries, not just name: a
+    green 'rolling'/'pallas' entry banked by pre-restoration code times
+    only the conv backend — it must not satisfy the conv-vs-pallas step
+    (which the carry would otherwise skip forever)."""
+    return {k: v for k, v in steps.items()
+            if k not in ("rolling", "pallas")
+            or any("pallas_ms_per_batch" in rec
+                   for rec in v.get("results") or []
+                   if isinstance(rec, dict))}
+
+
 def _run_one_step_child(name, timeout=1500):
     """Run a step's in-process body in a killable child.
 
@@ -357,17 +369,8 @@ def main():
                    None if "TPU_SESSION_HOST_QUIET" not in os.environ
                    else os.environ["TPU_SESSION_HOST_QUIET"] == "True"),
                "steps": {}}
-    session["steps"].update(
-        carry_green_steps(args.out, args.max_carry_age_hours))
-    # content check, not just name: a green 'rolling'/'pallas' entry
-    # banked by pre-restoration code times only the conv backend — it
-    # must not satisfy the conv-vs-pallas step
-    for alias in ("rolling", "pallas"):
-        r = session["steps"].get(alias)
-        if r and not any("pallas_ms_per_batch" in rec
-                         for rec in r.get("results") or []
-                         if isinstance(rec, dict)):
-            del session["steps"][alias]
+    session["steps"].update(drop_conv_only_rolling(
+        carry_green_steps(args.out, args.max_carry_age_hours)))
     if not args.skip_probe and not _probe():
         session["steps"]["probe"] = {"ok": False,
                                      "error": "tunnel unreachable"}

@@ -97,6 +97,24 @@ def test_mixed_artifact_carries_only_fresh_green(tpu_session, tmp_path):
     assert set(got) == {"headline"}
 
 
+def test_conv_only_rolling_dropped(tpu_session):
+    """A green rolling entry without pallas timing (banked by
+    pre-restoration code) must not satisfy the conv-vs-pallas step."""
+    steps = {
+        "rolling": {"ok": True, "results": [
+            {"backend": "tpu", "conv_ms_per_batch": 2.0}]},
+        "headline": {"ok": True, "results": [{"metric": "x"}]},
+    }
+    got = tpu_session.drop_conv_only_rolling(steps)
+    assert set(got) == {"headline"}
+
+
+def test_full_rolling_entry_kept(tpu_session):
+    steps = {"pallas": {"ok": True, "results": [
+        {"conv_ms_per_batch": 2.0, "pallas_ms_per_batch": 1.0}]}}
+    assert tpu_session.drop_conv_only_rolling(steps) == steps
+
+
 def test_pending_steps_skips_carried_green(tunnel_watch, tmp_path,
                                            monkeypatch):
     """The watcher's retry fire must re-run only non-green steps, in
