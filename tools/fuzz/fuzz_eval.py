@@ -58,4 +58,10 @@ for seed in range(lo, hi):
         fails.append(seed); print(f"SEED {seed}: {str(e)[:250]}", flush=True)
     if (seed - lo + 1) % 50 == 0:
         print(f"...{seed-lo+1} done, {len(fails)} failures", flush=True)
+    if (seed - lo + 1) % 10 == 0:
+        # bound the in-process XLA-CPU executable cache: shape-varying
+        # seeds each compile fresh graphs and the cache never evicts
+        # (a 140-seed wide-shape parity run exhausted 128 GB, 2026-08-01)
+        import jax
+        jax.clear_caches()
 print(f"DONE {hi-lo} seeds, {len(fails)} failures: {fails}")

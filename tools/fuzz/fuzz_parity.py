@@ -38,4 +38,13 @@ for seed in range(lo, hi):
         traceback.print_exc()
     if (seed - lo + 1) % 20 == 0:
         print(f"...{seed - lo + 1} seeds done, {len(fails)} failures", flush=True)
+    if (seed - lo + 1) % 10 == 0:
+        # the wide tiers compile a DISTINCT fused 58-kernel graph per
+        # seed (universe/day-count vary), and XLA-CPU's in-process
+        # executable cache never evicts: ~140 wide seeds in one process
+        # exhausted a 128 GB host (LLVM 'Cannot allocate memory' then
+        # SIGSEGV, 2026-08-01). Shapes rarely repeat there, so dropping
+        # the cache costs no recompiles worth keeping.
+        import jax
+        jax.clear_caches()
 print(f"DONE {hi-lo} seeds, {len(fails)} failures: {[s for s,_ in fails]}")

@@ -92,6 +92,11 @@ for seed in range(lo, hi):
     if (seed - lo + 1) % 10 == 0:
         print(f"...{seed - lo + 1} seeds done, {len(fails)} failures",
               flush=True)
+        # bound the in-process XLA-CPU executable cache: shape-varying
+        # seeds each compile fresh graphs and the cache never evicts
+        # (a 140-seed wide-shape parity run exhausted 128 GB, 2026-08-01)
+        import jax
+        jax.clear_caches()
 print(f"DONE {hi - lo} seeds, {len(fails)} failures: "
       f"{[s for s, _ in fails]}")
 sys.exit(1 if fails else 0)
