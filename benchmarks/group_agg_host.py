@@ -1,0 +1,78 @@
+"""Measure the HOST-side period aggregation inside ``group_test``.
+
+VERDICT r3 weak #6 flagged that ``factor.group_test`` does its period
+compounding/lagging on host numpy while SURVEY §3.3 sketched an
+on-device segmented form, and asked for either the device version or a
+measured "host is fine to N x" row (VERDICT r3 #7). This times the REAL
+code — :func:`factor.aggregate_period_returns`, the exact function
+group_test calls (factored out so this benchmark cannot drift from the
+production path) — at the 5-year x 5000-ticker scale of BASELINE
+config 4, so the decision is a number, not a guess:
+
+    python benchmarks/group_agg_host.py
+
+Prints one JSON line with seconds per factor and the implied wall time
+of a 58-factor sweep; docs/DESIGN.md records the verdict.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from replication_of_minute_frequency_factor_tpu.factor import (  # noqa: E402
+    aggregate_period_returns)
+
+N_DATES = 1220          # 5 trading years
+N_CODES = 5000
+GROUPS = 10
+REPS = 3
+
+
+def make_inputs(rng):
+    """Synthesized OUTSIDE the timed region: group_test's inputs already
+    exist when its host section runs, so ~18M RNG draws per rep would
+    inflate the number this script exists to settle."""
+    present = rng.random((N_DATES, N_CODES)) > 0.05
+    pv_present = rng.random((N_DATES, N_CODES)) > 0.03
+    pct = rng.standard_normal((N_DATES, N_CODES)) * 0.02
+    labels = rng.integers(0, GROUPS, (N_DATES, N_CODES))
+    return labels, present, pv_present, pct
+
+
+def main():
+    rng = np.random.default_rng(0)
+    dates = (np.datetime64("2020-01-01")
+             + np.arange(N_DATES).astype("timedelta64[D]"))
+
+    # distinct pre-built inputs per rep (a factor sweep aggregates a
+    # different exposure matrix each time), built before the clock
+    inputs = [make_inputs(rng) for _ in range(REPS + 1)]
+
+    def run(i):
+        labels, present, pv_present, pct = inputs[i]
+        return aggregate_period_returns(
+            labels, present, pv_present, pct, dates, "month", GROUPS)
+
+    run(REPS)  # warm allocator/caches
+    t0 = time.perf_counter()
+    for r in range(REPS):
+        run(r)
+    per_factor = (time.perf_counter() - t0) / REPS
+    print(json.dumps({
+        "metric": "group_test_host_agg_5yr_5000tkr_per_factor",
+        "value": round(per_factor, 4),
+        "unit": "s",
+        "dates": N_DATES, "codes": N_CODES, "groups": GROUPS,
+        "implied_58_factor_sweep_s": round(per_factor * 58, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

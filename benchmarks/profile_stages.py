@@ -32,12 +32,12 @@ def main():
     # put stage, the put+compute stage, and the loop below), so a
     # content-addressed cache anywhere in the transfer path cannot
     # flatter a stage — see bench.py
-    batches = [make_batch(rng) for _ in range(N)]
-    comp_packed = [wire.pack_arrays(wire.encode(*make_batch(rng)).arrays)
+    batches = [make_batch(rng, n_days=8) for _ in range(N)]
+    comp_packed = [wire.pack_arrays(wire.encode(*make_batch(rng, n_days=8)).arrays)
                    for _ in range(N)]
 
     # warm (compile + first transfers) — its own batch
-    w = wire.encode(*make_batch(rng))
+    w = wire.encode(*make_batch(rng, n_days=8))
     buf, spec = wire.pack_arrays(w.arrays)
     out = _compute_packed_jit(jax.device_put(buf), spec, "wire", names,
                               True, "conv")
@@ -75,7 +75,7 @@ def main():
     ITERS = 5
 
     del batches, wires, packed, comp_packed  # stage-timing data is dead
-    loop_batches = [make_batch(rng) for _ in range(ITERS)]
+    loop_batches = [make_batch(rng, n_days=8) for _ in range(ITERS)]
 
     def produce():
         for i in range(ITERS):

@@ -1,0 +1,259 @@
+"""The REAL pipeline, end to end: 244 on-disk day files through
+``compute_exposures`` — parquet io + grid packing + wire encode +
+device compute + result materialization + atomic cache save.
+
+Why this exists (VERDICT r3 weak #1): the headline bench times a
+synthetic pre-gridded loop and extrapolates; the full-year pipeline the
+framework actually ships had never been timed end to end on ANY
+backend. This closes that gap with a second metric:
+
+    {"metric": "cicc58_real_pipeline_1yr_wall", ...}
+
+Workload mirrors the reference driver's (SURVEY.md §3.1: one polars
+pass per factor per day-file; here one fused device pass per day
+batch): 5000 codes x 244 trading days, ~2% missing bars.
+
+The dataset (~244 parquet files, a few GB) is generated ONCE into
+``.bench_data/realpipe/`` (deterministic seed) and reused by every
+later run — generation is host-side synthesis, not pipeline work, and
+must not ride a tunnel up-window. ``--generate-only`` builds it ahead
+of time.
+
+Run:  python benchmarks/real_pipeline.py [--generate-only]
+Env:  BENCH_REQUIRE_TPU=1  fail (rc 17) instead of timing a CPU run
+      under a TPU-named metric (capture-session mode);
+      on a CPU platform without it, the metric gains ``_cpu``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pyarrow as pa
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from replication_of_minute_frequency_factor_tpu.data import io as dio  # noqa: E402
+
+N_TICKERS = 5000
+N_DAYS = 244
+MISSING_PROB = 0.02
+SEED = 7
+DATA_DIR = os.path.join(REPO, ".bench_data", "realpipe")
+MARKER = os.path.join(DATA_DIR, "DATASET.json")
+
+
+def trading_days(n=None, start="2024-01-01"):
+    """First ``n`` weekdays from ``start`` — a stand-in trading calendar
+    (the pipeline only needs distinct, ordered dates). ``n`` reads
+    N_DAYS at call time so tests can shrink the module constants."""
+    if n is None:
+        n = N_DAYS
+    out, d = [], np.datetime64(start)
+    while len(out) < n:
+        if np.is_busday(d):
+            out.append(d)
+        d = d + np.timedelta64(1, "D")
+    return out
+
+
+def write_day(path, rng, grid_times, codes_int):
+    """One long-format day file from a gridded synthetic day.
+
+    Vectorized (bench.make_batch's price model flattened through the
+    ~2% missing-bar mask) — data.synthetic.synth_day loops per code in
+    Python and would take ~an hour at 5000 codes x 244 days. Codes are
+    written as int64: a real CSMAR export shape the reader normalizes
+    (data/io.py read_minute_day zero-pads), and it keeps the dataset
+    small enough to regenerate cheaply."""
+    import bench
+    bars, mask = bench.make_batch(rng, n_days=1, n_tickers=N_TICKERS)
+    return _write_day_arrays(path, bars, mask, grid_times, codes_int)
+
+
+def _write_day_arrays(path, bars, mask, grid_times, codes_int):
+    bars, mask = bars[0], mask[0]          # [T, 240, 5], [T, 240]
+    keep = mask.reshape(-1)
+    cols = {
+        "code": np.repeat(codes_int, 240)[keep],
+        "time": np.tile(grid_times, N_TICKERS)[keep],
+    }
+    flat = bars.reshape(-1, 5)[keep]
+    for i, name in enumerate(("open", "high", "low", "close", "volume")):
+        cols[name] = flat[:, i].astype(np.float64)
+    # atomic (tempfile -> os.replace): a generation killed mid-write
+    # must not leave a truncated parquet the resume pass would keep
+    dio.write_parquet_atomic(pa.table(cols), path)
+
+
+def _params():
+    return {"n_tickers": N_TICKERS, "n_days": N_DAYS,
+            "missing_prob": MISSING_PROB, "seed": SEED, "version": 2}
+
+
+def dataset_ready():
+    """True iff the on-disk dataset matches the current parameters."""
+    try:
+        with open(MARKER) as fh:
+            return json.load(fh) == _params()
+    except (OSError, ValueError):
+        return False
+
+
+def ensure_dataset(progress=True):
+    """Generate the day files once; later calls are a marker-file hit.
+
+    Each day is seeded ``SEED + day_index`` (not one sequential stream)
+    so a generation killed part-way RESUMES: existing files are kept
+    and only missing days are written — a capture-session timeout
+    mid-generation must not restart the whole dataset on the next fire
+    (version 2; v1's sequential-rng files are regenerated)."""
+    params = _params()
+    if dataset_ready():
+        return os.path.join(DATA_DIR, "kline")
+    from replication_of_minute_frequency_factor_tpu import sessions
+    mdir = os.path.join(DATA_DIR, "kline")
+    # resume is only safe when the partial files came from THESE params
+    # (the in-progress stamp); anything else on disk is another
+    # configuration's data and must go
+    inprog = MARKER + ".inprogress"
+    try:
+        with open(inprog) as fh:
+            resume = json.load(fh) == params
+    except (OSError, ValueError):
+        resume = False
+    if not resume:
+        shutil.rmtree(mdir, ignore_errors=True)
+    os.makedirs(DATA_DIR, exist_ok=True)
+    os.makedirs(mdir, exist_ok=True)
+    with open(inprog, "w") as fh:
+        json.dump(params, fh)
+    codes_int = np.arange(600000, 600000 + N_TICKERS, dtype=np.int64)
+    t0 = time.monotonic()
+    for i, d in enumerate(trading_days()):
+        path = os.path.join(mdir, str(d).replace("-", "") + ".parquet")
+        if os.path.exists(path):  # atomic writes: existing == complete
+            continue
+        write_day(path, np.random.default_rng(SEED + i),
+                  np.asarray(sessions.GRID_TIMES), codes_int)
+        if progress and (i + 1) % 20 == 0:
+            print(f"# generated {i + 1}/{N_DAYS} day files "
+                  f"({time.monotonic() - t0:.0f}s)",
+                  file=sys.stderr, flush=True)
+    with open(MARKER, "w") as fh:
+        json.dump(params, fh)
+    os.unlink(inprog)
+    return mdir
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--generate-only", action="store_true",
+                    help="build the on-disk dataset and exit (run this "
+                         "OUTSIDE a tunnel up-window)")
+    args = ap.parse_args()
+
+    try:
+        from tools.cpu_busy import mark_busy
+    except ImportError:
+        pass
+    else:
+        # held BEFORE generation too: a first standalone run otherwise
+        # pegs the one host core for minutes with no sentinel and the
+        # tunnel watcher could fire a timed capture into the contention
+        mark_busy("real_pipeline bench")
+
+    if os.environ.get("BENCH_REQUIRE_TPU") and not args.generate_only \
+            and not dataset_ready():
+        # capture-session mode must NEVER synthesize inside a tunnel
+        # up-window (e.g. the watcher's pre-gen died and its rc was
+        # only logged): fail fast; a later down-window rebuilds it
+        print("# BENCH_REQUIRE_TPU set but the dataset is not "
+              "pre-generated; run --generate-only first",
+              file=sys.stderr, flush=True)
+        return 18
+
+    mdir = ensure_dataset()
+    if args.generate_only:
+        print(f"# dataset ready under {mdir}", file=sys.stderr)
+        return 0
+
+    if "PALLAS_AXON_POOL_IPS" in os.environ:
+        # killable-child reachability probe (bench.py's) before any
+        # in-process jax: a tunnel that wedges after interpreter start
+        # would otherwise hang backend init forever with nothing able
+        # to time out. No CPU self-heal here — a CPU run of this metric
+        # is a deliberate choice (hermetic env), not a fallback.
+        import bench
+        if not bench._tunnel_alive(
+                require_tpu=bool(os.environ.get("BENCH_REQUIRE_TPU"))):
+            print("# tunnel unreachable; refusing to start the real-"
+                  "pipeline run", file=sys.stderr, flush=True)
+            return 17
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    if os.environ.get("BENCH_REQUIRE_TPU") and platform == "cpu":
+        # same race bench.main guards: probe child saw a TPU, THIS
+        # process then resolved to CPU — never print a TPU-named metric
+        print("# BENCH_REQUIRE_TPU set but jax resolved to CPU; aborting",
+              file=sys.stderr, flush=True)
+        return 17
+    suffix = "_cpu" if platform == "cpu" else ""
+
+    from replication_of_minute_frequency_factor_tpu import (
+        Config, compute_exposures, set_config)
+    from replication_of_minute_frequency_factor_tpu.config import (
+        apply_compilation_cache, get_config)
+
+    days_per_batch = int(os.environ.get("BENCH_DAYS_PER_BATCH", "32"))
+    workdir = tempfile.mkdtemp(prefix="realpipe_")
+    set_config(Config(minute_dir=mdir, days_per_batch=days_per_batch))
+    apply_compilation_cache(get_config())
+    cache_path = os.path.join(workdir, "exposures.parquet")
+
+    # the timed section IS compute_exposures: list + read + grid + wire
+    # encode + transfer + fused 58-factor device graph + materialize +
+    # atomic cache save, with the pipeline's own producer/consumer
+    # overlap — nothing mocked, nothing extrapolated
+    t0 = time.perf_counter()
+    table = compute_exposures(cache_path=cache_path, progress=False)
+    wall = time.perf_counter() - t0
+
+    failures = getattr(table, "failures", None)
+    n_failed = len(failures) if failures is not None else 0
+    record = {
+        "metric": "cicc58_real_pipeline_1yr_wall" + suffix,
+        "value": round(wall, 3),
+        "unit": "s",
+        # same <60 s north star as the headline (BASELINE.json:5): the
+        # real pipeline carries io+grid the synthetic loop skips, so
+        # parity here is strictly stronger evidence
+        "vs_baseline": round(60.0 / wall, 3),
+        "days": N_DAYS,
+        "tickers": N_TICKERS,
+        "days_per_batch": days_per_batch,
+        "factors": len(table.factor_names),
+        "rows": len(table),
+        "failed_days": n_failed,
+        "stage_timings": {k: round(v, 3)
+                          for k, v in (table.timings or {}).items()}
+        if getattr(table, "timings", None) else None,
+    }
+    shutil.rmtree(workdir, ignore_errors=True)
+    print(json.dumps(record))
+    # a run that silently skipped days must not read as a green year
+    return 0 if n_failed == 0 and len(table) > 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,11 +6,15 @@ one command (VERDICT r1 items 2/4/6 and weak #5's lesson: don't spend
 an up-window on anything else):
 
   1. the hardened headline bench (bench.py, full methodology);
-  2. the BASELINE config ladder (benchmarks/ladder.py 1,2,4,5);
-  3. conv-vs-pallas on-chip timing for the rolling-moment kernel, plus a
+  2. conv-vs-pallas on-chip timing for the rolling-moment kernel, plus a
      numeric agreement check (the Pallas path's first-ever hardware run);
-  4. correctness spot-check of the full 58-kernel graph on-chip vs the
-     CPU oracle.
+  3. transfer/link diagnostics incl. the per-transfer latency floor;
+  4. the four BASELINE configs (benchmarks/ladder.py, one step each so
+     a window closing mid-config doesn't lose the others);
+  5. correctness spot-check of the full 58-kernel graph on-chip vs the
+     CPU oracle;
+  6. the DAYS_PER_BATCH sweep and the real 244-day pipeline run — the
+     two long tails, last so they only spend leftover window.
 
 Everything lands in ONE committed artifact (default
 ``benchmarks/TPU_SESSION.json``) with per-step status, so a window that
@@ -69,9 +73,8 @@ def _run_json_lines(cmd, timeout, env=None):
 def carry_green_steps(artifact_path, max_age_hours, now=None):
     """Green steps from a prior session artifact, age-bounded per step.
 
-    A retry window runs only the pending steps, and writing a fresh
-    artifact would DROP the banked results (and make
-    tunnel_watch._pending_steps re-burn them next fire). Failed entries
+    A retry window skips the carried-green steps, and writing a fresh
+    artifact would DROP the banked results. Failed entries
     are not carried — they re-run. The bound (default ~one round) is on
     each step's own ``captured_utc`` stamp: the artifact is committed,
     so without it a NEXT round's first fire would carry last round's
@@ -110,15 +113,29 @@ def carry_green_steps(artifact_path, max_age_hours, now=None):
 
 
 def drop_conv_only_rolling(steps):
-    """Content check for carried rolling-step entries, not just name: a
-    green 'rolling'/'pallas' entry banked by pre-restoration code times
-    only the conv backend — it must not satisfy the conv-vs-pallas step
-    (which the carry would otherwise skip forever)."""
-    return {k: v for k, v in steps.items()
-            if k not in ("rolling", "pallas")
-            or any("pallas_ms_per_batch" in rec
-                   for rec in v.get("results") or []
-                   if isinstance(rec, dict))}
+    """Content checks for carried entries, not just names — a green
+    entry from an older code/configuration must not satisfy this
+    round's step (the carry would skip it forever):
+
+    * 'rolling'/'pallas' entries banked by pre-restoration code time
+      only the conv backend (no ``pallas_ms_per_batch``), and entries
+      with a truthy ``pallas_interpret`` timed the interpreter
+      emulation, not compiled Mosaic (e.g. a local CPU smoke written
+      to the committed artifact) — both drop;
+    * 'headline' entries without a ``days_per_batch`` key predate the
+      32-day loop reshape and would silently keep the new shape from
+      ever running on hardware — drop.
+    """
+    def keep(name, v):
+        recs = [r for r in v.get("results") or [] if isinstance(r, dict)]
+        if name in ("rolling", "pallas"):
+            return (any("pallas_ms_per_batch" in r for r in recs)
+                    and not any(r.get("pallas_interpret") for r in recs))
+        if name == "headline":
+            return any("days_per_batch" in r for r in recs)
+        return True
+
+    return {k: v for k, v in steps.items() if keep(k, v)}
 
 
 def _run_one_step_child(name, timeout=1500):
@@ -161,9 +178,33 @@ def step_ladder():
         timeout=1800)
 
 
+def _step_ladder_one(cfg):
+    """One BASELINE config per step (VERDICT r3 #5): the r3 window died
+    at cfg2's compile and took cfg4/cfg5 down with it because all four
+    configs shared one child. Per-config steps bank and retry
+    independently."""
+    def step():
+        return _run_json_lines(
+            [sys.executable, "benchmarks/ladder.py", "--configs", cfg],
+            timeout=900)
+    return step
+
+
+def step_pipeline():
+    """The REAL pipeline end to end (VERDICT r3 #2): 244 on-disk day
+    files through compute_exposures — io + grid + encode + device +
+    materialize + cache save — not the synthetic pre-gridded loop. The
+    dataset is generated once and reused across fires; the step needs a
+    long window, so it runs last in the default order."""
+    return _run_json_lines(
+        [sys.executable, "benchmarks/real_pipeline.py"],
+        timeout=2700, env=dict(os.environ, BENCH_REQUIRE_TPU="1"))
+
+
 def step_sweep():
-    """Optional DAYS_PER_BATCH sweep (benchmarks/sweep_batch.py) — run
-    when the window allows; not in the default step list."""
+    """DAYS_PER_BATCH sweep (benchmarks/sweep_batch.py); in the default
+    list but near the end — it informs the next round's loop shape
+    rather than banking a headline."""
     return _run_json_lines([sys.executable, "benchmarks/sweep_batch.py"],
                            timeout=1800)
 
@@ -178,6 +219,21 @@ def step_link():
     return _run_json_lines(
         [sys.executable, "benchmarks/transfer_probe.py", "28", "--json"],
         timeout=600)
+
+
+def rolling_gate(out, allow_cpu=False):
+    """ok-gating for the conv-vs-pallas step (ADVICE r3): green only if
+    (a) the pallas path ran COMPILED, not the interpreter — an emulation
+    run banked green would be carried (skipped) by every later fire and
+    the compiled kernel would never execute — and (b) both agreement
+    gates hold. A failed gate gets a distinct ``status`` so the
+    artifact says WHY the step isn't green."""
+    agrees = bool(out.get("agree_5e-4")) and bool(out.get("oracle_agree_1e-2"))
+    interp = bool(out.get("pallas_interpret")) and not allow_cpu
+    if agrees and not interp:
+        return {"ok": True}
+    return {"ok": False,
+            "status": "interpret_run" if interp else "parity_disagree"}
 
 
 def step_pallas_vs_conv():
@@ -198,11 +254,17 @@ def _rolling_body():
     import jax
     import numpy as np
 
+    from replication_of_minute_frequency_factor_tpu.ops.pallas_rolling \
+        import resolve_interpret
     from replication_of_minute_frequency_factor_tpu.ops.rolling import (
         rolling_window_stats)
 
     out = {"backend": jax.devices()[0].platform,
-           "device": str(jax.devices()[0])}
+           "device": str(jax.devices()[0]),
+           # what the pallas path will actually run: compiled Mosaic
+           # (False) or the interpreter emulation (True). An interpret
+           # run must never bank as a hardware timing (ADVICE r3, high).
+           "pallas_interpret": resolve_interpret()}
     rng = np.random.default_rng(0)
     # env override so the CPU smoke test can use a tiny panel (pallas
     # interpret mode is slow on one core)
@@ -278,7 +340,11 @@ def _rolling_body():
     out["max_rel_diff_cov_f64_oracle"] = float(max(odiffs.values())) \
         if odiffs else None
     out["oracle_agree_1e-2"] = bool(odiffs and max(odiffs.values()) < 1e-2)
-    return {"ok": True, "results": [out]}
+    res = rolling_gate(out,
+                       allow_cpu=bool(os.environ.get(
+                           "TPU_SESSION_ALLOW_CPU")))
+    res["results"] = [out]
+    return res
 
 
 def step_graph_spotcheck():
@@ -321,7 +387,13 @@ def main():
     ap.add_argument("--out", default=os.path.join(
         REPO, "benchmarks", "TPU_SESSION.json"))
     ap.add_argument("--skip-probe", action="store_true")
-    ap.add_argument("--steps", default="headline,ladder,rolling,spot")
+    # value-per-second order for a window that may close any minute:
+    # the headline (the round's one must-have), the pallas
+    # prove-or-drop, the 1-minute link diagnostics, then the four
+    # ladder configs cheapest-first, parity spot-check, the batch-size
+    # sweep, and the long real-pipeline run last
+    ap.add_argument("--steps", default="headline,rolling,link,"
+                    "lad1,lad2,lad4,lad5,spot,sweep,pipeline")
     ap.add_argument("--one-step", default=None,
                     help="internal: run one step's body in-process and "
                          "print its result dict as the final JSON line "
@@ -389,7 +461,9 @@ def main():
              # running watcher and prior artifacts use it)
              "pallas": step_pallas_vs_conv, "rolling": step_pallas_vs_conv,
              "spot": step_graph_spotcheck, "sweep": step_sweep,
-             "link": step_link}
+             "link": step_link, "pipeline": step_pipeline,
+             "lad1": _step_ladder_one("1"), "lad2": _step_ladder_one("2"),
+             "lad4": _step_ladder_one("4"), "lad5": _step_ladder_one("5")}
     want = [s.strip() for s in args.steps.split(",") if s.strip()]
     for name in want:
         if session["steps"].get(name, {}).get("ok"):

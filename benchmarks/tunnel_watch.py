@@ -21,9 +21,11 @@ before launching the session, and stamps ``host_quiet`` both in the
 watch log and into the session environment so a contended capture is
 identifiable in the artifact itself.
 
-Retries never re-burn a window on green steps: if a session ends rc!=0
-(window closed mid-run), the next fire re-reads TPU_SESSION.json and
-passes only the steps that are not yet ok.
+Retries never re-burn a window on green steps: the session itself
+carries fresh green steps over from TPU_SESSION.json (age- and
+content-bounded) and skips them, so every fire passes the FULL step
+list (ADVICE r3: a watcher-side pending filter diverged from the
+session's carry filters and could drop a step from the artifact).
 
 Run (backgrounded for the round):
   python benchmarks/tunnel_watch.py [--max-hours 10.5] [--interval 150]
@@ -44,7 +46,6 @@ sys.path.insert(0, REPO)
 from tools.cpu_busy import live_owners  # noqa: E402
 
 LOG = os.path.join(REPO, "benchmarks", "TUNNEL_WATCH.jsonl")
-SESSION_JSON = os.path.join(REPO, "benchmarks", "TPU_SESSION.json")
 SESSION_OUT = os.path.join(REPO, "benchmarks", "tpu_session.out")
 
 
@@ -85,33 +86,48 @@ def _wait_quiet(max_wait_s=900.0):
     return not owners, owners
 
 
-def _pending_steps(want):
-    """Steps from ``want`` not yet ok in a previous session artifact, in
-    original order — a retry window must not re-time green steps."""
-    try:
-        with open(SESSION_JSON) as fh:
-            done = json.load(fh).get("steps", {})
-    except (OSError, json.JSONDecodeError):
-        return want
-    return [s for s in want if not done.get(s, {}).get("ok")] or want
-
-
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-hours", type=float, default=10.5)
     ap.add_argument("--interval", type=float, default=150.0,
                     help="sleep between probes while down (s)")
-    # sweep (DAYS_PER_BATCH tuning) runs LAST: valuable when the window
-    # lasts, and a window that closes mid-sweep has already banked the
-    # four core steps (retries then re-run only the sweep)
-    ap.add_argument("--steps",
-                    default="headline,ladder,rolling,spot,sweep")
+    # mirror tpu_session.py's default value-per-second order; the two
+    # long tails (sweep, real pipeline) run last so a window that
+    # closes mid-run has already banked the core steps
+    ap.add_argument("--steps", default="headline,rolling,link,"
+                    "lad1,lad2,lad4,lad5,spot,sweep,pipeline")
     args = ap.parse_args()
 
     want = [s.strip() for s in args.steps.split(",") if s.strip()]
     deadline = time.monotonic() + args.max_hours * 3600
     _log({"event": "watch_start", "interval_s": args.interval,
           "max_hours": args.max_hours, "steps": want})
+    gen_proc = None
+    if "pipeline" in want:
+        # pre-build the real-pipeline dataset in the BACKGROUND, so the
+        # pipeline step never spends a tunnel up-window on host-side
+        # synthesis — but without delaying the first probe (a brief
+        # up-window open right now must not be lost to ~minutes of
+        # parquet writing). While it runs, fires simply defer the
+        # pipeline step. Resumable and marker-gated: a warm start is a
+        # fast no-op. Hermetic CPU env: with the pool var set, a wedged
+        # tunnel hangs the child at interpreter start (sitecustomize
+        # dials it) — this is a pure host-side task.
+        genv = {k: v for k, v in os.environ.items()
+                if k != "PALLAS_AXON_POOL_IPS"}
+        genv["JAX_PLATFORMS"] = "cpu"
+        def _spawn_pregen():
+            out = open(os.path.join(REPO, "benchmarks", "pregen.out"),
+                       "ab")
+            p = subprocess.Popen(
+                [sys.executable, "benchmarks/real_pipeline.py",
+                 "--generate-only"],
+                cwd=REPO, env=genv, stdout=out, stderr=out)
+            _log({"event": "dataset_pregen_start", "pid": p.pid})
+            return p
+
+        gen_proc = _spawn_pregen()
+        pregen_tries = 1
     n = 0
     while time.monotonic() < deadline:
         alive, probe_s = _probe()
@@ -120,7 +136,39 @@ def main():
               "probe_s": probe_s})
         if alive:
             quiet, owners = _wait_quiet()
-            steps = _pending_steps(want)
+            # poll AFTER the quiet wait: generation often finishes
+            # DURING it (the pre-gen child holds the cpu_busy sentinel;
+            # a complete cold run measured ~70 s on this host), and a
+            # stale pre-wait poll would defer the pipeline step from a
+            # fire that is quiet precisely because pre-gen just ended —
+            # then an all-green session would exit the watcher with the
+            # real-pipeline metric never captured
+            if gen_proc is not None and gen_proc.poll() is not None:
+                _log({"event": "dataset_pregen_done",
+                      "rc": gen_proc.returncode})
+                if gen_proc.returncode == 0:
+                    gen_proc = None
+                elif pregen_tries < 3:
+                    # a died pre-gen (disk, import error — see
+                    # benchmarks/pregen.out) must be retried, not
+                    # dropped: without a dataset the pipeline step
+                    # perma-fails its REQUIRE_TPU dataset gate
+                    gen_proc = _spawn_pregen()
+                    pregen_tries += 1
+                else:
+                    _log({"event": "dataset_pregen_gave_up"})
+                    gen_proc, want = None, \
+                        [s for s in want if s != "pipeline"]
+            # ALWAYS pass the full step list: tpu_session.main itself
+            # skips carried-green steps, with age/content filters this
+            # watcher used to lack — a watcher-side pending filter
+            # diverged from those filters and could silently drop a
+            # stale-green step from the artifact forever (ADVICE r3).
+            # Exception: defer the pipeline step while its dataset is
+            # still generating (the step would otherwise synthesize
+            # inside the window).
+            steps = want if gen_proc is None \
+                else [s for s in want if s != "pipeline"]
             _log({"event": "fire_session", "host_quiet": quiet,
                   "busy_owners": owners, "steps": steps})
             env = dict(os.environ, TPU_SESSION_HOST_QUIET=str(quiet))
@@ -135,10 +183,14 @@ def main():
                                           time.gmtime()).encode())
                 out.flush()
                 try:
+                    # 4 h kill: the default step list's worst-case
+                    # child timeouts sum past 3 h now that sweep +
+                    # pipeline run by default; per-step re-probes make
+                    # a dead-tunnel session fail fast regardless
                     p = subprocess.run(
                         [sys.executable, "benchmarks/tpu_session.py",
                          "--steps", ",".join(steps)],
-                        cwd=REPO, timeout=3 * 3600, env=env,
+                        cwd=REPO, timeout=4 * 3600, env=env,
                         stdout=out, stderr=subprocess.STDOUT)
                     rc = p.returncode
                 except subprocess.TimeoutExpired:
@@ -152,11 +204,14 @@ def main():
             _log({"event": "session_done", "rc": rc,
                   "seconds": round(time.monotonic() - t0, 1),
                   "tail": tail})
-            # rc 0: every requested step ok -> done. Otherwise (rc!=0 or
-            # timeout): the window likely closed mid-run; TPU_SESSION.json
-            # has per-step status, and the next fire passes only the
-            # still-failing steps.
-            if rc == 0:
+            # rc 0 AND nothing deferred: every wanted step ok -> done.
+            # An all-green fire that deferred the pipeline step (pre-gen
+            # still running) must keep watching or the real-pipeline
+            # metric would never be captured. Otherwise (rc!=0 or
+            # timeout): the window likely closed mid-run;
+            # TPU_SESSION.json has per-step status, and the next fire's
+            # session skips the carried-green steps.
+            if rc == 0 and steps == want:
                 return 0
         time.sleep(args.interval)
     _log({"event": "watch_expired", "probes": n})

@@ -46,6 +46,21 @@ N_SLOTS = 240
 _BLOCK_ROWS = 256
 
 
+def resolve_interpret(interpret: Optional[bool] = None) -> bool:
+    """Resolve the pallas interpret flag the way the rest of the repo
+    detects "not a real accelerator": only the CPU backend interprets.
+
+    The tunnelled chip registers as platform ``'axon'``, not ``'tpu'``,
+    so the earlier ``!= "tpu"`` autodetect silently selected interpret
+    mode on the exact hardware the kernel was built for (ADVICE r3,
+    high) — timing the emulator and banking bogus speedups. Callers
+    that bank results (benchmarks/tpu_session.py) record this resolved
+    value and refuse to bank interpret runs."""
+    if interpret is None:
+        return jax.default_backend() == "cpu"
+    return interpret
+
+
 def _banded(window: int, n: int = N_SLOTS) -> np.ndarray:
     """A[s, m] = 1 iff slot s lies in m's trailing window (m-W, m]."""
     s = np.arange(n)[:, None]
@@ -97,8 +112,7 @@ def rolling_window_stats_pallas(
         interpret: Optional[bool] = None) -> Dict[str, jnp.ndarray]:
     """Drop-in for :func:`ops.rolling.rolling_window_stats` (same contract:
     stats are garbage outside ``valid`` lanes and must be masked)."""
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    interpret = resolve_interpret(interpret)
     lead = x.shape[:-1]
     n = int(np.prod(lead)) if lead else 1
     xf = jnp.reshape(x.astype(jnp.float32), (n, N_SLOTS))

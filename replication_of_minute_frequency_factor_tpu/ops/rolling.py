@@ -74,7 +74,15 @@ def rolling_window_stats(x, y, mask, window: int = 50,
         if degenerate:
             from .pallas_rolling import rolling_window_stats_pallas
             return rolling_window_stats_pallas(x, y, mask, window)
-        impl = "conv"  # the pallas kernel implements only the default pin
+        # the pallas kernel implements only the default pin; a caller
+        # who explicitly asked for it must hear about the downgrade or
+        # a pin-bound sweep's "pallas" numbers are really conv (ADVICE r3)
+        import warnings
+        warnings.warn(
+            "rolling impl='pallas' downgraded to 'conv': the pallas "
+            "kernel only implements the default constant_window="
+            "'degenerate' pin reading", RuntimeWarning, stacklevel=2)
+        impl = "conv"
     m = mask.astype(x.dtype)
     xm = jnp.where(mask, x, 0.0)
     ym = jnp.where(mask, y, 0.0)

@@ -103,7 +103,8 @@ def test_conv_only_rolling_dropped(tpu_session):
     steps = {
         "rolling": {"ok": True, "results": [
             {"backend": "tpu", "conv_ms_per_batch": 2.0}]},
-        "headline": {"ok": True, "results": [{"metric": "x"}]},
+        "headline": {"ok": True, "results": [
+            {"metric": "x", "days_per_batch": 32}]},
     }
     got = tpu_session.drop_conv_only_rolling(steps)
     assert set(got) == {"headline"}
@@ -111,31 +112,63 @@ def test_conv_only_rolling_dropped(tpu_session):
 
 def test_full_rolling_entry_kept(tpu_session):
     steps = {"pallas": {"ok": True, "results": [
-        {"conv_ms_per_batch": 2.0, "pallas_ms_per_batch": 1.0}]}}
+        {"conv_ms_per_batch": 2.0, "pallas_ms_per_batch": 1.0,
+         "pallas_interpret": False}]}}
     assert tpu_session.drop_conv_only_rolling(steps) == steps
 
 
-def test_pending_steps_skips_carried_green(tunnel_watch, tmp_path,
-                                           monkeypatch):
-    """The watcher's retry fire must re-run only non-green steps, in
-    the original priority order."""
-    art = tmp_path / "sess.json"
-    art.write_text(json.dumps({"steps": {
-        "headline": {"ok": True},
-        "ladder": {"ok": False},
-    }}))
-    monkeypatch.setattr(tunnel_watch, "SESSION_JSON", str(art))
-    want = ["headline", "sweep", "rolling", "spot", "ladder"]
-    assert tunnel_watch._pending_steps(want) == [
-        "sweep", "rolling", "spot", "ladder"]
+def test_interpret_rolling_entry_dropped(tpu_session):
+    """An interpret (emulation) run that reached the artifact — e.g. a
+    local CPU smoke with TPU_SESSION_ALLOW_CPU writing the default
+    --out — must not be carried as the hardware conv-vs-pallas step."""
+    steps = {"rolling": {"ok": True, "results": [
+        {"conv_ms_per_batch": 2.0, "pallas_ms_per_batch": 1.0,
+         "pallas_interpret": True}]}}
+    assert tpu_session.drop_conv_only_rolling(steps) == {}
 
 
-def test_pending_steps_all_green_reruns_everything(tunnel_watch,
-                                                   tmp_path,
-                                                   monkeypatch):
-    """All-green artifact: the watcher treats the fire as a fresh full
-    run (`or want` fallback) rather than firing an empty step list."""
-    art = tmp_path / "sess.json"
-    art.write_text(json.dumps({"steps": {"headline": {"ok": True}}}))
-    monkeypatch.setattr(tunnel_watch, "SESSION_JSON", str(art))
-    assert tunnel_watch._pending_steps(["headline"]) == ["headline"]
+def test_pre_reshape_headline_dropped(tpu_session):
+    """A green headline banked by the 8-day-loop bench (no
+    days_per_batch key) must re-run under the reshaped loop — carrying
+    it would mean the new configuration never executes on hardware."""
+    old = {"headline": {"ok": True, "results": [
+        {"metric": "cicc58_5000tickers_1yr_wall", "value": 146.2}]}}
+    assert tpu_session.drop_conv_only_rolling(old) == {}
+    new = {"headline": {"ok": True, "results": [
+        {"metric": "cicc58_5000tickers_1yr_wall", "value": 58.0,
+         "days_per_batch": 32}]}}
+    assert tpu_session.drop_conv_only_rolling(new) == new
+
+
+def test_watcher_has_no_pending_filter(tunnel_watch):
+    """ADVICE r3 (medium): the watcher must not pre-filter steps — the
+    session itself skips carried-green steps with age/content bounds the
+    watcher lacked, and a divergent watcher-side filter could drop a
+    stale-green step from the artifact forever."""
+    assert not hasattr(tunnel_watch, "_pending_steps")
+
+
+def test_rolling_gate_green_compiled_agreeing(tpu_session):
+    out = {"agree_5e-4": True, "oracle_agree_1e-2": True,
+           "pallas_interpret": False}
+    assert tpu_session.rolling_gate(out) == {"ok": True}
+
+
+def test_rolling_gate_refuses_interpret_run(tpu_session):
+    """An interpreter (emulation) run must never bank green — it would
+    be carried forever and the compiled kernel never executed."""
+    out = {"agree_5e-4": True, "oracle_agree_1e-2": True,
+           "pallas_interpret": True}
+    got = tpu_session.rolling_gate(out)
+    assert got == {"ok": False, "status": "interpret_run"}
+    # local-smoke escape hatch
+    assert tpu_session.rolling_gate(out, allow_cpu=True) == {"ok": True}
+
+
+def test_rolling_gate_refuses_parity_disagreement(tpu_session):
+    for bad in ({"agree_5e-4": False, "oracle_agree_1e-2": True},
+                {"agree_5e-4": True, "oracle_agree_1e-2": False},
+                {}):
+        got = tpu_session.rolling_gate(
+            dict(bad, pallas_interpret=False))
+        assert got == {"ok": False, "status": "parity_disagree"}
