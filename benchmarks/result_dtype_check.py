@@ -1,0 +1,101 @@
+"""Quantify a narrower result dtype for the [F, D, T] transfer.
+
+VERDICT r3 #1c: the headline's device->host leg ships f32 factor values
+(~9.3 MB per 8-day batch over a ~15 MB/s link); halving the bytes with
+f16/bf16 is a real lever ONLY if the quantization error fits inside the
+parity tolerance headroom (tests/test_parity.py: default rtol 2e-3 with
+per-factor overrides). This measures, per factor, what casting the f32
+results to each narrow dtype would do:
+
+  * overflow_lanes — finite f32 values that become inf (f16 max 65504;
+    several factors are CNY-volume/amount scaled, order 1e6+);
+  * max_rel_err — max |cast(x)-x|/|x| over finite lanes (f16 mantissa
+    ~4.9e-4 relative step, bf16 ~3.9e-3 — the latter exceeds the 2e-3
+    default tolerance by construction).
+
+Prints one JSON line per dtype plus a verdict line. Run on CPU —
+quantization is platform-independent:
+
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python benchmarks/result_dtype_check.py
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_TICKERS = 800
+N_DAYS = 4
+DEFAULT_RTOL = 2e-3  # tests/test_parity.py RTOL["default"]
+
+
+def main():
+    import jax
+
+    import bench
+    from replication_of_minute_frequency_factor_tpu.data import wire
+    from replication_of_minute_frequency_factor_tpu.models.registry import (
+        factor_names)
+    from replication_of_minute_frequency_factor_tpu.pipeline import (
+        compute_packed_prepared)
+
+    rng = np.random.default_rng(3)
+    names = factor_names()
+    bars, mask = bench.make_batch(rng, n_days=N_DAYS, n_tickers=N_TICKERS)
+    w = wire.encode(bars, mask)
+    if w is not None:  # wire can refuse a batch; raw f32 path like bench
+        buf, spec = wire.pack_arrays(w.arrays)
+        kind = "wire"
+    else:
+        buf, spec = wire.pack_arrays((bars, mask.view(np.uint8)))
+        kind = "raw"
+    out = np.asarray(jax.block_until_ready(compute_packed_prepared(
+        buf, spec, kind, names=names, replicate_quirks=True)))
+    # [F, D, T] f32
+
+    verdicts = {}
+    for dtype_name, dtype in (("float16", np.float16),
+                              ("bfloat16", None)):
+        if dtype is None:
+            import ml_dtypes
+            dtype = ml_dtypes.bfloat16
+        cast = out.astype(dtype).astype(np.float32)
+        finite = np.isfinite(out)
+        overflow = finite & ~np.isfinite(cast)
+        denom = np.maximum(np.abs(out), 1e-30)
+        rel = np.where(finite & np.isfinite(cast),
+                       np.abs(cast - out) / denom, 0.0)
+        per_factor_max = rel.reshape(rel.shape[0], -1).max(axis=1)
+        worst = int(np.argmax(per_factor_max))
+        n_over_factors = int(
+            (overflow.reshape(out.shape[0], -1).any(axis=1)).sum())
+        rec = {
+            "dtype": dtype_name,
+            "overflow_lanes": int(overflow.sum()),
+            "factors_with_overflow": n_over_factors,
+            "max_rel_err": float(per_factor_max.max()),
+            "worst_factor": names[worst],
+            "factors_over_default_rtol": int(
+                (per_factor_max > DEFAULT_RTOL).sum()),
+            "factors_over_half_rtol": int(
+                (per_factor_max > DEFAULT_RTOL / 2).sum()),
+        }
+        verdicts[dtype_name] = rec
+        print(json.dumps(rec))
+
+    usable = {k: v["overflow_lanes"] == 0
+              and v["max_rel_err"] < DEFAULT_RTOL / 2
+              for k, v in verdicts.items()}
+    print(json.dumps({"metric": "result_dtype_verdict",
+                      "usable_without_tolerance_loss": usable,
+                      "default_rtol": DEFAULT_RTOL}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -215,20 +215,32 @@ def _grid_batch(day_data: List[Tuple[np.datetime64, Dict[str, np.ndarray]]],
     matching the reference's per-group row). ``Tp`` pads to a multiple of
     both TICKER_BUCKET and ``shard_mult`` (the mesh tickers dim).
     """
-    all_codes = np.unique(np.concatenate(
-        [d["code"] for _, d in day_data])).astype(object)
+    # The code axis stays a native fixed-width 'U' array end to end:
+    # object dtype here put Python-level comparisons inside every
+    # searchsorted/compare/isin of every day (~3x the whole grid stage;
+    # measured 2026-08-01, 5000-ticker days: searchsorted 0.37 s object
+    # vs 0.11 s 'U9', isin 0.26 s vs 0.001 s). Per-day uniques are
+    # computed once and reused for both the union and `present`.
+    day_uniqs = [np.unique(np.asarray(d["code"])) for _, d in day_data]
+    all_codes = np.unique(np.concatenate(day_uniqs))
     bucket = TICKER_BUCKET * shard_mult // np.gcd(TICKER_BUCKET, shard_mult)
     t_pad = _pad_bucket(len(all_codes), bucket)
-    pads = np.array([f"__pad{i}__" for i in range(t_pad - len(all_codes))],
-                    dtype=object)
-    codes = np.sort(np.concatenate([all_codes, pads]))
+    all_str = all_codes.astype(str)
+    n_pads = t_pad - len(all_codes)
+    # explicit dtype for the empty case: np.array([]) is float64 and
+    # would promote the whole axis to U32 (or raise on older numpy)
+    pads = (np.array([f"__pad{i}__" for i in range(n_pads)])
+            if n_pads else np.empty(0, all_str.dtype))
+    # concatenate promotes to the wider 'U' width; pads sort after real
+    # codes ('_' > any digit/letter used in A-share codes) as before
+    codes = np.sort(np.concatenate([all_str, pads]))
     bars_l, mask_l, present_l = [], [], []
-    for _, d in day_data:
+    for (_, d), uniq in zip(day_data, day_uniqs):
         g = grid_day(d["code"], d["time"], d["open"], d["high"], d["low"],
                      d["close"], d["volume"], codes=codes)
         bars_l.append(g.bars)
         mask_l.append(g.mask)
-        present_l.append(np.isin(g.codes, np.unique(d["code"])))
+        present_l.append(np.isin(g.codes, uniq))
     return (np.stack(bars_l), np.stack(mask_l), codes, np.stack(present_l))
 
 

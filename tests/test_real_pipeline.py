@@ -1,0 +1,100 @@
+"""Dataset machinery of benchmarks/real_pipeline.py.
+
+The capture session's ``pipeline`` step depends on this dataset being
+pre-built, resumable, and schema-correct; a regression here silently
+burns tunnel up-windows (the step would synthesize or crash inside
+one), so the generation contract gets its own tests. Tiny shrunk
+constants — the real 5000x244 dataset is exercised by the benchmark
+itself.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def rp(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "_real_pipeline_under_test",
+        os.path.join(REPO, "benchmarks", "real_pipeline.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "DATA_DIR", str(tmp_path))
+    monkeypatch.setattr(mod, "MARKER", str(tmp_path / "DATASET.json"))
+    monkeypatch.setattr(mod, "N_TICKERS", 40)
+    monkeypatch.setattr(mod, "N_DAYS", 6)
+    return mod
+
+
+def test_generate_marker_and_schema(rp):
+    mdir = rp.ensure_dataset(progress=False)
+    files = sorted(os.listdir(mdir))
+    assert len(files) == 6
+    assert rp.dataset_ready()
+    # marker hit: second call must not rewrite anything
+    mtimes = {f: os.path.getmtime(os.path.join(mdir, f)) for f in files}
+    assert rp.ensure_dataset(progress=False) == mdir
+    assert mtimes == {f: os.path.getmtime(os.path.join(mdir, f))
+                      for f in files}
+    # schema: the package's own reader accepts the files and the codes
+    # come back zero-padded (int64 on disk is the CSMAR-export shape)
+    from replication_of_minute_frequency_factor_tpu.data import io as dio
+    cols = dio.read_minute_day(os.path.join(mdir, files[0]))
+    assert set(cols) == set(dio.MINUTE_COLUMNS)
+    assert cols["code"][0] == "600000"
+    from replication_of_minute_frequency_factor_tpu import sessions
+    assert set(np.unique(cols["time"])) <= set(
+        np.asarray(sessions.GRID_TIMES))
+
+
+def test_resume_regenerates_only_missing_days(rp):
+    mdir = rp.ensure_dataset(progress=False)
+    files = sorted(os.listdir(mdir))
+    victim = os.path.join(mdir, files[2])
+    want = open(victim, "rb").read()
+    # simulate a mid-generation kill: marker gone, in-progress stamp
+    # present, one day file missing
+    os.unlink(rp.MARKER)
+    with open(rp.MARKER + ".inprogress", "w") as fh:
+        json.dump(rp._params(), fh)
+    os.unlink(victim)
+    keep = os.path.join(mdir, files[0])
+    keep_mtime = os.path.getmtime(keep)
+    rp.ensure_dataset(progress=False)
+    assert sorted(os.listdir(mdir)) == files
+    assert os.path.getmtime(keep) == keep_mtime  # untouched
+    # per-day seeding makes the regenerated file byte-identical
+    assert open(victim, "rb").read() == want
+    assert rp.dataset_ready()
+    assert not os.path.exists(rp.MARKER + ".inprogress")
+
+
+def test_param_change_discards_foreign_files(rp, monkeypatch):
+    mdir = rp.ensure_dataset(progress=False)
+    old = sorted(os.listdir(mdir))
+    # params change (more tickers): stale files must not be "resumed"
+    monkeypatch.setattr(rp, "N_TICKERS", 41)
+    assert not rp.dataset_ready()
+    mdir2 = rp.ensure_dataset(progress=False)
+    assert mdir2 == mdir
+    cols_rows = []
+    import pyarrow.parquet as pq
+    for f in sorted(os.listdir(mdir)):
+        cols_rows.append(len(pq.read_table(
+            os.path.join(mdir, f), columns=["code"])
+            .column("code").unique()))
+    assert all(n == 41 for n in cols_rows), cols_rows
+    assert sorted(os.listdir(mdir)) == old  # same day names
+
+
+def test_require_tpu_refuses_missing_dataset(rp, monkeypatch, capsys):
+    monkeypatch.setenv("BENCH_REQUIRE_TPU", "1")
+    monkeypatch.setattr(sys, "argv", ["real_pipeline.py"])
+    assert rp.main() == 18
