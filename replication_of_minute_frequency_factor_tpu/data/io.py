@@ -95,12 +95,15 @@ def int_codes_to_str(code: np.ndarray) -> np.ndarray:
     the C-level ``astype('U7')``, slice off the leading '1' through a
     'U1' view) is bit-identical and ~3x faster (measured 0.21 s).
     Codes outside [0, 999999] can't take the trick (a 7-digit code must
-    keep all digits — and zfill(6) leaves it unpadded) and fall back."""
+    keep all its digits) and fall back to a per-element zfill —
+    np.char.zfill is NOT safe there: on numpy 2.x it allocates U6 and
+    silently TRUNCATES a 7-digit code ('1000000' -> '100000'), which
+    would merge two tickers onto one axis entry downstream."""
     code = np.asarray(code)
     if code.size == 0:
         return code.astype("U6")
     if code.min() < 0 or code.max() > 999_999:
-        return np.char.zfill(code.astype(str), 6)
+        return np.array([str(c).zfill(6) for c in code.tolist()])
     s = (code.astype(np.int64) + 1_000_000).astype("U7")
     return np.ascontiguousarray(
         s.view("U1").reshape(len(s), 7)[:, 1:]).view("U6").ravel()
@@ -112,10 +115,21 @@ def read_minute_day(path: str) -> Dict[str, np.ndarray]:
     codes as either, and without one normalization an int-coded minute
     file would join the daily PV table ('000002') as '2', silently
     producing an empty evaluation."""
-    out = read_columns(path, MINUTE_COLUMNS)
+    out = read_minute_day_raw(path)
     if out["code"].dtype.kind in "iu":
         out["code"] = int_codes_to_str(out["code"])
     return out
+
+
+def read_minute_day_raw(path: str) -> Dict[str, np.ndarray]:
+    """Like :func:`read_minute_day` but WITHOUT code normalization:
+    integer code columns come back as int64. The device pipeline's grid
+    path keeps integer codes integer until the 5000-element ticker axis
+    is rendered once per batch (pipeline._grid_batch) — normalizing the
+    1.2M-row column per day costs ~0.2 s that the axis-level render
+    avoids. Callers that JOIN on codes (evaluation, the oracle/polars
+    backends) must use the normalizing reader."""
+    return read_columns(path, MINUTE_COLUMNS)
 
 
 def write_parquet_atomic(table: pa.Table, path: str) -> None:

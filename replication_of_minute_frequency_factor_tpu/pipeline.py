@@ -215,33 +215,70 @@ def _grid_batch(day_data: List[Tuple[np.datetime64, Dict[str, np.ndarray]]],
     matching the reference's per-group row). ``Tp`` pads to a multiple of
     both TICKER_BUCKET and ``shard_mult`` (the mesh tickers dim).
     """
-    # The code axis stays a native fixed-width 'U' array end to end:
-    # object dtype here put Python-level comparisons inside every
-    # searchsorted/compare/isin of every day (~3x the whole grid stage;
-    # measured 2026-08-01, 5000-ticker days: searchsorted 0.37 s object
-    # vs 0.11 s 'U9', isin 0.26 s vs 0.001 s). Per-day uniques are
-    # computed once and reused for both the union and `present`.
-    day_uniqs = [np.unique(np.asarray(d["code"])) for _, d in day_data]
+    # The code axis never becomes object dtype: object put Python-level
+    # comparisons inside every searchsorted/compare/isin of every day
+    # (~3x the whole grid stage; measured 2026-08-01, 5000-ticker days:
+    # searchsorted 0.37 s object vs 0.11 s 'U9', isin 0.26 s vs
+    # 0.001 s). Per-day uniques are computed once and reused for both
+    # the union and `present`. When every day carries raw integer codes
+    # (data/io.read_minute_day_raw, the device pipeline's reader) the
+    # whole grid runs on int64 — unique/searchsorted another ~3x faster
+    # than 'U6' — and only the Tp-element axis is rendered to the
+    # normalized string form the rest of the framework speaks, once.
+    code_arrays = [np.asarray(d["code"]) for _, d in day_data]
+    int_path = all(c.dtype.kind in "iu" for c in code_arrays)
+    day_uniqs = [np.unique(c) for c in code_arrays]
+    if int_path and any(len(u) for u in day_uniqs):
+        nonempty = [u for u in day_uniqs if len(u)]
+        if (min(int(u[0]) for u in nonempty) < 0
+                or max(int(u[-1]) for u in nonempty) > 999_999):
+            # out of the zero-padded 6-char domain: int sort order would
+            # no longer match the rendered string sort order — normalize
+            # per day and take the string path
+            int_path = False
+            code_arrays = [dio.int_codes_to_str(c) for c in code_arrays]
+            day_uniqs = [np.unique(c) for c in code_arrays]
+    elif not int_path and any(c.dtype.kind in "iu" for c in code_arrays):
+        # mixed int/str days in one batch: normalize the int ones
+        code_arrays = [dio.int_codes_to_str(c) if c.dtype.kind in "iu"
+                       else c for c in code_arrays]
+        day_uniqs = [np.unique(c) for c in code_arrays]
     all_codes = np.unique(np.concatenate(day_uniqs))
     bucket = TICKER_BUCKET * shard_mult // np.gcd(TICKER_BUCKET, shard_mult)
     t_pad = _pad_bucket(len(all_codes), bucket)
-    all_str = all_codes.astype(str)
     n_pads = t_pad - len(all_codes)
-    # explicit dtype for the empty case: np.array([]) is float64 and
-    # would promote the whole axis to U32 (or raise on older numpy)
-    pads = (np.array([f"__pad{i}__" for i in range(n_pads)])
-            if n_pads else np.empty(0, all_str.dtype))
-    # concatenate promotes to the wider 'U' width; pads sort after real
-    # codes ('_' > any digit/letter used in A-share codes) as before
-    codes = np.sort(np.concatenate([all_str, pads]))
+    if int_path:
+        # pad codes 10^6+i sort after every real code, like the
+        # '__padN__' names do in the string path
+        axis = np.concatenate([all_codes.astype(np.int64),
+                               1_000_000 + np.arange(n_pads,
+                                                     dtype=np.int64)])
+        codes_out = np.concatenate([
+            dio.int_codes_to_str(all_codes),
+            np.array([f"__pad{i}__" for i in range(n_pads)])
+            if n_pads else np.empty(0, "U6")])
+    else:
+        all_str = all_codes.astype(str)
+        # explicit dtype for the empty case: np.array([]) is float64 and
+        # would promote the whole axis to U32 (or raise on older numpy)
+        pads = (np.array([f"__pad{i}__" for i in range(n_pads)])
+                if n_pads else np.empty(0, all_str.dtype))
+        # concatenate promotes to the wider 'U' width; pads sort after
+        # real codes ('_' > any digit used in A-share codes) as before
+        axis = codes_out = np.sort(np.concatenate([all_str, pads]))
     bars_l, mask_l, present_l = [], [], []
-    for (_, d), uniq in zip(day_data, day_uniqs):
-        g = grid_day(d["code"], d["time"], d["open"], d["high"], d["low"],
-                     d["close"], d["volume"], codes=codes)
+    for (_, d), c, uniq in zip(day_data, code_arrays, day_uniqs):
+        g = grid_day(c, d["time"], d["open"], d["high"], d["low"],
+                     d["close"], d["volume"], codes=axis)
         bars_l.append(g.bars)
         mask_l.append(g.mask)
+        # positions in `axis` == positions in `codes_out` (both carry
+        # the sorted real codes first, pads after — pad ORDER among
+        # themselves may differ between paths, but pads are never
+        # present so only their positions-as-filler matter)
         present_l.append(np.isin(g.codes, uniq))
-    return (np.stack(bars_l), np.stack(mask_l), codes, np.stack(present_l))
+    return (np.stack(bars_l), np.stack(mask_l), codes_out,
+            np.stack(present_l))
 
 
 #: consecutive failed batches before the device pipeline gives up (the
@@ -490,7 +527,9 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
                 continue
             try:
                 with timer("io"):
-                    day = dio.read_minute_day(path)
+                    # raw reader: this is always the device path, and
+                    # prep->_grid_batch normalizes at the axis level
+                    day = dio.read_minute_day_raw(path)
                 if len(day["code"]) == 0:
                     raise ValueError("empty day file")
                 materialize(launch(prep([(d, day)])))
@@ -848,6 +887,13 @@ def compute_exposures(
 
     t0 = time.perf_counter()
 
+    # the device pipeline keeps integer codes integer through the grid
+    # (normalized once at the batch axis, _grid_batch); the oracle and
+    # polars backends hand day columns to code that joins on code
+    # STRINGS and need the normalizing reader
+    reader = (dio.read_minute_day_raw if cfg.backend == "jax"
+              else dio.read_minute_day)
+
     def read_batches():
         """Yield lists of (date, day-columns), one list per device batch,
         with per-day failure isolation (reference :17-25)."""
@@ -857,7 +903,7 @@ def compute_exposures(
                 if fault_hook is not None:
                     fault_hook(date)
                 with timer("io"):
-                    day = dio.read_minute_day(path)
+                    day = reader(path)
                 if len(day["code"]) == 0:
                     raise ValueError("empty day file")
                 batch.append((date, day))

@@ -631,3 +631,53 @@ def test_isolation_gives_up_against_a_dead_device(minute_dir, tmp_path,
     assert calls["n"] == 4
     assert sorted(t.failures.keys()) == ["2024-01-02", "2024-01-03",
                                          "2024-01-04"]
+
+
+def test_int_coded_files_match_str_coded_files(tmp_path, rng):
+    """The device pipeline's int-code fast path (raw reader + integer
+    grid axis, normalized once per batch) must be value- and
+    code-identical to the string path a CSMAR string export takes —
+    including a code below 100000, whose zero-padding is exactly what
+    the normalization exists for."""
+    d_int = tmp_path / "kline_int"
+    d_str = tmp_path / "kline_str"
+    d_int.mkdir()
+    d_str.mkdir()
+    rng2 = np.random.default_rng(11)
+    for ds in ("2024-01-02", "2024-01-03", "2024-01-04"):
+        cols = synth_day(rng2, n_codes=7, date=ds, missing_prob=0.05)
+        # one low code to force real zero-padding ('000123')
+        lowest = np.sort(np.unique(cols["code"]))[0]
+        code_str = np.where(cols["code"] == lowest, "000123",
+                            cols["code"])
+        arrays = {"time": pa.array(cols["time"])}
+        for k in ("open", "high", "low", "close", "volume"):
+            arrays[k] = pa.array(cols[k])
+        name = ds.replace("-", "") + ".parquet"
+        pq.write_table(pa.table(dict(
+            code=pa.array(code_str.astype(str)), **arrays)),
+            os.path.join(str(d_str), name))
+        pq.write_table(pa.table(dict(
+            code=pa.array(code_str.astype(np.int64)), **arrays)),
+            os.path.join(str(d_int), name))
+    t_int = compute_exposures(str(d_int), NAMES, cache_path=None,
+                              cfg=_cfg(), progress=False)
+    t_str = compute_exposures(str(d_str), NAMES, cache_path=None,
+                              cfg=_cfg(), progress=False)
+    assert list(t_int.columns["code"]) == list(t_str.columns["code"])
+    assert "000123" in set(t_int.columns["code"])
+    assert (t_int.columns["date"] == t_str.columns["date"]).all()
+    for n in NAMES:
+        np.testing.assert_array_equal(t_int.columns[n], t_str.columns[n])
+
+
+def test_int_codes_to_str_never_truncates_wide_codes():
+    """numpy 2.x np.char.zfill allocates U6 and silently truncates a
+    7-digit code ('1000000' -> '100000'), which would merge two tickers
+    onto one axis entry; the fallback must keep every digit."""
+    got = dio.int_codes_to_str(np.array([1_000_000, 100_000, 2]))
+    assert list(got) == ["1000000", "100000", "000002"]
+    # fast path bit-equivalence incl. zero padding
+    got = dio.int_codes_to_str(np.array([0, 123, 999_999]))
+    assert list(got) == ["000000", "000123", "999999"]
+    assert dio.int_codes_to_str(np.array([], dtype=np.int64)).size == 0

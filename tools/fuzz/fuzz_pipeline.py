@@ -36,8 +36,17 @@ class _DeviceChaos:
 
 def write_day(d, rng, date_str, n_codes):
     cols = synth_day(rng, n_codes=n_codes, date=date_str, missing_prob=0.05)
-    arrays = {"code": pa.array([str(c) for c in cols["code"]]),
-              "time": pa.array(cols["time"])}
+    # ~40% of days carry integer code columns (the CSMAR int-export
+    # shape): the device pipeline's raw-reader + integer-axis fast path
+    # (pipeline._grid_batch) then runs under every chaos/resume/batch-
+    # geometry scenario below, including mixed int/str batches, and the
+    # cross-phase cache equality assertions prove the two forms
+    # normalize identically
+    if rng.random() < 0.4:
+        code_col = pa.array(cols["code"].astype(np.int64))
+    else:
+        code_col = pa.array([str(c) for c in cols["code"]])
+    arrays = {"code": code_col, "time": pa.array(cols["time"])}
     for k in ("open", "high", "low", "close", "volume"):
         arrays[k] = pa.array(cols[k])
     pq.write_table(pa.table(arrays),
