@@ -12,41 +12,56 @@ from replication_of_minute_frequency_factor_tpu.config import apply_compilation_
 apply_compilation_cache(get_config())  # persistent XLA cache when configured
 
 names = factor_names()
-# D=8 is what the headline itself measures (bench.py) — no need to
-# re-time it here, and D=16 is dominated either way (if latency-bound,
-# 32/61 amortize more; if bandwidth-bound, all D are equal), so two
-# points keep the sweep inside a short window (each D pays its own
-# ~40 s TPU compile). The large end matters most: the 2026-08-01
-# headline showed ~4.8 s/batch against ~0.7 s of bandwidth+compute at
-# probe rates, i.e. the pipeline looks per-dispatch-latency-bound over
-# the tunnel, and latency amortizes linearly with batch size. 61 days =
-# exactly 4 batches per trading year (244/61); decoded grid at D=61 is
-# ~1.5 GB f32 in HBM — comfortable on a 16 GB chip.
-for D in (32, 61):
+# Bracket the headline's new default (bench.py D=32): D=8 re-measures
+# the r3 loop shape under the SAME link weather — separating what the
+# round-4 reshape bought from what the tunnel's mood bought — and D=61
+# (exactly 4 batches/trading year) probes the aggressive end, where the
+# decoded grid + rolling-loop carries approach the 16 GB chip's limit.
+# Each point is exception-isolated: a D=61 RESOURCE_EXHAUSTED must
+# report as a data point, not kill the step (the sweep's whole job is
+# mapping where the curve ends). The 2026-08-01 headline showed
+# ~4.8 s/batch against ~0.7 s of bandwidth+compute at probe rates —
+# per-dispatch-latency-bound, and latency amortizes with batch size.
+for D in (8, 61):
     rng = np.random.default_rng(0)
     ITERS = max(3, 32 // D)  # amortize over >= 32 days per config
     # distinct bytes every iteration (incl. warmup) so transfer-path
     # content caching cannot flatter the number — see bench.py
-    batches = [bench.make_batch(rng, n_days=D) for _ in range(ITERS + 1)]
     def ep(b, m):
         w = wire.encode(b, m)
         return wire.pack_arrays(w.arrays) + ("wire",)
     def launch(item):
         buf, spec, kind = item
         return compute_packed_prepared(buf, spec, kind, names=names, replicate_quirks=True)
-    t0=time.perf_counter(); jax.block_until_ready(launch(ep(*batches[ITERS]))); warm=time.perf_counter()-t0
-    import queue, threading
-    q = queue.Queue(maxsize=2)
-    def produce():
-        for i in range(ITERS): q.put(ep(*batches[i]))
-    t0=time.perf_counter(); threading.Thread(target=produce, daemon=True).start()
-    outs=[]
-    for i in range(ITERS):
-        out = launch(q.get())
-        out.copy_to_host_async()  # mirror bench.py: results cross the link too
-        outs.append(out)
-        if i >= 2: np.asarray(outs[i-2])
-    for o in outs[-2:]: np.asarray(o)
-    per = (time.perf_counter()-t0)/ITERS
-    print(json.dumps({"days": D, "per_batch_s": round(per,3),
-                      "full_year_s": round(per*244/D,3), "warm_s": round(warm,1)}))
+    q = None
+    try:
+        batches = [bench.make_batch(rng, n_days=D) for _ in range(ITERS + 1)]
+        t0=time.perf_counter(); jax.block_until_ready(launch(ep(*batches[ITERS]))); warm=time.perf_counter()-t0
+        import queue, threading
+        q = queue.Queue(maxsize=2)
+        def produce():
+            for i in range(ITERS): q.put(ep(*batches[i]))
+        t0=time.perf_counter(); threading.Thread(target=produce, daemon=True).start()
+        outs=[]
+        for i in range(ITERS):
+            out = launch(q.get())
+            out.copy_to_host_async()  # mirror bench.py: results cross the link too
+            outs.append(out)
+            if i >= 2: np.asarray(outs[i-2])
+        for o in outs[-2:]: np.asarray(o)
+        per = (time.perf_counter()-t0)/ITERS
+        print(json.dumps({"days": D, "per_batch_s": round(per,3),
+                          "full_year_s": round(per*244/D,3), "warm_s": round(warm,1)}), flush=True)
+        del batches, outs
+    except Exception as e:  # noqa: BLE001 — per-point isolation
+        print(json.dumps({"days": D, "error": f"{type(e).__name__}: "
+                          + str(e)[:300]}), flush=True)
+        # unblock the producer thread: it may be parked in q.put()
+        # holding this point's encoded batches — memory the NEXT point
+        # (61 days, the curve's memory-limit probe) must not inherit
+        if q is not None:
+            try:
+                for _ in range(ITERS):
+                    q.get(timeout=5)
+            except Exception:  # queue drained / producer already done
+                pass

@@ -86,6 +86,23 @@ def _wait_quiet(max_wait_s=900.0):
     return not owners, owners
 
 
+def plan_steps(want, pregen_running):
+    """Steps for one fire: ALWAYS the full wanted list (the session
+    owns the skip decision via its carry filters — ADVICE r3), except
+    the pipeline step is deferred while its dataset is still
+    generating (it would otherwise synthesize inside the window)."""
+    if not pregen_running:
+        return list(want)
+    return [s for s in want if s != "pipeline"]
+
+
+def watch_complete(rc, steps, want):
+    """Done only when an all-green session covered the FULL wanted
+    list: an rc-0 fire that deferred the pipeline step must keep
+    watching or the real-pipeline metric is never captured."""
+    return rc == 0 and list(steps) == list(want)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-hours", type=float, default=10.5)
@@ -159,16 +176,7 @@ def main():
                     _log({"event": "dataset_pregen_gave_up"})
                     gen_proc, want = None, \
                         [s for s in want if s != "pipeline"]
-            # ALWAYS pass the full step list: tpu_session.main itself
-            # skips carried-green steps, with age/content filters this
-            # watcher used to lack — a watcher-side pending filter
-            # diverged from those filters and could silently drop a
-            # stale-green step from the artifact forever (ADVICE r3).
-            # Exception: defer the pipeline step while its dataset is
-            # still generating (the step would otherwise synthesize
-            # inside the window).
-            steps = want if gen_proc is None \
-                else [s for s in want if s != "pipeline"]
+            steps = plan_steps(want, gen_proc is not None)
             _log({"event": "fire_session", "host_quiet": quiet,
                   "busy_owners": owners, "steps": steps})
             env = dict(os.environ, TPU_SESSION_HOST_QUIET=str(quiet))
@@ -204,14 +212,10 @@ def main():
             _log({"event": "session_done", "rc": rc,
                   "seconds": round(time.monotonic() - t0, 1),
                   "tail": tail})
-            # rc 0 AND nothing deferred: every wanted step ok -> done.
-            # An all-green fire that deferred the pipeline step (pre-gen
-            # still running) must keep watching or the real-pipeline
-            # metric would never be captured. Otherwise (rc!=0 or
-            # timeout): the window likely closed mid-run;
-            # TPU_SESSION.json has per-step status, and the next fire's
-            # session skips the carried-green steps.
-            if rc == 0 and steps == want:
+            # Otherwise (rc!=0 or timeout): the window likely closed
+            # mid-run; TPU_SESSION.json has per-step status, and the
+            # next fire's session skips the carried-green steps.
+            if watch_complete(rc, steps, want):
                 return 0
         time.sleep(args.interval)
     _log({"event": "watch_expired", "probes": n})

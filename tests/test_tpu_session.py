@@ -172,3 +172,22 @@ def test_rolling_gate_refuses_parity_disagreement(tpu_session):
         got = tpu_session.rolling_gate(
             dict(bad, pallas_interpret=False))
         assert got == {"ok": False, "status": "parity_disagree"}
+
+
+def test_watcher_defers_pipeline_while_pregen_runs(tunnel_watch):
+    want = ["headline", "rolling", "pipeline"]
+    assert tunnel_watch.plan_steps(want, pregen_running=True) == [
+        "headline", "rolling"]
+    assert tunnel_watch.plan_steps(want, pregen_running=False) == want
+
+
+def test_watcher_not_complete_when_pipeline_was_deferred(tunnel_watch):
+    """An all-green fire that deferred the pipeline step must keep
+    watching — exiting would mean the real-pipeline metric is never
+    captured in any later window."""
+    want = ["headline", "pipeline"]
+    deferred = tunnel_watch.plan_steps(want, pregen_running=True)
+    assert not tunnel_watch.watch_complete(0, deferred, want)
+    assert tunnel_watch.watch_complete(0, want, want)
+    assert not tunnel_watch.watch_complete(1, want, want)
+    assert not tunnel_watch.watch_complete("timeout", want, want)
