@@ -56,9 +56,12 @@ for D in (8, 61):
     except Exception as e:  # noqa: BLE001 — per-point isolation
         print(json.dumps({"days": D, "error": f"{type(e).__name__}: "
                           + str(e)[:300]}), flush=True)
-        # unblock the producer thread: it may be parked in q.put()
-        # holding this point's encoded batches — memory the NEXT point
-        # (61 days, the curve's memory-limit probe) must not inherit
+        # release the failed point's memory before the next point (61
+        # days, the curve's memory-limit probe, must not inherit it):
+        # clear this scope's device/host refs, then unblock the
+        # producer thread parked in q.put() so its closure (encoded
+        # batches + the `batches` list) can exit and free
+        batches = outs = out = None  # noqa: F841
         if q is not None:
             try:
                 for _ in range(ITERS):

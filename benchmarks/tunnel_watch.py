@@ -111,7 +111,7 @@ def main():
     # mirror tpu_session.py's default value-per-second order; the two
     # long tails (sweep, real pipeline) run last so a window that
     # closes mid-run has already banked the core steps
-    ap.add_argument("--steps", default="headline,rolling,link,"
+    ap.add_argument("--steps", default="headline,rolling,link,headc,"
                     "lad1,lad2,lad4,lad5,spot,sweep,pipeline")
     args = ap.parse_args()
 
@@ -191,14 +191,19 @@ def main():
                                           time.gmtime()).encode())
                 out.flush()
                 try:
-                    # 4 h kill: the default step list's worst-case
-                    # child timeouts sum past 3 h now that sweep +
-                    # pipeline run by default; per-step re-probes make
-                    # a dead-tunnel session fail fast regardless
+                    # 5 h kill: the default step list's worst-case
+                    # child timeouts sum to exactly 4 h (headline 1800
+                    # + rolling 1500 + link 600 + headc 1800 + 4x900
+                    # ladder + spot 600 + sweep 1800 + pipeline 2700)
+                    # before per-step probes — a kill sized below that
+                    # would always sacrifice the pipeline step, the
+                    # last and longest, in a slow-but-progressing
+                    # window; per-step re-probes make a dead-tunnel
+                    # session fail fast regardless
                     p = subprocess.run(
                         [sys.executable, "benchmarks/tpu_session.py",
                          "--steps", ",".join(steps)],
-                        cwd=REPO, timeout=4 * 3600, env=env,
+                        cwd=REPO, timeout=5 * 3600, env=env,
                         stdout=out, stderr=subprocess.STDOUT)
                     rc = p.returncode
                 except subprocess.TimeoutExpired:
