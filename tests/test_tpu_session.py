@@ -191,3 +191,30 @@ def test_watcher_not_complete_when_pipeline_was_deferred(tunnel_watch):
     assert tunnel_watch.watch_complete(0, want, want)
     assert not tunnel_watch.watch_complete(1, want, want)
     assert not tunnel_watch.watch_complete("timeout", want, want)
+
+
+def test_stale_tpu_headline_reader(tmp_path):
+    """bench.py's CPU fallback surfaces the latest hardened TPU
+    headline from the session artifact (VERDICT r3 #3) — but never a
+    CPU-fallback metric, and never from a failed step."""
+    import bench
+    p = tmp_path / "sess.json"
+    rec = {"metric": "cicc58_5000tickers_1yr_wall", "value": 146.2}
+    p.write_text(json.dumps({"steps": {"headline": {
+        "ok": True, "captured_utc": "2026-08-01T08:36:00Z",
+        "results": [rec]}}}))
+    got, cap = bench.stale_tpu_headline(str(p))
+    assert got == rec and cap == "2026-08-01T08:36:00Z"
+    # failed step -> nothing
+    p.write_text(json.dumps({"steps": {"headline": {
+        "ok": False, "results": [rec]}}}))
+    assert bench.stale_tpu_headline(str(p)) == (None, None)
+    # a fallback metric must never surface as TPU evidence
+    p.write_text(json.dumps({"steps": {"headline": {
+        "ok": True, "results": [{
+            "metric": "cicc58_5000tickers_1yr_wall_cpu_fallback_tunnel_down",
+            "value": 600.0}]}}}))
+    assert bench.stale_tpu_headline(str(p)) == (None, None)
+    # missing / garbage artifact
+    assert bench.stale_tpu_headline(str(tmp_path / "nope.json")) == \
+        (None, None)
