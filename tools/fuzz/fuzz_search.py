@@ -440,6 +440,17 @@ def np_eval(genome, bars, mask, skeleton):
         n_fin = np.maximum(m_fin.sum(-1), 1)
         e_fin = np.where(m_fin, errs[0], 0.0).sum(-1) / n_fin \
             + _EPS32 * scale
+        # a NON-FINITE propagated bound means the first-order model
+        # itself diverged (stacked 1/sd and 1/|b| amplifications can
+        # overflow f64): the model is then asserting the lane's
+        # magnitude is unbounded by rounding alone, and judging it by
+        # the flat 2e-3-of-scale fallback claims more than the model
+        # can promise — seed 3470 (program 13) produced two correct f32
+        # evaluations 1.1e-2 of scale apart on exactly such a lane.
+        # Fold these into `degenerate`, the same treatment near-gate
+        # divides already get; systematic interpreter bugs still show
+        # on well-conditioned lanes, which this cannot mask.
+        degenerate |= ~np.isfinite(e_fin)
     return np_masked_mean(x_fin, m_fin), scale, degenerate, e_fin
 
 
