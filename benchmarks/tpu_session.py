@@ -5,16 +5,19 @@ moment it is reachable, EVERYTHING the round needs must be captured in
 one command (VERDICT r1 items 2/4/6 and weak #5's lesson: don't spend
 an up-window on anything else):
 
-  1. the hardened headline bench (bench.py, full methodology);
-  2. conv-vs-pallas on-chip timing for the rolling-moment kernel, plus a
-     numeric agreement check (the Pallas path's first-ever hardware run);
-  3. transfer/link diagnostics incl. the per-transfer latency floor;
-  4. the four BASELINE configs (benchmarks/ladder.py, one step each so
+  1. the hardened headline bench (bench.py, full methodology) and its
+     consolidated-fetch variant;
+  2. transfer/link diagnostics incl. the per-transfer latency floor;
+  3. the four BASELINE configs (benchmarks/ladder.py, one step each so
      a window closing mid-config doesn't lose the others);
-  5. correctness spot-check of the full 58-kernel graph on-chip vs the
+  4. correctness spot-check of the full 58-kernel graph on-chip vs the
      CPU oracle;
-  6. the DAYS_PER_BATCH sweep and the real 244-day pipeline run — the
+  5. the DAYS_PER_BATCH sweep and the real 244-day pipeline run — the
      two long tails, last so they only spend leftover window.
+
+(The conv-vs-pallas rolling step was removed with the Pallas kernel —
+three rounds carried, zero tunnel windows coincided with it, dropped
+per the round-3 verdict's final prove-or-drop; docs/ROADMAP.md.)
 
 Everything lands in ONE committed artifact (default
 ``benchmarks/TPU_SESSION.json``) with per-step status, so a window that
@@ -117,11 +120,8 @@ def drop_conv_only_rolling(steps):
     entry from an older code/configuration must not satisfy this
     round's step (the carry would skip it forever):
 
-    * 'rolling'/'pallas' entries banked by pre-restoration code time
-      only the conv backend (no ``pallas_ms_per_batch``), and entries
-      with a truthy ``pallas_interpret`` timed the interpreter
-      emulation, not compiled Mosaic (e.g. a local CPU smoke written
-      to the committed artifact) — both drop;
+    * 'rolling'/'pallas' entries belong to the step removed with the
+      Pallas kernel (round 4 prove-or-drop) — never carried;
     * 'headline' entries without a ``days_per_batch`` key predate the
       32-day loop reshape and would silently keep the new shape from
       ever running on hardware — drop.
@@ -129,8 +129,7 @@ def drop_conv_only_rolling(steps):
     def keep(name, v):
         recs = [r for r in v.get("results") or [] if isinstance(r, dict)]
         if name in ("rolling", "pallas"):
-            return (any("pallas_ms_per_batch" in r for r in recs)
-                    and not any(r.get("pallas_interpret") for r in recs))
+            return False  # step removed with the Pallas kernel (r4)
         if name == "headline":
             return any("days_per_batch" in r for r in recs)
         return True
@@ -240,132 +239,6 @@ def step_link():
         timeout=600)
 
 
-def rolling_gate(out, allow_cpu=False):
-    """ok-gating for the conv-vs-pallas step (ADVICE r3): green only if
-    (a) the pallas path ran COMPILED, not the interpreter — an emulation
-    run banked green would be carried (skipped) by every later fire and
-    the compiled kernel would never execute — and (b) both agreement
-    gates hold. A failed gate gets a distinct ``status`` so the
-    artifact says WHY the step isn't green."""
-    agrees = bool(out.get("agree_5e-4")) and bool(out.get("oracle_agree_1e-2"))
-    interp = bool(out.get("pallas_interpret")) and not allow_cpu
-    if agrees and not interp:
-        return {"ok": True}
-    return {"ok": False,
-            "status": "interpret_run" if interp else "parity_disagree"}
-
-
-def step_pallas_vs_conv():
-    """On-chip timing + agreement for the rolling-moment kernel backends
-    (conv vs pallas — the Pallas path's first-ever hardware run), plus an
-    f64-oracle spot check on a window sample.
-
-    Body runs in a killable child via --one-step (a tunnel that drops
-    mid-session hangs jax backend init before any in-process code can
-    time out — observed 2026-08-01, a 3 h watcher backstop was the only
-    recovery). Shapes mirror the mmt_ols_* production use:
-    [tickers, 240] minute panels.
-    """
-    return _run_one_step_child("rolling")
-
-
-def _rolling_body():
-    import jax
-    import numpy as np
-
-    from replication_of_minute_frequency_factor_tpu.ops.pallas_rolling \
-        import resolve_interpret
-    from replication_of_minute_frequency_factor_tpu.ops.rolling import (
-        rolling_window_stats)
-
-    out = {"backend": jax.devices()[0].platform,
-           "device": str(jax.devices()[0]),
-           # what the pallas path will actually run: compiled Mosaic
-           # (False) or the interpreter emulation (True). An interpret
-           # run must never bank as a hardware timing (ADVICE r3, high).
-           "pallas_interpret": resolve_interpret()}
-    rng = np.random.default_rng(0)
-    # env override so the CPU smoke test can use a tiny panel (pallas
-    # interpret mode is slow on one core)
-    n_tickers = int(os.environ.get("TPU_SESSION_TICKERS", "4096"))
-    shape = (n_tickers, 240)
-    low = 10.0 * np.exp(np.cumsum(rng.normal(0, 1e-3, shape), -1)) \
-        .astype(np.float32)
-    high = (low * (1 + np.abs(rng.normal(0, 1e-3, shape)))) \
-        .astype(np.float32)
-    mask = rng.random(shape) > 0.03
-
-    def time_impl(fn, iters=20):
-        r = jax.block_until_ready(fn())  # compile + warm
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            r = fn()
-        jax.block_until_ready(r)
-        return (time.perf_counter() - t0) / iters, r
-
-    # real device-buffer arguments (a zero-arg jit would bake the
-    # inputs in as constants and let XLA fold work at compile time)
-    dlow, dhigh = jax.device_put(low), jax.device_put(high)
-    dmask = jax.device_put(mask)
-    conv_jit = jax.jit(lambda x, y, m: rolling_window_stats(
-        x, y, m, 50, impl="conv"))
-    pal_jit = jax.jit(lambda x, y, m: rolling_window_stats(
-        x, y, m, 50, impl="pallas"))
-    t_conv, r_conv = time_impl(lambda: conv_jit(dlow, dhigh, dmask))
-    t_pal, r_pal = time_impl(lambda: pal_jit(dlow, dhigh, dmask))
-    out["conv_ms_per_batch"] = round(t_conv * 1e3, 3)
-    out["pallas_ms_per_batch"] = round(t_pal * 1e3, 3)
-    out["speedup_pallas_over_conv"] = round(t_conv / t_pal, 3)
-    out["n_tickers"] = n_tickers
-
-    # numeric agreement on valid lanes (first hardware run of the kernel).
-    # The valid masks must MATCH, not merely intersect: a compiled kernel
-    # that corrupts window counts at block edges would shrink the
-    # intersection and let the value comparison pass vacuously.
-    v_conv = np.asarray(r_conv["valid"])
-    v_pal = np.asarray(r_pal["valid"])
-    out["valid_mismatch_lanes"] = int((v_conv != v_pal).sum())
-    valid = v_conv & v_pal
-    diffs = {}
-    for k in ("cov", "var_x", "var_y", "mean_x", "mean_y"):
-        a = np.asarray(r_conv[k])[valid]
-        b = np.asarray(r_pal[k])[valid]
-        if a.size == 0:
-            diffs[k] = float("inf")
-            continue
-        scale = np.maximum(np.abs(a), 1e-6)
-        diffs[k] = float(np.max(np.abs(a - b) / scale))
-    out["max_rel_diff"] = diffs
-    out["agree_5e-4"] = bool(out["valid_mismatch_lanes"] == 0
-                             and max(diffs.values()) < 5e-4)
-
-    # f64 two-pass oracle agreement on a row sample: conv-vs-pallas
-    # agreement alone can't catch a shared misreading — anchor a few
-    # windows to ground truth computed host-side
-    odiffs = {}
-    conv_valid = np.asarray(r_conv["valid"])
-    for t in range(0, n_tickers, max(1, n_tickers // 8)):
-        x = low[t].astype(np.float64)
-        y = high[t].astype(np.float64)
-        m = mask[t]
-        for i in np.nonzero(conv_valid[t])[0][:4]:
-            w = slice(i - 49, i + 1)
-            xw = x[w][m[w]]
-            yw = y[w][m[w]]
-            cov = ((xw - xw.mean()) * (yw - yw.mean())).mean()
-            got = float(np.asarray(r_conv["cov"])[t, i])
-            scale = max(abs(cov), 1e-9)
-            odiffs[f"{t}/{i}"] = abs(got - cov) / scale
-    out["max_rel_diff_cov_f64_oracle"] = float(max(odiffs.values())) \
-        if odiffs else None
-    out["oracle_agree_1e-2"] = bool(odiffs and max(odiffs.values()) < 1e-2)
-    res = rolling_gate(out,
-                       allow_cpu=bool(os.environ.get(
-                           "TPU_SESSION_ALLOW_CPU")))
-    res["results"] = [out]
-    return res
-
-
 def step_graph_spotcheck():
     """Full 58-kernel fused graph on the chip vs the CPU oracle, using
     the parity suite's FULL comparator protocol (tolerance matrix,
@@ -407,11 +280,11 @@ def main():
         REPO, "benchmarks", "TPU_SESSION.json"))
     ap.add_argument("--skip-probe", action="store_true")
     # value-per-second order for a window that may close any minute:
-    # the headline (the round's one must-have), the pallas
-    # prove-or-drop, the 1-minute link diagnostics, then the four
-    # ladder configs cheapest-first, parity spot-check, the batch-size
-    # sweep, and the long real-pipeline run last
-    ap.add_argument("--steps", default="headline,rolling,link,headc,"
+    # the headline (the round's one must-have), the 1-minute link
+    # diagnostics, the consolidated-fetch headline variant, then the
+    # four ladder configs cheapest-first, parity spot-check, the
+    # batch-size sweep, and the long real-pipeline run last
+    ap.add_argument("--steps", default="headline,link,headc,"
                     "lad1,lad2,lad4,lad5,spot,sweep,pipeline")
     ap.add_argument("--one-step", default=None,
                     help="internal: run one step's body in-process and "
@@ -432,7 +305,7 @@ def main():
         os.environ.setdefault("MFF_COMPILATION_CACHE_DIR",
                               os.path.join(REPO, ".xla_cache"))
         apply_compilation_cache(get_config())
-        body = {"rolling": _rolling_body, "spot": _spot_body}[args.one_step]
+        body = {"spot": _spot_body}[args.one_step]
         result = body()
         # same race step_headline guards against: the pre-step probe saw
         # a TPU, the backend then failed FAST (not wedged) and jax fell
@@ -476,9 +349,6 @@ def main():
         apply_compilation_cache, get_config)
     apply_compilation_cache(get_config())
     steps = {"headline": step_headline, "ladder": step_ladder,
-             # "rolling" is the historical name for the same step (the
-             # running watcher and prior artifacts use it)
-             "pallas": step_pallas_vs_conv, "rolling": step_pallas_vs_conv,
              "spot": step_graph_spotcheck, "sweep": step_sweep,
              "link": step_link, "pipeline": step_pipeline,
              "headc": step_headline_consolidated,

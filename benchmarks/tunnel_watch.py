@@ -111,7 +111,7 @@ def main():
     # mirror tpu_session.py's default value-per-second order; the two
     # long tails (sweep, real pipeline) run last so a window that
     # closes mid-run has already banked the core steps
-    ap.add_argument("--steps", default="headline,rolling,link,headc,"
+    ap.add_argument("--steps", default="headline,link,headc,"
                     "lad1,lad2,lad4,lad5,spot,sweep,pipeline")
     args = ap.parse_args()
 
@@ -192,14 +192,14 @@ def main():
                 out.flush()
                 try:
                     # 5 h kill: the default step list's worst-case
-                    # child timeouts sum to exactly 4 h (headline 1800
-                    # + rolling 1500 + link 600 + headc 1800 + 4x900
-                    # ladder + spot 600 + sweep 1800 + pipeline 2700)
-                    # before per-step probes — a kill sized below that
-                    # would always sacrifice the pipeline step, the
-                    # last and longest, in a slow-but-progressing
-                    # window; per-step re-probes make a dead-tunnel
-                    # session fail fast regardless
+                    # child timeouts sum to ~3.6 h (headline 1800 +
+                    # link 600 + headc 1800 + 4x900 ladder + spot 600
+                    # + sweep 1800 + pipeline 2700) before per-step
+                    # probes and inter-step overhead — a kill sized
+                    # below that would always sacrifice the pipeline
+                    # step, the last and longest, in a slow-but-
+                    # progressing window; per-step re-probes make a
+                    # dead-tunnel session fail fast regardless
                     p = subprocess.run(
                         [sys.executable, "benchmarks/tpu_session.py",
                          "--steps", ",".join(steps)],

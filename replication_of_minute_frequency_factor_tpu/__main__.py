@@ -49,7 +49,7 @@ def _add_compute(sub: "argparse._SubParsersAction") -> None:
                    help="execution backend: jax (device), numpy "
                         "(f64 oracle), polars (the reference's own "
                         "kernels; slow, differential use)")
-    p.add_argument("--rolling-impl", choices=("conv", "pallas"),
+    p.add_argument("--rolling-impl", choices=("conv",),
                    default=None)
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace here")

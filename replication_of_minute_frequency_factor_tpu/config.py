@@ -42,8 +42,9 @@ class Config:
     #: debug sanitizer: validate day tensors (finite prices, high>=low,
     #: volume>=0 on valid lanes) before compute; raises DayDataError
     debug_validate: bool = False
-    #: rolling-moment backend for the mmt_ols_* family: 'conv' (XLA) or
-    #: 'pallas' (fused VMEM-resident kernel, ops/pallas_rolling.py)
+    #: rolling-moment backend for the mmt_ols_* family; 'conv' (the
+    #: XLA formulation) is the only value — the seam stays for a
+    #: future kernel (docs/ROADMAP.md, pallas prove-or-drop)
     rolling_impl: str = "conv"
     #: index-pool membership parquet enabling cal_final_exposure's
     #: stock_pool= (data/io.py read_stock_pool); None keeps the

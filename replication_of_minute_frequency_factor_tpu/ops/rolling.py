@@ -58,31 +58,23 @@ def rolling_window_stats(x, y, mask, window: int = 50,
     Stats are only meaningful where ``valid``; other lanes are garbage and
     must be masked by the caller.
 
-    ``impl``: ``'conv'`` (XLA, default) or ``'pallas'`` (the VMEM-resident
-    fused kernel, ops/pallas_rolling.py); None reads ``Config.rolling_impl``.
+    ``impl``: ``'conv'`` (the XLA formulation — the only backend; a
+    Pallas VMEM-resident kernel was carried rounds 2-4 but never won a
+    tunnel window for a single hardware execution and was dropped per
+    the round-3 verdict's prove-or-drop deadline, docs/ROADMAP.md);
+    None reads ``Config.rolling_impl``. The parameter stays plumbed
+    (registry/pipeline/collectives) so a future kernel slots back in
+    without re-threading every call site.
     """
     from replication_of_minute_frequency_factor_tpu import pins
 
     if impl is None:
         from ..config import get_config
         impl = get_config().rolling_impl
-    if impl not in ("conv", "pallas"):
+    if impl != "conv":
         raise ValueError(f"unknown rolling_impl {impl!r}; "
-                         "expected 'conv' or 'pallas'")
+                         "expected 'conv'")
     degenerate = pins.reading("constant_window") == "degenerate"
-    if impl == "pallas":
-        if degenerate:
-            from .pallas_rolling import rolling_window_stats_pallas
-            return rolling_window_stats_pallas(x, y, mask, window)
-        # the pallas kernel implements only the default pin; a caller
-        # who explicitly asked for it must hear about the downgrade or
-        # a pin-bound sweep's "pallas" numbers are really conv (ADVICE r3)
-        import warnings
-        warnings.warn(
-            "rolling impl='pallas' downgraded to 'conv': the pallas "
-            "kernel only implements the default constant_window="
-            "'degenerate' pin reading", RuntimeWarning, stacklevel=2)
-        impl = "conv"
     m = mask.astype(x.dtype)
     xm = jnp.where(mask, x, 0.0)
     ym = jnp.where(mask, y, 0.0)

@@ -97,34 +97,22 @@ def test_mixed_artifact_carries_only_fresh_green(tpu_session, tmp_path):
     assert set(got) == {"headline"}
 
 
-def test_conv_only_rolling_dropped(tpu_session):
-    """A green rolling entry without pallas timing (banked by
-    pre-restoration code) must not satisfy the conv-vs-pallas step."""
+def test_legacy_rolling_entries_never_carry(tpu_session):
+    """The conv-vs-pallas step was removed with the Pallas kernel
+    (round-4 prove-or-drop): any banked 'rolling'/'pallas' artifact
+    entry belongs to a step that no longer exists and must not be
+    carried into a fresh session."""
     steps = {
         "rolling": {"ok": True, "results": [
-            {"backend": "tpu", "conv_ms_per_batch": 2.0}]},
+            {"conv_ms_per_batch": 2.0, "pallas_ms_per_batch": 1.0,
+             "pallas_interpret": False}]},
+        "pallas": {"ok": True, "results": [
+            {"conv_ms_per_batch": 2.0}]},
         "headline": {"ok": True, "results": [
             {"metric": "x", "days_per_batch": 32}]},
     }
     got = tpu_session.drop_conv_only_rolling(steps)
     assert set(got) == {"headline"}
-
-
-def test_full_rolling_entry_kept(tpu_session):
-    steps = {"pallas": {"ok": True, "results": [
-        {"conv_ms_per_batch": 2.0, "pallas_ms_per_batch": 1.0,
-         "pallas_interpret": False}]}}
-    assert tpu_session.drop_conv_only_rolling(steps) == steps
-
-
-def test_interpret_rolling_entry_dropped(tpu_session):
-    """An interpret (emulation) run that reached the artifact — e.g. a
-    local CPU smoke with TPU_SESSION_ALLOW_CPU writing the default
-    --out — must not be carried as the hardware conv-vs-pallas step."""
-    steps = {"rolling": {"ok": True, "results": [
-        {"conv_ms_per_batch": 2.0, "pallas_ms_per_batch": 1.0,
-         "pallas_interpret": True}]}}
-    assert tpu_session.drop_conv_only_rolling(steps) == {}
 
 
 def test_pre_reshape_headline_dropped(tpu_session):
@@ -147,31 +135,6 @@ def test_watcher_has_no_pending_filter(tunnel_watch):
     stale-green step from the artifact forever."""
     assert not hasattr(tunnel_watch, "_pending_steps")
 
-
-def test_rolling_gate_green_compiled_agreeing(tpu_session):
-    out = {"agree_5e-4": True, "oracle_agree_1e-2": True,
-           "pallas_interpret": False}
-    assert tpu_session.rolling_gate(out) == {"ok": True}
-
-
-def test_rolling_gate_refuses_interpret_run(tpu_session):
-    """An interpreter (emulation) run must never bank green — it would
-    be carried forever and the compiled kernel never executed."""
-    out = {"agree_5e-4": True, "oracle_agree_1e-2": True,
-           "pallas_interpret": True}
-    got = tpu_session.rolling_gate(out)
-    assert got == {"ok": False, "status": "interpret_run"}
-    # local-smoke escape hatch
-    assert tpu_session.rolling_gate(out, allow_cpu=True) == {"ok": True}
-
-
-def test_rolling_gate_refuses_parity_disagreement(tpu_session):
-    for bad in ({"agree_5e-4": False, "oracle_agree_1e-2": True},
-                {"agree_5e-4": True, "oracle_agree_1e-2": False},
-                {}):
-        got = tpu_session.rolling_gate(
-            dict(bad, pallas_interpret=False))
-        assert got == {"ok": False, "status": "parity_disagree"}
 
 
 def test_watcher_defers_pipeline_while_pregen_runs(tunnel_watch):
