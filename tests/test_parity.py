@@ -169,11 +169,57 @@ _PDF_THRESHOLDS = {"doc_pdf60": 0.6, "doc_pdf70": 0.7, "doc_pdf80": 0.8,
                    "doc_pdf90": 0.9, "doc_pdf95": 0.95}
 
 
+def _eod_ret_device(bars, mask):
+    """The production graph's end-of-day-relative return, run standalone
+    on the ACTIVE jax backend (context.DayContext.eod_ret formulation)."""
+    from replication_of_minute_frequency_factor_tpu.ops import masked_last
+    close = bars[..., 3]
+    last = masked_last(close, mask)
+    return last[..., None] / close
+
+
+_eod_ret_device_jit = jax.jit(_eod_ret_device)
+
+
+def _device_eod_rows(code, time, cols):
+    """Acceptance channel 3: the active backend's OWN f32 eod returns,
+    one per (sorted) row. Channels 1-2 assume device f32 division is
+    correctly rounded (true on XLA-CPU, where f64-divide-then-cast equals
+    f32 divide bit-for-bit); the first on-hardware spot check
+    (benchmarks/tpu_session.py step ``spot``, 2026-08-02) falsified that
+    for the TPU backend — a sub-ulp divide difference re-split a
+    cross-code tie group and moved doc_pdf70 by 102 rank units. Fetching
+    the device's own returns (a tiny [T, 240] f32 array) makes the
+    tie/threshold walk exact for whatever rounding the backend
+    implements; share/cumsum rounding stays covered by PDF_EDGE_EPS.
+    Returns None when a row can't be mapped onto the minute grid (never
+    happens for synth days; bail rather than guess)."""
+    from replication_of_minute_frequency_factor_tpu import sessions
+    g = grid_day(code, time, cols["open"], cols["high"], cols["low"],
+                 cols["close"], cols["volume"])
+    eod = np.asarray(_eod_ret_device_jit(g.bars, g.mask), np.float64)
+    gcodes = np.asarray(g.codes)
+    ti = np.searchsorted(gcodes, code)
+    si = sessions.time_to_slot(np.asarray(time))
+    known = ((ti < len(gcodes))
+             & (gcodes[np.minimum(ti, len(gcodes) - 1)] == code))
+    if (si < 0).any() or not known.all():
+        return None
+    # duplicate (code, slot) rows: grid_day keeps the last occurrence,
+    # so gathering the grid cell would hand BOTH rows that one close —
+    # misattributed returns could then trip the regression bound on
+    # legitimate input (rows arrive sorted by (code, time), so
+    # duplicates are adjacent)
+    if ((code[1:] == code[:-1]) & (si[1:] == si[:-1])).any():
+        return None
+    return eod[ti, si]
+
+
 def _doc_pdf_acceptable(df: pd.DataFrame):
     """Acceptance sets for doc_pdf* on a single-date frame.
 
-    Two measure-zero channels make the rank legitimately backend-dependent
-    (docs/DESIGN.md precision policy):
+    Three measure-zero channels make the rank legitimately backend-
+    dependent (docs/DESIGN.md precision policy):
       * threshold crossing: a group's cumulative share within float
         rounding of the quantile edge crosses one group earlier/later —
         modelled by re-reading the crossing at threshold +/- PDF_EDGE_EPS;
@@ -182,7 +228,12 @@ def _doc_pdf_acceptable(df: pd.DataFrame):
         tie groups merged, moving the average rank by 31.5), and can also
         split or merge the crossing group itself — modelled by running
         the walk a second time with the returns quantized to f32 before
-        ranking (and only the returns; see the share note below).
+        ranking (and only the returns; see the share note below);
+      * device rounding: the backend's f32 division may differ from
+        correctly-rounded by sub-ulp amounts (observed on TPU hardware),
+        re-splitting tie groups neither f64 nor cast-f32 ranking
+        reproduces — modelled by a third walk over the device's own
+        returns (``_device_eod_rows``).
     Returns ``{(code, factor): {acceptable rank values}}``; a jax value is
     OK if it is within the normal slack of ANY member.
 
@@ -190,8 +241,9 @@ def _doc_pdf_acceptable(df: pd.DataFrame):
     comparator) is the oracle's own ``_doc_pdf`` on ``Group`` objects —
     only the global-rank wiring is rebuilt here, mirroring
     ``compute_oracle``'s driver, because the f32 channel needs the DERIVED
-    return quantized before ranking (f32 division is correctly rounded,
-    so f64-divide-then-cast equals the device's f32 divide bit-for-bit).
+    return quantized before ranking (on XLA-CPU f32 division is correctly
+    rounded, so f64-divide-then-cast equals the device's f32 divide
+    bit-for-bit; on TPU it need not — hence the device channel).
     Shares stay f64: they differ from device f32 shares by <=1 ulp each,
     which the PDF_EDGE_EPS band already covers.
     """
@@ -206,7 +258,7 @@ def _doc_pdf_acceptable(df: pd.DataFrame):
     time = df["time"].to_numpy(np.int64)
     starts = np.r_[0, np.nonzero(code[1:] != code[:-1])[0] + 1, len(code)]
     spans = list(zip(starts[:-1], starts[1:]))
-    out: dict = {}
+    channels = []
     for quantize in (False, True):
         eod = np.empty(len(df), np.float64)
         with np.errstate(divide="ignore", invalid="ignore"):
@@ -217,6 +269,33 @@ def _doc_pdf_acceptable(df: pd.DataFrame):
                 eod[b0:b1] = c[b1 - 1] / c[b0:b1]
         if quantize:
             eod = eod.astype(np.float32).astype(np.float64)
+        channels.append(eod)
+    dev = _device_eod_rows(code, time, cols)
+    if dev is not None:
+        # The channel is only legitimate while the device's returns sit
+        # within float rounding of the correctly-rounded f32 realization
+        # (channels[1]): an actually-wrong device divide (think fast-math
+        # reciprocal-multiply, ~1e-3 off — not sub-ulp wobble) must fail
+        # the comparison loudly, not mint its own acceptance set.
+        q = channels[1]
+        if not np.array_equal(dev, q, equal_nan=True):
+            # (bit-identical on XLA-CPU — skip the redundant third walk)
+            fin = np.isfinite(q) & np.isfinite(dev)
+            inf = np.isinf(q) | np.isinf(dev)
+            eps = np.finfo(np.float32).eps
+            bounded = (
+                np.array_equal(np.isnan(dev), np.isnan(q))
+                and np.array_equal(dev[inf], q[inf])  # incl. inf signs
+                and bool(np.all(np.abs(dev[fin] - q[fin])
+                                <= 4 * eps * np.abs(q[fin])))
+            )
+            assert bounded, (
+                "device eod_ret deviates from correctly-rounded f32 "
+                "beyond the 4-ulp band — a device arithmetic regression, "
+                "not a tie-structure channel")
+            channels.append(dev)
+    out: dict = {}
+    for eod in channels:
         grank = rank_average(eod)
         for b0, b1 in spans:
             g = Group(time=time[b0:b1],
@@ -565,6 +644,22 @@ def test_comparator_detects_injected_distortion(rng, monkeypatch,
                      "mutated", noisy=True)
     finally:
         jax.clear_caches()
+
+
+def test_device_channel_bound_rejects_wrong_divide(rng, monkeypatch):
+    """Meta-test for the doc_pdf device acceptance channel: device
+    returns that deviate from correctly-rounded f32 by more than
+    rounding (a fast-math-style divide regression, here +1e-3 rel) must
+    trip the 4-ulp bound assert — not mint their own acceptance ranks on
+    the very hardware the channel exists to validate."""
+    import sys as _sys
+    mod = _sys.modules[__name__]
+    monkeypatch.setattr(
+        mod, "_eod_ret_device_jit",
+        lambda bars, mask: _eod_ret_device(bars, mask) * (1.0 + 1e-3))
+    df = pd.DataFrame(synth_day(rng, n_codes=6))
+    with pytest.raises(AssertionError, match="device arithmetic regression"):
+        _doc_pdf_acceptable(df)
 
 
 def test_fixed_variants_compute_the_intended_math(rng):
