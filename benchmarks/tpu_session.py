@@ -122,16 +122,23 @@ def drop_conv_only_rolling(steps):
 
     * 'rolling'/'pallas' entries belong to the step removed with the
       Pallas kernel (round 4 prove-or-drop) — never carried;
-    * 'headline' entries without a ``days_per_batch`` key predate the
-      32-day loop reshape and would silently keep the new shape from
-      ever running on hardware — drop.
+    * 'headc' entries belong to the r4 consolidated-fetch A/B, which
+      the r5 resident loop supersedes — never carried;
+    * 'headline' entries must be the r5 resident methodology (a
+      ``mode: resident`` record with the per-phase breakdown); r1-r4
+      stream-loop headlines would silently keep the O(1)-round-trip
+      loop from ever running on hardware — drop;
+    * 'stream' entries must be ``mode: stream`` records (the r1-r4
+      series continuation under its own metric suffix).
     """
     def keep(name, v):
         recs = [r for r in v.get("results") or [] if isinstance(r, dict)]
-        if name in ("rolling", "pallas"):
-            return False  # step removed with the Pallas kernel (r4)
+        if name in ("rolling", "pallas", "headc"):
+            return False  # steps removed in r4/r5
         if name == "headline":
-            return any("days_per_batch" in r for r in recs)
+            return any(r.get("mode") == "resident" for r in recs)
+        if name == "stream":
+            return any(r.get("mode") == "stream" for r in recs)
         return True
 
     return {k: v for k, v in steps.items() if keep(k, v)}
@@ -149,9 +156,13 @@ def _run_one_step_child(name, timeout=1500):
     r = _run_json_lines(
         [sys.executable, os.path.abspath(__file__), "--one-step", name],
         timeout=timeout)
-    # unwrap: the child's last JSON line IS the step result
+    # unwrap: the child's last JSON line IS the step result; carry the
+    # wrapper's rc + seconds so every step entry shares one schema
+    # (ADVICE r4: the spot entry omitted rc, unlike _run_json_lines
+    # steps, leaving the artifact schema inconsistent across steps)
     for rec in reversed(r.get("results") or []):
         if isinstance(rec, dict) and "ok" in rec:
+            rec.setdefault("rc", r.get("rc"))
             rec.setdefault("seconds", r.get("seconds"))
             return rec
     return r  # child died before printing a result (timeout/crash)
@@ -174,19 +185,22 @@ def _run_bench_gated(extra_env):
 
 
 def step_headline():
-    return _run_bench_gated({})
+    """r5 resident headline (bench.py default mode) with the profiler
+    dir wired in (VERDICT r4 #1): the stage pass attempts an on-chip
+    jax.profiler trace and banks profile_ok/profile_error either way;
+    trace files land uncommitted under .bench_data/profile_r5."""
+    return _run_bench_gated({"MFF_PROFILE_DIR": os.path.join(
+        REPO, ".bench_data", "profile_r5")})
 
 
-def step_headline_consolidated():
-    """The headline workload with BENCH_CONSOLIDATE=1: results
-    accumulate on device and the year materializes in ONE fetch —
-    saving (iters-1) per-fetch latency floors. Banked under its own
-    metric suffix; if it beats the per-batch loop on hardware, flip
-    bench.py's default before round end so the driver's capture
-    inherits the winner. Stage pass AND link probes off — the
-    headline/link steps already bank those diagnostics this window."""
-    return _run_bench_gated({"BENCH_CONSOLIDATE": "1",
-                             "BENCH_METRIC_SUFFIX": "_consolidated",
+def step_stream():
+    """The r1-r4 per-batch stream loop under its own metric suffix —
+    the series continuation that makes the resident loop's gain an
+    A/B on the same hardware window rather than a methodology break
+    (VERDICT r4 #3). Stage pass AND link probes off — the headline
+    step already banks those diagnostics this window."""
+    return _run_bench_gated({"BENCH_MODE": "stream",
+                             "BENCH_METRIC_SUFFIX": "_stream",
                              "BENCH_STAGES": "0", "BENCH_LINK": "0"})
 
 
@@ -281,10 +295,10 @@ def main():
     ap.add_argument("--skip-probe", action="store_true")
     # value-per-second order for a window that may close any minute:
     # the headline (the round's one must-have), the 1-minute link
-    # diagnostics, the consolidated-fetch headline variant, then the
+    # diagnostics, the stream-loop series continuation, then the
     # four ladder configs cheapest-first, parity spot-check, the
     # batch-size sweep, and the long real-pipeline run last
-    ap.add_argument("--steps", default="headline,link,headc,"
+    ap.add_argument("--steps", default="headline,link,stream,"
                     "lad1,lad2,lad4,lad5,spot,sweep,pipeline")
     ap.add_argument("--one-step", default=None,
                     help="internal: run one step's body in-process and "
@@ -351,7 +365,7 @@ def main():
     steps = {"headline": step_headline, "ladder": step_ladder,
              "spot": step_graph_spotcheck, "sweep": step_sweep,
              "link": step_link, "pipeline": step_pipeline,
-             "headc": step_headline_consolidated,
+             "stream": step_stream,
              "lad1": _step_ladder_one("1"), "lad2": _step_ladder_one("2"),
              "lad4": _step_ladder_one("4"), "lad5": _step_ladder_one("5")}
     want = [s.strip() for s in args.steps.split(",") if s.strip()]

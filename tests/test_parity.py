@@ -201,6 +201,10 @@ def _device_eod_rows(code, time, cols):
     gcodes = np.asarray(g.codes)
     ti = np.searchsorted(gcodes, code)
     si = sessions.time_to_slot(np.asarray(time))
+    # NOTE: with codes=None above, gcodes is np.unique of this very
+    # `code` array, so every row's code is always found and the guard
+    # can't fire today — it only matters if a pinned ``codes=`` axis is
+    # ever threaded through here (ADVICE r4).
     known = ((ti < len(gcodes))
              & (gcodes[np.minimum(ti, len(gcodes) - 1)] == code))
     if (si < 0).any() or not known.all():
@@ -283,11 +287,16 @@ def _doc_pdf_acceptable(df: pd.DataFrame):
             fin = np.isfinite(q) & np.isfinite(dev)
             inf = np.isinf(q) | np.isinf(dev)
             eps = np.finfo(np.float32).eps
+            # + tiny: a purely relative band degenerates to
+            # exact-equality at q == 0; eod price ratios are ~O(1) and
+            # never 0 today, but the absolute floor keeps the channel
+            # safe for any signed/zero-crossing reuse (ADVICE r4)
             bounded = (
                 np.array_equal(np.isnan(dev), np.isnan(q))
                 and np.array_equal(dev[inf], q[inf])  # incl. inf signs
                 and bool(np.all(np.abs(dev[fin] - q[fin])
-                                <= 4 * eps * np.abs(q[fin])))
+                                <= 4 * eps * np.abs(q[fin])
+                                + np.finfo(np.float32).tiny))
             )
             assert bounded, (
                 "device eod_ret deviates from correctly-rounded f32 "

@@ -108,24 +108,40 @@ def test_legacy_rolling_entries_never_carry(tpu_session):
              "pallas_interpret": False}]},
         "pallas": {"ok": True, "results": [
             {"conv_ms_per_batch": 2.0}]},
+        "headc": {"ok": True, "results": [
+            {"metric": "cicc58_5000tickers_1yr_wall_consolidated",
+             "value": 141.7}]},
         "headline": {"ok": True, "results": [
-            {"metric": "x", "days_per_batch": 32}]},
+            {"metric": "x", "days_per_batch": 32, "mode": "resident"}]},
     }
     got = tpu_session.drop_conv_only_rolling(steps)
     assert set(got) == {"headline"}
 
 
 def test_pre_reshape_headline_dropped(tpu_session):
-    """A green headline banked by the 8-day-loop bench (no
-    days_per_batch key) must re-run under the reshaped loop — carrying
-    it would mean the new configuration never executes on hardware."""
+    """A green headline banked by a pre-r5 bench (stream loop, or no
+    mode key at all) must re-run under the resident loop — carrying it
+    would mean the new configuration never executes on hardware. Same
+    content bound for the stream series step."""
     old = {"headline": {"ok": True, "results": [
         {"metric": "cicc58_5000tickers_1yr_wall", "value": 146.2}]}}
     assert tpu_session.drop_conv_only_rolling(old) == {}
+    r4 = {"headline": {"ok": True, "results": [
+        {"metric": "cicc58_5000tickers_1yr_wall", "value": 148.1,
+         "days_per_batch": 32}]}}
+    assert tpu_session.drop_conv_only_rolling(r4) == {}
     new = {"headline": {"ok": True, "results": [
         {"metric": "cicc58_5000tickers_1yr_wall", "value": 58.0,
-         "days_per_batch": 32}]}}
+         "days_per_batch": 32, "mode": "resident"}]}}
     assert tpu_session.drop_conv_only_rolling(new) == new
+    stream_wrong = {"stream": {"ok": True, "results": [
+        {"metric": "cicc58_5000tickers_1yr_wall_stream",
+         "value": 150.0, "mode": "resident"}]}}
+    assert tpu_session.drop_conv_only_rolling(stream_wrong) == {}
+    stream_ok = {"stream": {"ok": True, "results": [
+        {"metric": "cicc58_5000tickers_1yr_wall_stream",
+         "value": 150.0, "mode": "stream"}]}}
+    assert tpu_session.drop_conv_only_rolling(stream_ok) == stream_ok
 
 
 def test_watcher_has_no_pending_filter(tunnel_watch):

@@ -189,6 +189,48 @@ def test_packed_compute_matches_dict_paths(batch):
                                    err_msg=n)
 
 
+def test_resident_scan_matches_per_batch(rng):
+    """The resident scan path (one executable over N packed buffers —
+    the r5 O(1)-round-trip headline loop) must equal the per-batch
+    packed path bit-for-bit, including when a later batch widens the
+    shared floor (bench.encode_year's uniform-spec contract)."""
+    import jax
+    from replication_of_minute_frequency_factor_tpu.pipeline import (
+        compute_packed_prepared, compute_packed_resident)
+
+    names = ("vol_return1min", "mmt_ols_qrs", "doc_pdf60", "trade_headRatio")
+    batches = []
+    for i in range(3):
+        days = []
+        for _ in range(2):
+            cols = synth_day(rng, n_codes=8, missing_prob=0.1,
+                             zero_volume_prob=0.1)
+            if i == 2:
+                # integer price scale keeps ticks aligned while blowing
+                # up the tick deltas, so the shared floor widens AFTER
+                # batches 0/1 were encoded (exercises the re-encode)
+                for f in ("open", "high", "low", "close"):
+                    cols[f] = (cols[f] * 50).astype(np.float32)
+            g = grid_day(cols["code"], cols["time"], cols["open"],
+                         cols["high"], cols["low"], cols["close"],
+                         cols["volume"])
+            days.append(g)
+        batches.append((np.stack([g.bars for g in days]),
+                        np.stack([g.mask for g in days])))
+
+    import bench
+    bufs, spec, kind = bench.encode_year(batches, use_wire=True)
+    assert len({b.nbytes for b in bufs}) == 1  # uniform length
+    dbufs = tuple(jax.device_put(b) for b in bufs)
+    got = np.asarray(compute_packed_resident(dbufs, spec, kind,
+                                             names=names))
+    assert got.shape == (3, len(names), 2, batches[0][0].shape[1])
+    for i, buf in enumerate(bufs):
+        want = np.asarray(compute_packed_prepared(buf, spec, kind,
+                                                  names=names))
+        np.testing.assert_array_equal(got[i], want, err_msg=f"batch {i}")
+
+
 def test_wire_fuzz_native_numpy_byte_parity():
     """Compact randomized sweep (the long-run version cleared 700 seeds):
     random shapes, price scales from 0.05 to 41000 CNY, volume modes,
