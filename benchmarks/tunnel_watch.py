@@ -111,7 +111,7 @@ def main():
     # mirror tpu_session.py's default value-per-second order; the two
     # long tails (sweep, real pipeline) run last so a window that
     # closes mid-run has already banked the core steps
-    ap.add_argument("--steps", default="headline,link,headc,"
+    ap.add_argument("--steps", default="headline,link,stream,"
                     "lad1,lad2,lad4,lad5,spot,sweep,pipeline")
     args = ap.parse_args()
 
