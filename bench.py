@@ -26,6 +26,8 @@ import numpy as np
 from replication_of_minute_frequency_factor_tpu.data import wire
 from replication_of_minute_frequency_factor_tpu.models.registry import (
     factor_names)
+from replication_of_minute_frequency_factor_tpu.telemetry import (
+    get_telemetry)
 
 N_TICKERS = int(os.environ.get("BENCH_TICKERS", "5000"))
 TRADING_DAYS_PER_YEAR = 244
@@ -128,6 +130,36 @@ class _NullTimer:
         return contextlib.nullcontext()
 
 
+def _count_sync(point: str) -> None:
+    """Count one host-BLOCKING device sync (block_until_ready or a
+    materializing np.asarray) in the registry. The headline's
+    ``round_trips.host_blocking_syncs`` is the measured delta of this
+    counter over the timed loop — counted at the call sites, not
+    predicted from the loop shape (round-5 ADVICE low #4: the predicted
+    numbers under/over-counted per branch)."""
+    get_telemetry().counter("bench.host_blocking_syncs", point=point)
+
+
+def _encode_kind_marks() -> dict:
+    """Snapshot of the encode-kind counters (see encode_year/encode_pack);
+    diff two snapshots with :func:`_encode_kind_delta`."""
+    reg = get_telemetry().registry
+    return {k: reg.counter_value("bench.encode_kind", kind=k)
+            for k in ("wire", "raw")}
+
+
+def _encode_kind_delta(before: dict) -> str:
+    """'wire' / 'raw' / 'mixed' / None over a counter window."""
+    after = _encode_kind_marks()
+    dw = after["wire"] - before["wire"]
+    dr = after["raw"] - before["raw"]
+    if dw and not dr:
+        return "wire"
+    if dr and not dw:
+        return "raw"
+    return "mixed" if (dw and dr) else None
+
+
 def make_batch(rng, n_days=None, n_tickers=N_TICKERS):
     # f32 draws throughout (standard_normal/random with dtype=) — the
     # synth preamble runs on one host core inside a precious tunnel
@@ -151,27 +183,40 @@ def make_batch(rng, n_days=None, n_tickers=N_TICKERS):
     return bars.astype(np.float32), mask
 
 
-def encode_year(batches, use_wire):
+def encode_year(batches, use_wire, max_passes=4):
     """Encode every batch under ONE shared widen-only floor so all
     buffers land on a single (spec, length) — the resident scan path
-    stacks them device-side, which needs uniform shapes. A batch that
-    widens the floor after earlier batches were encoded forces a
-    re-encode of the stragglers (floors are monotonic, so one extra
-    pass converges). Falls back to raw-f32 packing when the wire format
-    can't represent the data."""
+    stacks them device-side, which needs uniform shapes.
+
+    The FLOOR is monotonic but the SPEC is not a simple ladder: the
+    volume-mode switch (lots -> shares) can change a straggler's packed
+    width when re-encoded under the final floor, so one extra pass does
+    NOT always converge (round-5 ADVICE low #2 — the old single pass
+    silently dropped the whole year to raw-f32, 4x the wire bytes,
+    invisibly). Now re-encode passes repeat until every batch shares one
+    spec (the floor is bounded, so this terminates; ``max_passes``
+    guards the pathological case), and the outcome lands in the
+    ``bench.encode_kind`` registry counter either way — the headline
+    record's ``encode_kind`` field reads it back, so a raw fallback can
+    never again be invisible."""
+    tel = get_telemetry()
     if use_wire:
         floor: dict = {}
         encs = [wire.encode(b, m, floor=floor) for b, m in batches]
-        if all(e is not None for e in encs):
+        for _ in range(max_passes):
+            if not all(e is not None for e in encs):
+                break  # unrepresentable under wire: raw fallback
             packs = [wire.pack_arrays(e.arrays) for e in encs]
-            final = packs[-1][1]
-            for i in range(len(packs)):
-                if packs[i][1] != final:
-                    redo = wire.encode(*batches[i], floor=floor)
-                    packs[i] = wire.pack_arrays(redo.arrays)
-            if all(p[1] == final for p in packs):
-                return [p[0] for p in packs], final, "wire"
+            if len({p[1] for p in packs}) == 1:
+                tel.counter("bench.encode_kind", kind="wire")
+                return [p[0] for p in packs], packs[0][1], "wire"
+            # divergent specs: re-encode EVERYTHING under the
+            # accumulated floor — once a full pass stops widening it,
+            # every batch encodes at the floor's widths and the specs
+            # are uniform
+            encs = [wire.encode(b, m, floor=floor) for b, m in batches]
     packs = [wire.pack_arrays((b, m.view(np.uint8))) for b, m in batches]
+    tel.counter("bench.encode_kind", kind="raw")
     return [p[0] for p in packs], packs[0][1], "raw"
 
 
@@ -195,6 +240,7 @@ def run_resident(batches, names, use_wire, group):
     phases["encode_s"] = round(time.perf_counter() - t0, 3)
     t0 = time.perf_counter()
     dbufs = [jax.device_put(b) for b in bufs]  # all puts in flight
+    _count_sync("resident_ingest")
     jax.block_until_ready(dbufs)
     phases["ingest_s"] = round(time.perf_counter() - t0, 3)
     phases["ingest_MB"] = round(sum(b.nbytes for b in bufs) / 1e6, 1)
@@ -204,10 +250,14 @@ def run_resident(batches, names, use_wire, group):
         outs.append(compute_packed_resident(
             tuple(dbufs[g0:g0 + group]), spec, kind, names=names,
             replicate_quirks=True))
+    _count_sync("resident_compute")
     jax.block_until_ready(outs)
     phases["compute_s"] = round(time.perf_counter() - t0, 3)
     t0 = time.perf_counter()
-    host = [np.asarray(o) for o in outs]
+    host = []
+    for o in outs:
+        _count_sync("resident_fetch")
+        host.append(np.asarray(o))
     phases["fetch_s"] = round(time.perf_counter() - t0, 3)
     phases["fetch_MB"] = round(sum(h.nbytes for h in host) / 1e6, 1)
     return phases, kind
@@ -388,6 +438,8 @@ def main():
         ctx = t if t is not None else _NullTimer()
         with ctx("wire_encode"):
             w = wire.encode(b, m) if use_wire else None
+        get_telemetry().counter("bench.encode_kind",
+                                kind="wire" if w is not None else "raw")
         with ctx("pack"):
             if w is not None:
                 return wire.pack_arrays(w.arrays) + ("wire",)
@@ -513,9 +565,12 @@ def main():
     if os.environ.get("BENCH_STAGES", "1") != "0":
         from replication_of_minute_frequency_factor_tpu.pipeline import (
             _compute_packed_jit)
-        from replication_of_minute_frequency_factor_tpu.utils.tracing \
-            import Timer
-        t = Timer()
+        # StageTimer: Timer semantics for the stages dict below, PLUS
+        # every stage lands in the shared registry as a
+        # span_seconds{span=...} histogram — the BENCH series and the
+        # pipeline's telemetry can no longer drift apart (they are the
+        # same records)
+        t = get_telemetry().stage_timer()
         with t("synth_batch"):
             b, m = make_batch(np.random.default_rng(99), n_days=8)
         sbuf, sspec, skind = encode_pack(b, m, t)  # wire_encode + pack
@@ -595,6 +650,13 @@ def main():
     # batch with async overlap, like pipeline._run_device_pipeline.
     # (``consolidate`` resolved above so _warm could pre-compile the
     # device concat.)
+    # measured-counter windows over the timed loop ONLY (warmup and the
+    # stage pass incremented the same counters; deltas exclude them):
+    # host_blocking_syncs comes from _count_sync call sites, encode_kind
+    # from encode_year/encode_pack's registry counter
+    reg = get_telemetry().registry
+    syncs_before = reg.counter_total("bench.host_blocking_syncs")
+    kind_before = _encode_kind_marks()
     phases = None
     if mode == "resident":
         t0 = time.perf_counter()
@@ -603,10 +665,7 @@ def main():
         per_batch = wall / iters
         round_trips = {"puts_async": iters,
                        "executes": -(-iters // group),
-                       "fetches": -(-iters // group),
-                       # 1 ingest block + 1 compute block + one
-                       # blocking np.asarray per scan group
-                       "host_blocking_syncs": 2 + -(-iters // group)}
+                       "fetches": -(-iters // group)}
     else:
         t0 = time.perf_counter()
         threading.Thread(target=produce, daemon=True).start()
@@ -617,6 +676,7 @@ def main():
                 outs.append(launch(q.get()))
             big = jnp.concatenate(outs, axis=1)  # [F, iters*days, T]
             del outs
+            _count_sync("stream_consolidated_fetch")
             np.asarray(big)  # the year's results land in one transfer
         else:
             for i in range(iters):
@@ -632,21 +692,38 @@ def main():
                     # pipeline lag (pipeline.materialize): the [58,D,T]
                     # result crosses the link too, so it belongs in the
                     # wall clock
+                    _count_sync("stream_lagged_fetch")
                     np.asarray(outs[i - 2])
             for o in outs[-2:]:
+                _count_sync("stream_drain_fetch")
                 np.asarray(o)
         per_batch = (time.perf_counter() - t0) / iters
         round_trips = {"puts_async": iters, "executes": iters,
-                       "fetches": 1 if consolidate else iters,
-                       "host_blocking_syncs": 1 if consolidate
-                       else iters}
+                       "fetches": 1 if consolidate else iters}
+    # the ACTUAL number of host-blocking sync points the timed loop hit,
+    # counted at the call sites (ADVICE r5 low #4: the old per-branch
+    # formulas under-counted the stream drain and the resident
+    # group-level blocks)
+    round_trips["host_blocking_syncs"] = int(
+        reg.counter_total("bench.host_blocking_syncs") - syncs_before)
+    encode_kind = _encode_kind_delta(kind_before)
     full_year = per_batch * (TRADING_DAYS_PER_YEAR / days)
 
     target = 60.0
     record = {
-        "metric": "cicc58_5000tickers_1yr_wall" + _SUFFIX,
+        # the name is DERIVED from the ticker count (ADVICE r5 medium:
+        # a BENCH_TICKERS=500 run used to print a much faster number
+        # under the hardcoded 5000-ticker name, and the session carry
+        # would bank it as the headline series); tpu_session's carry
+        # additionally rejects non-5000-ticker headline records
+        "metric": f"cicc58_{N_TICKERS}tickers_1yr_wall" + _SUFFIX,
         "value": round(full_year, 3),
         "unit": "s",
+        "tickers": N_TICKERS,
+        # 'wire' / 'raw' / 'mixed', measured from the registry counter
+        # the timed loop's encoders incremented — a raw fallback ships
+        # ~4x the bytes and must be visible in the record it distorted
+        "encode_kind": encode_kind,
         "vs_baseline": round(target / full_year, 3),
         # loop shape: with 32-day batches the 8 timed iterations cover
         # 256 days — MORE than the 244-day year the metric names, so
@@ -693,6 +770,12 @@ def main():
         record["stale_tpu_headline"] = stale
         record["stale_tpu_captured_utc"] = captured
     print(json.dumps(record))
+    tdir = os.environ.get("BENCH_TELEMETRY_DIR")
+    if tdir:
+        # full bundle (manifest + metrics.jsonl + Chrome trace) of
+        # everything the run counted/spanned — including warmup and the
+        # stage pass, which the record's measured deltas exclude
+        get_telemetry().write(tdir, manifest_extra={"run_kind": "bench"})
 
 
 if __name__ == "__main__":

@@ -125,9 +125,13 @@ def drop_conv_only_rolling(steps):
     * 'headc' entries belong to the r4 consolidated-fetch A/B, which
       the r5 resident loop supersedes — never carried;
     * 'headline' entries must be the r5 resident methodology (a
-      ``mode: resident`` record with the per-phase breakdown); r1-r4
-      stream-loop headlines would silently keep the O(1)-round-trip
-      loop from ever running on hardware — drop;
+      ``mode: resident`` record with the per-phase breakdown) AND a
+      5000-ticker record (``tickers: 5000``, stamped by bench.py since
+      r6): N_TICKERS is BENCH_TICKERS-overridable, and before the stamp
+      a 500-ticker run printed a much faster number under the
+      5000-ticker name which this carry would have banked forever
+      (round-5 ADVICE medium). Pre-stamp records have no ``tickers``
+      key and are dropped — they re-run once under the new schema;
     * 'stream' entries must be ``mode: stream`` records (the r1-r4
       series continuation under its own metric suffix).
     """
@@ -136,7 +140,8 @@ def drop_conv_only_rolling(steps):
         if name in ("rolling", "pallas", "headc"):
             return False  # steps removed in r4/r5
         if name == "headline":
-            return any(r.get("mode") == "resident" for r in recs)
+            return any(r.get("mode") == "resident"
+                       and r.get("tickers") == 5000 for r in recs)
         if name == "stream":
             return any(r.get("mode") == "stream" for r in recs)
         return True
@@ -369,10 +374,18 @@ def main():
              "lad1": _step_ladder_one("1"), "lad2": _step_ladder_one("2"),
              "lad4": _step_ladder_one("4"), "lad5": _step_ladder_one("5")}
     want = [s.strip() for s in args.steps.split(",") if s.strip()]
+    # session-level telemetry rides the same registry subsystem as
+    # bench.py/the pipeline (step durations as histograms, outcomes as
+    # labeled counters) and is embedded in the artifact, so the session
+    # series cannot drift from the in-run telemetry schema
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        Telemetry)
+    tel = Telemetry(annotate_spans=False)
     for name in want:
         if session["steps"].get(name, {}).get("ok"):
             print(f"--- step: {name} (already green, carried over)",
                   flush=True)
+            tel.counter("session.steps", outcome="carried")
             continue
         # Re-probe before every step: the tunnel drops mid-session
         # (observed 2026-08-01: up-window closed between headline and
@@ -381,11 +394,14 @@ def main():
         if not args.skip_probe and not _probe():
             session["steps"][name] = {
                 "ok": False, "error": "tunnel unreachable at step start"}
+            tel.counter("session.steps", outcome="unreachable")
+            session["telemetry"] = tel.registry.snapshot()
             with open(args.out, "w") as fh:
                 json.dump(session, fh, indent=1)
             print(json.dumps({name: False}), flush=True)
             continue
         print(f"--- step: {name}", flush=True)
+        t_step = time.monotonic()
         try:
             session["steps"][name] = steps[name]()
         except Exception as e:  # keep capturing the rest of the window
@@ -393,9 +409,15 @@ def main():
             session["steps"][name] = {
                 "ok": False, "error": f"{type(e).__name__}: {e}",
                 "trace": traceback.format_exc()[-1500:]}
+        tel.observe("session.step_seconds",
+                    round(time.monotonic() - t_step, 1), step=name)
+        tel.counter("session.steps",
+                    outcome="ok" if session["steps"][name].get("ok")
+                    else "failed")
         # per-step freshness stamp — what the carry-over bound ages
         session["steps"][name]["captured_utc"] = time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        session["telemetry"] = tel.registry.snapshot()
         with open(args.out, "w") as fh:  # persist after EVERY step
             json.dump(session, fh, indent=1)
         print(json.dumps({name: session["steps"][name].get("ok")}),

@@ -15,6 +15,11 @@ compute exposures, then evaluate them — without writing any code:
 
     # list the factor catalog
     python -m replication_of_minute_frequency_factor_tpu list-factors
+
+    # observability demo: run the device pipeline over synthetic day
+    # files and write the full telemetry bundle (manifest.json,
+    # metrics.jsonl, trace.json) — see docs/observability.md
+    python -m replication_of_minute_frequency_factor_tpu --telemetry-dir out/
 """
 
 from __future__ import annotations
@@ -58,6 +63,13 @@ def _add_compute(sub: "argparse._SubParsersAction") -> None:
                         "(a plain rerun only resumes past the cached max "
                         "date, so previously-failed days stay lost "
                         "without this)")
+    # SUPPRESS: only set when present, so it can't clobber the
+    # main-parser --telemetry-dir given before the subcommand
+    p.add_argument("--telemetry-dir", default=argparse.SUPPRESS,
+                   metavar="DIR",
+                   help="write run telemetry (manifest.json, "
+                        "metrics.jsonl, trace.json) into DIR and print "
+                        "an end-of-run summary (docs/observability.md)")
     p.add_argument("--quiet", action="store_true")
 
 
@@ -97,6 +109,7 @@ def cmd_compute(args: argparse.Namespace) -> int:
     from .config import Config
     from .models.registry import factor_names
     from .pipeline import compute_exposures
+    from .telemetry import Telemetry, set_telemetry
 
     all_names = factor_names()
     names = (all_names if args.factors == "all"
@@ -122,17 +135,29 @@ def cmd_compute(args: argparse.Namespace) -> int:
         cfg.rolling_impl = args.rolling_impl
     if args.profile_dir is not None:
         cfg.profile_dir = args.profile_dir
+    telemetry_dir = getattr(args, "telemetry_dir", None)
+    tel = None
+    if telemetry_dir:
+        # install as the process default so the data/wire/parallel
+        # layer counters land in the same stream the pipeline uses
+        tel = set_telemetry(Telemetry())
     table = compute_exposures(args.minute_dir, names,
                               cache_path=args.cache, cfg=cfg,
                               progress=not args.quiet,
-                              retry_failed=args.retry_failed)  # saves cache
+                              retry_failed=args.retry_failed,
+                              telemetry=tel)  # saves cache
     n_days = len(set(map(str, table.columns["date"])))
-    print(json.dumps({
+    out = {
         "rows": len(table), "days": n_days,
         "factors": len(table.factor_names),
         "failed_days": len(table.failures) if table.failures else 0,
         "cache": args.cache,
-    }))
+    }
+    if tel is not None:
+        out["telemetry"] = tel.write(telemetry_dir, cfg=cfg,
+                                     manifest_extra={"run_kind": "compute"})
+        print(tel.summary(), file=sys.stderr)
+    print(json.dumps(out))
     return 0
 
 
@@ -265,16 +290,74 @@ def cmd_doctor(args: argparse.Namespace) -> int:
     return 0 if report["device_probe"] == "ok" else 1
 
 
+def run_synthetic_pipeline(telemetry_dir: str, n_days: int = 3,
+                           n_codes: int = 16) -> int:
+    """Zero-setup observability demo: synthesize a few day files, run the
+    REAL device pipeline over them (grid + wire-encode + fused factor
+    graph + cache-shaped materialize), and write the full telemetry
+    bundle into ``telemetry_dir``. This is the tier-1 smoke target
+    ``run_tests.sh`` validates against the JSONL schema."""
+    import os
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from .config import Config
+    from .data.synthetic import synth_day
+    from .pipeline import compute_exposures
+    from .telemetry import Telemetry, set_telemetry
+
+    tel = set_telemetry(Telemetry())
+    rng = np.random.default_rng(0)
+    names = ("vol_return1min", "mmt_am", "liq_openvol")
+    with tempfile.TemporaryDirectory() as md:
+        for i in range(n_days):
+            ds = str(np.datetime64("2024-01-02") + i)
+            cols = synth_day(rng, n_codes=n_codes, date=ds,
+                             missing_prob=0.05)
+            arrays = {"code": pa.array([str(c) for c in cols["code"]]),
+                      "time": pa.array(cols["time"])}
+            for k in ("open", "high", "low", "close", "volume"):
+                arrays[k] = pa.array(cols[k])
+            pq.write_table(pa.table(arrays),
+                           os.path.join(md, ds.replace("-", "")
+                                        + ".parquet"))
+        cfg = Config.from_env()
+        cfg.minute_dir = md
+        cfg.days_per_batch = 2
+        table = compute_exposures(md, names, cfg=cfg, progress=False,
+                                  telemetry=tel)
+    paths = tel.write(telemetry_dir, cfg=cfg,
+                      manifest_extra={"run_kind": "synthetic_pipeline"})
+    print(tel.summary(), file=sys.stderr)
+    print(json.dumps({"rows": len(table),
+                      "days": n_days, "factors": len(names),
+                      "telemetry": paths}))
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m replication_of_minute_frequency_factor_tpu",
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
-    sub = ap.add_subparsers(dest="cmd", required=True)
+    ap.add_argument("--telemetry-dir", default=None, metavar="DIR",
+                    help="with no subcommand: run the synthetic demo "
+                         "pipeline and write its telemetry bundle into "
+                         "DIR (with `compute`, pass the flag after the "
+                         "subcommand)")
+    sub = ap.add_subparsers(dest="cmd", required=False)
     _add_compute(sub)
     _add_evaluate(sub)
     _add_list(sub)
     _add_doctor(sub)
     args = ap.parse_args(argv)
+    if args.cmd is None:
+        if args.telemetry_dir:
+            return run_synthetic_pipeline(args.telemetry_dir)
+        ap.error("a subcommand is required (or --telemetry-dir DIR for "
+                 "the synthetic telemetry demo)")
     return {"compute": cmd_compute, "evaluate": cmd_evaluate,
             "list-factors": cmd_list_factors,
             "doctor": cmd_doctor}[args.cmd](args)

@@ -24,6 +24,8 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
+from ..telemetry import get_telemetry
+
 _DATE_RE = re.compile(r"^(\d{8})")
 
 #: CSMAR -> canonical column renames (reference Factor.py:32-47)
@@ -129,6 +131,12 @@ def read_minute_day_raw(path: str) -> Dict[str, np.ndarray]:
     1.2M-row column per day costs ~0.2 s that the axis-level render
     avoids. Callers that JOIN on codes (evaluation, the oracle/polars
     backends) must use the normalizing reader."""
+    tel = get_telemetry()
+    tel.counter("io.day_files_read")
+    try:
+        tel.counter("io.bytes_read", os.path.getsize(path))
+    except OSError:
+        pass  # path may be unreadable; the read below raises properly
     return read_columns(path, MINUTE_COLUMNS)
 
 
@@ -141,7 +149,11 @@ def write_parquet_atomic(table: pa.Table, path: str) -> None:
     os.close(fd)
     try:
         pq.write_table(table, tmp)
+        nbytes = os.path.getsize(tmp)
         os.replace(tmp, path)
+        tel = get_telemetry()
+        tel.counter("io.parquet_writes")
+        tel.counter("io.bytes_written", nbytes)
     except BaseException:
         if os.path.exists(tmp):
             os.remove(tmp)

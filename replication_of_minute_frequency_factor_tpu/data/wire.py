@@ -50,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..native import narrow_wire
+from ..telemetry import get_telemetry
 
 TICK = 0.01
 _I16 = 32767
@@ -94,7 +95,23 @@ def encode(bars: np.ndarray, mask: np.ndarray, tick: float = TICK,
     Dispatches to the C++ single-pass encoder (:mod:`..native`) when built
     (~100x the numpy path below, which remains the portable fallback and
     parity oracle). ``floor`` is the widen-only dtype state a pipeline run
-    threads through successive batches (see ``native.narrow_wire``)."""
+    threads through successive batches (see ``native.narrow_wire``).
+
+    Telemetry: every call lands in ``wire.encode_batches{kind=wire|raw}``
+    (``raw`` = returned None, caller ships f32) and successful encodes in
+    ``wire.encode_bytes`` — the counters behind the pipeline's and
+    bench's encode-kind reporting (docs/observability.md)."""
+    out = _encode_impl(bars, mask, tick, use_native, floor)
+    tel = get_telemetry()
+    if out is None:
+        tel.counter("wire.encode_batches", kind="raw")
+    else:
+        tel.counter("wire.encode_batches", kind="wire")
+        tel.counter("wire.encode_bytes", out.nbytes)
+    return out
+
+
+def _encode_impl(bars, mask, tick, use_native, floor):
     bars = np.asarray(bars)
     mask = np.asarray(mask)
     if use_native is None or use_native:
@@ -264,7 +281,11 @@ def pack_arrays(arrays) -> tuple:
         if pad:
             chunks.append(np.zeros(pad, np.uint8))
         off += b.nbytes + pad
-    return np.concatenate(chunks), tuple(spec)
+    buf = np.concatenate(chunks)
+    tel = get_telemetry()
+    tel.counter("wire.packed_buffers")
+    tel.counter("wire.packed_bytes", buf.nbytes)
+    return buf, tuple(spec)
 
 
 def unpack(buf, spec):

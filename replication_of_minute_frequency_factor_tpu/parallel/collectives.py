@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.registry import compute_factors
 from ..ops import rank_average
+from ..telemetry import get_telemetry
 from .mesh import TICKERS_AXIS, day_batch_spec, mask_spec
 
 
@@ -168,11 +169,16 @@ def xs_qcut_local(x, mask, group_num: int, axis_name=TICKERS_AXIS):
 # shard_map wrappers for [dates, tickers] matrices
 # --------------------------------------------------------------------------
 
-def _xs_wrap(body):
-    """Wrap a local body into a jitted shard_map over P(None, 'tickers')."""
+def _xs_wrap(body, label: str):
+    """Wrap a local body into a jitted shard_map over P(None, 'tickers').
+
+    The outer (non-jit) wrapper spans the dispatch as
+    ``collective.<label>`` — host-side time to trace/launch the
+    collective graph (JAX dispatch is async, so this is NOT on-device
+    collective time; see docs/observability.md on reading these)."""
 
     @functools.partial(jax.jit, static_argnames=("mesh",))
-    def run(mesh: Mesh, *arrays):
+    def run_jit(mesh: Mesh, *arrays):
         spec = P(None, TICKERS_AXIS)
         fn = shard_map(
             body, mesh=mesh,
@@ -181,6 +187,11 @@ def _xs_wrap(body):
         )
         return fn(*arrays)
 
+    def run(mesh: Mesh, *arrays):
+        with get_telemetry().span(f"collective.{label}"):
+            return run_jit(mesh, *arrays)
+
+    run.jitted = run_jit
     return run
 
 
@@ -212,20 +223,25 @@ def _rank_body(x, m):
 _rank_body.out_spec = P(None, TICKERS_AXIS)
 
 
-xs_masked_mean = _xs_wrap(_mean_body)
-xs_masked_std = _xs_wrap(_std_body)
-xs_pearson = _xs_wrap(_pearson_body)
-xs_rank = _xs_wrap(_rank_body)
+xs_masked_mean = _xs_wrap(_mean_body, "xs_masked_mean")
+xs_masked_std = _xs_wrap(_std_body, "xs_masked_std")
+xs_pearson = _xs_wrap(_pearson_body, "xs_pearson")
+xs_rank = _xs_wrap(_rank_body, "xs_rank")
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "group_num"))
-def xs_qcut(mesh: Mesh, x, m, group_num: int = 5):
-    """Sharded per-date quantile-bucket labels (see xs_qcut_local)."""
+def _xs_qcut_jit(mesh: Mesh, x, m, group_num: int = 5):
     spec = P(None, TICKERS_AXIS)
     fn = shard_map(
         lambda a, b: xs_qcut_local(a, b, group_num),
         mesh=mesh, in_specs=(spec, spec), out_specs=spec)
     return fn(x, m)
+
+
+def xs_qcut(mesh: Mesh, x, m, group_num: int = 5):
+    """Sharded per-date quantile-bucket labels (see xs_qcut_local)."""
+    with get_telemetry().span("collective.xs_qcut"):
+        return _xs_qcut_jit(mesh, x, m, group_num)
 
 
 # --------------------------------------------------------------------------
@@ -266,4 +282,7 @@ def sharded_compute_factors(
         rolling_impl = get_config().rolling_impl
     fn = _sharded_fn(mesh, bars.ndim == 4, names, replicate_quirks,
                      rolling_impl)
-    return fn(bars, mask)
+    tel = get_telemetry()
+    tel.counter("collective.sharded_factor_batches")
+    with tel.span("collective.sharded_factors"):
+        return fn(bars, mask)

@@ -21,6 +21,7 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding
 
+from ..telemetry import get_telemetry
 from .mesh import day_batch_spec, mask_spec, make_mesh
 
 
@@ -38,15 +39,18 @@ def initialize(coordinator_address: Optional[str] = None,
     caller who names a coordinator gets the failure raised."""
     if jax.distributed.is_initialized():
         return
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes, process_id=process_id)
-    except (ValueError, RuntimeError):
-        if coordinator_address is not None:
-            raise
-        # single-process run without a coordinator: local devices only
-        pass
+    # spanned: on a pod slice this blocks until every process dials the
+    # coordinator, so its duration IS the cross-host startup skew
+    with get_telemetry().span("multihost.initialize"):
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+        except (ValueError, RuntimeError):
+            if coordinator_address is not None:
+                raise
+            # single-process run without a coordinator: local devices only
+            pass
 
 
 def global_mesh(shape: Optional[Tuple[int, int]] = None):
@@ -64,9 +68,17 @@ def shard_from_host_local(bars: np.ndarray, mask: np.ndarray, mesh):
     :func:`..parallel.mesh.shard_day_batch`.
     """
     batched = bars.ndim == 4
-    return (
-        jax.make_array_from_process_local_data(
-            NamedSharding(mesh, day_batch_spec(batched)), bars),
-        jax.make_array_from_process_local_data(
-            NamedSharding(mesh, mask_spec(batched)), mask),
-    )
+    tel = get_telemetry()
+    try:
+        host = str(jax.process_index())
+    except Exception:  # noqa: BLE001 — labeling must not fail the shard
+        host = "?"
+    with tel.span("multihost.shard_from_host_local"):
+        out = (
+            jax.make_array_from_process_local_data(
+                NamedSharding(mesh, day_batch_spec(batched)), bars),
+            jax.make_array_from_process_local_data(
+                NamedSharding(mesh, mask_spec(batched)), mask),
+        )
+    tel.counter("multihost.shards_built", host=host)
+    return out

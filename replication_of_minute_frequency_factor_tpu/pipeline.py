@@ -149,6 +149,7 @@ def compute_packed_resident(dbufs, spec, kind, names,
         rolling_impl = get_config().rolling_impl
     return _compute_packed_scan_jit(tuple(dbufs), spec, kind, names,
                                     replicate_quirks, rolling_impl)
+from .telemetry import Telemetry, get_telemetry
 from .utils.logging import get_logger, FailureReport
 from .utils.tracing import Timer, trace_annotation
 
@@ -342,7 +343,8 @@ _CIRCUIT_BREAKER = 3
 def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
                          parts: List["ExposureTable"],
                          failures: Optional["FailureReport"] = None,
-                         path_of: Optional[Dict[str, str]] = None) -> None:
+                         path_of: Optional[Dict[str, str]] = None,
+                         telemetry: Optional[Telemetry] = None) -> None:
     """Double-buffered device pipeline (replaces the reference's joblib
     fan-out, SURVEY.md §7 L2): a reader thread prepares batch i+1
     (grid + validate + wire-encode) while the device computes batch i;
@@ -370,6 +372,16 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
     caller saves a resume-safe partial cache)."""
     import queue
     import threading
+
+    tel = telemetry if telemetry is not None else get_telemetry()
+    inflight = [0]  # launched-not-yet-materialized batches (gauge)
+
+    def _note_queue_depth(depth: int) -> None:
+        # gauge = the last sampled depth; histogram = its distribution
+        # over the run (a p95 pinned at maxsize means the device is the
+        # bottleneck; pinned at 0 means the producer is)
+        tel.gauge("pipeline.queue_depth", depth)
+        tel.observe("pipeline.queue_depth", depth)
 
     mesh = shardings = bars_sharding = None
     n_shards = 1
@@ -401,6 +413,7 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
         while not stop.is_set():
             try:
                 q.put(item, timeout=0.5)
+                _note_queue_depth(q.qsize())
                 return True
             except queue.Full:
                 continue
@@ -409,6 +422,7 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
     def _record_batch_failure(dates, exc):
         if failures is None:
             raise exc
+        tel.counter("pipeline.failed_days", len(dates))
         for d in dates:
             failures.record(str(d),
                             (path_of or {}).get(str(d), ""), exc)
@@ -430,6 +444,11 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
         if cfg.wire_transfer:
             with timer("wire_encode"):
                 w = wire.encode(bars, mask, floor=wire_floor)
+        # the wire->raw fallback quadruples the bytes on the link; count
+        # it per batch so it can never again be invisible (round-5
+        # ADVICE: a silent raw fallback skewed a headline)
+        tel.counter("pipeline.encode_kind",
+                    kind="wire" if w is not None else "raw")
         if mesh is None:
             # single-device: pack HERE so the multi-MB host concatenate
             # overlaps device compute; ship one (buf, spec, kind) triple
@@ -471,6 +490,7 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
 
     def launch(item):
         dates, codes, present, w, bars, mask = item
+        tel.counter("pipeline.batches_launched")
         with trace_annotation("factor_batch"):
             if mesh is None:
                 # single-device: one packed buffer in (packed on the
@@ -504,16 +524,24 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
         for v in vals:
             if hasattr(v, "copy_to_host_async"):  # skip test doubles
                 v.copy_to_host_async()
+        inflight[0] += 1  # in flight only once the dispatch succeeded
+        tel.gauge("pipeline.inflight_batches", inflight[0])
         return dates, codes, present, out
 
     def materialize(pending):
         dates, codes, present, out = pending
-        with timer("device"):
-            if isinstance(out, dict):
-                out = {k: np.asarray(v) for k, v in out.items()}
-            else:  # stacked [F, D, T] from the packed path
-                stacked = np.asarray(out)
-                out = {n: stacked[j] for j, n in enumerate(names)}
+        try:
+            with timer("device"):
+                if isinstance(out, dict):
+                    out = {k: np.asarray(v) for k, v in out.items()}
+                else:  # stacked [F, D, T] from the packed path
+                    stacked = np.asarray(out)
+                    out = {n: stacked[j] for j, n in enumerate(names)}
+        finally:
+            # the batch leaves the in-flight window whether the fetch
+            # succeeded or is about to be retried through launch()
+            inflight[0] = max(0, inflight[0] - 1)
+            tel.gauge("pipeline.inflight_batches", inflight[0])
         # build ALL day tables before touching parts: a mid-loop failure
         # followed by the whole-batch retry must not leave day 1's rows
         # appended twice (duplicate (code, date) rows in the cache)
@@ -526,13 +554,17 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
                 cols[n] = out[n][i, sel].astype(np.float32)
             batch_parts.append(ExposureTable(cols))
         parts.extend(batch_parts)
+        tel.counter("pipeline.batches_completed")
+        tel.counter("pipeline.days_completed", len(dates))
 
     consecutive = 0
 
     def _bump_breaker(exc):
         nonlocal consecutive
         consecutive += 1
+        tel.gauge("pipeline.breaker_consecutive_failures", consecutive)
         if consecutive >= _CIRCUIT_BREAKER:
+            tel.counter("pipeline.circuit_breaker_trips")
             raise RuntimeError(
                 f"device pipeline: {consecutive} consecutive batches "
                 "failed — device/transport looks dead; aborting "
@@ -572,10 +604,12 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
             return
         logger.warning("batch %s failed beyond retry (%s); isolating "
                        "per day", dates, exc)
+        tel.counter("pipeline.batch_isolations")
         solo_fails = 0
         for d in dates:
             path = (path_of or {}).get(str(d), "")
             if solo_fails >= _ISOLATION_GIVEUP:
+                tel.counter("pipeline.isolation_giveup_days")
                 failures.record(str(d), path, exc)
                 continue
             try:
@@ -588,6 +622,7 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
                 materialize(launch(prep([(d, day)])))
             except Exception as e2:  # noqa: BLE001 — per-day isolation
                 logger.warning("day %s failed in isolation: %s", d, e2)
+                tel.counter("pipeline.isolated_day_failures")
                 failures.record(str(d), path, e2)
                 solo_fails += 1
         _bump_breaker(exc)
@@ -600,11 +635,13 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
         try:
             materialize(launched)
             consecutive = 0
+            tel.gauge("pipeline.breaker_consecutive_failures", 0)
             return
         except Exception as e:  # noqa: BLE001 — batch isolation
             if not retried:
                 logger.warning("batch %s failed on device (%s); "
                                "retrying once", payload[0], e)
+                tel.counter("pipeline.retries", stage="materialize")
                 try:
                     relaunched = launch(payload)
                 except Exception as e2:  # noqa: BLE001
@@ -630,6 +667,7 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
     try:
         while True:
             kind, payload = q.get()
+            _note_queue_depth(q.qsize())
             if kind == "error":
                 try:
                     flush_pending()
@@ -646,6 +684,7 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
                 # way (a systemic host problem must abort, not grind
                 # through the file list recording every day)
                 dates, e = payload
+                tel.counter("pipeline.host_prep_failures")
                 flush_pending()
                 _isolate_batch(dates, e)
                 continue
@@ -654,6 +693,7 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
             except Exception as e:  # noqa: BLE001 — batch isolation
                 logger.warning("batch %s failed at launch (%s); "
                                "retrying once", payload[0], e)
+                tel.counter("pipeline.retries", stage="launch")
                 try:
                     launched = launch(payload)
                 except Exception as e2:  # noqa: BLE001
@@ -809,6 +849,7 @@ def compute_exposures(
     progress: bool = True,
     fault_hook: Optional[Callable[[np.datetime64], None]] = None,
     retry_failed: bool = False,
+    telemetry: Optional[Telemetry] = None,
     _files_override: Optional[Sequence] = None,
 ) -> ExposureTable:
     """Compute factor exposures for every day file, incrementally.
@@ -830,7 +871,10 @@ def compute_exposures(
       ledger and recomputes them alongside any new days;
     * a failing day is logged into the returned table's
       ``.failures`` report and skipped (reference :17-25);
-    * ``fault_hook(date)`` is the fault-injection test hook (SURVEY.md §5).
+    * ``fault_hook(date)`` is the fault-injection test hook (SURVEY.md §5);
+    * ``telemetry`` injects a :class:`..telemetry.Telemetry` for this
+      run's metrics/spans (default: the process-wide instance) — see
+      docs/observability.md for the metric and span taxonomy.
     """
     cfg = cfg or get_config()
     if cfg.backend not in ("jax", "numpy", "polars"):
@@ -924,7 +968,10 @@ def compute_exposures(
                 # the cache if the retry fails or the run aborts first
 
     failures = FailureReport()
-    timer = Timer()
+    tel = telemetry if telemetry is not None else get_telemetry()
+    # a StageTimer keeps Timer's per-run totals (``.timings``) AND feeds
+    # every stage into the telemetry span tracer + histograms
+    timer = tel.stage_timer()
     parts: List[ExposureTable] = []
     profiling = False
     if cfg.profile_dir and files:
@@ -1018,7 +1065,8 @@ def compute_exposures(
             _run_device_pipeline(
                 read_batches(), names, cfg, timer, parts,
                 failures=failures,
-                path_of={str(d): p for d, p in files})
+                path_of={str(d): p for d, p in files},
+                telemetry=tel)
     except Exception as e:  # noqa: BLE001 — crash-consistent save below
         # preserve every completed batch before re-raising: parts hold
         # whole days only, so the cache written below is resume-safe and

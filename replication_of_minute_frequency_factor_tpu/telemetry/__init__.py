@@ -1,0 +1,190 @@
+"""Unified run telemetry (SURVEY.md §5, grown into a subsystem).
+
+One injectable :class:`Telemetry` object bundles the four pieces every
+layer emits into:
+
+* a :class:`.registry.MetricsRegistry` — counters, gauges, bounded
+  histograms (p50/p95/max) keyed by name+labels;
+* a :class:`.spans.SpanTracer` — nesting span context managers with
+  ``Timer`` semantics, ``jax.profiler`` annotation, Chrome/Perfetto
+  ``trace_events`` export;
+* a schema-versioned JSONL stream (:mod:`.sink`);
+* a once-per-run manifest (:mod:`.manifest`).
+
+A process-wide default instance exists from import (``get_telemetry``),
+so hot paths instrument unconditionally at dict-update cost; anything
+that wants an isolated stream (tests, the bench timed loop) builds its
+own ``Telemetry`` and passes it down or installs it via
+``set_telemetry``. ``python -m replication_of_minute_frequency_factor_tpu
+--telemetry-dir DIR`` writes the whole bundle to disk; validate a
+written directory with ``python -m
+replication_of_minute_frequency_factor_tpu.telemetry.validate DIR``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..utils.tracing import Timer
+from .registry import Histogram, MetricsRegistry, render_key
+from .sink import SCHEMA_VERSION, EventSink, validate_jsonl, validate_record
+from .spans import SpanTracer
+
+__all__ = [
+    "SCHEMA_VERSION", "EventSink", "Histogram", "MetricsRegistry",
+    "SpanTracer", "StageTimer", "Telemetry", "get_telemetry",
+    "render_key", "set_telemetry", "validate_jsonl", "validate_record",
+]
+
+#: retained free-form events bound (events past it count, not retain)
+MAX_FREE_EVENTS = 5000
+
+
+class StageTimer(Timer):
+    """Drop-in :class:`..utils.tracing.Timer` whose stages ALSO land in
+    a Telemetry object: each ``with timer("io")`` is a span (nesting,
+    profiler annotation, trace export) plus a
+    ``span_seconds{span=io}`` histogram observation, while
+    ``totals()``/``report()`` keep their per-run Timer meaning for
+    existing callers (``ExposureTable.timings``)."""
+
+    def __init__(self, telemetry: "Telemetry"):
+        super().__init__()
+        self._tel = telemetry
+
+    @contextlib.contextmanager
+    def __call__(self, name: str):
+        with self._tel.tracer(name):
+            t0 = time.perf_counter()
+            try:
+                yield
+            finally:
+                dt = time.perf_counter() - t0
+                with self._lock:
+                    self._totals[name] = self._totals.get(name, 0.0) + dt
+                    self._counts[name] = self._counts.get(name, 0) + 1
+
+
+class Telemetry:
+    """Registry + tracer + event buffer + write-to-disk, as one unit."""
+
+    def __init__(self, annotate_spans: bool = True):
+        self.registry = MetricsRegistry()
+        self.tracer = SpanTracer(registry=self.registry,
+                                 annotate=annotate_spans)
+        self._events: List[dict] = []
+        self._events_dropped = 0
+        self._lock = threading.Lock()
+
+    # --- emit -----------------------------------------------------------
+    def counter(self, name: str, value: float = 1.0, **labels) -> None:
+        self.registry.counter(name, value, **labels)
+
+    def gauge(self, name: str, value: float, **labels) -> None:
+        self.registry.gauge(name, value, **labels)
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        self.registry.observe(name, value, **labels)
+
+    def span(self, name: str):
+        return self.tracer(name)
+
+    def stage_timer(self) -> StageTimer:
+        return StageTimer(self)
+
+    def event(self, name: str, **data) -> None:
+        """Free-form structured event (bounded retention)."""
+        with self._lock:
+            if len(self._events) < MAX_FREE_EVENTS:
+                self._events.append({"name": name,
+                                     "ts": round(time.time(), 3),
+                                     "data": data})
+            else:
+                self._events_dropped += 1
+
+    # --- persist --------------------------------------------------------
+    def write(self, out_dir: str, cfg=None,
+              manifest_extra: Optional[dict] = None) -> Dict[str, str]:
+        """Write the run bundle into ``out_dir``:
+
+        * ``manifest.json`` — provenance (once per run);
+        * ``metrics.jsonl`` — schema-versioned stream: the manifest,
+          every counter/gauge/histogram, every retained span, every
+          free-form event;
+        * ``trace.json`` — Chrome/Perfetto ``trace_events``.
+
+        Returns ``{artifact: path}``.
+        """
+        from .manifest import build_manifest
+
+        os.makedirs(out_dir, exist_ok=True)
+        paths = {"manifest": os.path.join(out_dir, "manifest.json"),
+                 "metrics": os.path.join(out_dir, "metrics.jsonl"),
+                 "trace": os.path.join(out_dir, "trace.json")}
+        manifest = build_manifest(cfg, manifest_extra)
+        import json
+        with open(paths["manifest"], "w") as fh:
+            json.dump(manifest, fh, indent=1)
+        with EventSink(paths["metrics"]) as sink:
+            sink.emit("manifest", payload=manifest)
+            for rec in self.registry.records():
+                sink.emit(**{k: v for k, v in rec.items()})
+            for ev in self.tracer.events():
+                sink.emit("span", **ev)
+            with self._lock:
+                events = list(self._events)
+            for ev in events:
+                sink.emit("event", name=ev["name"], data=ev["data"])
+        self.tracer.write_chrome_trace(paths["trace"])
+        return paths
+
+    # --- report ---------------------------------------------------------
+    def summary(self) -> str:
+        """Human-readable end-of-run digest."""
+        snap = self.registry.snapshot()
+        lines = ["telemetry summary:"]
+        if snap["counters"]:
+            lines.append("  counters:")
+            lines += [f"    {k} = {v:g}"
+                      for k, v in snap["counters"].items()]
+        if snap["gauges"]:
+            lines.append("  gauges (last value):")
+            lines += [f"    {k} = {v:g}" for k, v in snap["gauges"].items()]
+        if snap["histograms"]:
+            lines.append("  histograms (p50/p95/max, n):")
+            for k, st in snap["histograms"].items():
+                if st["count"]:
+                    lines.append(
+                        f"    {k}: p50={st['p50']:.4g} p95={st['p95']:.4g}"
+                        f" max={st['max']:.4g} n={st['count']}")
+        dropped = self.tracer.dropped_spans + self._events_dropped
+        if dropped:
+            lines.append(f"  ({dropped} spans/events dropped past "
+                         "retention bounds)")
+        return "\n".join(lines)
+
+
+_current: Optional[Telemetry] = None
+_current_lock = threading.Lock()
+
+
+def get_telemetry() -> Telemetry:
+    """The process-wide default Telemetry (created on first use)."""
+    global _current
+    if _current is None:
+        with _current_lock:
+            if _current is None:
+                _current = Telemetry()
+    return _current
+
+
+def set_telemetry(tel: Telemetry) -> Telemetry:
+    """Install ``tel`` as the process-wide default; returns it."""
+    global _current
+    with _current_lock:
+        _current = tel
+    return tel

@@ -1,0 +1,106 @@
+"""Run manifest: the once-per-run provenance record.
+
+Answers "what exactly produced these numbers" after the fact: config
+(and its hash), jax/jaxlib/numpy versions, device topology, the wire
+format spec, and the git SHA. Written as ``manifest.json`` by
+``Telemetry.write`` and embedded as the first JSONL record of the
+metrics stream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import platform
+import subprocess
+import sys
+import time
+from typing import Optional
+
+from .sink import SCHEMA_VERSION
+
+
+def _git_sha() -> Optional[str]:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        out = subprocess.run(
+            ["git", "-C", root, "rev-parse", "HEAD"], timeout=5,
+            capture_output=True, text=True)
+        return out.stdout.strip() if out.returncode == 0 else None
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+
+
+def _device_topology() -> dict:
+    """Best-effort device inventory. Only probes when the caller already
+    initialized a backend (the pipeline has, by manifest time) — a
+    wedged attached-TPU tunnel hangs backend INIT, not an initialized
+    backend, so this never adds a new hang point."""
+    if "jax" not in sys.modules:
+        return {"probed": False}
+    try:
+        import jax
+        devs = jax.devices()
+        return {"probed": True,
+                "platform": devs[0].platform if devs else None,
+                "device_kind": getattr(devs[0], "device_kind", None)
+                if devs else None,
+                "num_devices": len(devs),
+                "process_count": jax.process_count()}
+    except Exception as e:  # noqa: BLE001 — diagnostics must not raise
+        return {"probed": False, "error": f"{type(e).__name__}: {e}"}
+
+
+def _wire_spec() -> dict:
+    from ..data import wire  # lazy: wire imports telemetry
+
+    return {"tick": wire.TICK, "n_slots": wire.N_SLOTS,
+            "mask_bytes": wire.MASK_BYTES,
+            "vol10_bytes": wire.VOL10_BYTES, "i16_max": wire._I16}
+
+
+def config_hash(cfg) -> str:
+    """sha256 of the sorted-JSON config; the manifest's join key back to
+    a reproducible configuration."""
+    d = dataclasses.asdict(cfg) if dataclasses.is_dataclass(cfg) else dict(cfg)
+    return hashlib.sha256(
+        json.dumps(d, sort_keys=True, default=str).encode()).hexdigest()
+
+
+def build_manifest(cfg=None, extra: Optional[dict] = None) -> dict:
+    if cfg is None:
+        from ..config import get_config
+        cfg = get_config()
+    versions = {"python": platform.python_version()}
+    for mod in ("jax", "jaxlib", "numpy", "pyarrow"):
+        try:
+            versions[mod] = __import__(mod).__version__
+        except Exception:  # noqa: BLE001 — absent/broken dep recorded as null
+            versions[mod] = None
+    manifest = {
+        "schema": SCHEMA_VERSION,
+        "created_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "config": dataclasses.asdict(cfg),
+        "config_hash": config_hash(cfg),
+        "versions": versions,
+        "devices": _device_topology(),
+        "wire_spec": _wire_spec(),
+        "git_sha": _git_sha(),
+        "host": platform.node(),
+        "pid": os.getpid(),
+        "argv": list(sys.argv),
+    }
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def write_manifest(path: str, cfg=None,
+                   extra: Optional[dict] = None) -> dict:
+    m = build_manifest(cfg, extra)
+    with open(path, "w") as fh:
+        json.dump(m, fh, indent=1)
+    return m

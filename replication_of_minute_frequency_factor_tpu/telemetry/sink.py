@@ -1,0 +1,121 @@
+"""Schema-versioned JSONL event sink + record validation.
+
+Every line a run emits is one JSON object carrying ``schema`` (the
+integer schema version), ``ts`` (unix seconds) and ``kind``; the
+remaining fields are kind-specific. The validator below IS the schema —
+`run_tests.sh`'s telemetry smoke check and the unit suite both validate
+emitted streams through it, so producers and the schema cannot drift
+apart silently. Bump ``SCHEMA_VERSION`` on any breaking field change.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import IO, Iterator, List, Optional, Tuple
+
+SCHEMA_VERSION = 1
+
+#: kind -> required fields beyond the envelope (field, allowed types).
+#: histogram stat fields admit None (an empty histogram has no min/max).
+_NUM = (int, float)
+KIND_FIELDS = {
+    "manifest": (("payload", (dict,)),),
+    "counter": (("name", (str,)), ("labels", (dict,)), ("value", _NUM)),
+    "gauge": (("name", (str,)), ("labels", (dict,)), ("value", _NUM)),
+    "histogram": (("name", (str,)), ("labels", (dict,)),
+                  ("count", (int,)), ("sum", _NUM),
+                  ("min", _NUM + (type(None),)),
+                  ("max", _NUM + (type(None),)),
+                  ("p50", _NUM + (type(None),)),
+                  ("p95", _NUM + (type(None),))),
+    "span": (("name", (str,)), ("ts_us", _NUM), ("dur_us", _NUM),
+             ("tid", (int,)), ("depth", (int,))),
+    "event": (("name", (str,)), ("data", (dict,))),
+}
+
+
+def validate_record(rec) -> List[str]:
+    """Problems with one decoded JSONL record; [] means schema-valid."""
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+    problems = []
+    if rec.get("schema") != SCHEMA_VERSION:
+        problems.append(f"schema={rec.get('schema')!r} "
+                        f"(expected {SCHEMA_VERSION})")
+    if not isinstance(rec.get("ts"), _NUM):
+        problems.append(f"ts={rec.get('ts')!r} is not a number")
+    kind = rec.get("kind")
+    if kind not in KIND_FIELDS:
+        problems.append(f"kind={kind!r} not one of "
+                        f"{sorted(KIND_FIELDS)}")
+        return problems
+    for field, types in KIND_FIELDS[kind]:
+        v = rec.get(field, _MISSING)
+        if v is _MISSING:
+            problems.append(f"{kind} record missing {field!r}")
+        elif not isinstance(v, types) or isinstance(v, bool):
+            problems.append(
+                f"{kind}.{field}={v!r} has type {type(v).__name__}")
+    return problems
+
+
+class _Missing:
+    pass
+
+
+_MISSING = _Missing()
+
+
+def validate_jsonl(path: str) -> Iterator[Tuple[int, List[str]]]:
+    """Yield ``(lineno, problems)`` per line; empty problems = valid."""
+    with open(path) as fh:
+        for i, line in enumerate(fh, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                yield i, [f"not JSON: {e}"]
+                continue
+            yield i, validate_record(rec)
+
+
+class EventSink:
+    """Append-only JSONL writer stamping the schema envelope on every
+    record; thread-safe, line-buffered (one flush per record so a
+    crashed run keeps everything emitted before the crash)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh: Optional[IO[str]] = open(path, "a")
+        self._lock = threading.Lock()
+
+    def emit(self, kind: str, **fields) -> dict:
+        rec = {"schema": SCHEMA_VERSION, "ts": round(time.time(), 3),
+               "kind": kind, **fields}
+        problems = validate_record(rec)
+        if problems:
+            raise ValueError(f"refusing to emit schema-invalid record: "
+                             f"{problems}")
+        line = json.dumps(rec)
+        with self._lock:
+            if self._fh is None:
+                raise ValueError(f"sink {self.path} is closed")
+            self._fh.write(line + "\n")
+            self._fh.flush()
+        return rec
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def __enter__(self) -> "EventSink":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

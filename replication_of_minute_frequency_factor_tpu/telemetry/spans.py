@@ -1,0 +1,129 @@
+"""Span tracer: nesting context managers with Timer semantics, optional
+``jax.profiler`` annotation, and Chrome/Perfetto ``trace_events`` export.
+
+A span is one timed region. Spans nest (a thread-local stack tracks
+depth), accumulate per-name totals exactly like
+:class:`..utils.tracing.Timer` (``totals()``/``report()``), feed a
+``span_seconds{span=<name>}`` histogram into an attached
+:class:`.registry.MetricsRegistry`, and are retained (bounded) as events
+exportable as a Chrome trace JSON — load it at https://ui.perfetto.dev
+or chrome://tracing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .registry import MetricsRegistry
+
+#: retained-span bound; past it spans still time/aggregate but drop from
+#: the trace export (`dropped_spans` counts them)
+MAX_EVENTS = 20000
+
+
+class SpanTracer:
+    """``with tracer("name"): ...`` — nested, thread-safe span timing.
+
+    Drop-in for ``utils.tracing.Timer`` wherever one is accepted: the
+    same ``__call__`` context-manager protocol, ``totals()`` and
+    ``report()``. On top of that every span lands in ``registry`` as a
+    ``span_seconds{span=name}`` observation and in the bounded event
+    list behind :meth:`to_chrome_trace`.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 annotate: bool = True, max_events: int = MAX_EVENTS):
+        self.registry = registry
+        self.annotate = annotate
+        self.max_events = max_events
+        self._epoch = time.perf_counter()
+        self._lock = threading.Lock()
+        self._totals: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+        self._events: List[dict] = []
+        self.dropped_spans = 0
+        self._tls = threading.local()
+
+    def _depth(self) -> int:
+        return getattr(self._tls, "depth", 0)
+
+    @contextlib.contextmanager
+    def _annotation(self, name: str):
+        if not self.annotate:
+            yield
+            return
+        try:
+            import jax
+            cm = jax.profiler.TraceAnnotation(name)
+        except Exception:  # noqa: BLE001 — tracing must never break work
+            yield
+            return
+        with cm:
+            yield
+
+    @contextlib.contextmanager
+    def __call__(self, name: str):
+        self._tls.depth = depth = self._depth() + 1
+        t0 = time.perf_counter()
+        try:
+            with self._annotation(name):
+                yield
+        finally:
+            t1 = time.perf_counter()
+            self._tls.depth = depth - 1
+            dt = t1 - t0
+            with self._lock:
+                self._totals[name] = self._totals.get(name, 0.0) + dt
+                self._counts[name] = self._counts.get(name, 0) + 1
+                if len(self._events) < self.max_events:
+                    self._events.append({
+                        "name": name,
+                        "ts_us": round((t0 - self._epoch) * 1e6, 1),
+                        "dur_us": round(dt * 1e6, 1),
+                        "tid": threading.get_ident() & 0x7FFFFFFF,
+                        "depth": depth - 1,
+                    })
+                else:
+                    self.dropped_spans += 1
+            if self.registry is not None:
+                self.registry.observe("span_seconds", dt, span=name)
+
+    # --- Timer parity ---------------------------------------------------
+    def totals(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._totals)
+
+    def report(self) -> str:
+        with self._lock:
+            rows = [f"{k}: {self._totals[k]:.3f}s x{self._counts[k]}"
+                    for k in sorted(self._totals, key=self._totals.get,
+                                    reverse=True)]
+        return "; ".join(rows) or "no timings"
+
+    # --- export ---------------------------------------------------------
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def to_chrome_trace(self) -> dict:
+        """Chrome/Perfetto ``trace_events`` JSON (complete 'X' events)."""
+        pid = os.getpid()
+        return {
+            "displayTimeUnit": "ms",
+            "traceEvents": [
+                {"name": e["name"], "ph": "X", "pid": pid,
+                 "tid": e["tid"], "ts": e["ts_us"], "dur": e["dur_us"],
+                 "args": {"depth": e["depth"]}}
+                for e in self.events()
+            ],
+        }
+
+    def write_chrome_trace(self, path: str) -> str:
+        with open(path, "w") as fh:
+            json.dump(self.to_chrome_trace(), fh)
+        return path

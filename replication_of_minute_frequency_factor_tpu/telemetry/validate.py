@@ -1,0 +1,90 @@
+"""Validate a written telemetry directory against the schema.
+
+    python -m replication_of_minute_frequency_factor_tpu.telemetry.validate DIR
+
+Checks the three artifacts ``Telemetry.write`` produces:
+
+* ``manifest.json`` — parseable, right schema version, config hash;
+* ``metrics.jsonl`` — EVERY line validates via :func:`..sink.validate_record`;
+* ``trace.json`` — parseable Chrome trace with a ``traceEvents`` list.
+
+Prints a one-line JSON report and exits non-zero on any problem — this
+is the check ``run_tests.sh`` runs after the synthetic-pipeline smoke.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .sink import SCHEMA_VERSION, validate_jsonl
+
+
+def validate_dir(out_dir: str) -> dict:
+    """Report dict: ``{"ok": bool, "problems": [...], ...counts}``."""
+    problems: List[str] = []
+    kinds: dict = {}
+
+    mpath = os.path.join(out_dir, "manifest.json")
+    try:
+        with open(mpath) as fh:
+            manifest = json.load(fh)
+        if manifest.get("schema") != SCHEMA_VERSION:
+            problems.append(f"manifest schema={manifest.get('schema')!r}")
+        if not isinstance(manifest.get("config_hash"), str) \
+                or len(manifest["config_hash"]) != 64:
+            problems.append("manifest config_hash missing/malformed")
+    except (OSError, json.JSONDecodeError) as e:
+        problems.append(f"manifest.json: {e}")
+
+    jpath = os.path.join(out_dir, "metrics.jsonl")
+    n_lines = 0
+    try:
+        for lineno, line_problems in validate_jsonl(jpath):
+            n_lines += 1
+            for p in line_problems:
+                problems.append(f"metrics.jsonl:{lineno}: {p}")
+        if n_lines == 0:
+            problems.append("metrics.jsonl is empty")
+        else:
+            with open(jpath) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        k = json.loads(line).get("kind")
+                    except json.JSONDecodeError:
+                        continue
+                    kinds[k] = kinds.get(k, 0) + 1
+    except OSError as e:
+        problems.append(f"metrics.jsonl: {e}")
+
+    tpath = os.path.join(out_dir, "trace.json")
+    try:
+        with open(tpath) as fh:
+            trace = json.load(fh)
+        if not isinstance(trace.get("traceEvents"), list):
+            problems.append("trace.json has no traceEvents list")
+    except (OSError, json.JSONDecodeError) as e:
+        problems.append(f"trace.json: {e}")
+
+    return {"ok": not problems, "dir": out_dir, "jsonl_lines": n_lines,
+            "kinds": kinds, "problems": problems}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if len(argv) != 1:
+        print("usage: python -m replication_of_minute_frequency_factor_tpu"
+              ".telemetry.validate DIR", file=sys.stderr)
+        return 2
+    report = validate_dir(argv[0])
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,3 +30,13 @@ run env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 # devices and skip the path under test)
 run env -u XLA_FLAGS python -c \
     "import __graft_entry__ as g; g.dryrun_multichip(8)"
+# telemetry smoke (tier-1 observability contract, docs/observability.md):
+# the synthetic pipeline with --telemetry-dir must produce a manifest, a
+# Chrome trace, and a metrics.jsonl whose EVERY line validates against
+# the schema — the validator exits non-zero otherwise
+TELDIR="$(mktemp -d)"
+trap 'rm -f "$BUSY_DIR/$$"; rm -rf "$TELDIR"' EXIT INT TERM
+run python -m replication_of_minute_frequency_factor_tpu \
+    --telemetry-dir "$TELDIR"
+run python -m replication_of_minute_frequency_factor_tpu.telemetry.validate \
+    "$TELDIR"

@@ -1,0 +1,351 @@
+"""Telemetry subsystem: registry merge/label semantics, histogram
+percentiles, span nesting + Chrome-trace export, JSONL schema
+validation, manifest provenance, and the device-pipeline smoke test
+(queue-depth/retry gauges under an injected failure)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from replication_of_minute_frequency_factor_tpu.config import Config
+from replication_of_minute_frequency_factor_tpu.telemetry import (
+    SCHEMA_VERSION, Histogram, MetricsRegistry, SpanTracer, Telemetry,
+    get_telemetry, set_telemetry, validate_record)
+from replication_of_minute_frequency_factor_tpu.telemetry.validate import (
+    validate_dir)
+
+from test_pipeline import _write_day
+
+
+# --------------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------------
+
+def test_counter_label_semantics():
+    r = MetricsRegistry()
+    r.counter("reqs", 1, kind="wire")
+    r.counter("reqs", 2, kind="wire")
+    r.counter("reqs", 5, kind="raw")
+    r.counter("reqs")  # unlabeled is its own series
+    assert r.counter_value("reqs", kind="wire") == 3
+    assert r.counter_value("reqs", kind="raw") == 5
+    assert r.counter_value("reqs") == 1
+    assert r.counter_total("reqs") == 9
+    assert r.counter_value("nope") == 0.0
+    snap = r.snapshot()
+    assert snap["counters"]["reqs{kind=wire}"] == 3
+    assert snap["counters"]["reqs"] == 1
+
+
+def test_labels_are_order_insensitive():
+    r = MetricsRegistry()
+    r.counter("m", 1, a="1", b="2")
+    r.counter("m", 1, b="2", a="1")
+    assert r.counter_value("m", b="2", a="1") == 2
+    assert list(r.snapshot()["counters"]) == ["m{a=1,b=2}"]
+
+
+def test_gauge_last_write_wins():
+    r = MetricsRegistry()
+    r.gauge("depth", 1)
+    r.gauge("depth", 4)
+    r.gauge("depth", 2)
+    assert r.gauge_value("depth") == 2
+    assert r.gauge_value("absent") is None
+
+
+def test_registry_merge_semantics():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("c", 2, k="x")
+    b.counter("c", 3, k="x")
+    b.counter("c", 7, k="y")
+    a.gauge("g", 1)
+    b.gauge("g", 9)  # b is the later writer: last-write-wins
+    for v in (1, 2, 3):
+        a.observe("h", v)
+    for v in (10, 20):
+        b.observe("h", v)
+    a.merge(b)
+    assert a.counter_value("c", k="x") == 5
+    assert a.counter_value("c", k="y") == 7
+    assert a.gauge_value("g") == 9
+    st = a.histogram_stats("h")
+    assert st["count"] == 5 and st["sum"] == 36
+    assert st["min"] == 1 and st["max"] == 20
+
+
+def test_histogram_percentiles():
+    h = Histogram()
+    for v in range(1, 101):
+        h.observe(v)
+    st = h.stats()
+    assert st["count"] == 100 and st["sum"] == 5050
+    assert st["min"] == 1 and st["max"] == 100
+    assert 49 <= st["p50"] <= 52
+    assert 94 <= st["p95"] <= 97
+    assert Histogram().stats()["p50"] is None  # empty: no percentiles
+
+
+def test_histogram_is_bounded_but_exact_on_aggregates():
+    h = Histogram(bound=128)
+    n = 50_000
+    for v in range(n):
+        h.observe(v)
+    assert h.count == n                       # aggregates stay exact
+    assert h.total == n * (n - 1) / 2
+    assert h.max == n - 1 and h.min == 0
+    assert len(h._samples) <= 128             # reservoir stays bounded
+    # decimated reservoir still spans the stream: p50 within a few %
+    assert abs(h.stats()["p50"] - n / 2) < n * 0.1
+
+
+# --------------------------------------------------------------------------
+# spans
+# --------------------------------------------------------------------------
+
+def test_span_nesting_and_timer_parity():
+    reg = MetricsRegistry()
+    tr = SpanTracer(registry=reg, annotate=False)
+    with tr("outer"):
+        with tr("inner"):
+            pass
+        with tr("inner"):
+            pass
+    events = tr.events()
+    assert [e["name"] for e in events] == ["inner", "inner", "outer"]
+    by_name = {e["name"]: e for e in events}
+    assert by_name["inner"]["depth"] == 1 and by_name["outer"]["depth"] == 0
+    # spans nest in time: inner lies within outer
+    outer, inner = by_name["outer"], events[0]
+    assert inner["ts_us"] >= outer["ts_us"]
+    assert (inner["ts_us"] + inner["dur_us"]
+            <= outer["ts_us"] + outer["dur_us"] + 1)
+    # Timer-compatible accounting
+    totals = tr.totals()
+    assert set(totals) == {"outer", "inner"}
+    assert totals["outer"] >= totals["inner"] > 0
+    assert "inner:" in tr.report() and "x2" in tr.report()
+    # registry histograms fed per span name
+    assert reg.histogram_stats("span_seconds", span="inner")["count"] == 2
+
+
+def test_chrome_trace_export_round_trip(tmp_path):
+    tr = SpanTracer(annotate=False)
+    with tr("a"):
+        with tr("b"):
+            pass
+    path = str(tmp_path / "trace.json")
+    tr.write_chrome_trace(path)
+    with open(path) as fh:
+        trace = json.load(fh)
+    evs = trace["traceEvents"]
+    assert len(evs) == 2
+    for e in evs:
+        assert e["ph"] == "X"
+        assert set(e) >= {"name", "pid", "tid", "ts", "dur"}
+        assert isinstance(e["ts"], (int, float))
+    assert {e["name"] for e in evs} == {"a", "b"}
+
+
+def test_span_retention_is_bounded():
+    tr = SpanTracer(annotate=False, max_events=10)
+    for _ in range(25):
+        with tr("s"):
+            pass
+    assert len(tr.events()) == 10
+    assert tr.dropped_spans == 15
+    assert tr.totals()["s"] > 0  # aggregation continues past the bound
+
+
+# --------------------------------------------------------------------------
+# JSONL schema
+# --------------------------------------------------------------------------
+
+def test_validate_record_rejects_malformed():
+    ok = {"schema": SCHEMA_VERSION, "ts": 1.0, "kind": "counter",
+          "name": "x", "labels": {}, "value": 1}
+    assert validate_record(ok) == []
+    assert validate_record("nope")
+    assert validate_record({})  # missing everything
+    bad_ver = dict(ok, schema=SCHEMA_VERSION + 1)
+    assert any("schema" in p for p in validate_record(bad_ver))
+    bad_kind = dict(ok, kind="mystery")
+    assert any("kind" in p for p in validate_record(bad_kind))
+    missing = {k: v for k, v in ok.items() if k != "value"}
+    assert any("value" in p for p in validate_record(missing))
+    bad_type = dict(ok, value="fast")
+    assert any("value" in p for p in validate_record(bad_type))
+
+
+def test_write_bundle_is_schema_valid(tmp_path):
+    tel = Telemetry(annotate_spans=False)
+    tel.counter("c", 2, kind="wire")
+    tel.gauge("g", 1)
+    for v in (1, 2, 3):
+        tel.observe("h", v)
+    with tel.span("s"):
+        pass
+    tel.event("note", detail="hello")
+    d = str(tmp_path / "tel")
+    paths = tel.write(d, cfg=Config())
+    assert set(paths) == {"manifest", "metrics", "trace"}
+    report = validate_dir(d)
+    assert report["ok"], report["problems"]
+    # every artifact kind present in the stream
+    assert {"manifest", "counter", "gauge", "histogram", "span",
+            "event"} <= set(report["kinds"])
+
+
+def test_validate_dir_flags_corruption(tmp_path):
+    tel = Telemetry(annotate_spans=False)
+    tel.counter("c")
+    d = str(tmp_path / "tel")
+    tel.write(d, cfg=Config())
+    with open(os.path.join(d, "metrics.jsonl"), "a") as fh:
+        fh.write('{"schema": 999, "kind": "counter"}\n')
+        fh.write('not json at all\n')
+    report = validate_dir(d)
+    assert not report["ok"]
+    assert len(report["problems"]) >= 2
+
+
+def test_manifest_provenance(tmp_path):
+    from replication_of_minute_frequency_factor_tpu.telemetry.manifest \
+        import build_manifest, config_hash
+    cfg = Config(days_per_batch=4)
+    m = build_manifest(cfg)
+    assert m["schema"] == SCHEMA_VERSION
+    assert m["config"]["days_per_batch"] == 4
+    assert m["config_hash"] == config_hash(cfg)
+    assert len(m["config_hash"]) == 64
+    assert m["versions"]["jax"] and m["versions"]["numpy"]
+    assert m["wire_spec"]["n_slots"] == 240
+    # config hash is stable and config-sensitive
+    assert config_hash(cfg) == config_hash(Config(days_per_batch=4))
+    assert config_hash(cfg) != config_hash(Config(days_per_batch=5))
+
+
+def test_get_set_telemetry_roundtrip():
+    prev = get_telemetry()
+    try:
+        mine = Telemetry(annotate_spans=False)
+        assert set_telemetry(mine) is mine
+        assert get_telemetry() is mine
+    finally:
+        set_telemetry(prev)
+
+
+# --------------------------------------------------------------------------
+# pipeline integration
+# --------------------------------------------------------------------------
+
+@pytest.fixture
+def minute_dir(tmp_path, rng):
+    d = tmp_path / "kline"
+    d.mkdir()
+    for ds in ("2024-01-02", "2024-01-03", "2024-01-04"):
+        _write_day(str(d), rng, ds, missing_prob=0.05)
+    return str(d)
+
+
+def test_pipeline_smoke_populates_gauges_under_failure(
+        minute_dir, tmp_path, monkeypatch):
+    """_run_device_pipeline under one injected transient device failure:
+    the injected Telemetry must come back with queue-depth gauges,
+    per-stage histograms, the retry counter, and the encode-kind
+    counter — the observability contract ISSUE 1 names."""
+    from replication_of_minute_frequency_factor_tpu import pipeline as pl
+
+    real = pl.compute_packed_prepared
+    calls = {"n": 0}
+
+    def flaky(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("injected transport failure")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(pl, "compute_packed_prepared", flaky)
+    tel = Telemetry(annotate_spans=False)
+    t = pl.compute_exposures(minute_dir, ["vol_return1min"],
+                             cache_path=str(tmp_path / "c.parquet"),
+                             cfg=Config(days_per_batch=2),
+                             progress=False, telemetry=tel)
+    assert not t.failures and len(np.unique(t.columns["date"])) == 3
+
+    reg = tel.registry
+    # retry path was exercised and counted
+    assert reg.counter_total("pipeline.retries") >= 1
+    # queue-depth: gauge sampled and distribution retained
+    assert reg.gauge_value("pipeline.queue_depth") is not None
+    assert reg.histogram_stats("pipeline.queue_depth")["count"] > 0
+    # in-flight gauge settled back to zero
+    assert reg.gauge_value("pipeline.inflight_batches") == 0
+    # per-stage histograms for the hot stages
+    for stage in ("io", "grid", "pack", "device"):
+        st = reg.histogram_stats("span_seconds", span=stage)
+        assert st is not None and st["count"] > 0, stage
+    # every batch's encode kind is classified
+    assert reg.counter_total("pipeline.encode_kind") \
+        == reg.counter_value("pipeline.batches_launched") \
+        - reg.counter_total("pipeline.retries")
+    # completion accounting
+    assert reg.counter_value("pipeline.batches_completed") == 2
+    assert reg.counter_value("pipeline.days_completed") == 3
+    # Timer semantics still flow to the result object
+    assert {"io", "grid", "device"} <= set(t.timings)
+
+
+def test_pipeline_counts_failed_days_and_breaker(minute_dir, tmp_path,
+                                                 monkeypatch):
+    from replication_of_minute_frequency_factor_tpu import pipeline as pl
+
+    def dead(*a, **kw):
+        raise RuntimeError("dead device")
+
+    monkeypatch.setattr(pl, "compute_packed_prepared", dead)
+    tel = Telemetry(annotate_spans=False)
+    with pytest.raises(RuntimeError, match="consecutive"):
+        pl.compute_exposures(minute_dir, ["vol_return1min"],
+                             cache_path=str(tmp_path / "c.parquet"),
+                             cfg=Config(days_per_batch=1),
+                             progress=False, telemetry=tel)
+    reg = tel.registry
+    assert reg.counter_value("pipeline.circuit_breaker_trips") == 1
+    assert reg.gauge_value("pipeline.breaker_consecutive_failures") == 3
+    assert reg.counter_total("pipeline.failed_days") >= 3
+
+
+def test_cli_telemetry_dir_end_to_end(tmp_path, capsys):
+    """`python -m <pkg> --telemetry-dir DIR` (no subcommand) runs the
+    synthetic pipeline and writes a schema-valid bundle — the
+    acceptance-criterion invocation, also smoke-checked by
+    run_tests.sh."""
+    from replication_of_minute_frequency_factor_tpu.__main__ import main
+
+    d = str(tmp_path / "tel")
+    prev = get_telemetry()
+    try:
+        rc = main(["--telemetry-dir", d])
+    finally:
+        set_telemetry(prev)  # the CLI installs its own instance
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["rows"] > 0
+    report = validate_dir(d)
+    assert report["ok"], report["problems"]
+    # the stream carries the pipeline's queue-depth + stage histograms
+    with open(os.path.join(d, "metrics.jsonl")) as fh:
+        recs = [json.loads(ln) for ln in fh if ln.strip()]
+    hists = {r["name"] for r in recs if r["kind"] == "histogram"}
+    assert "pipeline.queue_depth" in hists
+    assert "span_seconds" in hists
+    gauges = {r["name"] for r in recs if r["kind"] == "gauge"}
+    assert "pipeline.queue_depth" in gauges
+    # manifest is both a file and the stream's first record
+    assert recs[0]["kind"] == "manifest"
+    assert recs[0]["payload"]["run_kind"] == "synthetic_pipeline"
+    with open(os.path.join(d, "trace.json")) as fh:
+        assert json.load(fh)["traceEvents"]

@@ -112,7 +112,8 @@ def test_legacy_rolling_entries_never_carry(tpu_session):
             {"metric": "cicc58_5000tickers_1yr_wall_consolidated",
              "value": 141.7}]},
         "headline": {"ok": True, "results": [
-            {"metric": "x", "days_per_batch": 32, "mode": "resident"}]},
+            {"metric": "x", "days_per_batch": 32, "mode": "resident",
+             "tickers": 5000}]},
     }
     got = tpu_session.drop_conv_only_rolling(steps)
     assert set(got) == {"headline"}
@@ -132,8 +133,21 @@ def test_pre_reshape_headline_dropped(tpu_session):
     assert tpu_session.drop_conv_only_rolling(r4) == {}
     new = {"headline": {"ok": True, "results": [
         {"metric": "cicc58_5000tickers_1yr_wall", "value": 58.0,
-         "days_per_batch": 32, "mode": "resident"}]}}
+         "days_per_batch": 32, "mode": "resident", "tickers": 5000}]}}
     assert tpu_session.drop_conv_only_rolling(new) == new
+    # a resident record WITHOUT the tickers stamp predates the r6
+    # schema (N_TICKERS was already overridable, so it could be a
+    # mislabeled small run) — never carried (ADVICE r5 medium)
+    r5 = {"headline": {"ok": True, "results": [
+        {"metric": "cicc58_5000tickers_1yr_wall", "value": 58.0,
+         "days_per_batch": 32, "mode": "resident"}]}}
+    assert tpu_session.drop_conv_only_rolling(r5) == {}
+    # a BENCH_TICKERS override run is honest about its count now, and
+    # still must not satisfy the 5000-ticker headline step
+    small = {"headline": {"ok": True, "results": [
+        {"metric": "cicc58_500tickers_1yr_wall", "value": 6.0,
+         "days_per_batch": 32, "mode": "resident", "tickers": 500}]}}
+    assert tpu_session.drop_conv_only_rolling(small) == {}
     stream_wrong = {"stream": {"ok": True, "results": [
         {"metric": "cicc58_5000tickers_1yr_wall_stream",
          "value": 150.0, "mode": "resident"}]}}
