@@ -422,6 +422,19 @@ def main():
             json.dump(session, fh, indent=1)
         print(json.dumps({name: session["steps"][name].get("ok")}),
               flush=True)
+    # regression gate (telemetry.regress) over the repo's banked
+    # BENCH_r*.json series, embedded in the artifact: a series drift
+    # ships WITH the numbers it flags instead of waiting for a human
+    # to eyeball the trajectory next round
+    gate = _run_json_lines(
+        [sys.executable, "-m",
+         "replication_of_minute_frequency_factor_tpu.telemetry.regress",
+         REPO], timeout=120)
+    recs = [r for r in gate.get("results") or [] if isinstance(r, dict)]
+    session["regress"] = recs[-1] if recs else {
+        "ok": False, "error": gate.get("error") or gate.get("tail")}
+    with open(args.out, "w") as fh:
+        json.dump(session, fh, indent=1)
     oks = {k: v.get("ok") for k, v in session["steps"].items()}
     print(json.dumps({"session_done": oks}))
     return 0 if all(oks.values()) else 1

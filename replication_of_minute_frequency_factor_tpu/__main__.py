@@ -154,8 +154,20 @@ def cmd_compute(args: argparse.Namespace) -> int:
         "cache": args.cache,
     }
     if tel is not None:
+        import os
+
+        from .telemetry.attribution import build_report, write_report
+
         out["telemetry"] = tel.write(telemetry_dir, cfg=cfg,
                                      manifest_extra={"run_kind": "compute"})
+        report = build_report(table.timings,
+                              reconciliation=getattr(table,
+                                                     "reconciliation",
+                                                     None),
+                              profile_dir=cfg.profile_dir,
+                              tolerance=cfg.attribution_tolerance)
+        out["telemetry"]["attribution"] = write_report(
+            os.path.join(telemetry_dir, "attribution.json"), report)
         print(tel.summary(), file=sys.stderr)
     print(json.dumps(out))
     return 0
@@ -291,11 +303,15 @@ def cmd_doctor(args: argparse.Namespace) -> int:
 
 
 def run_synthetic_pipeline(telemetry_dir: str, n_days: int = 3,
-                           n_codes: int = 16) -> int:
+                           n_codes: int = 16,
+                           profile_dir: Optional[str] = None) -> int:
     """Zero-setup observability demo: synthesize a few day files, run the
     REAL device pipeline over them (grid + wire-encode + fused factor
     graph + cache-shaped materialize), and write the full telemetry
-    bundle into ``telemetry_dir``. This is the tier-1 smoke target
+    bundle plus an attribution report into ``telemetry_dir``. With
+    ``profile_dir`` set, the run is wrapped in a crash-safe
+    ``jax.profiler`` capture and the report embeds the post-processed
+    per-op-class trace summary. This is the tier-1 smoke target
     ``run_tests.sh`` validates against the JSONL schema."""
     import os
     import tempfile
@@ -305,9 +321,10 @@ def run_synthetic_pipeline(telemetry_dir: str, n_days: int = 3,
     import pyarrow.parquet as pq
 
     from .config import Config
-    from .data.synthetic import synth_day
     from .pipeline import compute_exposures
+    from .data.synthetic import synth_day
     from .telemetry import Telemetry, set_telemetry
+    from .telemetry.attribution import build_report, write_report
 
     tel = set_telemetry(Telemetry())
     rng = np.random.default_rng(0)
@@ -327,13 +344,24 @@ def run_synthetic_pipeline(telemetry_dir: str, n_days: int = 3,
         cfg = Config.from_env()
         cfg.minute_dir = md
         cfg.days_per_batch = 2
+        if profile_dir:
+            cfg.profile_dir = profile_dir
         table = compute_exposures(md, names, cfg=cfg, progress=False,
                                   telemetry=tel)
     paths = tel.write(telemetry_dir, cfg=cfg,
                       manifest_extra={"run_kind": "synthetic_pipeline"})
+    report = build_report(table.timings,
+                          reconciliation=table.reconciliation,
+                          profile_dir=cfg.profile_dir,
+                          tolerance=cfg.attribution_tolerance)
+    paths["attribution"] = write_report(
+        os.path.join(telemetry_dir, "attribution.json"), report)
     print(tel.summary(), file=sys.stderr)
     print(json.dumps({"rows": len(table),
                       "days": n_days, "factors": len(names),
+                      "reconciliation_ok": report["reconciliation"]["ok"],
+                      "unattributed_s":
+                          report["reconciliation"]["unattributed_s"],
                       "telemetry": paths}))
     return 0
 
@@ -347,6 +375,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "pipeline and write its telemetry bundle into "
                          "DIR (with `compute`, pass the flag after the "
                          "subcommand)")
+    ap.add_argument("--profile-dir", default=None, metavar="DIR",
+                    help="with no subcommand: wrap the synthetic demo in "
+                         "a crash-safe jax.profiler capture into DIR and "
+                         "embed the post-processed trace summary in the "
+                         "attribution report")
     sub = ap.add_subparsers(dest="cmd", required=False)
     _add_compute(sub)
     _add_evaluate(sub)
@@ -355,7 +388,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
     if args.cmd is None:
         if args.telemetry_dir:
-            return run_synthetic_pipeline(args.telemetry_dir)
+            return run_synthetic_pipeline(args.telemetry_dir,
+                                          profile_dir=args.profile_dir)
         ap.error("a subcommand is required (or --telemetry-dir DIR for "
                  "the synthetic telemetry demo)")
     return {"compute": cmd_compute, "evaluate": cmd_evaluate,

@@ -51,8 +51,17 @@ class Config:
     #: reference's only-'full' behaviour (quirk Q9)
     stock_pool_path: Optional[str] = None
     #: capture a jax.profiler trace of each compute_exposures run into
-    #: this directory (open with tensorboard / xprof); None = off
+    #: this directory (open with tensorboard / xprof, or post-process
+    #: with telemetry.attribution.summarize_trace_dir); None = off
     profile_dir: Optional[str] = None
+    #: record XLA compile/cost telemetry (jax.monitoring listeners
+    #: feeding xla.* compile-seconds histograms and compilation-cache
+    #: hit/miss counters); dict-update cost per compile, so on by default
+    compile_telemetry: bool = True
+    #: wall-clock reconciliation gate: the fraction of a run's wall time
+    #: allowed to stay unattributed (no stage accounts for it) before
+    #: the attribution layer flags the run (telemetry.attribution)
+    attribution_tolerance: float = 0.10
     #: persistent XLA compilation cache directory: the fused 58-factor
     #: graph costs ~20-40s to compile on TPU, and this makes that a
     #: once-per-machine cost instead of once-per-process (applied lazily
@@ -85,6 +94,12 @@ class Config:
         if "MFF_REPLICATE_QUIRKS" in os.environ:
             cfg.replicate_quirks = os.environ["MFF_REPLICATE_QUIRKS"] not in (
                 "0", "false", "False")
+        if "MFF_COMPILE_TELEMETRY" in os.environ:
+            cfg.compile_telemetry = os.environ["MFF_COMPILE_TELEMETRY"] \
+                not in ("0", "false", "False")
+        if "MFF_ATTRIBUTION_TOLERANCE" in os.environ:
+            cfg.attribution_tolerance = float(
+                os.environ["MFF_ATTRIBUTION_TOLERANCE"])
         return cfg
 
 

@@ -149,7 +149,8 @@ def compute_packed_resident(dbufs, spec, kind, names,
         rolling_impl = get_config().rolling_impl
     return _compute_packed_scan_jit(tuple(dbufs), spec, kind, names,
                                     replicate_quirks, rolling_impl)
-from .telemetry import Telemetry, get_telemetry
+from .telemetry import Telemetry, TraceCapture, get_telemetry
+from .telemetry import attribution as _attribution
 from .utils.logging import get_logger, FailureReport
 from .utils.tracing import Timer, trace_annotation
 
@@ -491,7 +492,11 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
     def launch(item):
         dates, codes, present, w, bars, mask = item
         tel.counter("pipeline.batches_launched")
-        with trace_annotation("factor_batch"):
+        # timed as its own stage: dispatch covers jaxpr tracing + XLA
+        # compile on a cold cache (seconds-scale), which used to be the
+        # run's biggest unattributed wall-clock term (ISSUE 2 — the
+        # reconciliation block needs every serial consumer step named)
+        with timer("launch"), trace_annotation("factor_batch"):
             if mesh is None:
                 # single-device: one packed buffer in (packed on the
                 # producer thread), one stacked tensor out — one tunnel
@@ -874,7 +879,12 @@ def compute_exposures(
     * ``fault_hook(date)`` is the fault-injection test hook (SURVEY.md §5);
     * ``telemetry`` injects a :class:`..telemetry.Telemetry` for this
       run's metrics/spans (default: the process-wide instance) — see
-      docs/observability.md for the metric and span taxonomy.
+      docs/observability.md for the metric and span taxonomy;
+    * the returned table carries ``.timings`` (per-stage seconds) and
+      ``.reconciliation`` (stage sum vs wall with the
+      ``unattributed_s`` residual explicit — telemetry.attribution);
+      with ``cfg.profile_dir`` set the whole run sits inside a
+      crash-safe ``jax.profiler`` capture window.
     """
     cfg = cfg or get_config()
     if cfg.backend not in ("jax", "numpy", "polars"):
@@ -891,6 +901,11 @@ def compute_exposures(
             "jax backend; the numpy/polars backends reproduce the "
             "reference's quirked semantics by construction")
     apply_compilation_cache(cfg)
+    if cfg.compile_telemetry:
+        # per-jit backend-compile seconds + compilation-cache hit/miss
+        # counters land in the run's registry (telemetry.attribution);
+        # idempotent, so every entry point may call it
+        _attribution.install_compile_listeners()
     minute_dir = minute_dir or cfg.minute_dir
     names = tuple(names) if names is not None else factor_names()
 
@@ -973,10 +988,13 @@ def compute_exposures(
     # every stage into the telemetry span tracer + histograms
     timer = tel.stage_timer()
     parts: List[ExposureTable] = []
-    profiling = False
-    if cfg.profile_dir and files:
-        jax.profiler.start_trace(cfg.profile_dir)
-        profiling = True
+    # crash-safe capture window: the old bare start_trace here had no
+    # stop on the failure paths (an abort between here and the happy
+    # exit left the profiler running and the trace unusable); the
+    # context manager below guarantees stop_trace on EVERY exit,
+    # including per-day failure isolation and circuit-breaker aborts
+    trace = TraceCapture(cfg.profile_dir if files else None,
+                         telemetry=tel, timer=timer)
     iterator: Sequence = files
     if progress and files:
         try:
@@ -1017,7 +1035,7 @@ def compute_exposures(
         if batch:
             yield batch
 
-    try:
+    def _dispatch_backend():
         if cfg.backend == "numpy":
             # CPU oracle path: reference (polars) semantics in f64
             # (SURVEY.md §7 backend dispatch; container has no polars)
@@ -1067,6 +1085,10 @@ def compute_exposures(
                 failures=failures,
                 path_of={str(d): p for d, p in files},
                 telemetry=tel)
+
+    try:
+        with trace:  # stop_trace guaranteed on every exit path
+            _dispatch_backend()
     except Exception as e:  # noqa: BLE001 — crash-consistent save below
         # preserve every completed batch before re-raising: parts hold
         # whole days only, so the cache written below is resume-safe and
@@ -1076,9 +1098,6 @@ def compute_exposures(
                      "before re-raising", e, len(parts))
     else:
         fatal = None
-    finally:
-        if profiling:
-            jax.profiler.stop_trace()
 
     if parts:
         new = ExposureTable.concat(parts).sort()
@@ -1107,6 +1126,23 @@ def compute_exposures(
                     "(%d rows, %d failed days) [%s]", len(names), len(files),
                     elapsed, len(new), len(failures), timer.report())
     result.timings = timer.totals()
+    # wall-clock reconciliation (telemetry.attribution): sum of the
+    # timed stages vs the measured wall, unattributed residual explicit.
+    # Past-tolerance unattributed time is a measurement gap — flagged
+    # and logged, never fatal; overlap from the pipelined threads is
+    # reported separately and never flagged.
+    result.reconciliation = _attribution.reconcile(
+        elapsed, result.timings, tolerance=cfg.attribution_tolerance)
+    if files:
+        tel.event("reconciliation", **result.reconciliation)
+        if not result.reconciliation["ok"]:
+            logger.warning(
+                "wall-clock reconciliation FAILED: %.2fs of %.2fs (%.0f%%)"
+                " unattributed — the stage taxonomy is missing a term "
+                "(stages: %s)",
+                result.reconciliation["unattributed_s"], elapsed,
+                100 * result.reconciliation["unattributed_frac"],
+                timer.report())
     if cache_path is not None and len(result):
         result.save(cache_path)
     if cache_path is not None:

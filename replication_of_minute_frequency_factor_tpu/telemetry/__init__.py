@@ -30,14 +30,16 @@ import time
 from typing import Dict, List, Optional
 
 from ..utils.tracing import Timer
+from .attribution import TraceCapture, reconcile
 from .registry import Histogram, MetricsRegistry, render_key
 from .sink import SCHEMA_VERSION, EventSink, validate_jsonl, validate_record
 from .spans import SpanTracer
 
 __all__ = [
     "SCHEMA_VERSION", "EventSink", "Histogram", "MetricsRegistry",
-    "SpanTracer", "StageTimer", "Telemetry", "get_telemetry",
-    "render_key", "set_telemetry", "validate_jsonl", "validate_record",
+    "SpanTracer", "StageTimer", "Telemetry", "TraceCapture",
+    "get_telemetry", "reconcile", "render_key", "set_telemetry",
+    "validate_jsonl", "validate_record",
 ]
 
 #: retained free-form events bound (events past it count, not retain)
@@ -119,12 +121,19 @@ class Telemetry:
 
         Returns ``{artifact: path}``.
         """
+        from .attribution import xla_summary
         from .manifest import build_manifest
 
         os.makedirs(out_dir, exist_ok=True)
         paths = {"manifest": os.path.join(out_dir, "manifest.json"),
                  "metrics": os.path.join(out_dir, "metrics.jsonl"),
                  "trace": os.path.join(out_dir, "trace.json")}
+        # the compile/cost story is provenance: stamp it into the
+        # manifest so "what did this run compile, and did the cache
+        # help" is answerable without replaying the metrics stream
+        xla = xla_summary(self.registry)
+        if xla:
+            manifest_extra = {"xla": xla, **(manifest_extra or {})}
         manifest = build_manifest(cfg, manifest_extra)
         import json
         with open(paths["manifest"], "w") as fh:

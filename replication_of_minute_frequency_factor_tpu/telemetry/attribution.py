@@ -1,0 +1,569 @@
+"""Performance attribution: guaranteed profiler capture, trace
+post-processing, XLA compile/cost telemetry, and wall-clock
+reconciliation.
+
+PR 1 built the *emit* side of observability (registry, spans, JSONL,
+manifest); this module is the *attribution* side — the layer that turns
+"the run took N seconds" into "N seconds = these stages + these op
+classes + this much compile, with an explicit unattributed residual":
+
+* :class:`TraceCapture` — a crash-safe context manager around
+  ``jax.profiler.start_trace``/``stop_trace``. The seed's pipeline
+  started a trace and only stopped it on the happy path (the round-5
+  VERDICT's top measurement gap: ``Config.profile_dir`` existed but no
+  usable trace was ever banked); this wrapper guarantees ``stop_trace``
+  on EVERY exit path and records start/stop failures as metrics instead
+  of letting diagnostics kill work.
+* trace post-processing — :func:`load_trace_events` /
+  :func:`device_op_breakdown` / :func:`stage_annotation_totals` parse
+  Chrome ``trace_events`` JSON (what ``jax.profiler`` emits as
+  ``*.trace.json.gz`` next to the xplane protobuf, and what
+  :meth:`..spans.SpanTracer.write_chrome_trace` exports) into a
+  per-op-class device-time breakdown plus per-stage annotation totals.
+* XLA compile/cost telemetry — :func:`compile_with_telemetry` (AOT
+  compile with per-jit compile seconds, ``cost_analysis()`` FLOPs and
+  bytes-accessed, HLO module size) and :func:`install_compile_listeners`
+  (``jax.monitoring`` listeners feeding backend-compile durations and
+  compilation-cache hit/miss counters into the CURRENT telemetry
+  registry). :func:`xla_summary` condenses those metrics for the run
+  manifest.
+* reconciliation — :func:`reconcile` compares ``sum(stages)`` against a
+  measured wall clock and reports the ``unattributed_s`` residual
+  explicitly, flagging (or, in strict mode, raising on) runs where more
+  than ``tolerance`` of the wall is unaccounted for. Stage overlap
+  (pipelined producer/consumer threads) legitimately makes the sum
+  EXCEED the wall; that surplus is reported as ``overlap_s`` and never
+  flagged — only *missing* attribution is a measurement gap.
+
+See docs/observability.md §"Attribution" for the report schema and
+docs/BENCHMARKS.md for how bench records embed the reconciliation block.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import gzip
+import json
+import os
+import re
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+#: attribution report schema version (independent of the JSONL schema)
+REPORT_SCHEMA = 1
+
+#: default fraction of wall time allowed to stay unattributed
+DEFAULT_TOLERANCE = 0.10
+
+
+def _tel(telemetry=None):
+    if telemetry is not None:
+        return telemetry
+    from . import get_telemetry
+
+    return get_telemetry()
+
+
+# --------------------------------------------------------------------------
+# TraceCapture
+# --------------------------------------------------------------------------
+
+
+class TraceCapture:
+    """Crash-safe ``jax.profiler`` capture window.
+
+    ``with TraceCapture(cfg.profile_dir):`` starts a trace on entry (a
+    None/empty dir makes the whole manager a no-op) and GUARANTEES
+    ``stop_trace`` on every exit path — normal return, body exception,
+    or per-day failure isolation churning inside the body. Start/stop
+    failures are recorded as ``attribution.trace_start_failures`` /
+    ``attribution.trace_stop_failures`` counters and never mask the
+    body's own exception: profiling is diagnostics, and diagnostics must
+    not change a run's fate.
+    """
+
+    def __init__(self, profile_dir: Optional[str], telemetry=None,
+                 timer=None):
+        #: ``timer`` (a Timer/StageTimer) attributes the capture's OWN
+        #: cost — start_trace instrumentation setup and stop_trace's
+        #: trace serialization are seconds-scale, and without a named
+        #: ``trace_capture`` stage every profiled run would carry a
+        #: phantom unattributed residual exactly when measuring it
+        #: matters most
+        self.profile_dir = profile_dir or None
+        self._telemetry = telemetry
+        self._timer = timer
+        self.active = False
+
+    def _timed(self):
+        return (self._timer("trace_capture") if self._timer is not None
+                else contextlib.nullcontext())
+
+    def __enter__(self) -> "TraceCapture":
+        if not self.profile_dir:
+            return self
+        tel = _tel(self._telemetry)
+        try:
+            with self._timed():
+                os.makedirs(self.profile_dir, exist_ok=True)
+                import jax
+
+                jax.profiler.start_trace(self.profile_dir)
+            self.active = True
+            tel.counter("attribution.trace_captures")
+            tel.event("trace_capture_started", dir=str(self.profile_dir))
+        except Exception as e:  # noqa: BLE001 — diagnostics must not kill work
+            tel.counter("attribution.trace_start_failures")
+            tel.event("trace_start_failed", dir=str(self.profile_dir),
+                      error=f"{type(e).__name__}: {e}")
+            logger.warning("profiler trace start failed for %s: %s",
+                           self.profile_dir, e)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if not self.active:
+            return False
+        self.active = False
+        tel = _tel(self._telemetry)
+        try:
+            with self._timed():
+                import jax
+
+                jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001 — never mask the body's error
+            tel.counter("attribution.trace_stop_failures")
+            tel.event("trace_stop_failed", dir=str(self.profile_dir),
+                      error=f"{type(e).__name__}: {e}")
+            logger.warning("profiler trace stop failed for %s: %s",
+                           self.profile_dir, e)
+        return False
+
+
+# --------------------------------------------------------------------------
+# Trace post-processing
+# --------------------------------------------------------------------------
+
+#: op-class patterns, FIRST match wins (order matters: 'all-reduce'
+#: must classify as collective before 'reduce' claims it, 'copy-start'
+#: as transfer before 'copy' claims it)
+OP_CLASS_PATTERNS: Tuple[Tuple[str, "re.Pattern"], ...] = tuple(
+    (cls, re.compile(pat, re.IGNORECASE)) for cls, pat in (
+        ("collective", r"all-?reduce|all-?gather|all-?to-?all|"
+                       r"reduce-?scatter|collective-?permute|\bpsum\b"),
+        ("infeed_outfeed", r"infeed|outfeed|copy-start|copy-done|"
+                           r"\bh2d\b|\bd2h\b|transfer"),
+        ("fusion", r"^fusion|\bfused\b"),
+        ("matmul_conv", r"^dot\b|\bdot\.|dot-|convolution|\bgemm\b|"
+                        r"matmul|einsum"),
+        ("sort_scan", r"\bsort\b|while|top-?k|cumsum"),
+        ("reduction", r"reduce|arg-?max|arg-?min"),
+        ("data_movement", r"copy|transpose|reshape|broadcast|concat|"
+                          r"slice|\bpad\b|gather|scatter|select|iota|"
+                          r"bitcast|convert"),
+    ))
+
+#: span names the pipeline/bench annotate (utils.tracing/StageTimer wrap
+#: jax.profiler.TraceAnnotation, so these appear verbatim in captures)
+KNOWN_STAGE_NAMES = (
+    "io", "grid", "wire_encode", "pack", "launch", "device",
+    "trace_capture", "factor_batch", "synth_batch", "ingest_put",
+    "compile", "device_exec_first", "device_exec_steady",
+    "result_to_host",
+)
+
+
+def classify_op(name: str) -> str:
+    """Op-class of one trace-event name; ``other`` when nothing matches."""
+    for cls, pat in OP_CLASS_PATTERNS:
+        if pat.search(name):
+            return cls
+    return "other"
+
+
+def find_trace_files(root: str) -> List[str]:
+    """Chrome-trace files under ``root`` (recursive): the profiler's
+    ``*.trace.json.gz``, plain ``*.trace.json``, and the span export's
+    ``trace.json``."""
+    out: List[str] = []
+    for r, _, fs in os.walk(root):
+        for f in fs:
+            if (f.endswith(".trace.json.gz") or f.endswith(".trace.json")
+                    or f == "trace.json"):
+                out.append(os.path.join(r, f))
+    return sorted(out)
+
+
+def load_trace_events(path: str) -> Tuple[List[dict], Dict[int, str]]:
+    """Events + pid->process-name map from ONE Chrome trace JSON file
+    (gzipped or plain). Returns ``([], {})`` on an unreadable file —
+    post-processing is best-effort over whatever the capture left."""
+    try:
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rt") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as e:
+        logger.warning("unreadable trace file %s: %s", path, e)
+        return [], {}
+    events = doc.get("traceEvents", []) if isinstance(doc, dict) else []
+    procs: Dict[int, str] = {}
+    for e in events:
+        if (isinstance(e, dict) and e.get("ph") == "M"
+                and e.get("name") == "process_name"):
+            name = (e.get("args") or {}).get("name")
+            if name is not None and e.get("pid") is not None:
+                procs[e["pid"]] = str(name)
+    return [e for e in events if isinstance(e, dict)], procs
+
+
+def _is_device_process(name: str) -> bool:
+    n = name.lower()
+    return ("/device:" in n or n.startswith("tpu") or n.startswith("gpu")
+            or "xla:#global" in n)
+
+
+def device_op_breakdown(events: Sequence[dict],
+                        processes: Dict[int, str],
+                        top_n: int = 15) -> dict:
+    """Per-op-class device-time totals from complete ('X') events.
+
+    Only events on *device* processes count (pid whose process_name
+    looks like ``/device:TPU:0``); host-side Python frames would
+    otherwise swamp the totals. A capture with no device pids (the
+    CPU backend's traces put XLA ops on the host pid) yields zeroed
+    totals with ``device_pids: []`` so callers can tell "no device
+    time" from "no device visibility".
+    """
+    dev_pids = {pid for pid, name in processes.items()
+                if _is_device_process(name)}
+    by_class: Dict[str, float] = {}
+    by_op: Dict[str, float] = {}
+    n_events = 0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        dur = e.get("dur")
+        name = e.get("name")
+        if not isinstance(dur, (int, float)) or not isinstance(name, str):
+            continue
+        n_events += 1
+        cls = classify_op(name)
+        by_class[cls] = by_class.get(cls, 0.0) + float(dur)
+        # strip the .N instance suffix so repeated ops aggregate
+        op = name.split(".")[0] if "." in name else name
+        by_op[op] = by_op.get(op, 0.0) + float(dur)
+    total = sum(by_class.values())
+    top = sorted(by_op.items(), key=lambda kv: kv[1], reverse=True)[:top_n]
+    return {
+        "device_pids": sorted(processes[p] for p in dev_pids),
+        "device_events": n_events,
+        "total_device_us": round(total, 1),
+        "by_class_us": {k: round(v, 1)
+                        for k, v in sorted(by_class.items(),
+                                           key=lambda kv: kv[1],
+                                           reverse=True)},
+        "top_ops_us": [{"op": k, "us": round(v, 1)} for k, v in top],
+    }
+
+
+def stage_annotation_totals(events: Sequence[dict],
+                            stage_names: Sequence[str] = KNOWN_STAGE_NAMES,
+                            ) -> Dict[str, float]:
+    """Total duration (us) of every known stage/span annotation in a
+    capture, regardless of which process carries it — these are the
+    TraceAnnotation spans the pipeline/bench emit, the join key between
+    the profiler's view and the span export's."""
+    want = set(stage_names)
+    out: Dict[str, float] = {}
+    for e in events:
+        if e.get("ph") == "X" and e.get("name") in want \
+                and isinstance(e.get("dur"), (int, float)):
+            out[e["name"]] = out.get(e["name"], 0.0) + float(e["dur"])
+    return {k: round(v, 1) for k, v in out.items()}
+
+
+def summarize_trace_dir(profile_dir: str) -> dict:
+    """Post-process every trace file under ``profile_dir`` into one
+    merged summary (file count, device op-class breakdown, stage
+    annotation totals)."""
+    files = find_trace_files(profile_dir)
+    all_events: List[dict] = []
+    procs: Dict[int, str] = {}
+    for f in files:
+        ev, pr = load_trace_events(f)
+        all_events.extend(ev)
+        procs.update(pr)
+    return {
+        "profile_dir": profile_dir,
+        "files": len(files),
+        "events": len(all_events),
+        "device_breakdown": device_op_breakdown(all_events, procs),
+        "stage_annotations_us": stage_annotation_totals(all_events),
+    }
+
+
+# --------------------------------------------------------------------------
+# Wall-clock reconciliation
+# --------------------------------------------------------------------------
+
+
+class ReconciliationError(RuntimeError):
+    """Raised in strict mode when too much wall time is unattributed."""
+
+
+def reconcile(wall_s: float, stages: Optional[Dict[str, float]],
+              tolerance: float = DEFAULT_TOLERANCE,
+              floor_s: float = 0.05, strict: bool = False) -> dict:
+    """``sum(stages)`` vs ``wall_s`` with an explicit residual.
+
+    Non-seconds entries (``*_ms``, ``*_MB``, booleans, non-numbers) are
+    dropped so callers can pass a phases/stages dict verbatim.
+    ``unattributed_s`` is the wall time NO stage accounts for
+    (``max(0, wall - sum)``); ``overlap_s`` is the surplus when
+    concurrent stages sum past the wall (expected in the pipelined
+    loops, never flagged). ``ok`` is False when the unattributed
+    fraction exceeds ``tolerance`` AND the residual exceeds ``floor_s``
+    (micro-runs carry a few ms of interpreter slack between stages that
+    is 50% of a 10 ms wall and 0% of any real one); ``strict=True``
+    raises :class:`ReconciliationError` instead.
+    """
+    comp = {}
+    for k, v in (stages or {}).items():
+        if (isinstance(v, (int, float)) and not isinstance(v, bool)
+                and not k.endswith("_ms") and not k.endswith("_MB")):
+            comp[k] = float(v)
+    attributed = sum(comp.values())
+    wall = float(wall_s)
+    unattributed = max(0.0, wall - attributed)
+    overlap = max(0.0, attributed - wall)
+    frac = (unattributed / wall) if wall > 0 else 0.0
+    ok = frac <= tolerance or unattributed <= floor_s
+    block = {
+        "wall_s": round(wall, 3),
+        "attributed_s": round(attributed, 3),
+        "unattributed_s": round(unattributed, 3),
+        "overlap_s": round(overlap, 3),
+        "unattributed_frac": round(frac, 4),
+        "tolerance": tolerance,
+        "stages": {k: round(v, 3) for k, v in comp.items()},
+        "ok": ok,
+    }
+    if strict and not ok:
+        raise ReconciliationError(
+            f"wall-clock reconciliation failed: {unattributed:.2f}s of "
+            f"{wall:.2f}s ({frac:.0%}) unattributed (> {tolerance:.0%} "
+            f"tolerance); stages: {block['stages']}")
+    return block
+
+
+def build_report(stages: Optional[Dict[str, float]],
+                 wall_s: Optional[float] = None,
+                 reconciliation: Optional[dict] = None,
+                 profile_dir: Optional[str] = None,
+                 tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """Full attribution report: reconciliation block (computed from
+    ``wall_s`` unless a precomputed one is passed) plus — when a
+    ``profile_dir`` is given — the post-processed trace summary."""
+    if reconciliation is None:
+        reconciliation = reconcile(wall_s or 0.0, stages, tolerance)
+    report = {
+        "schema": REPORT_SCHEMA,
+        "stages_s": {k: round(float(v), 3)
+                     for k, v in (stages or {}).items()
+                     if isinstance(v, (int, float))},
+        "reconciliation": reconciliation,
+    }
+    if profile_dir:
+        report["trace"] = summarize_trace_dir(profile_dir)
+    return report
+
+
+def write_report(path: str, report: dict) -> str:
+    with open(path, "w") as fh:
+        json.dump(report, fh, indent=1)
+    return path
+
+
+# --------------------------------------------------------------------------
+# XLA compile / cost telemetry
+# --------------------------------------------------------------------------
+
+
+def _first_cost_dict(cost) -> dict:
+    # cost_analysis() returns a per-computation list on some backends
+    # and a bare dict on others
+    if isinstance(cost, (list, tuple)):
+        return cost[0] if cost else {}
+    return cost or {}
+
+
+def compile_with_telemetry(label: str, lowered, telemetry=None):
+    """AOT-compile a ``jax.jit(...).lower(...)`` result, recording
+    per-jit compile telemetry into the registry:
+
+    * ``xla.compile_seconds{fn=label}`` histogram — wall time of the
+      ``.compile()`` call (cache hits included: a near-zero observation
+      IS the cache-hit signal at this grain);
+    * ``xla.hlo_module_bytes{fn=label}`` gauge — StableHLO text size,
+      the compile-input-size axis of the cost story;
+    * ``xla.flops{fn=label}`` / ``xla.bytes_accessed{fn=label}`` gauges
+      from ``cost_analysis()`` (absent keys recorded as nothing, not 0);
+    * ``xla.generated_code_bytes{fn=label}`` /
+      ``xla.temp_bytes{fn=label}`` from ``memory_analysis()``;
+    * an ``xla_compile`` event tying them together.
+
+    Returns the compiled executable. Telemetry failures never fail the
+    compile.
+    """
+    tel = _tel(telemetry)
+    try:
+        hlo_bytes = len(lowered.as_text())
+    except Exception:  # noqa: BLE001 — diagnostics only
+        hlo_bytes = None
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    dt = time.perf_counter() - t0
+    try:
+        tel.counter("xla.compiles", fn=label)
+        tel.observe("xla.compile_seconds", dt, fn=label)
+        if hlo_bytes is not None:
+            tel.gauge("xla.hlo_module_bytes", hlo_bytes, fn=label)
+        detail = {"fn": label, "seconds": round(dt, 4),
+                  "hlo_module_bytes": hlo_bytes}
+        try:
+            cost = _first_cost_dict(compiled.cost_analysis())
+        except Exception:  # noqa: BLE001
+            cost = {}
+        flops = cost.get("flops")
+        bytes_acc = cost.get("bytes accessed")
+        if isinstance(flops, (int, float)):
+            tel.gauge("xla.flops", flops, fn=label)
+            detail["flops"] = flops
+        if isinstance(bytes_acc, (int, float)):
+            tel.gauge("xla.bytes_accessed", bytes_acc, fn=label)
+            detail["bytes_accessed"] = bytes_acc
+        try:
+            mem = compiled.memory_analysis()
+            code = getattr(mem, "generated_code_size_in_bytes", None)
+            temp = getattr(mem, "temp_size_in_bytes", None)
+        except Exception:  # noqa: BLE001
+            code = temp = None
+        if isinstance(code, (int, float)):
+            tel.gauge("xla.generated_code_bytes", code, fn=label)
+            detail["generated_code_bytes"] = code
+        if isinstance(temp, (int, float)):
+            tel.gauge("xla.temp_bytes", temp, fn=label)
+            detail["temp_bytes"] = temp
+        tel.event("xla_compile", **detail)
+    except Exception as e:  # noqa: BLE001 — telemetry must not fail work
+        logger.warning("compile telemetry for %s failed: %s", label, e)
+    return compiled
+
+
+#: jax.monitoring duration event -> histogram metric name
+_DURATION_EVENTS = {
+    "/jax/core/compile/backend_compile_duration":
+        "xla.backend_compile_seconds",
+    "/jax/core/compile/jaxpr_trace_duration": "xla.jaxpr_trace_seconds",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration":
+        "xla.lowering_seconds",
+    "/jax/compilation_cache/cache_retrieval_time_sec":
+        "xla.cache_retrieval_seconds",
+    "/jax/compilation_cache/compile_time_saved_sec":
+        "xla.cache_time_saved_seconds",
+}
+
+#: jax.monitoring count event -> (counter name, labels)
+_COUNT_EVENTS = {
+    "/jax/compilation_cache/cache_hits":
+        ("xla.compilation_cache", {"outcome": "hit"}),
+    "/jax/compilation_cache/cache_misses":
+        ("xla.compilation_cache", {"outcome": "miss"}),
+}
+
+_listeners_installed = False
+
+
+def install_compile_listeners() -> bool:
+    """Subscribe ``jax.monitoring`` compile/cache events into telemetry.
+
+    Idempotent and once-per-process (jax has no listener *removal* API,
+    so the callbacks resolve the CURRENT process-default telemetry at
+    fire time — an isolated-``Telemetry`` test that ``set_telemetry``\\ s
+    its instance still captures everything fired while installed).
+    Feeds ``xla.backend_compile_seconds`` (per-jit backend compile
+    wall), trace/lowering durations, persistent-cache retrieval times,
+    and ``xla.compilation_cache{outcome=hit|miss}`` counters. Returns
+    whether listeners are active.
+    """
+    global _listeners_installed
+    if _listeners_installed:
+        return True
+    try:
+        import jax.monitoring as monitoring
+
+        def _on_duration(event: str, duration: float, **kw) -> None:
+            name = _DURATION_EVENTS.get(event)
+            if name is None:
+                return
+            try:
+                _tel().observe(name, float(duration))
+            except Exception:  # noqa: BLE001 — never break compilation
+                pass
+
+        def _on_event(event: str, **kw) -> None:
+            hit = _COUNT_EVENTS.get(event)
+            if hit is None:
+                return
+            try:
+                name, labels = hit
+                _tel().counter(name, 1.0, **labels)
+            except Exception:  # noqa: BLE001 — never break compilation
+                pass
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        monitoring.register_event_listener(_on_event)
+        _listeners_installed = True
+        return True
+    except Exception as e:  # noqa: BLE001 — optional instrumentation
+        logger.warning("could not install jax.monitoring listeners: %s", e)
+        return False
+
+
+def xla_summary(registry) -> dict:
+    """Condensed compile/cost story for the run manifest: total backend
+    compiles and seconds, cache hit/miss counts, per-jit compile
+    seconds and FLOPs/bytes gauges (everything under the ``xla.``
+    prefix, rendered-key form). Empty dict when nothing was recorded."""
+    snap = registry.snapshot()
+    out: dict = {}
+    bc = registry.histogram_stats("xla.backend_compile_seconds")
+    if bc and bc["count"]:
+        out["backend_compiles"] = bc["count"]
+        out["backend_compile_seconds_total"] = round(bc["sum"], 3)
+        out["backend_compile_seconds_max"] = round(bc["max"], 3)
+    hits = registry.counter_value("xla.compilation_cache", outcome="hit")
+    misses = registry.counter_value("xla.compilation_cache",
+                                    outcome="miss")
+    if hits or misses:
+        out["compilation_cache"] = {"hits": int(hits),
+                                    "misses": int(misses)}
+    saved = registry.histogram_stats("xla.cache_time_saved_seconds")
+    if saved and saved["count"]:
+        out["cache_time_saved_seconds_total"] = round(saved["sum"], 3)
+    per_fn = {}
+    for section in ("counters", "gauges"):
+        for key, v in snap[section].items():
+            if key.startswith("xla.") and "{fn=" in key:
+                per_fn[key] = v
+    for key, st in snap["histograms"].items():
+        if key.startswith("xla.compile_seconds{") and st["count"]:
+            per_fn[key] = {"count": st["count"],
+                           "sum": round(st["sum"], 4),
+                           "max": round(st["max"], 4)}
+    if per_fn:
+        out["per_jit"] = per_fn
+    return out

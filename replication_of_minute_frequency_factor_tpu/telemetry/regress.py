@@ -1,0 +1,374 @@
+"""Bench-series regression gate.
+
+``python -m replication_of_minute_frequency_factor_tpu.telemetry.regress
+ROOT`` parses the banked ``BENCH_r*.json`` trajectory under ``ROOT``
+(the round-end driver artifacts committed at the repo root), builds
+per-metric baselines, and flags deviations with a stage-level diff of
+where the time moved. The verdict prints as ONE machine-readable JSON
+line so harnesses (``run_tests.sh``'s regress smoke,
+``benchmarks/tpu_session.py``'s end-of-session gate) can embed it.
+
+Series semantics (VERDICT r4 #3: series breaks are DECLARED, not
+smeared):
+
+* records group by ``(metric, methodology)``. A record carrying a new
+  ``methodology`` value starts a fresh series — one record alone has no
+  baseline and is never flagged, so a declared break stays quiet by
+  construction.
+* records predating the ``methodology`` field (r01–r04) are all the
+  r1–r4 double-buffered stream loop (bench.py's own series history), so
+  they join the declared ``r4_stream_v2`` series rather than forming a
+  phantom "undeclared" one. This is the ONE inference the gate makes,
+  and it is pinned here so it cannot drift.
+
+Baseline = median of every record in the group EXCEPT the latest; the
+latest is the record under test. ``--check FILE`` instead gates a fresh
+candidate record against the baseline of the FULL banked group (the
+bench-harness mode: "is the record I just measured a regression?").
+
+Exit codes: 0 = report emitted (deviations, if any, are *reported* —
+the committed trajectory is history, not a failure of this checkout);
+with ``--strict`` or ``--check``, 1 = a flagged regression; 2 = no
+usable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: deviation (fraction of baseline) past which a record is flagged
+DEFAULT_TOLERANCE = 0.05
+
+#: methodology assigned to pre-r5 records that predate the field (every
+#: one of them ran bench.py's stream loop; see module docstring)
+LEGACY_METHODOLOGY = "r4_stream_v2"
+
+#: stage keys are seconds unless suffixed otherwise
+_NON_SECONDS = ("_ms", "_MB")
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+# --------------------------------------------------------------------------
+# loading
+# --------------------------------------------------------------------------
+
+
+def _extract_record(doc) -> Optional[dict]:
+    """The bench record inside one BENCH_r*.json document.
+
+    Banked files are driver wrappers ``{"n": .., "parsed": {record}}``;
+    bare record files (a harness checking its own fresh output) are
+    accepted too. The nested ``stale_tpu_headline`` carry is NOT a
+    record of the run that banked it — it never becomes a data point.
+    """
+    if not isinstance(doc, dict):
+        return None
+    if isinstance(doc.get("parsed"), dict) and "metric" in doc["parsed"]:
+        return doc["parsed"]
+    if "metric" in doc and "value" in doc:
+        return doc
+    # last resort: the wrapper's tail holds the printed JSON line
+    tail = doc.get("tail")
+    if isinstance(tail, str):
+        for line in reversed(tail.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and "metric" in rec:
+                    return rec
+    return None
+
+
+def load_bench_series(root: str) -> List[dict]:
+    """``[{n, source, record}, ...]`` from ``ROOT/BENCH_r*.json``
+    (top-level only — fixtures and telemetry dirs below ROOT are not
+    part of the banked trajectory), ordered by round number."""
+    entries: List[dict] = []
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        rec = _extract_record(doc)
+        if rec is None:
+            continue
+        m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+        n = doc.get("n") if isinstance(doc, dict) else None
+        if not isinstance(n, int):
+            n = int(m.group(1)) if m else 0
+        entries.append({"n": n, "source": os.path.basename(path),
+                        "record": rec})
+    entries.sort(key=lambda e: (e["n"], e["source"]))
+    return entries
+
+
+def load_telemetry_spans(paths: List[str]) -> dict:
+    """Fold ``span_seconds{span=...}`` histogram records out of
+    telemetry ``metrics.jsonl`` streams into per-span stats — the
+    cross-check between the bench series' ``stages`` dicts and what the
+    instrumented run itself recorded."""
+    spans: Dict[str, dict] = {}
+    files = 0
+    for path in paths:
+        try:
+            with open(path) as fh:
+                lines = fh.readlines()
+        except OSError:
+            continue
+        files += 1
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if (rec.get("kind") == "histogram"
+                    and rec.get("name") == "span_seconds"):
+                span = (rec.get("labels") or {}).get("span")
+                if span:
+                    spans[span] = {"count": rec.get("count"),
+                                   "sum_s": rec.get("sum"),
+                                   "p50_s": rec.get("p50"),
+                                   "p95_s": rec.get("p95")}
+    return {"files": files, "spans": spans}
+
+
+def find_metrics_jsonl(path: str, max_depth: int = 3) -> List[str]:
+    """metrics.jsonl files at or under ``path`` (bounded depth)."""
+    if os.path.isfile(path):
+        return [path]
+    out: List[str] = []
+    base_depth = path.rstrip(os.sep).count(os.sep)
+    for r, dirs, fs in os.walk(path):
+        if r.count(os.sep) - base_depth >= max_depth:
+            dirs[:] = []
+        if "metrics.jsonl" in fs:
+            out.append(os.path.join(r, "metrics.jsonl"))
+    return sorted(out)
+
+
+# --------------------------------------------------------------------------
+# baselines + evaluation
+# --------------------------------------------------------------------------
+
+
+def effective_methodology(record: dict) -> str:
+    m = record.get("methodology")
+    return str(m) if m else LEGACY_METHODOLOGY
+
+
+def group_entries(entries: List[dict]) -> Dict[Tuple[str, str], List[dict]]:
+    groups: Dict[Tuple[str, str], List[dict]] = {}
+    for e in entries:
+        rec = e["record"]
+        key = (str(rec.get("metric")), effective_methodology(rec))
+        groups.setdefault(key, []).append(e)
+    return groups
+
+
+def _stages_seconds(record: dict) -> Dict[str, float]:
+    out = {}
+    for k, v in (record.get("stages") or {}).items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                and not any(k.endswith(s) for s in _NON_SECONDS):
+            out[k] = float(v)
+    return out
+
+
+def stage_diff(baseline_entries: List[dict], latest: dict) -> List[dict]:
+    """Where the time moved: latest record's per-stage seconds vs the
+    per-stage median over the baseline entries, sorted by |delta|
+    descending. Stages present on only one side report a null for the
+    missing side (a stage appearing/disappearing IS a finding)."""
+    base: Dict[str, List[float]] = {}
+    for e in baseline_entries:
+        for k, v in _stages_seconds(e["record"]).items():
+            base.setdefault(k, []).append(v)
+    base_med = {k: _median(v) for k, v in base.items()}
+    latest_st = _stages_seconds(latest)
+    rows = []
+    for k in sorted(set(base_med) | set(latest_st)):
+        b = base_med.get(k)
+        l_ = latest_st.get(k)
+        row = {"stage": k,
+               "baseline_s": round(b, 3) if b is not None else None,
+               "latest_s": round(l_, 3) if l_ is not None else None}
+        if b is not None and l_ is not None:
+            row["delta_s"] = round(l_ - b, 3)
+            row["delta_pct"] = (round(100.0 * (l_ - b) / b, 1)
+                                if b else None)
+        rows.append(row)
+    rows.sort(key=lambda r: abs(r.get("delta_s") or 0.0), reverse=True)
+    return rows
+
+
+def _evaluate_group(key: Tuple[str, str], entries: List[dict],
+                    candidate: Optional[dict],
+                    tolerance: float) -> Optional[dict]:
+    """Verdict row for one (metric, methodology) series. With a
+    ``candidate`` record, the whole banked group is the baseline;
+    otherwise the group's latest entry is under test. None when there
+    is nothing to compare against (a declared break's first record)."""
+    if candidate is not None:
+        baseline_entries = entries
+        latest_rec = candidate
+        latest_src = "candidate"
+    else:
+        if len(entries) < 2:
+            return None
+        baseline_entries = entries[:-1]
+        latest_rec = entries[-1]["record"]
+        latest_src = entries[-1]["source"]
+    vals = [e["record"].get("value") for e in baseline_entries]
+    vals = [float(v) for v in vals
+            if isinstance(v, (int, float)) and not isinstance(v, bool)]
+    latest_val = latest_rec.get("value")
+    if not vals or not isinstance(latest_val, (int, float)):
+        return None
+    baseline = _median(vals)
+    deviation = ((float(latest_val) - baseline) / baseline
+                 if baseline else 0.0)
+    flagged = abs(deviation) > tolerance
+    row = {
+        "metric": key[0],
+        "methodology": key[1],
+        "n_baseline": len(vals),
+        "baseline_value": round(baseline, 3),
+        "baseline_band": [round(min(vals), 3), round(max(vals), 3)],
+        "latest_value": round(float(latest_val), 3),
+        "latest_source": latest_src,
+        "deviation_pct": round(100.0 * deviation, 2),
+        "flagged": flagged,
+    }
+    if flagged:
+        row["stage_diff"] = stage_diff(baseline_entries, latest_rec)
+    return row
+
+
+def evaluate(entries: List[dict], tolerance: float = DEFAULT_TOLERANCE,
+             candidate: Optional[dict] = None) -> dict:
+    """The machine-readable verdict over a loaded trajectory (and an
+    optional fresh candidate record)."""
+    groups = group_entries(entries)
+    rows: List[dict] = []
+    if candidate is not None:
+        key = (str(candidate.get("metric")),
+               effective_methodology(candidate))
+        row = _evaluate_group(key, groups.get(key, []), candidate,
+                              tolerance)
+        if row is None:
+            # no banked series for this (metric, methodology): a
+            # declared break — reported, never flagged
+            rows.append({"metric": key[0], "methodology": key[1],
+                         "n_baseline": 0, "flagged": False,
+                         "note": "no baseline series (declared break "
+                                 "or first record)"})
+        else:
+            rows.append(row)
+    else:
+        for key in sorted(groups):
+            row = _evaluate_group(key, groups[key], None, tolerance)
+            if row is not None:
+                rows.append(row)
+    flagged = [r for r in rows if r.get("flagged")]
+    return {
+        "schema": 1,
+        "tolerance_pct": round(100.0 * tolerance, 2),
+        "records": sum(len(v) for v in groups.values()),
+        "series": len(groups),
+        "groups": rows,
+        "flagged": [{"metric": r["metric"],
+                     "methodology": r["methodology"],
+                     "deviation_pct": r["deviation_pct"]}
+                    for r in flagged],
+        "ok": not flagged,
+    }
+
+
+# --------------------------------------------------------------------------
+# CLI
+# --------------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m replication_of_minute_frequency_factor_tpu"
+             ".telemetry.regress",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("root", help="directory holding the BENCH_r*.json "
+                                 "trajectory (the repo root)")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="flag |deviation| past this fraction of the "
+                         "baseline (default 0.05)")
+    ap.add_argument("--check", metavar="FILE", default=None,
+                    help="gate a fresh candidate record (bare record "
+                         "JSON or driver wrapper) against the banked "
+                         "baselines; exits 1 when flagged")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when the trajectory's own latest "
+                         "record in any series is flagged")
+    ap.add_argument("--telemetry", metavar="PATH", default=None,
+                    help="also fold span_seconds stats out of "
+                         "metrics.jsonl streams at/under PATH into the "
+                         "verdict (cross-check, never flags)")
+    ap.add_argument("--out", metavar="FILE", default=None,
+                    help="additionally write the verdict (indented) "
+                         "to FILE")
+    args = ap.parse_args(argv)
+
+    entries = load_bench_series(args.root)
+    candidate = None
+    if args.check:
+        try:
+            with open(args.check) as fh:
+                candidate = _extract_record(json.load(fh))
+        except (OSError, ValueError) as e:
+            print(json.dumps({"ok": False,
+                              "error": f"unreadable --check file: {e}"}))
+            return 2
+        if candidate is None:
+            print(json.dumps({"ok": False,
+                              "error": "--check file holds no bench "
+                                       "record"}))
+            return 2
+    if not entries and candidate is None:
+        print(json.dumps({"ok": False,
+                          "error": f"no BENCH_r*.json under "
+                                   f"{args.root!r}"}))
+        return 2
+
+    verdict = evaluate(entries, tolerance=args.tolerance,
+                       candidate=candidate)
+    if args.telemetry:
+        verdict["telemetry"] = load_telemetry_spans(
+            find_metrics_jsonl(args.telemetry))
+    # ONE line on stdout: harnesses parse it as a JSON line
+    print(json.dumps(verdict))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(verdict, fh, indent=1)
+    if (args.strict or candidate is not None) and not verdict["ok"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

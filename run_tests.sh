@@ -5,6 +5,8 @@
 #
 # With no arguments, also exercises the driver entry points
 # (__graft_entry__.py) on an 8-device virtual CPU mesh after the suite.
+# `./run_tests.sh --quick` runs the quick tier: the suite minus
+# slow-marked tests plus the telemetry + regress smokes.
 set -e
 # Hold a CPU-busy sentinel for the whole run so benchmarks/tunnel_watch.py
 # never launches a timed TPU session while the suite saturates the 1-core
@@ -17,6 +19,26 @@ trap 'rm -f "$BUSY_DIR/$$"' EXIT INT TERM
 run() {
     env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu "$@"
 }
+REPO="$(cd "$(dirname "$0")" && pwd)"
+if [ "$1" = "--quick" ]; then
+    # quick tier (<2 min): the suite minus the slow-marked fuzz
+    # harnesses and seed sweeps, plus the telemetry and regress-gate
+    # smokes — every layer still touched once. The smokes run even
+    # when pytest fails (a failing suite must not hide a broken
+    # telemetry schema); pytest's status is the tier's status.
+    shift
+    rc=0
+    run python -m pytest tests/ -q -m "not slow" "$@" || rc=$?
+    TELDIR="$(mktemp -d)"
+    trap 'rm -f "$BUSY_DIR/$$"; rm -rf "$TELDIR"' EXIT INT TERM
+    run python -m replication_of_minute_frequency_factor_tpu \
+        --telemetry-dir "$TELDIR"
+    run python -m replication_of_minute_frequency_factor_tpu.telemetry.validate \
+        "$TELDIR"
+    run python -m replication_of_minute_frequency_factor_tpu.telemetry.regress \
+        "$REPO"
+    exit $rc
+fi
 if [ "$#" -gt 0 ]; then
     # no exec: the EXIT trap must outlive pytest to drop the sentinel
     run python -m pytest -q "$@"
@@ -40,3 +62,8 @@ run python -m replication_of_minute_frequency_factor_tpu \
     --telemetry-dir "$TELDIR"
 run python -m replication_of_minute_frequency_factor_tpu.telemetry.validate \
     "$TELDIR"
+# regress smoke: the gate must parse the repo's own banked BENCH_r*.json
+# trajectory and emit its one-line verdict (report mode — historical
+# deviations are reported, only --strict/--check runs gate on them)
+run python -m replication_of_minute_frequency_factor_tpu.telemetry.regress \
+    "$REPO"

@@ -1,0 +1,336 @@
+"""Attribution layer (telemetry/attribution.py): crash-safe trace
+capture, trace post-processing, XLA compile/cost telemetry, and
+wall-clock reconciliation."""
+
+import gzip
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from replication_of_minute_frequency_factor_tpu.config import Config
+from replication_of_minute_frequency_factor_tpu.data.synthetic import (
+    synth_day)
+from replication_of_minute_frequency_factor_tpu.pipeline import (
+    compute_exposures)
+from replication_of_minute_frequency_factor_tpu.telemetry import (
+    Telemetry, get_telemetry, set_telemetry)
+from replication_of_minute_frequency_factor_tpu.telemetry import (
+    attribution as attr)
+
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures", "synthetic_trace.trace.json")
+
+NAMES = ("vol_return1min", "mmt_am", "liq_openvol")
+
+
+# --------------------------------------------------------------------------
+# reconcile
+# --------------------------------------------------------------------------
+
+
+def test_reconcile_fully_attributed_is_ok():
+    block = attr.reconcile(10.0, {"io": 4.0, "device": 6.0})
+    assert block["ok"]
+    assert block["unattributed_s"] == 0.0
+    assert block["attributed_s"] == 10.0
+
+
+def test_reconcile_flags_large_unattributed_residual():
+    block = attr.reconcile(10.0, {"io": 4.0})
+    assert not block["ok"]
+    assert block["unattributed_s"] == 6.0
+    assert block["unattributed_frac"] == 0.6
+    with pytest.raises(attr.ReconciliationError):
+        attr.reconcile(10.0, {"io": 4.0}, strict=True)
+
+
+def test_reconcile_overlap_is_reported_not_flagged():
+    """Pipelined stages legitimately sum past the wall; only MISSING
+    attribution is a measurement gap."""
+    block = attr.reconcile(10.0, {"io": 8.0, "device": 7.0})
+    assert block["ok"]
+    assert block["overlap_s"] == 5.0
+    assert block["unattributed_s"] == 0.0
+
+
+def test_reconcile_absolute_floor_tolerates_microruns():
+    # 50% unattributed but only 5 ms — interpreter slack, not a gap
+    assert attr.reconcile(0.010, {"io": 0.005})["ok"]
+    assert not attr.reconcile(10.0, {"io": 5.0})["ok"]
+
+
+def test_reconcile_drops_non_second_entries():
+    block = attr.reconcile(1.0, {"io": 1.0, "ingest_MB": 500.0,
+                                 "dispatch_floor_ms": 3.0, "ok": True})
+    assert set(block["stages"]) == {"io"}
+    assert block["ok"]
+
+
+# --------------------------------------------------------------------------
+# trace post-processing
+# --------------------------------------------------------------------------
+
+
+def test_classify_op_precedence():
+    assert attr.classify_op("all-reduce.3") == "collective"
+    assert attr.classify_op("fusion.123") == "fusion"
+    assert attr.classify_op("copy-start.2") == "infeed_outfeed"
+    assert attr.classify_op("copy.9") == "data_movement"
+    assert attr.classify_op("dynamic-update-slice.1") == "data_movement"
+    assert attr.classify_op("dot.5") == "matmul_conv"
+    assert attr.classify_op("frobnicate") == "other"
+
+
+def test_fixture_breakdown_per_op_class():
+    events, procs = attr.load_trace_events(FIXTURE)
+    assert procs == {1: "/device:TPU:0", 2: "/host:CPU"}
+    bd = attr.device_op_breakdown(events, procs)
+    assert bd["device_pids"] == ["/device:TPU:0"]
+    # host-side events (grid/factor_batch/python frames) must not count
+    assert bd["total_device_us"] == pytest.approx(255.0)
+    assert bd["by_class_us"] == pytest.approx({
+        "fusion": 150.0, "data_movement": 40.0, "collective": 20.0,
+        "matmul_conv": 40.0, "other": 5.0})
+    # instance suffixes aggregate per op
+    top = {row["op"]: row["us"] for row in bd["top_ops_us"]}
+    assert top["fusion"] == pytest.approx(150.0)
+
+
+def test_fixture_stage_annotations():
+    events, _ = attr.load_trace_events(FIXTURE)
+    totals = attr.stage_annotation_totals(events)
+    assert totals == {"grid": 500.0, "factor_batch": 300.0}
+
+
+def test_load_trace_events_gz_and_summarize(tmp_path):
+    with open(FIXTURE) as fh:
+        doc = fh.read()
+    gz = tmp_path / "cap" / "host.trace.json.gz"
+    gz.parent.mkdir()
+    with gzip.open(gz, "wt") as fh:
+        fh.write(doc)
+    summary = attr.summarize_trace_dir(str(tmp_path / "cap"))
+    assert summary["files"] == 1
+    assert summary["events"] == 12
+    assert summary["device_breakdown"]["total_device_us"] == \
+        pytest.approx(255.0)
+
+
+def test_unreadable_trace_file_is_empty_not_fatal(tmp_path):
+    bad = tmp_path / "bad.trace.json"
+    bad.write_text("{not json")
+    events, procs = attr.load_trace_events(str(bad))
+    assert events == [] and procs == {}
+
+
+# --------------------------------------------------------------------------
+# TraceCapture
+# --------------------------------------------------------------------------
+
+
+def _trace_files(root):
+    return [os.path.join(r, f) for r, _, fs in os.walk(root) for f in fs]
+
+
+def test_trace_capture_writes_nonempty_dir(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    tel = Telemetry(annotate_spans=False)
+    pdir = str(tmp_path / "cap")
+    with attr.TraceCapture(pdir, telemetry=tel):
+        jax.block_until_ready(jax.jit(lambda x: x * 2)(jnp.ones(8)))
+    assert _trace_files(pdir), "no trace files captured"
+    assert tel.registry.counter_value("attribution.trace_captures") == 1
+
+
+def test_trace_capture_stops_on_body_exception(tmp_path):
+    """The crash-safety contract: stop_trace runs (and the trace lands
+    on disk) even when the body raises — and the exception propagates
+    unmasked."""
+    import jax
+    import jax.numpy as jnp
+
+    tel = Telemetry(annotate_spans=False)
+    pdir = str(tmp_path / "cap")
+    with pytest.raises(ValueError, match="boom"):
+        with attr.TraceCapture(pdir, telemetry=tel):
+            jax.block_until_ready(jax.jit(lambda x: x + 1)(jnp.ones(4)))
+            raise ValueError("boom")
+    assert _trace_files(pdir), "crash path must still flush the trace"
+    # a second capture must work (the profiler was really stopped)
+    with attr.TraceCapture(str(tmp_path / "cap2"), telemetry=tel):
+        pass
+    assert tel.registry.counter_value(
+        "attribution.trace_stop_failures") == 0
+
+
+def test_trace_capture_none_dir_is_noop():
+    tel = Telemetry(annotate_spans=False)
+    with attr.TraceCapture(None, telemetry=tel) as tc:
+        assert not tc.active
+    assert tel.registry.counter_value("attribution.trace_captures") == 0
+
+
+def test_trace_capture_attributes_its_own_cost(tmp_path):
+    tel = Telemetry(annotate_spans=False)
+    timer = tel.stage_timer()
+    with attr.TraceCapture(str(tmp_path / "cap"), telemetry=tel,
+                           timer=timer):
+        pass
+    assert timer.totals().get("trace_capture", 0.0) > 0.0
+
+
+# --------------------------------------------------------------------------
+# pipeline integration (satellite: the old pipeline start_trace had no
+# stop on failure paths)
+# --------------------------------------------------------------------------
+
+
+def _write_days(tmp_path, rng, n=3):
+    d = tmp_path / "kline"
+    d.mkdir()
+    for i in range(n):
+        ds = str(np.datetime64("2024-01-02") + i)
+        cols = synth_day(rng, n_codes=6, date=ds, missing_prob=0.05)
+        arrays = {"code": pa.array([str(c) for c in cols["code"]]),
+                  "time": pa.array(cols["time"])}
+        for k in ("open", "high", "low", "close", "volume"):
+            arrays[k] = pa.array(cols[k])
+        pq.write_table(pa.table(arrays),
+                       str(d / (ds.replace("-", "") + ".parquet")))
+    return str(d)
+
+
+def test_pipeline_trace_nonempty_and_reconciled(tmp_path, rng):
+    """Acceptance shape: a pipeline run with profile_dir set leaves a
+    non-empty trace AND a reconciliation block whose stage terms cover
+    the wall within tolerance (unattributed_s explicit)."""
+    md = _write_days(tmp_path, rng)
+    pdir = str(tmp_path / "trace")
+    tel = Telemetry(annotate_spans=False)
+    t = compute_exposures(
+        md, NAMES, cfg=Config(days_per_batch=2, profile_dir=pdir),
+        progress=False, telemetry=tel)
+    assert _trace_files(pdir), "profile_dir produced no trace files"
+    block = t.reconciliation
+    assert block["ok"], block
+    assert "unattributed_s" in block
+    # the capture's own cost is a named stage, not a residual
+    assert "trace_capture" in block["stages"]
+    assert tel.registry.counter_value("attribution.trace_captures") == 1
+
+
+def test_pipeline_trace_stopped_on_abort(tmp_path, rng, monkeypatch):
+    """A mid-run abort (wedged device, circuit breaker) must still stop
+    the trace and flush it to disk — the failure-mode capture is the
+    one that matters most."""
+    import replication_of_minute_frequency_factor_tpu.pipeline as pl
+
+    md = _write_days(tmp_path, rng)
+    pdir = str(tmp_path / "trace")
+
+    def explode(*a, **kw):
+        raise RuntimeError("synthetic device wedge")
+
+    monkeypatch.setattr(pl, "_run_device_pipeline", explode)
+    with pytest.raises(RuntimeError, match="synthetic device wedge"):
+        compute_exposures(
+            md, NAMES, cfg=Config(days_per_batch=2, profile_dir=pdir),
+            progress=False, telemetry=Telemetry(annotate_spans=False))
+    assert _trace_files(pdir), "abort path dropped the trace"
+    # the profiler must really be stopped: a fresh capture succeeds
+    tel2 = Telemetry(annotate_spans=False)
+    with attr.TraceCapture(str(tmp_path / "t2"), telemetry=tel2):
+        pass
+    assert tel2.registry.counter_value(
+        "attribution.trace_start_failures") == 0
+
+
+# --------------------------------------------------------------------------
+# XLA compile / cost telemetry
+# --------------------------------------------------------------------------
+
+
+def test_compile_with_telemetry_records_cost_and_size():
+    import jax
+    import jax.numpy as jnp
+
+    tel = Telemetry(annotate_spans=False)
+    lowered = jax.jit(lambda x: jnp.tanh(x @ x).sum()).lower(
+        jnp.ones((16, 16)))
+    compiled = attr.compile_with_telemetry("toy", lowered, telemetry=tel)
+    assert compiled(jnp.ones((16, 16))).shape == ()
+    reg = tel.registry
+    assert reg.counter_value("xla.compiles", fn="toy") == 1
+    st = reg.histogram_stats("xla.compile_seconds", fn="toy")
+    assert st and st["count"] == 1 and st["sum"] > 0
+    assert reg.gauge_value("xla.hlo_module_bytes", fn="toy") > 0
+    # cost_analysis on CPU reports flops + bytes accessed for this graph
+    assert reg.gauge_value("xla.flops", fn="toy") > 0
+    assert reg.gauge_value("xla.bytes_accessed", fn="toy") > 0
+    # the event ties them together for the JSONL stream
+    assert any(e["name"] == "xla_compile" and e["data"]["fn"] == "toy"
+               for e in tel._events)
+
+
+def test_install_compile_listeners_feed_current_telemetry():
+    """jax.monitoring durations land in whatever telemetry is current
+    AT FIRE TIME (there is no listener-removal API, so the hook must
+    not capture an instance)."""
+    import jax
+    import jax.numpy as jnp
+
+    assert attr.install_compile_listeners()
+    assert attr.install_compile_listeners()  # idempotent
+    prev = get_telemetry()
+    tel = set_telemetry(Telemetry(annotate_spans=False))
+    try:
+        # a shape this suite never compiles elsewhere -> fresh compile
+        jax.block_until_ready(
+            jax.jit(lambda x: (x * 3 + 1).sum())(jnp.ones((7, 13))))
+    finally:
+        set_telemetry(prev)
+    st = tel.registry.histogram_stats("xla.backend_compile_seconds")
+    assert st and st["count"] >= 1
+
+
+def test_xla_summary_lands_in_manifest(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    tel = Telemetry(annotate_spans=False)
+    attr.compile_with_telemetry(
+        "m", jax.jit(lambda x: x * 2).lower(jnp.ones(4)), telemetry=tel)
+    tel.registry.observe("xla.backend_compile_seconds", 0.25)
+    tel.registry.counter("xla.compilation_cache", outcome="hit")
+    paths = tel.write(str(tmp_path / "out"))
+    with open(paths["manifest"]) as fh:
+        manifest = json.load(fh)
+    xla = manifest["xla"]
+    assert xla["backend_compiles"] == 1
+    assert xla["compilation_cache"] == {"hits": 1, "misses": 0}
+    assert any(k.startswith("xla.compile_seconds{fn=m}")
+               for k in xla["per_jit"])
+
+
+def test_build_report_embeds_trace_summary(tmp_path):
+    import shutil
+
+    cap = tmp_path / "cap"
+    cap.mkdir()
+    shutil.copy(FIXTURE, cap / "x.trace.json")
+    report = attr.build_report({"io": 1.0, "device": 8.5}, wall_s=10.0,
+                               profile_dir=str(cap))
+    assert report["schema"] == attr.REPORT_SCHEMA
+    assert report["reconciliation"]["ok"]
+    assert report["trace"]["files"] == 1
+    assert report["trace"]["device_breakdown"]["by_class_us"]["fusion"] \
+        == pytest.approx(150.0)
+    out = attr.write_report(str(tmp_path / "attribution.json"), report)
+    with open(out) as fh:
+        assert json.load(fh)["reconciliation"]["ok"]

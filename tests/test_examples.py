@@ -10,9 +10,13 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.mark.parametrize("script", ["quickstart.py",
-                                    "advanced_evaluation.py",
-                                    "symbolic_search.py"])
+@pytest.mark.parametrize("script", [
+    "quickstart.py",
+    "advanced_evaluation.py",
+    # the symbolic-search example is an evolution seed sweep (~1 min on
+    # the 1-core host) — slow tier, like the search suite's sweeps
+    pytest.param("symbolic_search.py", marks=pytest.mark.slow),
+])
 def test_example_runs(script, tmp_path):
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     env["PYTHONPATH"] = os.pathsep.join(

@@ -9,7 +9,15 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the harness subprocess re-compiles the differential kernels from
+# scratch on one CPU core — minutes, not seconds, so the fuzz
+# regression smoke lives in the slow tier (full suite / nightly), not
+# in tier-1 or run_tests.sh --quick
+pytestmark = pytest.mark.slow
 
 
 def run_harness(name, lo, hi, timeout=400):

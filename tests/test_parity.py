@@ -445,6 +445,7 @@ def test_parity_degenerate_codes(rng):
         "degenerate", noisy=True)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", [0, 5, 10, 11])
 def test_parity_kitchen_sink(seed):
     rng = np.random.default_rng(seed)
@@ -454,6 +455,7 @@ def test_parity_kitchen_sink(seed):
         f"sink{seed}", noisy=True)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", [116, 120, 206, 217, 218, 330, 739, 781,
                                   850, 982, 6223, 7024, 7164])
 def test_parity_boundary_regressions(seed):
@@ -509,6 +511,7 @@ def run_wide_scenario_seed(seed, label=None):
         _compare(synth_day(rng, **kw), label, noisy=True)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("seed", [30044, 30202, 30658, 31069, 32461,
                                   32796, 32811])
 def test_parity_wide_scenario_regressions(seed):

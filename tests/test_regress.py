@@ -1,0 +1,220 @@
+"""Bench-series regression gate (telemetry/regress.py): trajectory
+parsing, methodology-keyed baselines, stage-level diffs, and the CLI
+exit-code contract."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from replication_of_minute_frequency_factor_tpu.telemetry import regress
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+INJECTED = os.path.join(HERE, "fixtures", "regress_injected")
+
+
+def _write_series(root, values, metric="toy_wall", methodology="mA",
+                  stages=None, start=1):
+    for i, v in enumerate(values):
+        rec = {"metric": metric, "value": v, "unit": "s"}
+        if methodology is not None:
+            rec["methodology"] = methodology
+        if stages is not None:
+            rec["stages"] = stages[i]
+        doc = {"n": start + i, "parsed": rec}
+        with open(os.path.join(root, f"BENCH_r{start + i:02d}.json"),
+                  "w") as fh:
+            json.dump(doc, fh)
+
+
+def _cli(*args):
+    p = subprocess.run(
+        [sys.executable, "-m",
+         "replication_of_minute_frequency_factor_tpu.telemetry.regress",
+         *args],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    lines = [ln for ln in p.stdout.splitlines() if ln.startswith("{")]
+    verdict = json.loads(lines[-1]) if lines else None
+    return p.returncode, verdict
+
+
+# --------------------------------------------------------------------------
+# loading / grouping
+# --------------------------------------------------------------------------
+
+
+def test_load_bench_series_wrapper_bare_and_tail(tmp_path):
+    with open(tmp_path / "BENCH_r01.json", "w") as fh:
+        json.dump({"n": 1, "parsed": {"metric": "m", "value": 1.0}}, fh)
+    with open(tmp_path / "BENCH_r02.json", "w") as fh:
+        json.dump({"metric": "m", "value": 2.0}, fh)
+    with open(tmp_path / "BENCH_r03.json", "w") as fh:
+        json.dump({"n": 3, "rc": 0,
+                   "tail": 'noise\n{"metric": "m", "value": 3.0}\n'}, fh)
+    entries = regress.load_bench_series(str(tmp_path))
+    assert [e["record"]["value"] for e in entries] == [1.0, 2.0, 3.0]
+
+
+def test_stale_carry_is_not_a_data_point(tmp_path):
+    """The CPU-fallback record embeds the last TPU headline under
+    stale_tpu_headline; the gate must never count it as a record."""
+    with open(tmp_path / "BENCH_r01.json", "w") as fh:
+        json.dump({"n": 1, "parsed": {
+            "metric": "m_cpu", "value": 600.0,
+            "stale_tpu_headline": {"metric": "m", "value": 148.0}}}, fh)
+    entries = regress.load_bench_series(str(tmp_path))
+    assert len(entries) == 1
+    assert entries[0]["record"]["metric"] == "m_cpu"
+
+
+def test_legacy_records_join_the_stream_series():
+    assert regress.effective_methodology({}) == "r4_stream_v2"
+    assert regress.effective_methodology(
+        {"methodology": "r5_resident_v1"}) == "r5_resident_v1"
+
+
+# --------------------------------------------------------------------------
+# evaluation semantics
+# --------------------------------------------------------------------------
+
+
+def test_gate_flags_injected_regression_with_stage_diff():
+    entries = regress.load_bench_series(INJECTED)
+    verdict = regress.evaluate(entries)
+    assert not verdict["ok"]
+    (g,) = verdict["groups"]
+    assert g["flagged"] and g["deviation_pct"] == pytest.approx(10.0)
+    # the diff points at WHERE the time moved: compute grew ~+10 s
+    top = g["stage_diff"][0]
+    assert top["stage"] == "compute"
+    assert top["delta_s"] == pytest.approx(10.0)
+
+
+def test_gate_quiet_within_tolerance(tmp_path):
+    _write_series(str(tmp_path), [100.0, 102.0, 99.0, 101.0])
+    verdict = regress.evaluate(regress.load_bench_series(str(tmp_path)))
+    assert verdict["ok"]
+    assert verdict["groups"][0]["flagged"] is False
+
+
+def test_declared_methodology_break_stays_quiet(tmp_path):
+    """A 30% jump under a NEW methodology value is a declared series
+    break — its group has no baseline, so nothing flags."""
+    _write_series(str(tmp_path), [100.0, 101.0, 99.0])
+    with open(tmp_path / "BENCH_r04.json", "w") as fh:
+        json.dump({"n": 4, "parsed": {"metric": "toy_wall",
+                                      "value": 130.0,
+                                      "methodology": "mB"}}, fh)
+    verdict = regress.evaluate(regress.load_bench_series(str(tmp_path)))
+    assert verdict["ok"]
+    # the mA series' own latest (99.0 vs median 100.5) is in-band
+    assert all(not g["flagged"] for g in verdict["groups"])
+
+
+def test_undeclared_break_is_flagged(tmp_path):
+    """The same 30% jump WITHOUT a methodology change must flag — this
+    is exactly the smeared-series failure the gate exists to catch."""
+    _write_series(str(tmp_path), [100.0, 101.0, 99.0, 130.0])
+    verdict = regress.evaluate(regress.load_bench_series(str(tmp_path)))
+    assert not verdict["ok"]
+
+
+def test_candidate_mode_gates_against_full_series(tmp_path):
+    _write_series(str(tmp_path), [100.0, 102.0, 98.0])
+    entries = regress.load_bench_series(str(tmp_path))
+    good = {"metric": "toy_wall", "value": 101.0, "methodology": "mA"}
+    bad = {"metric": "toy_wall", "value": 120.0, "methodology": "mA"}
+    assert regress.evaluate(entries, candidate=good)["ok"]
+    assert not regress.evaluate(entries, candidate=bad)["ok"]
+    # a candidate opening a NEW series is a declared break: reported,
+    # never flagged
+    fresh = {"metric": "toy_wall", "value": 500.0, "methodology": "mZ"}
+    v = regress.evaluate(entries, candidate=fresh)
+    assert v["ok"] and v["groups"][0]["n_baseline"] == 0
+
+
+def test_faster_is_also_a_deviation(tmp_path):
+    """|deviation| gates both directions: an unexplained 20% SPEEDUP is
+    a methodology smell (or a silent workload change), not a win to
+    bank quietly."""
+    _write_series(str(tmp_path), [100.0, 101.0, 99.0, 80.0])
+    verdict = regress.evaluate(regress.load_bench_series(str(tmp_path)))
+    assert not verdict["ok"]
+    assert verdict["groups"][0]["deviation_pct"] < 0
+
+
+# --------------------------------------------------------------------------
+# telemetry JSONL cross-check
+# --------------------------------------------------------------------------
+
+
+def test_telemetry_span_folding(tmp_path):
+    mdir = tmp_path / "tel"
+    mdir.mkdir()
+    with open(mdir / "metrics.jsonl", "w") as fh:
+        fh.write(json.dumps({
+            "schema": 1, "ts": 0, "kind": "histogram",
+            "name": "span_seconds", "labels": {"span": "device"},
+            "count": 4, "sum": 8.0, "min": 1.0, "max": 3.0,
+            "p50": 2.0, "p95": 3.0}) + "\n")
+        fh.write(json.dumps({
+            "schema": 1, "ts": 0, "kind": "counter", "name": "x",
+            "labels": {}, "value": 1}) + "\n")
+    found = regress.find_metrics_jsonl(str(tmp_path))
+    tel = regress.load_telemetry_spans(found)
+    assert tel["files"] == 1
+    assert tel["spans"]["device"]["p50_s"] == 2.0
+
+
+# --------------------------------------------------------------------------
+# CLI contract (acceptance criteria)
+# --------------------------------------------------------------------------
+
+
+def test_cli_reports_banked_series_and_exits_zero():
+    """`python -m ...telemetry.regress .` over the repo's own banked
+    BENCH_r0*.json series: reports the r05-vs-band deviation with a
+    stage-level diff, exit 0 (report mode)."""
+    rc, verdict = _cli(REPO)
+    assert rc == 0
+    assert verdict["records"] >= 5
+    fallback = [g for g in verdict["groups"]
+                if g["metric"].endswith("_cpu_fallback_tunnel_down")]
+    assert fallback, verdict
+    g = fallback[0]
+    assert g["latest_source"] == "BENCH_r05.json"
+    assert g["methodology"] == "r4_stream_v2"
+    # r05 (649.0) vs the r01-r04 band: the drift the VERDICT called out
+    assert g["flagged"] and g["deviation_pct"] > 5.0
+    assert g["stage_diff"], "flagged group must carry a stage diff"
+
+
+def test_cli_exits_nonzero_on_injected_fixture_strict():
+    rc, verdict = _cli(INJECTED, "--strict")
+    assert rc == 1
+    assert not verdict["ok"]
+    assert verdict["flagged"][0]["metric"] == "toy_wall"
+
+
+def test_cli_check_mode_gates_candidate(tmp_path):
+    cand = tmp_path / "candidate.json"
+    with open(cand, "w") as fh:
+        json.dump({"metric": "toy_wall", "value": 120.0,
+                   "methodology": "fixture_v1"}, fh)
+    rc, verdict = _cli(INJECTED, "--check", str(cand))
+    assert rc == 1 and not verdict["ok"]
+    with open(cand, "w") as fh:
+        json.dump({"metric": "toy_wall", "value": 100.5,
+                   "methodology": "fixture_v1"}, fh)
+    rc, verdict = _cli(INJECTED, "--check", str(cand))
+    assert rc == 0 and verdict["ok"]
+
+
+def test_cli_no_input_exits_two(tmp_path):
+    rc, verdict = _cli(str(tmp_path))
+    assert rc == 2
+    assert not verdict["ok"]

@@ -46,6 +46,7 @@ def test_describe_roundtrip():
     assert s.startswith("mean(") and s.count("(") == s.count(")")
 
 
+@pytest.mark.slow  # GA generations on one core: an evolution seed sweep
 def test_evolve_recovers_planted_signal(day_batch, rng):
     bars, mask = day_batch
     # forward return = cross-sectional signal proportional to mean intrabar
@@ -227,6 +228,7 @@ def test_agg_primitives_and_composition(day_batch):
     assert s == "mean((std(id(ret)[pos]) / std(id(ret))))"
 
 
+@pytest.mark.slow  # GA generations on one core: an evolution seed sweep
 def test_rich_skeleton_recovers_planted_upratio(day_batch, rng):
     """Plant a vol_upRatio-shaped forward return; the GA on the
     ratio-of-aggregates skeleton must find a high-IC program

@@ -349,3 +349,42 @@ def test_cli_telemetry_dir_end_to_end(tmp_path, capsys):
     assert recs[0]["payload"]["run_kind"] == "synthetic_pipeline"
     with open(os.path.join(d, "trace.json")) as fh:
         assert json.load(fh)["traceEvents"]
+
+
+def test_cli_profile_dir_produces_trace_and_attribution(tmp_path, capsys):
+    """`--telemetry-dir DIR --profile-dir PDIR` (the acceptance-
+    criterion invocation): non-empty profiler trace, an attribution
+    report whose stage terms cover the wall within tolerance
+    (unattributed_s explicit), and compile metrics in JSONL+manifest."""
+    from replication_of_minute_frequency_factor_tpu.__main__ import main
+
+    d = str(tmp_path / "tel")
+    pdir = str(tmp_path / "prof")
+    prev = get_telemetry()
+    import jax
+    jax.clear_caches()  # earlier tests compiled these shapes already;
+    # the compile-metrics assertions below need a real compile to fire
+    try:
+        rc = main(["--telemetry-dir", d, "--profile-dir", pdir])
+    finally:
+        set_telemetry(prev)
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["reconciliation_ok"] is True
+    found = [os.path.join(r, f) for r, _, fs in os.walk(pdir) for f in fs]
+    assert found, "profile dir is empty"
+    with open(os.path.join(d, "attribution.json")) as fh:
+        report = json.load(fh)
+    block = report["reconciliation"]
+    assert block["ok"] and "unattributed_s" in block
+    assert report["trace"]["files"] >= 1
+    assert report["trace"]["events"] > 0
+    # compile telemetry reached the stream and the manifest
+    with open(os.path.join(d, "metrics.jsonl")) as fh:
+        recs = [json.loads(ln) for ln in fh if ln.strip()]
+    hists = {r["name"] for r in recs if r["kind"] == "histogram"}
+    assert "xla.backend_compile_seconds" in hists
+    with open(os.path.join(d, "manifest.json")) as fh:
+        assert json.load(fh)["xla"]["backend_compiles"] >= 1
+    report2 = validate_dir(d)
+    assert report2["ok"], report2["problems"]
