@@ -130,6 +130,13 @@ class _NullTimer:
         return contextlib.nullcontext()
 
 
+#: every _count_sync call-site label, for the per-point measured
+#: breakdown in the headline record's ``round_trips``
+_SYNC_POINTS = ("resident_ingest", "resident_compute", "resident_fetch",
+                "stream_lagged_fetch", "stream_drain_fetch",
+                "stream_consolidated_fetch")
+
+
 def _count_sync(point: str) -> None:
     """Count one host-BLOCKING device sync (block_until_ready or a
     materializing np.asarray) in the registry. The headline's
@@ -146,6 +153,22 @@ def _encode_kind_marks() -> dict:
     reg = get_telemetry().registry
     return {k: reg.counter_value("bench.encode_kind", kind=k)
             for k in ("wire", "raw")}
+
+
+def _rolling_impl_resolved(requested: str):
+    """What the rolling engine actually traced with this process
+    (ops/rolling counts every trace-time resolution in the registry):
+    'conv'/'pallas'/'pallas_interpret', 'mixed' if retraces diverged,
+    None if no graph traced. requested='pallas' resolving to 'conv' is
+    the off-TPU fallback — the record must say so, or a pallas A/B
+    could silently bank conv numbers under the pallas name."""
+    reg = get_telemetry().registry
+    seen = [r for r in ("conv", "pallas", "pallas_interpret")
+            if reg.counter_value("rolling.impl", requested=requested,
+                                 resolved=r) > 0]
+    if not seen:
+        return None
+    return seen[0] if len(seen) == 1 else "mixed"
 
 
 def _encode_kind_delta(before: dict) -> str:
@@ -523,12 +546,19 @@ def main():
     group = int(os.environ.get("BENCH_RESIDENT_GROUP", "0")) or iters
     warm_info: dict = {}
 
+    class _ResidentOOM(RuntimeError):
+        """Resident scan still OOMs at group == 1 — signal for the
+        stream-mode fallback below (ADVICE r5: re-raising here lost the
+        hardware window with nothing banked)."""
+
     def _warm_resident(group):
         """Compile + first-execute the resident scan graph on DISTINCT
         warm bytes (same caching rationale as the stream warmup), full
         fetch included so every path the timed run takes is warm. OOM
         halves ``group`` (smaller scan groups shrink the resident
-        input + output footprint) down to single-batch groups."""
+        input + output footprint) down to single-batch groups; an OOM
+        at group == 1 raises ``_ResidentOOM`` so the caller can fall
+        back to the stream loop instead of losing the window."""
         wb = [make_batch(rng, n_days=days) for _ in range(iters)]
         while True:
             try:
@@ -542,8 +572,10 @@ def main():
                 oom = any(s in str(e) for s in
                           ("RESOURCE_EXHAUSTED", "Out of memory",
                            "out of memory"))
-                if not oom or group <= 1:
+                if not oom:
                     raise
+                if group <= 1:
+                    raise _ResidentOOM(str(e)[:300]) from e
                 group = max(1, group // 2)
                 print(f"# resident scan exhausted device memory; "
                       f"retrying with group={group}",
@@ -573,8 +605,21 @@ def main():
                 jax.block_until_ready(jnp.concatenate(refs, axis=1))
 
     if mode == "resident":
-        group = _warm_resident(group)
-    else:
+        try:
+            group = _warm_resident(group)
+        except _ResidentOOM as e:
+            # even single-batch scan groups exhaust HBM: keep the
+            # hardware window and bank a STREAM number at the proven
+            # 8-day shape instead of re-raising with nothing recorded
+            # (ADVICE r5); the record's mode/methodology fields flip
+            # with it, so the number can never be read as resident
+            print("# resident scan OOM at group=1; falling back to "
+                  "stream mode at the proven 8-day shape",
+                  file=sys.stderr, flush=True)
+            mode = "stream"
+            warm_info["resident_oom_fallback"] = str(e)[:200]
+            days, iters = 8, max(iters, 5)
+    if mode == "stream":
         try:
             _warm(days)
         except Exception as e:  # noqa: BLE001 — filtered to OOM below
@@ -633,7 +678,8 @@ def main():
         # span_seconds{span=...} histogram — the BENCH series and the
         # pipeline's telemetry can no longer drift apart (they are the
         # same records)
-        t = get_telemetry().stage_timer()
+        t = get_telemetry().stage_timer(
+            rolling_impl=get_config().rolling_impl)
         with t("synth_batch"):
             b, m = make_batch(np.random.default_rng(99), n_days=8)
         sbuf, sspec, skind = encode_pack(b, m, t)  # wire_encode + pack
@@ -645,10 +691,18 @@ def main():
         # 32-day warmup never compiled, so its 116 s "device_compute"
         # folded remote compile + cache handling into "compute" and
         # contradicted the ~3 ms graph time the ladder measures).
+        # compile_with_telemetry stamps compile seconds, FLOPs/bytes and
+        # the HLO op-count fingerprint (while/dot/gather counts — the
+        # "fori_loop is gone" evidence) into the registry, so a
+        # BENCH_TELEMETRY_DIR bundle's manifest carries them.
+        from replication_of_minute_frequency_factor_tpu.telemetry import (
+            attribution as _attr)
         roll = get_config().rolling_impl
         with t("compile"):
-            compiled = _compute_packed_jit.lower(
-                dbuf, sspec, skind, names, True, roll).compile()
+            compiled = _attr.compile_with_telemetry(
+                "bench_packed_8day",
+                _compute_packed_jit.lower(
+                    dbuf, sspec, skind, names, True, roll))
         # Per-dispatch fixed cost on a trivial resident graph: if this
         # floor is seconds-scale, the sweep's ~12 s/round-trip term is
         # transport DISPATCH overhead (not graph time, not bandwidth) —
@@ -719,6 +773,9 @@ def main():
     # from encode_year/encode_pack's registry counter
     reg = get_telemetry().registry
     syncs_before = reg.counter_total("bench.host_blocking_syncs")
+    syncs_before_by_point = {
+        p: reg.counter_value("bench.host_blocking_syncs", point=p)
+        for p in _SYNC_POINTS}
     kind_before = _encode_kind_marks()
     phases = None
     # one-shot resident-path driver artifact on the CPU fallback
@@ -811,9 +868,21 @@ def main():
     # the ACTUAL number of host-blocking sync points the timed loop hit,
     # counted at the call sites (ADVICE r5 low #4: the old per-branch
     # formulas under-counted the stream drain and the resident
-    # group-level blocks)
+    # group-level blocks), with a per-point breakdown so each branch's
+    # blocking profile is auditable (stream non-consolidated: iters-2
+    # lagged blocks + 2 drain blocks; resident: 1 ingest + 1 compute +
+    # ceil(iters/group) fetches). puts_async/executes/fetches above
+    # remain LOOP-SHAPE PREDICTIONS, marked as such in the record.
     round_trips["host_blocking_syncs"] = int(
         reg.counter_total("bench.host_blocking_syncs") - syncs_before)
+    round_trips["host_blocking_syncs_by_point"] = {
+        p: int(reg.counter_value("bench.host_blocking_syncs", point=p)
+               - syncs_before_by_point[p])
+        for p in _SYNC_POINTS
+        if reg.counter_value("bench.host_blocking_syncs", point=p)
+        - syncs_before_by_point[p]}
+    round_trips["predicted_fields"] = ["puts_async", "executes",
+                                       "fetches"]
     encode_kind = _encode_kind_delta(kind_before)
     full_year = per_batch * (TRADING_DAYS_PER_YEAR / days)
 
@@ -865,14 +934,27 @@ def main():
         "iters": iters,
         "consolidated_fetch": consolidate,
         # loop methodology (VERDICT r4 #3: series breaks must be
-        # explicit): "resident" = r5's O(1)-round-trip year (encode ->
+        # explicit): "resident" = the O(1)-round-trip year (encode ->
         # N async puts -> scan execute(s) -> single fetch pass);
-        # "stream" = the r1-r4 double-buffered per-batch loop (the CPU
-        # fallback pins stream/8-day/2-iter for series continuity).
+        # "stream" = the double-buffered per-batch loop (the CPU
+        # fallback pins stream/8-day/2-iter shape). r6 DECLARES a new
+        # series for both modes: the fused rolling engine (the
+        # fori_loop-of-roll second moments became one gather+Gram-dot
+        # pass) changes device compute on every backend, and the
+        # packed/resident buffers are now donated on accelerators —
+        # r5_resident_v1/r4_stream_v2 numbers are not comparable.
         # docs/BENCHMARKS.md records the series history.
         "mode": mode,
-        "methodology": ("r5_resident_v1" if mode == "resident"
-                        else "r4_stream_v2"),
+        "methodology": ("r6_resident_v2" if mode == "resident"
+                        else "r6_stream_v3"),
+        # which rolling backend was REQUESTED (config) and which one
+        # the graphs actually RESOLVED to at trace time (registry
+        # counter; 'conv' under a 'pallas' request = the off-TPU
+        # fallback fired); the per-stage span histograms carry the
+        # requested value as their rolling_impl tag
+        "rolling_impl": get_config().rolling_impl,
+        "rolling_impl_resolved": _rolling_impl_resolved(
+            get_config().rolling_impl),
         "phases": phases,
         # sum(components) vs the timed wall, residual explicit — the
         # telemetry.regress gate diffs these across rounds

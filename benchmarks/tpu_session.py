@@ -120,8 +120,13 @@ def drop_conv_only_rolling(steps):
     entry from an older code/configuration must not satisfy this
     round's step (the carry would skip it forever):
 
-    * 'rolling'/'pallas' entries belong to the step removed with the
-      Pallas kernel (round 4 prove-or-drop) — never carried;
+    * 'rolling' entries belong to the step removed with the round-4
+      Pallas prove-or-drop — never carried;
+    * 'pallas' entries must be records of the REINTRODUCED kernel
+      (ISSUE 3): a ``rolling_impl: pallas`` 5000-ticker record under
+      the ``_pallas`` metric suffix. Pre-reintroduction green entries
+      (the dropped r2-r4 step shared the name) have none of those
+      fields and drop — they re-run under the new contract;
     * 'headc' entries belong to the r4 consolidated-fetch A/B, which
       the r5 resident loop supersedes — never carried;
     * 'headline' entries must be the r5 resident methodology (a
@@ -137,8 +142,15 @@ def drop_conv_only_rolling(steps):
     """
     def keep(name, v):
         recs = [r for r in v.get("results") or [] if isinstance(r, dict)]
-        if name in ("rolling", "pallas", "headc"):
+        if name in ("rolling", "headc"):
             return False  # steps removed in r4/r5
+        if name == "pallas":
+            # rolling_impl_resolved (not just requested): a record whose
+            # graphs silently fell back to conv is NOT kernel validation
+            return any("_pallas" in str(r.get("metric", ""))
+                       and r.get("rolling_impl") == "pallas"
+                       and r.get("rolling_impl_resolved") == "pallas"
+                       and r.get("tickers") == 5000 for r in recs)
         if name == "headline":
             return any(r.get("mode") == "resident"
                        and r.get("tickers") == 5000 for r in recs)
@@ -207,6 +219,26 @@ def step_stream():
     return _run_bench_gated({"BENCH_MODE": "stream",
                              "BENCH_METRIC_SUFFIX": "_stream",
                              "BENCH_STAGES": "0", "BENCH_LINK": "0"})
+
+
+def step_pallas():
+    """The rolling Pallas VMEM kernel vs the fused conv path, SAME
+    hardware window as the headline (ISSUE 3: the reintroduced kernel
+    must not linger hardware-unvalidated). Runs the resident headline
+    workload with ``MFF_ROLLING_IMPL=pallas`` under its own metric
+    suffix; the stage pass stays ON so the record carries the pallas
+    graph's compile telemetry + HLO op counts and a profiler capture
+    (``MFF_PROFILE_DIR``) for the per-op-class before/after that
+    docs/BENCHMARKS.md §Round-6 leaves pending. Interpret-mode parity
+    is gated in tier-1 (tests/test_parity.py, ``pallas`` marker); this
+    step is the hardware half: a compile/runtime failure here records
+    loudly, and rolling.impl{requested=pallas,resolved=conv} in the
+    bundle would expose a silent fallback."""
+    return _run_bench_gated({"MFF_ROLLING_IMPL": "pallas",
+                             "BENCH_METRIC_SUFFIX": "_pallas",
+                             "BENCH_LINK": "0",
+                             "MFF_PROFILE_DIR": os.path.join(
+                                 REPO, ".bench_data", "profile_pallas")})
 
 
 def step_ladder():
@@ -303,7 +335,10 @@ def main():
     # diagnostics, the stream-loop series continuation, then the
     # four ladder configs cheapest-first, parity spot-check, the
     # batch-size sweep, and the long real-pipeline run last
-    ap.add_argument("--steps", default="headline,link,stream,"
+    # pallas rides directly behind the headline: the conv-vs-pallas A/B
+    # is only meaningful inside ONE window, and the kernel's hardware
+    # validation is this round's must-bank evidence (ISSUE 3)
+    ap.add_argument("--steps", default="headline,pallas,link,stream,"
                     "lad1,lad2,lad4,lad5,spot,sweep,pipeline")
     ap.add_argument("--one-step", default=None,
                     help="internal: run one step's body in-process and "
@@ -370,7 +405,7 @@ def main():
     steps = {"headline": step_headline, "ladder": step_ladder,
              "spot": step_graph_spotcheck, "sweep": step_sweep,
              "link": step_link, "pipeline": step_pipeline,
-             "stream": step_stream,
+             "stream": step_stream, "pallas": step_pallas,
              "lad1": _step_ladder_one("1"), "lad2": _step_ladder_one("2"),
              "lad4": _step_ladder_one("4"), "lad5": _step_ladder_one("5")}
     want = [s.strip() for s in args.steps.split(",") if s.strip()]

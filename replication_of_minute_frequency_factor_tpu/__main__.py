@@ -54,8 +54,14 @@ def _add_compute(sub: "argparse._SubParsersAction") -> None:
                    help="execution backend: jax (device), numpy "
                         "(f64 oracle), polars (the reference's own "
                         "kernels; slow, differential use)")
-    p.add_argument("--rolling-impl", choices=("conv",),
-                   default=None)
+    p.add_argument("--rolling-impl",
+                   choices=("conv", "pallas", "pallas_interpret"),
+                   default=None,
+                   help="mmt_ols_* rolling backend: conv (fused XLA "
+                        "formulation), pallas (VMEM-resident TPU "
+                        "kernel, auto-falls back to conv off-TPU), "
+                        "pallas_interpret (interpreter; CPU-safe "
+                        "parity checks)")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler trace here")
     p.add_argument("--retry-failed", action="store_true",

@@ -42,10 +42,21 @@ class Config:
     #: debug sanitizer: validate day tensors (finite prices, high>=low,
     #: volume>=0 on valid lanes) before compute; raises DayDataError
     debug_validate: bool = False
-    #: rolling-moment backend for the mmt_ols_* family; 'conv' (the
-    #: XLA formulation) is the only value — the seam stays for a
-    #: future kernel (docs/ROADMAP.md, pallas prove-or-drop)
+    #: rolling-moment backend for the mmt_ols_* family
+    #: (ops/rolling.ROLLING_IMPLS): 'conv' — the fused XLA formulation
+    #: (trailing windows gathered once, second moments as one batched
+    #: Gram dot); 'pallas' — VMEM-resident Pallas TPU kernel for the
+    #: second-moment pass, auto-falls back to 'conv' off-TPU;
+    #: 'pallas_interpret' — the same kernel on the Pallas interpreter
+    #: (CPU-safe; parity tests)
     rolling_impl: str = "conv"
+    #: donate freshly-transferred device input buffers (packed day
+    #: batches, wire arrays, the resident scan's buffer year) to their
+    #: consuming executables so XLA reuses their HBM for decode
+    #: intermediates/outputs — cuts the peak footprint that OOM'd
+    #: days_per_batch=32; only applied on accelerator backends (CPU
+    #: PJRT ignores donation with a warning)
+    donate_buffers: bool = True
     #: index-pool membership parquet enabling cal_final_exposure's
     #: stock_pool= (data/io.py read_stock_pool); None keeps the
     #: reference's only-'full' behaviour (quirk Q9)
@@ -93,6 +104,9 @@ class Config:
             cfg.days_per_batch = int(os.environ["MFF_DAYS_PER_BATCH"])
         if "MFF_REPLICATE_QUIRKS" in os.environ:
             cfg.replicate_quirks = os.environ["MFF_REPLICATE_QUIRKS"] not in (
+                "0", "false", "False")
+        if "MFF_DONATE_BUFFERS" in os.environ:
+            cfg.donate_buffers = os.environ["MFF_DONATE_BUFFERS"] not in (
                 "0", "false", "False")
         if "MFF_COMPILE_TELEMETRY" in os.environ:
             cfg.compile_telemetry = os.environ["MFF_COMPILE_TELEMETRY"] \

@@ -139,12 +139,23 @@ class DayContext:
             return r.reshape(v.shape)
         return self._get("eod_grank", f)
 
+    #: the mmt_ols_* family's window length in trade minutes (reference
+    #: ``period='50i'``) — shared by every rolling backend
+    ROLLING_WINDOW = 50
+
     @property
     def rolling50(self):
-        """Windowed (low, high) regression stats, window=50 trade minutes."""
+        """Windowed (low, high) regression stats over
+        :data:`ROLLING_WINDOW` trade minutes — the single largest shared
+        intermediate in the fused factor graph (all five mmt_ols_*
+        kernels read it). ``self.rolling_impl`` picks the backend
+        (ops/rolling.ROLLING_IMPLS); validity and windowed means are
+        bit-identical across backends, only the second moments are
+        backend-computed."""
         return self._get(
             "rolling50",
-            lambda: rolling_window_stats(self.low, self.high, self.mask, 50,
+            lambda: rolling_window_stats(self.low, self.high, self.mask,
+                                         self.ROLLING_WINDOW,
                                          impl=self.rolling_impl))
 
     @property
@@ -167,11 +178,13 @@ class DayContext:
         arithmetic — e.g. the dropped bar's (low, high) coincides with
         the added bar's, fuzz seed 739 — the f64 oracle computes std==0
         and takes the degenerate branch of ``mmt_ols_qrs``/
-        ``mmt_ols_beta_zscore_last``, while f32 conv round-off yields a
-        tiny nonzero std whose z-scores are pure noise amplification. A
-        sub-resolution std asserts a spread f32 cannot distinguish, so
-        reporting 0 is the honest value (and matches the oracle's
-        branch)."""
+        ``mmt_ols_beta_zscore_last``, while f32 round-off (conv and
+        pallas backends alike) yields a tiny nonzero std whose z-scores
+        are pure noise amplification. A sub-resolution std asserts a
+        spread f32 cannot distinguish, so reporting 0 is the honest
+        value (and matches the oracle's branch); the snap is
+        backend-independent, which is why the seed-739 pin must hold
+        under every ``rolling_impl``."""
         def f():
             st = self.rolling50
             valid, beta = st["valid"], self.rolling_beta

@@ -73,7 +73,8 @@ def compute_factors(bars, mask, names: Optional[Sequence[str]] = None,
     Pure function of ``(bars [..., T, 240, 5], mask [..., T, 240])``;
     returns ``{name: [..., T]}``. Trace it under jit via
     :func:`compute_factors_jit`. ``rolling_impl`` picks the mmt_ols_*
-    backend ('conv' is the only one); keep it explicit under jit — a None falls
+    backend (``ops.rolling.ROLLING_IMPLS``: 'conv', 'pallas',
+    'pallas_interpret'); keep it explicit under jit — a None falls
     back to the config value *at trace time*, which the jit cache key
     cannot see.
     """

@@ -18,9 +18,17 @@ stability on TPU.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 _NAN = jnp.nan
+
+
+def cummax_last(a):
+    """Running max along the last axis (``jnp.maximum.accumulate``
+    semantics; that ufunc method does not exist on this jax, and
+    ``lax.cummax`` rejects negative axes)."""
+    return jax.lax.cummax(a, axis=a.ndim - 1)
 
 
 def count(mask):
@@ -156,7 +164,7 @@ def ffill(x, mask):
     """
     L = x.shape[-1]
     idx = jnp.arange(L)
-    last_valid = jnp.maximum.accumulate(jnp.where(mask, idx, -1), axis=-1)
+    last_valid = cummax_last(jnp.where(mask, idx, -1))
     has_prev = last_valid >= 0
     filled = jnp.take_along_axis(x, jnp.maximum(last_valid, 0), axis=-1)
     return jnp.where(has_prev, filled, _NAN), has_prev
@@ -178,7 +186,7 @@ def shift_valid(x, mask, periods: int = 1):
     if periods > 0:
         if periods != 1:
             raise NotImplementedError("only |periods| <= 1 supported")
-        last_valid = jnp.maximum.accumulate(jnp.where(mask, idx, -1), axis=-1)
+        last_valid = cummax_last(jnp.where(mask, idx, -1))
         # previous valid index *strictly before* lane i
         prev = jnp.concatenate(
             [jnp.full(last_valid.shape[:-1] + (1,), -1, last_valid.dtype),

@@ -16,6 +16,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .masked import cummax_last
+
 _NAN = jnp.nan
 
 
@@ -26,14 +28,13 @@ def _group_bounds(new_group):
     """
     L = new_group.shape[-1]
     idx = jnp.arange(L)
-    start = jnp.maximum.accumulate(jnp.where(new_group, idx, -1), axis=-1)
+    start = cummax_last(jnp.where(new_group, idx, -1))
     # end of my group = (next group's start) - 1; compute via reversed scan
     is_end = jnp.concatenate(
         [new_group[..., 1:], jnp.ones(new_group.shape[:-1] + (1,), bool)],
         axis=-1)
     rev = is_end[..., ::-1]
-    nearest_end_rev = jnp.maximum.accumulate(
-        jnp.where(rev, jnp.arange(L), -1), axis=-1)
+    nearest_end_rev = cummax_last(jnp.where(rev, jnp.arange(L), -1))
     end = (L - 1 - nearest_end_rev)[..., ::-1]
     return start, end
 

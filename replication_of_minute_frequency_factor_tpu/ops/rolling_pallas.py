@@ -1,0 +1,123 @@
+"""Pallas TPU kernel for the rolling second-moment pass (VMEM-resident).
+
+The fused conv formulation (:func:`.rolling._second_moments_conv`) asks
+XLA to fuse a ``[..., L, W]`` window gather into one Gram reduction; on
+TPU that fusion's intermediate traffic is at XLA's discretion. This
+kernel removes the discretion: one row-block of the day tensor is loaded
+into VMEM once and ALL ``window`` shifted accumulations run against that
+resident tile — the 50-term second-moment accumulation never touches HBM
+between steps.
+
+Scope is deliberately the second moments only: counts, windowed sums and
+means stay on the shared conv path (:mod:`.rolling`), so ``valid`` /
+``mean_*`` / ``mu`` are bit-identical across every backend and the
+parity surface of this kernel is exactly the three Gram sums.
+
+History: a VMEM rolling kernel was carried rounds 2-4 and dropped under
+the round-3 prove-or-drop deadline because no tunnel window ever ran it
+on hardware. This reintroduction ships differently: interpret-mode CPU
+tests gate parity on every tier-1 run (``tests/test_parity.py``,
+``pallas`` marker), production use auto-falls back to conv off-TPU, and
+the attribution layer (PR 2) stamps which backend ran into every
+manifest — so the kernel cannot linger hardware-unvalidated or silently
+claim wins it never produced.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+#: rows per VMEM tile: 5 resident [BLOCK_ROWS, 240] f32 arrays (2 inputs,
+#: 3 accumulators) plus shift temporaries stay ~1.5 MB, far under the
+#: ~16 MB/core VMEM budget, while a 240-lane tile keeps the VPU fed
+BLOCK_ROWS = 128
+
+
+def available() -> bool:
+    """Whether the Pallas TPU lowering path is importable here."""
+    try:
+        from jax.experimental import pallas  # noqa: F401
+        from jax.experimental.pallas import tpu  # noqa: F401
+        return True
+    except Exception:  # noqa: BLE001 — absence is a supported state
+        return False
+
+
+def _shift_right(a, j: int):
+    """out[..., m] = a[..., m-j], zero-filled on the left edge (the same
+    only-pollutes-invalid-windows contract as the conv path's padding)."""
+    if j == 0:
+        return a
+    L = a.shape[-1]
+    pad = [(0, 0)] * (a.ndim - 1) + [(j, 0)]
+    return jnp.pad(a[..., :L - j], pad)
+
+
+def _moment_kernel(window: int, xc_ref, yc_ref, mux_ref, muy_ref,
+                   sxx_ref, syy_ref, sxy_ref):
+    """One [block, L] tile: Σ_j d_j², Σ_j e_j², Σ_j d_j·e_j with
+    d_j = shift(xc, j) - μ_x. The j-loop is unrolled at trace time
+    (``window`` is static) and every operand is VMEM-resident."""
+    xc = xc_ref[...]
+    yc = yc_ref[...]
+    mu_x = mux_ref[...]
+    mu_y = muy_ref[...]
+    s_xx = jnp.zeros_like(xc)
+    s_yy = jnp.zeros_like(xc)
+    s_xy = jnp.zeros_like(xc)
+    for j in range(window):
+        d = _shift_right(xc, j) - mu_x
+        e = _shift_right(yc, j) - mu_y
+        s_xx = s_xx + d * d
+        s_yy = s_yy + e * e
+        s_xy = s_xy + d * e
+    sxx_ref[...] = s_xx
+    syy_ref[...] = s_yy
+    sxy_ref[...] = s_xy
+
+
+def second_moments(xc, yc, mu_x, mu_y, window: int,
+                   interpret: bool = False,
+                   block_rows: int = BLOCK_ROWS):
+    """VMEM-resident ``(s_xx, s_yy, s_xy)`` for day-centred inputs.
+
+    Inputs are the conv path's own centred series and window means
+    (``[..., L]`` each, any leading shape); outputs match. ``interpret``
+    runs the identical kernel on the Pallas interpreter — CPU-safe, the
+    parity-test path. Leading dims flatten to rows; rows pad up to the
+    grid's block multiple and the pad rows are sliced back off (their
+    zero inputs produce zeros — never read).
+    """
+    from jax.experimental import pallas as pl
+
+    xc = jnp.asarray(xc)
+    yc = jnp.asarray(yc)
+    lead, L = xc.shape[:-1], xc.shape[-1]
+    dt = xc.dtype if jnp.issubdtype(xc.dtype, jnp.floating) else jnp.float32
+    rows = 1
+    for n in lead:
+        rows *= n
+    flat = []
+    for a in (xc, yc, mu_x, mu_y):
+        flat.append(jnp.asarray(a, dt).reshape((rows, L)))
+    block = max(8, min(block_rows, rows))  # >=8 sublanes for f32 tiles
+    pad = (-rows) % block
+    if pad:
+        flat = [jnp.pad(a, ((0, pad), (0, 0))) for a in flat]
+    grid = ((rows + pad) // block,)
+    spec = pl.BlockSpec((block, L), lambda i: (i, 0))
+    shape = jax.ShapeDtypeStruct((rows + pad, L), dt)
+    s_xx, s_yy, s_xy = pl.pallas_call(
+        functools.partial(_moment_kernel, window),
+        grid=grid,
+        in_specs=[spec] * 4,
+        out_specs=[spec] * 3,
+        out_shape=[shape] * 3,
+        interpret=interpret,
+    )(*flat)
+    return (s_xx[:rows].reshape(lead + (L,)),
+            s_yy[:rows].reshape(lead + (L,)),
+            s_xy[:rows].reshape(lead + (L,)))

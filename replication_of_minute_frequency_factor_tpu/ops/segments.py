@@ -21,9 +21,10 @@ from __future__ import annotations
 
 from typing import Tuple
 
+import jax
 import jax.numpy as jnp
 
-from .masked import masked_kurtosis, masked_skew
+from .masked import cummax_last, masked_kurtosis, masked_skew
 
 _NAN = jnp.nan
 
@@ -55,7 +56,7 @@ def _sorted_segments(values, weights, mask):
 
     cumw = jnp.cumsum(sw, axis=-1)
     idx = jnp.arange(L)
-    start = jnp.maximum.accumulate(jnp.where(new_group, idx, -1), axis=-1)
+    start = cummax_last(jnp.where(new_group, idx, -1))
     prev_cum = jnp.where(
         start > 0,
         jnp.take_along_axis(cumw, jnp.maximum(start - 1, 0), axis=-1),

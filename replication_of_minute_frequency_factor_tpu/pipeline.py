@@ -32,14 +32,22 @@ from .data.minute import grid_day
 from .models.registry import compute_factors, compute_factors_jit, factor_names
 
 
-@functools.partial(jax.jit, static_argnames=("names", "replicate_quirks",
-                                             "rolling_impl"))
-def _compute_from_wire_jit(base, dclose, dohl, volume, maskbits, vol_scale,
-                           names, replicate_quirks, rolling_impl):
+def _compute_from_wire_fn(base, dclose, dohl, volume, maskbits, vol_scale,
+                          names, replicate_quirks, rolling_impl):
     bars, m = wire.decode(base, dclose, dohl, volume, maskbits, vol_scale)
     return compute_factors(bars, m, names=names,
                            replicate_quirks=replicate_quirks,
                            rolling_impl=rolling_impl)
+
+
+_WIRE_STATIC = ("names", "replicate_quirks", "rolling_impl")
+_compute_from_wire_jit = functools.partial(
+    jax.jit, static_argnames=_WIRE_STATIC)(_compute_from_wire_fn)
+#: donated twin (accelerator backends): the six wire arrays die at the
+#: on-device decode, so their HBM becomes scratch for the factor graph
+_compute_from_wire_jit_donated = functools.partial(
+    jax.jit, static_argnames=_WIRE_STATIC,
+    donate_argnums=tuple(range(6)))(_compute_from_wire_fn)
 
 
 def _compute_from_wire(base, dclose, dohl, volume, maskbits, vol_scale,
@@ -47,19 +55,20 @@ def _compute_from_wire(base, dclose, dohl, volume, maskbits, vol_scale,
     """Fused on-device wire-decode + all-factor graph (one XLA module).
 
     A None ``rolling_impl`` resolves the config value before the jit
-    boundary so the choice is always part of the cache key."""
+    boundary so the choice is always part of the cache key. The wire
+    arrays (freshly ``wire.put`` by the caller, no other owner) are
+    donated on accelerator backends — see ``_donate_device_buffers``."""
     if rolling_impl is None:
         rolling_impl = get_config().rolling_impl
-    return _compute_from_wire_jit(base, dclose, dohl, volume, maskbits,
-                                  vol_scale, names, replicate_quirks,
-                                  rolling_impl)
+    fn = (_compute_from_wire_jit_donated if _donate_device_buffers()
+          else _compute_from_wire_jit)
+    return fn(base, dclose, dohl, volume, maskbits,
+              vol_scale, names, replicate_quirks,
+              rolling_impl)
 
 
-@functools.partial(jax.jit, static_argnames=("spec", "kind", "names",
-                                             "replicate_quirks",
-                                             "rolling_impl"))
-def _compute_packed_jit(buf, spec, kind, names, replicate_quirks,
-                        rolling_impl):
+def _compute_packed(buf, spec, kind, names, replicate_quirks,
+                    rolling_impl):
     """Single-buffer variant of the fused graph: ONE uint8 input (unpacked
     by static-offset bitcasts on device) and ONE stacked ``[F, ...]``
     output, so a batch costs one transfer each way over the tunnel instead
@@ -77,17 +86,48 @@ def _compute_packed_jit(buf, spec, kind, names, replicate_quirks,
     return jnp.stack([out[n] for n in names])
 
 
+_PACKED_STATIC = ("spec", "kind", "names", "replicate_quirks",
+                  "rolling_impl")
+_compute_packed_jit = functools.partial(
+    jax.jit, static_argnames=_PACKED_STATIC)(_compute_packed)
+#: donated twin: the multi-MB packed day buffer is dead the moment the
+#: on-device unpack reads it, so donating it lets XLA reuse its HBM for
+#: the decode intermediates / output instead of holding both footprints
+#: live — the lever that fits days_per_batch=32 on the 16 GB chip
+_compute_packed_jit_donated = functools.partial(
+    jax.jit, static_argnames=_PACKED_STATIC,
+    donate_argnums=(0,))(_compute_packed)
+
+
+def _donate_device_buffers(cfg: Optional["Config"] = None) -> bool:
+    """Whether to route packed launches through the donated executables:
+    gated by ``Config.donate_buffers`` AND an accelerator backend — CPU
+    PJRT ignores donation with a per-compile warning, so tests and the
+    oracle paths stay on the plain twins."""
+    cfg = cfg or get_config()
+    if not cfg.donate_buffers:
+        return False
+    try:
+        return jax.default_backend() in ("tpu", "gpu")
+    except Exception:  # noqa: BLE001 — backend init can fail late
+        return False
+
+
 def compute_packed_prepared(buf, spec, kind, names, replicate_quirks=True,
                             rolling_impl=None):
     """Device half of the packed path: one device_put of an already-packed
     buffer -> fused graph -> stacked [len(names), D, T] result (still on
     device). The streaming pipeline packs on its producer thread and
     calls this from the consumer, so the multi-MB host concatenate
-    overlaps device compute."""
+    overlaps device compute. On accelerator backends the freshly-put
+    device buffer is DONATED to the graph (see
+    ``_compute_packed_jit_donated``) — it has no other owner."""
     if rolling_impl is None:
         rolling_impl = get_config().rolling_impl
-    return _compute_packed_jit(jax.device_put(buf), spec, kind, names,
-                               replicate_quirks, rolling_impl)
+    fn = (_compute_packed_jit_donated if _donate_device_buffers()
+          else _compute_packed_jit)
+    return fn(jax.device_put(buf), spec, kind, names,
+              replicate_quirks, rolling_impl)
 
 
 def compute_packed(arrays, kind, names, replicate_quirks=True,
@@ -98,11 +138,8 @@ def compute_packed(arrays, kind, names, replicate_quirks=True,
                                    replicate_quirks, rolling_impl)
 
 
-@functools.partial(jax.jit, static_argnames=("spec", "kind", "names",
-                                             "replicate_quirks",
-                                             "rolling_impl"))
-def _compute_packed_scan_jit(bufs, spec, kind, names, replicate_quirks,
-                             rolling_impl):
+def _compute_packed_scan(bufs, spec, kind, names, replicate_quirks,
+                         rolling_impl):
     """Device-resident multi-batch variant: a whole year of packed
     buffers in ONE executable.
 
@@ -138,17 +175,34 @@ def _compute_packed_scan_jit(bufs, spec, kind, names, replicate_quirks,
     return ys  # [N, F, D, T]
 
 
+_compute_packed_scan_jit = functools.partial(
+    jax.jit, static_argnames=_PACKED_STATIC)(_compute_packed_scan)
+#: donated twin: the year of resident packed buffers is the scan's only
+#: HBM-scale input and each buffer dies after its scan step consumes it;
+#: donation hands that whole region back to XLA for the scan carry /
+#: [N, F, D, T] accumulator instead of pinning input + output footprints
+#: simultaneously (the days_per_batch=32 OOM the r5 warmup kept hitting)
+_compute_packed_scan_jit_donated = functools.partial(
+    jax.jit, static_argnames=_PACKED_STATIC,
+    donate_argnums=(0,))(_compute_packed_scan)
+
+
 def compute_packed_resident(dbufs, spec, kind, names,
                             replicate_quirks=True, rolling_impl=None):
     """Run N device-resident packed buffers through one fused scan
     executable; returns the stacked [N, F, D, T] result STILL ON DEVICE
     (callers fetch once). ``dbufs``: tuple of device uint8 buffers that
     all share ``spec`` (encode with a shared widen-only ``floor`` to
-    guarantee that; see bench.py's encode_year)."""
+    guarantee that; see bench.py's encode_year). On accelerator
+    backends (``Config.donate_buffers``) the buffers are DONATED — they
+    are dead to the caller after this call; re-``device_put`` fresh ones
+    rather than reusing a donated handle."""
     if rolling_impl is None:
         rolling_impl = get_config().rolling_impl
-    return _compute_packed_scan_jit(tuple(dbufs), spec, kind, names,
-                                    replicate_quirks, rolling_impl)
+    fn = (_compute_packed_scan_jit_donated if _donate_device_buffers()
+          else _compute_packed_scan_jit)
+    return fn(tuple(dbufs), spec, kind, names,
+              replicate_quirks, rolling_impl)
 from .telemetry import Telemetry, TraceCapture, get_telemetry
 from .telemetry import attribution as _attribution
 from .utils.logging import get_logger, FailureReport
@@ -985,8 +1039,10 @@ def compute_exposures(
     failures = FailureReport()
     tel = telemetry if telemetry is not None else get_telemetry()
     # a StageTimer keeps Timer's per-run totals (``.timings``) AND feeds
-    # every stage into the telemetry span tracer + histograms
-    timer = tel.stage_timer()
+    # every stage into the telemetry span tracer + histograms; the
+    # rolling_impl label on every per-stage histogram lets attribution
+    # output say which rolling backend a run's device time belongs to
+    timer = tel.stage_timer(rolling_impl=cfg.rolling_impl)
     parts: List[ExposureTable] = []
     # crash-safe capture window: the old bare start_trace here had no
     # stop on the failure paths (an abort between here and the happy

@@ -52,15 +52,21 @@ class StageTimer(Timer):
     profiler annotation, trace export) plus a
     ``span_seconds{span=io}`` histogram observation, while
     ``totals()``/``report()`` keep their per-run Timer meaning for
-    existing callers (``ExposureTable.timings``)."""
+    existing callers (``ExposureTable.timings``).
 
-    def __init__(self, telemetry: "Telemetry"):
+    Constructor ``labels`` attach to every stage's ``span_seconds``
+    histogram observation (e.g. ``rolling_impl=conv``) so attribution
+    output can say which backend/configuration a stage's time belongs
+    to; the span name, totals and trace export stay label-free."""
+
+    def __init__(self, telemetry: "Telemetry", **labels):
         super().__init__()
         self._tel = telemetry
+        self._labels = labels
 
     @contextlib.contextmanager
     def __call__(self, name: str):
-        with self._tel.tracer(name):
+        with self._tel.tracer(name, **self._labels):
             t0 = time.perf_counter()
             try:
                 yield
@@ -95,8 +101,10 @@ class Telemetry:
     def span(self, name: str):
         return self.tracer(name)
 
-    def stage_timer(self) -> StageTimer:
-        return StageTimer(self)
+    def stage_timer(self, **labels) -> StageTimer:
+        """A :class:`StageTimer` on this telemetry; ``labels`` tag every
+        stage's ``span_seconds`` histogram observation."""
+        return StageTimer(self, **labels)
 
     def event(self, name: str, **data) -> None:
         """Free-form structured event (bounded retention)."""

@@ -400,6 +400,28 @@ def _first_cost_dict(cost) -> dict:
     return cost or {}
 
 
+#: HLO/StableHLO ops whose counts tell the loop-shape story of a
+#: compiled module: ``while`` = a sequential fori_loop/scan survived
+#: into the graph; ``dot_general``/``convolution``/``gather`` = the
+#: fused single-pass formulations. The rolling-engine acceptance gate
+#: ("the 50-iteration fori_loop is GONE") reads these counts from the
+#: run manifest instead of trusting the source
+_HLO_COUNTED_OPS = ("while", "dot_general", "convolution", "gather",
+                    "reduce", "sort")
+_HLO_OP_RE = re.compile(
+    r"\b(?:stablehlo|mhlo)\.(" + "|".join(_HLO_COUNTED_OPS) + r")\b")
+
+
+def hlo_op_counts(hlo_text: str) -> Dict[str, int]:
+    """Counts of the loop-shape-relevant ops in a lowered module's
+    StableHLO/MHLO text (``lowered.as_text()``). Ops absent from the
+    module report 0 — "no ``while``" is the finding, not a missing key."""
+    counts = {op: 0 for op in _HLO_COUNTED_OPS}
+    for m in _HLO_OP_RE.finditer(hlo_text or ""):
+        counts[m.group(1)] += 1
+    return counts
+
+
 def compile_with_telemetry(label: str, lowered, telemetry=None):
     """AOT-compile a ``jax.jit(...).lower(...)`` result, recording
     per-jit compile telemetry into the registry:
@@ -413,6 +435,9 @@ def compile_with_telemetry(label: str, lowered, telemetry=None):
       from ``cost_analysis()`` (absent keys recorded as nothing, not 0);
     * ``xla.generated_code_bytes{fn=label}`` /
       ``xla.temp_bytes{fn=label}`` from ``memory_analysis()``;
+    * ``xla.hlo_op_count{fn=label,op=...}`` gauges (:func:`hlo_op_counts`)
+      — the loop-shape fingerprint of the module (a nonzero ``while``
+      means a sequential loop survived into the graph);
     * an ``xla_compile`` event tying them together.
 
     Returns the compiled executable. Telemetry failures never fail the
@@ -420,9 +445,12 @@ def compile_with_telemetry(label: str, lowered, telemetry=None):
     """
     tel = _tel(telemetry)
     try:
-        hlo_bytes = len(lowered.as_text())
+        hlo_text = lowered.as_text()
+        hlo_bytes = len(hlo_text)
+        op_counts = hlo_op_counts(hlo_text)
     except Exception:  # noqa: BLE001 — diagnostics only
         hlo_bytes = None
+        op_counts = None
     t0 = time.perf_counter()
     compiled = lowered.compile()
     dt = time.perf_counter() - t0
@@ -433,6 +461,10 @@ def compile_with_telemetry(label: str, lowered, telemetry=None):
             tel.gauge("xla.hlo_module_bytes", hlo_bytes, fn=label)
         detail = {"fn": label, "seconds": round(dt, 4),
                   "hlo_module_bytes": hlo_bytes}
+        if op_counts is not None:
+            for op, n in op_counts.items():
+                tel.gauge("xla.hlo_op_count", n, fn=label, op=op)
+            detail["hlo_op_counts"] = op_counts
         try:
             cost = _first_cost_dict(compiled.cost_analysis())
         except Exception:  # noqa: BLE001

@@ -67,7 +67,12 @@ class SpanTracer:
             yield
 
     @contextlib.contextmanager
-    def __call__(self, name: str):
+    def __call__(self, name: str, **labels):
+        """Extra ``labels`` ride on the ``span_seconds`` histogram
+        observation only (e.g. ``rolling_impl=``, so per-stage
+        histograms say which backend a stage's time belongs to); the
+        span name, totals and trace export are label-free — attribution
+        joins on the bare name."""
         self._tls.depth = depth = self._depth() + 1
         t0 = time.perf_counter()
         try:
@@ -91,7 +96,8 @@ class SpanTracer:
                 else:
                     self.dropped_spans += 1
             if self.registry is not None:
-                self.registry.observe("span_seconds", dt, span=name)
+                self.registry.observe("span_seconds", dt, span=name,
+                                      **labels)
 
     # --- Timer parity ---------------------------------------------------
     def totals(self) -> Dict[str, float]:

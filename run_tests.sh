@@ -37,6 +37,12 @@ if [ "$1" = "--quick" ]; then
         "$TELDIR"
     run python -m replication_of_minute_frequency_factor_tpu.telemetry.regress \
         "$REPO"
+    # rolling-parity smoke (ISSUE 3): the fused conv path AND the Pallas
+    # interpret-mode kernel vs the f64 reference on two seeds incl. the
+    # constant-window degenerate pin — one JSON line, nonzero on drift
+    run python -c "import json; \
+from replication_of_minute_frequency_factor_tpu.ops.rolling import _smoke; \
+print(json.dumps(_smoke()))"
     exit $rc
 fi
 if [ "$#" -gt 0 ]; then

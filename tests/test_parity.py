@@ -398,14 +398,19 @@ def _lazy(build):
     return get
 
 
-def _compare(day, label, noisy=False):
+def _compare(day, label, noisy=False, rolling_impl=None):
+    """``rolling_impl`` pins the mmt_ols_* backend for the jax side
+    (None = the config default, 'conv'): the same comparator protocol
+    gates every backend, so the Pallas interpret path faces the full
+    f64-oracle sweep rather than a private softer one."""
     df = pd.DataFrame(day)
     oracle = compute_oracle(df).set_index("code")
     beta_degenerate, beta_num_scale = _degenerate_beta_codes(df)
     g = grid_day(day["code"], day["time"], day["open"], day["high"],
                  day["low"], day["close"], day["volume"])
     jax_out = {k: np.asarray(v)
-               for k, v in compute_factors_jit(g.bars, g.mask).items()}
+               for k, v in compute_factors_jit(
+                   g.bars, g.mask, rolling_impl=rolling_impl).items()}
     assert set(jax_out) == set(factor_names())
 
     failures = []
@@ -619,6 +624,160 @@ def test_quirk_aliases(rng):
     assert not np.allclose(fixed["mmt_bottom20VolumeRet"],
                            out["mmt_bottom50VolumeRet"])
     assert not np.allclose(fixed["doc_vol50_ratio"], out["doc_vol5_ratio"])
+
+
+# ---------------------------------------------------------------------------
+# Rolling-engine parity (ISSUE 3): the fused conv formulation and the Pallas
+# interpret-mode kernel vs a per-window f64 oracle, on fuzz-seeded masked
+# price panels — including the constant-window degenerate pin and the
+# seed-739 equal-beta std==0 branch.
+# ---------------------------------------------------------------------------
+
+from replication_of_minute_frequency_factor_tpu.ops.rolling import (  # noqa: E402
+    _f64_reference, rolling_window_stats)
+
+#: same closeness contract as the factor-level sweep (RTOL default / ATOL
+#: default above): the rolling stats are the mmt_ols_* family's inputs
+ROLLING_RTOL = 2e-3
+ROLLING_ATOL = 1e-6
+ROLLING_SWEEP_SEEDS = (0, 7, 739, 4242, 31069)
+
+
+def _rolling_case(seed):
+    """Fuzz-seeded (low, high, mask) panel: tick-rounded prices, one
+    full-coverage row, one constant row (the degenerate pin's case), one
+    short-coverage row, and a seed-dependent missing-bar rate."""
+    rng = np.random.default_rng(seed)
+    shape = (4, 240)
+    close = 10.0 * np.exp(np.cumsum(
+        rng.standard_normal(shape) * 1e-3, axis=-1))
+    low = np.round(close * (1 - rng.random(shape) * 2e-3), 2)
+    high = np.round(low * (1 + rng.random(shape) * 4e-3), 2)
+    mask = rng.random(shape) > float(rng.choice([0.02, 0.15, 0.5]))
+    mask[0] = True
+    low[1] = low[1, 0]
+    high[1] = high[1, 0]
+    mask[1] = True
+    mask[2, :60] = False
+    return low.astype(np.float32), high.astype(np.float32), mask
+
+
+def _rolling_stats(low, high, mask, impl):
+    return {k: np.asarray(v) for k, v in rolling_window_stats(
+        jax.numpy.asarray(low), jax.numpy.asarray(high),
+        jax.numpy.asarray(mask), 50, impl=impl).items()}
+
+
+def _assert_rolling_close(st, ref, label):
+    np.testing.assert_array_equal(st["valid"], ref["valid"],
+                                  err_msg=f"{label}: valid mask")
+    v = ref["valid"]
+    for k in ("mean_x", "mean_y", "cov", "var_x", "var_y"):
+        np.testing.assert_allclose(
+            st[k][v], ref[k][v], rtol=ROLLING_RTOL, atol=ROLLING_ATOL,
+            err_msg=f"{label}: {k}")
+
+
+@pytest.mark.parametrize("seed", ROLLING_SWEEP_SEEDS)
+def test_rolling_conv_parity_sweep(seed):
+    """The fused conv path (windows gathered once + one Gram dot — the
+    formulation that replaced the 50-pass fori_loop) vs the f64 oracle."""
+    low, high, mask = _rolling_case(seed)
+    ref = _f64_reference(low, high, mask, 50)
+    st = _rolling_stats(low, high, mask, "conv")
+    _assert_rolling_close(st, ref, f"conv{seed}")
+    # constant row under the default degenerate pin: exactly-zero var
+    assert float(np.max(np.where(ref["valid"][1], st["var_x"][1], 0.0))) \
+        == 0.0
+
+
+@pytest.mark.pallas
+@pytest.mark.parametrize("seed", ROLLING_SWEEP_SEEDS)
+def test_rolling_pallas_interpret_parity_sweep(seed):
+    """The Pallas kernel (interpret mode — CPU-safe) must pass the SAME
+    f64-oracle sweep as conv, and agree with conv far tighter than
+    either agrees with f64 (both consume identical centred inputs and
+    window means; only the accumulation order differs)."""
+    low, high, mask = _rolling_case(seed)
+    ref = _f64_reference(low, high, mask, 50)
+    conv = _rolling_stats(low, high, mask, "conv")
+    pal = _rolling_stats(low, high, mask, "pallas_interpret")
+    _assert_rolling_close(pal, ref, f"pallas{seed}")
+    v = conv["valid"]
+    np.testing.assert_array_equal(pal["valid"], conv["valid"])
+    for k in ("mean_x", "mean_y"):  # shared conv path: bit-identical
+        np.testing.assert_array_equal(pal[k], conv[k])
+    for k in ("cov", "var_x", "var_y"):
+        np.testing.assert_allclose(pal[k][v], conv[k][v],
+                                   rtol=1e-5, atol=1e-9,
+                                   err_msg=f"pallas-vs-conv {k}")
+
+
+@pytest.mark.pallas
+def test_rolling_constant_window_pin_both_impls():
+    """The constant_window pin holds on every backend: degenerate ->
+    exactly-zero var on a constant full-coverage window; noise -> f32
+    accumulation decides (strictly positive)."""
+    from replication_of_minute_frequency_factor_tpu import pins
+
+    x = np.full((1, 240), 0.1, np.float32)
+    m = np.ones((1, 240), bool)
+    for impl in ("conv", "pallas_interpret"):
+        st = _rolling_stats(x, x, m, impl)
+        assert float(np.max(np.where(st["valid"], st["var_x"], 0.0))) \
+            == 0.0, impl
+    with pins.pinned(constant_window="noise"):
+        for impl in ("conv", "pallas_interpret"):
+            st = _rolling_stats(x, x, m, impl)
+            assert float(np.max(np.where(st["valid"], st["var_x"],
+                                         0.0))) > 0.0, impl
+
+
+@pytest.mark.pallas
+def test_beta_std_snap_backend_independent():
+    """The seed-739 pin's production half: windows whose betas are equal
+    in exact arithmetic must report beta std EXACTLY 0 (the f32
+    sub-resolution snap in context.beta_moments) under every
+    rolling_impl — the oracle's degenerate branch is then taken on both
+    sides regardless of backend accumulation order."""
+    from replication_of_minute_frequency_factor_tpu.models.context import (
+        DayContext)
+
+    bars = np.zeros((1, 240, 5), np.float32)
+    bars[..., 0] = 10.0   # open
+    bars[..., 1] = 10.02  # high
+    bars[..., 2] = 9.98   # low
+    bars[..., 3] = 10.0   # close
+    bars[..., 4] = 100.0  # volume
+    mask = np.ones((1, 240), bool)
+    for impl in ("conv", "pallas_interpret"):
+        ctx = DayContext(jax.numpy.asarray(bars), jax.numpy.asarray(mask),
+                         rolling_impl=impl)
+        _, std, _, n = ctx.beta_moments()
+        assert int(np.asarray(n)[0]) > 0
+        assert float(np.asarray(std)[0]) == 0.0, impl
+
+
+@pytest.mark.pallas
+def test_parity_clean_day_pallas_interpret(rng):
+    """Full 58-factor parity vs the f64 oracle with the Pallas
+    interpret-mode rolling backend — the tier-1 gate that keeps the
+    kernel honest on every CPU run."""
+    _compare(synth_day(rng, n_codes=4), "pallas_clean",
+             rolling_impl="pallas_interpret")
+
+
+@pytest.mark.pallas
+@pytest.mark.slow
+def test_parity_seed739_pallas_interpret():
+    """The seed-739 boundary day (two windows with exactly-equal betas:
+    the beta_std sub-resolution snap) through the FULL comparator with
+    the Pallas rolling backend."""
+    rng = np.random.default_rng(739)
+    _compare(
+        synth_day(rng, n_codes=10, missing_prob=0.12, zero_volume_prob=0.12,
+                  constant_price_codes=2, short_day_codes=3),
+        "pallas739", noisy=True, rolling_impl="pallas_interpret")
 
 
 @pytest.mark.parametrize("name,distort", [

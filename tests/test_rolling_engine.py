@@ -1,0 +1,190 @@
+"""Rolling-engine mechanics (ISSUE 3): the fused conv graph carries no
+sequential loop (HLO-verified, not asserted from source), the 'pallas'
+impl auto-falls back to conv off-TPU with the outcome counted, per-stage
+telemetry carries the rolling_impl tag, buffer donation gates on
+config + backend, and bench.py's resident-OOM path falls back to the
+stream loop instead of re-raising.
+
+Numerical parity lives in tests/test_parity.py (the fuzz-seeded sweeps
+against the f64 oracle); this file owns the engine's plumbing contracts.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from replication_of_minute_frequency_factor_tpu.ops import rolling
+from replication_of_minute_frequency_factor_tpu.telemetry import (
+    Telemetry, attribution, get_telemetry)
+
+
+def _lower_rolling(impl):
+    f = jax.jit(lambda x, y, m: rolling.rolling_window_stats(
+        x, y, m, 50, impl=impl))
+    x = jnp.ones((2, 3, 240), jnp.float32)
+    m = jnp.ones((2, 3, 240), bool)
+    return f.lower(x, x, m)
+
+
+def test_conv_graph_has_no_while_op():
+    """Acceptance gate: the sequential fori_loop formulation is GONE
+    from the conv graph — zero ``while`` ops in the lowered module, and
+    the fused replacement's fingerprint (gather + dot_general +
+    convolution) is present."""
+    counts = attribution.hlo_op_counts(_lower_rolling("conv").as_text())
+    assert counts["while"] == 0, counts
+    assert counts["gather"] >= 1      # the strided window materialization
+    assert counts["convolution"] >= 1  # the ones-kernel windowed sums
+
+
+def test_hlo_op_counts_parses_both_dialects():
+    text = ("%0 = stablehlo.while ... \n"
+            "%1 = mhlo.dot_general ...\n"
+            "%2 = stablehlo.reduce_window ...\n"  # must NOT count as reduce
+            "%3 = stablehlo.gather ...")
+    counts = attribution.hlo_op_counts(text)
+    assert counts == {"while": 1, "dot_general": 1, "convolution": 0,
+                      "gather": 1, "reduce": 0, "sort": 0}
+
+
+def test_unknown_rolling_impl_raises():
+    with pytest.raises(ValueError, match="rolling_impl"):
+        rolling.rolling_window_stats(
+            jnp.ones((1, 240)), jnp.ones((1, 240)),
+            jnp.ones((1, 240), bool), 50, impl="cumsum")
+
+
+def test_pallas_falls_back_to_conv_on_cpu():
+    """impl='pallas' off-TPU resolves to conv — bit-identical results to
+    an explicit conv call, and the resolution lands in the registry so
+    attribution output says which backend actually ran."""
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(10, 0.1, (2, 240)).astype(np.float32))
+    y = x * 1.001
+    m = jnp.asarray(rng.random((2, 240)) > 0.1)
+    reg = get_telemetry().registry
+    before = reg.counter_value("rolling.impl", requested="pallas",
+                               resolved="conv")
+    jax.clear_caches()  # force a retrace so the trace-time counter fires
+    out_p = rolling.rolling_window_stats(x, y, m, 50, impl="pallas")
+    out_c = rolling.rolling_window_stats(x, y, m, 50, impl="conv")
+    for k in out_c:
+        np.testing.assert_array_equal(np.asarray(out_p[k]),
+                                      np.asarray(out_c[k]))
+    assert reg.counter_value("rolling.impl", requested="pallas",
+                             resolved="conv") > before
+
+
+@pytest.mark.pallas
+def test_pallas_kernel_odd_row_counts_pad_and_slice():
+    """Row counts that don't divide the VMEM block (including fewer rows
+    than one f32 sublane tile) round-trip through the pad/slice path."""
+    from replication_of_minute_frequency_factor_tpu.ops import rolling_pallas
+
+    rng = np.random.default_rng(0)
+    for lead in ((1,), (3,), (2, 5)):
+        xc = jnp.asarray(rng.normal(0, 0.1, lead + (64,)).astype(np.float32))
+        yc = xc * 0.5
+        mu_x = jnp.zeros_like(xc)
+        mu_y = jnp.zeros_like(xc)
+        s_xx, s_yy, s_xy = rolling_pallas.second_moments(
+            xc, yc, mu_x, mu_y, 10, interpret=True, block_rows=8)
+        assert s_xx.shape == lead + (64,)
+        ref = np.zeros(lead + (64,), np.float32)
+        xnp = np.asarray(xc)
+        for j in range(10):
+            sh = np.zeros_like(xnp)
+            sh[..., j:] = xnp[..., :64 - j]
+            ref += sh * sh
+        np.testing.assert_allclose(np.asarray(s_xx), ref,
+                                   rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(s_xy),
+                                   np.asarray(s_xx) * 0.5,
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_stage_timer_carries_rolling_impl_label():
+    tel = Telemetry(annotate_spans=False)
+    t = tel.stage_timer(rolling_impl="conv")
+    with t("device"):
+        pass
+    keys = tel.registry.snapshot()["histograms"]
+    assert "span_seconds{rolling_impl=conv,span=device}" in keys
+    # Timer semantics intact for ExposureTable.timings
+    assert "device" in t.totals()
+
+
+def test_donation_gates_on_config_and_backend(monkeypatch):
+    from replication_of_minute_frequency_factor_tpu import config as cfgmod
+    from replication_of_minute_frequency_factor_tpu import pipeline
+
+    cfg = cfgmod.Config()
+    assert cfg.donate_buffers  # default on
+    # CPU backend (the test harness): never donate — CPU PJRT ignores
+    # donation with a per-compile warning
+    assert pipeline._donate_device_buffers(cfg) is False
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert pipeline._donate_device_buffers(cfg) is True
+    cfg.donate_buffers = False
+    assert pipeline._donate_device_buffers(cfg) is False
+
+
+def test_donated_and_plain_packed_paths_share_one_function():
+    """The donated twins must wrap the SAME python callables — a fix to
+    the graph that only lands in one twin would silently fork the
+    device semantics by backend."""
+    from replication_of_minute_frequency_factor_tpu import pipeline
+
+    assert (pipeline._compute_packed_jit.__wrapped__
+            is pipeline._compute_packed_jit_donated.__wrapped__)
+    assert (pipeline._compute_packed_scan_jit.__wrapped__
+            is pipeline._compute_packed_scan_jit_donated.__wrapped__)
+    assert (pipeline._compute_from_wire_jit.__wrapped__
+            is pipeline._compute_from_wire_jit_donated.__wrapped__)
+
+
+def test_bench_resident_oom_falls_back_to_stream(monkeypatch, capsys):
+    """ADVICE r5 (bench.py:430): a resident warmup that still OOMs at
+    group == 1 must fall back to the stream loop at the proven 8-day
+    shape and print a record — not re-raise and lose the hardware
+    window. The emitted record must say so (mode/methodology flip,
+    warm.resident_oom_fallback carries the error)."""
+    import sys
+    import types
+
+    import bench
+
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.setenv("BENCH_FACTORS", "vol_return1min")
+    monkeypatch.setenv("BENCH_STAGES", "0")
+    monkeypatch.delenv("BENCH_MODE", raising=False)
+    monkeypatch.setattr(bench, "MODE", "resident")
+    monkeypatch.setattr(bench, "N_TICKERS", 30)
+    monkeypatch.setattr(bench, "DAYS_PER_BATCH", 2)
+    monkeypatch.setattr(bench, "ITERS", 2)
+    monkeypatch.setattr(bench, "_SUFFIX", "")
+    monkeypatch.setattr(bench, "_wait_host_quiet", lambda *a, **k: True)
+    stub = types.ModuleType("tools.cpu_busy")
+    stub.mark_busy = lambda *a, **k: None
+    stub.live_owners = lambda: []
+    monkeypatch.setitem(sys.modules, "tools.cpu_busy", stub)
+
+    def oom(*a, **k):
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory "
+                           "(synthetic, injected by test)")
+
+    monkeypatch.setattr(bench, "run_resident", oom)
+    bench.main()
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("{")]
+    rec = json.loads(lines[-1])
+    assert rec["mode"] == "stream"
+    assert rec["methodology"] == "r6_stream_v3"
+    assert rec["days_per_batch"] == 8
+    assert "RESOURCE_EXHAUSTED" in rec["warm"]["resident_oom_fallback"]
+    assert rec["round_trips"]["host_blocking_syncs"] > 0
+    assert set(rec["round_trips"]["predicted_fields"]) == {
+        "puts_async", "executes", "fetches"}

@@ -283,9 +283,13 @@ def test_pipeline_smoke_populates_gauges_under_failure(
     assert reg.histogram_stats("pipeline.queue_depth")["count"] > 0
     # in-flight gauge settled back to zero
     assert reg.gauge_value("pipeline.inflight_batches") == 0
-    # per-stage histograms for the hot stages
+    # per-stage histograms for the hot stages; the pipeline's stage
+    # timer tags every observation with the run's rolling backend
+    # (docs/observability.md) so attribution can split stage time by
+    # rolling_impl
     for stage in ("io", "grid", "pack", "device"):
-        st = reg.histogram_stats("span_seconds", span=stage)
+        st = reg.histogram_stats("span_seconds", span=stage,
+                                 rolling_impl="conv")
         assert st is not None and st["count"] > 0, stage
     # every batch's encode kind is classified
     assert reg.counter_total("pipeline.encode_kind") \

@@ -98,10 +98,12 @@ def test_mixed_artifact_carries_only_fresh_green(tpu_session, tmp_path):
 
 
 def test_legacy_rolling_entries_never_carry(tpu_session):
-    """The conv-vs-pallas step was removed with the Pallas kernel
-    (round-4 prove-or-drop): any banked 'rolling'/'pallas' artifact
-    entry belongs to a step that no longer exists and must not be
-    carried into a fresh session."""
+    """'rolling' belongs to the step removed with the round-4 Pallas
+    prove-or-drop — never carried. 'pallas' exists AGAIN (the ISSUE-3
+    reintroduction) under a new contract: only a ``rolling_impl:
+    pallas`` 5000-ticker ``_pallas``-suffixed record satisfies it;
+    green entries from the dropped r2-r4 step (different schema) must
+    re-run rather than carry."""
     steps = {
         "rolling": {"ok": True, "results": [
             {"conv_ms_per_batch": 2.0, "pallas_ms_per_batch": 1.0,
@@ -117,6 +119,18 @@ def test_legacy_rolling_entries_never_carry(tpu_session):
     }
     got = tpu_session.drop_conv_only_rolling(steps)
     assert set(got) == {"headline"}
+    new_pallas = {"pallas": {"ok": True, "results": [
+        {"metric": "cicc58_5000tickers_1yr_wall_pallas", "value": 60.0,
+         "mode": "resident", "rolling_impl": "pallas",
+         "rolling_impl_resolved": "pallas", "tickers": 5000}]}}
+    assert tpu_session.drop_conv_only_rolling(new_pallas) == new_pallas
+    # a pallas record whose graphs silently fell back to conv (or a
+    # small-ticker A/B) must not satisfy the hardware-validation step
+    fell_back = {"pallas": {"ok": True, "results": [
+        {"metric": "cicc58_5000tickers_1yr_wall_pallas", "value": 60.0,
+         "mode": "resident", "rolling_impl": "pallas",
+         "rolling_impl_resolved": "conv", "tickers": 5000}]}}
+    assert tpu_session.drop_conv_only_rolling(fell_back) == {}
 
 
 def test_pre_reshape_headline_dropped(tpu_session):
