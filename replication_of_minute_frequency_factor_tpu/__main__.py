@@ -111,6 +111,20 @@ def _add_doctor(sub: "argparse._SubParsersAction") -> None:
         " native encoder status, config")
 
 
+def _add_analyze(sub: "argparse._SubParsersAction") -> None:
+    from .analysis import cli as analysis_cli
+    p = sub.add_parser(
+        "analyze", help="graftlint: static AST + jaxpr contract "
+        "analysis of the factor engine (docs/static-analysis.md); "
+        "exits 0 iff clean against the committed baseline")
+    analysis_cli.add_args(p)
+
+
+def cmd_analyze(args: argparse.Namespace) -> int:
+    from .analysis import cli as analysis_cli
+    return analysis_cli.run(args)
+
+
 def cmd_compute(args: argparse.Namespace) -> int:
     from .config import Config
     from .models.registry import factor_names
@@ -391,6 +405,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_evaluate(sub)
     _add_list(sub)
     _add_doctor(sub)
+    _add_analyze(sub)
     args = ap.parse_args(argv)
     if args.cmd is None:
         if args.telemetry_dir:
@@ -400,7 +415,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                  "the synthetic telemetry demo)")
     return {"compute": cmd_compute, "evaluate": cmd_evaluate,
             "list-factors": cmd_list_factors,
-            "doctor": cmd_doctor}[args.cmd](args)
+            "doctor": cmd_doctor, "analyze": cmd_analyze}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,35 @@
+"""graftlint — static contract analysis for the 58-kernel factor engine.
+
+Two tiers (docs/static-analysis.md):
+
+* **Tier A** (:mod:`.ast_tier`) — a rule engine over the package's
+  Python AST. Rules GL-A1..GL-A5 encode the bug classes earlier PRs
+  found by archaeology: jax attributes that don't exist on the pinned
+  jax (the ``jnp.maximum.accumulate`` incident), serial loop primitives
+  in the kernel layers (the PR 3 rolling pathology), host-sync calls in
+  device-hot modules, unpaired ``start_trace``-style acquisitions (the
+  PR 2 bug), and raw ``jnp.mean``/``jnp.std`` where ``ops.masked``
+  reductions are mandated.
+* **Tier B** (:mod:`.jaxpr_tier`) — abstract-traces every registered
+  kernel at the canonical ``(days, tickers, 240)`` shape and walks the
+  closed jaxpr: zero ``while``/``scan`` primitives, zero f64
+  ``convert_element_type``, zero host callbacks, plus a per-kernel
+  primitive-count fingerprint written to ``analysis_report.json`` so
+  graph drift is diffable in review.
+
+Accepted violations live in the committed :data:`BASELINE_PATH`
+(:mod:`.violations`), each with a mandatory written justification.
+Run it: ``python -m replication_of_minute_frequency_factor_tpu analyze``.
+"""
+
+from __future__ import annotations
+
+from .violations import BASELINE_PATH, Baseline, Violation
+from .ast_tier import run_ast_tier
+from .jaxpr_tier import run_jaxpr_tier
+from .report import build_report, manifest_block, write_report
+
+__all__ = [
+    "BASELINE_PATH", "Baseline", "Violation", "build_report",
+    "manifest_block", "run_ast_tier", "run_jaxpr_tier", "write_report",
+]

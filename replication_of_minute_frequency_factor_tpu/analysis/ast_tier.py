@@ -1,0 +1,392 @@
+"""Tier A: the AST rule engine (rules GL-A1..GL-A5).
+
+One parse per file, one ancestor-tracking walk, every rule dispatched
+per node. Rules never import the scanned files — only their AST — so
+fixture files with deliberate violations are safe to scan. The only
+live imports are of *jax itself* (rule GL-A1 resolves attribute chains
+against the installed modules, which is the entire point: the linter's
+truth is the pinned jax, not a hardcoded API list).
+
+Rule catalog (docs/static-analysis.md):
+
+GL-A1  jax attribute chains that do not exist on the installed jax
+       (the ``jnp.maximum.accumulate`` / ``jax.distributed.is_initialized``
+       incident class).
+GL-A2  serial loop constructs in the kernel layers (``ops/``,
+       ``models/``): ``jnp.roll`` inside a loop, or any
+       ``lax.fori_loop``/``while_loop``/``scan`` — the pathology the
+       fused rolling engine exists to avoid.
+GL-A3  host-sync calls in device-hot modules (``ops/``, ``models/``,
+       ``parallel/``): ``.item()``, ``.block_until_ready()``,
+       ``np.asarray``/``np.array``, ``float()``/``int()`` of a
+       jax expression.
+GL-A4  unpaired resource acquisition (``start_trace`` without a
+       guaranteed ``stop_trace`` via try/finally or an
+       ``__enter__``/``__exit__`` pair) — anywhere in the package.
+GL-A5  raw ``jnp.mean``/``std``/``var``/``nan*`` reductions in
+       ``models/`` where the ``ops.masked`` equivalents are mandated.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import os
+import types
+from typing import Dict, List, Optional, Tuple
+
+from .violations import Violation
+
+#: layers whose modules must stay free of serial loops (GL-A2)
+LOOP_SCOPE = ("ops", "models")
+#: layers whose modules must stay free of host syncs (GL-A3)
+HOST_SYNC_SCOPE = ("ops", "models", "parallel")
+#: layer where raw jnp reductions are banned in favour of ops.masked (GL-A5)
+MASKED_SCOPE = ("models",)
+
+#: (acquire, release) method-name pairs for GL-A4
+RESOURCE_PAIRS = (("start_trace", "stop_trace"),)
+
+#: lax serial-loop entry points (GL-A2)
+SERIAL_LOOP_CALLS = {"fori_loop", "while_loop", "scan"}
+
+#: raw reductions with mandated ops.masked equivalents (GL-A5)
+RAW_REDUCTIONS = {"mean", "std", "var", "average", "median",
+                  "nanmean", "nanstd", "nanvar", "nanmedian"}
+
+
+# --------------------------------------------------------------------------
+# import-alias and attribute-chain helpers
+# --------------------------------------------------------------------------
+
+
+def _collect_imports(tree: ast.AST) -> Dict[str, str]:
+    """Local name -> dotted path, for names bound from jax/numpy."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                root = a.name.split(".")[0]
+                if root not in ("jax", "numpy"):
+                    continue
+                if a.asname:
+                    out[a.asname] = a.name
+                else:
+                    out[root] = root
+        elif isinstance(node, ast.ImportFrom) and node.level == 0:
+            if node.module and node.module.split(".")[0] in ("jax",
+                                                            "numpy"):
+                for a in node.names:
+                    out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def _attr_chain(node: ast.Attribute) -> Tuple[Optional[str], List[str]]:
+    """``a.b.c`` -> ('a', ['b', 'c']); None root if not Name-rooted."""
+    parts: List[str] = []
+    cur: ast.AST = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        return cur.id, list(reversed(parts))
+    return None, []
+
+
+_import_cache: Dict[str, Optional[object]] = {}
+
+
+def _import_dotted(dotted: str) -> Optional[object]:
+    if dotted in _import_cache:
+        return _import_cache[dotted]
+    obj: Optional[object]
+    try:
+        obj = importlib.import_module(dotted)
+    except ImportError:
+        obj = None
+        if "." in dotted:
+            head, _, tail = dotted.rpartition(".")
+            base = _import_dotted(head)
+            if base is not None:
+                obj = getattr(base, tail, None)
+    _import_cache[dotted] = obj
+    return obj
+
+
+_chain_cache: Dict[Tuple[str, Tuple[str, ...]], int] = {}
+
+
+def _chain_failure(dotted_root: str, attrs: Tuple[str, ...]) -> int:
+    """Index of the first attr that does not resolve on the live
+    modules, or -1 when the whole chain (or the root itself) resolves
+    /cannot be checked."""
+    key = (dotted_root, attrs)
+    if key in _chain_cache:
+        return _chain_cache[key]
+    obj = _import_dotted(dotted_root)
+    result = -1
+    if obj is not None:
+        for i, a in enumerate(attrs):
+            try:
+                obj = getattr(obj, a)
+            except AttributeError:
+                # a submodule may simply not be imported yet
+                if isinstance(obj, types.ModuleType):
+                    try:
+                        obj = importlib.import_module(
+                            f"{obj.__name__}.{a}")
+                        continue
+                    except ImportError:
+                        pass
+                result = i
+                break
+    _chain_cache[key] = result
+    return result
+
+
+def _dotted_of(scan: "_ModuleScan", name: str) -> Optional[str]:
+    return scan.imports.get(name)
+
+
+def _is_jax_rooted(scan: "_ModuleScan", node: ast.AST) -> bool:
+    """Does ``node``'s subtree reference any jax-bound name?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            dotted = scan.imports.get(sub.id)
+            if dotted and dotted.split(".")[0] == "jax":
+                return True
+    return False
+
+
+def _call_target(scan: "_ModuleScan", call: ast.Call
+                 ) -> Tuple[Optional[str], str]:
+    """(dotted module path or None, final attr/function name)."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        root, attrs = _attr_chain(f)
+        if root is not None:
+            dotted = _dotted_of(scan, root)
+            if dotted is not None:
+                return ".".join([dotted] + attrs[:-1]), attrs[-1]
+        return None, f.attr
+    if isinstance(f, ast.Name):
+        dotted = _dotted_of(scan, f.id)
+        if dotted is not None:
+            head, _, tail = dotted.rpartition(".")
+            return head, tail
+        return None, f.id
+    return None, ""
+
+
+# --------------------------------------------------------------------------
+# per-module scan
+# --------------------------------------------------------------------------
+
+
+class _ModuleScan:
+    def __init__(self, file_path: str, display_path: str,
+                 scope_parts: Tuple[str, ...]):
+        self.file_path = file_path
+        self.path = display_path
+        self.scope_parts = scope_parts
+        with open(file_path, "rb") as fh:
+            self.tree = ast.parse(fh.read(), filename=file_path)
+        self.imports = _collect_imports(self.tree)
+        self.violations: List[Violation] = []
+
+    def in_scope(self, layers: Tuple[str, ...]) -> bool:
+        return bool(set(self.scope_parts[:-1]) & set(layers))
+
+    def add(self, code: str, node: ast.AST, symbol: str,
+            message: str) -> None:
+        self.violations.append(Violation(
+            code=code, path=self.path,
+            line=getattr(node, "lineno", 0), symbol=symbol,
+            message=message))
+
+
+def _rule_a1(scan: _ModuleScan, node: ast.AST,
+             stack: List[ast.AST]) -> None:
+    """GL-A1: jax attribute chains missing on the installed jax."""
+    if not isinstance(node, ast.Attribute):
+        return
+    if stack and isinstance(stack[-1], ast.Attribute):
+        return  # only maximal chains
+    root, attrs = _attr_chain(node)
+    if root is None:
+        return
+    dotted = _dotted_of(scan, root)
+    if dotted is None or dotted.split(".")[0] != "jax":
+        return
+    i = _chain_failure(dotted, tuple(attrs))
+    if i >= 0:
+        symbol = ".".join([root] + attrs[:i + 1])
+        resolved = ".".join([dotted] + attrs[:i + 1])
+        scan.add("GL-A1", node, symbol,
+                 f"{resolved} does not exist on the installed jax "
+                 "(the jnp.maximum.accumulate incident class); use an "
+                 "API present on the pinned version")
+
+
+def _rule_a2(scan: _ModuleScan, node: ast.AST,
+             stack: List[ast.AST]) -> None:
+    """GL-A2: serial loop constructs in ops/ and models/."""
+    if not scan.in_scope(LOOP_SCOPE) or not isinstance(node, ast.Call):
+        return
+    dotted, name = _call_target(scan, node)
+    if name == "roll" and dotted in ("jax.numpy", "numpy"):
+        if any(isinstance(a, (ast.For, ast.While)) for a in stack):
+            scan.add("GL-A2", node, f"{name} in loop",
+                     "full-tensor roll inside a loop builds a serial "
+                     "dependency chain (the pre-PR-3 rolling-moment "
+                     "pathology); materialize windows by strided "
+                     "gather instead (ops/rolling.py)")
+        return
+    if name in SERIAL_LOOP_CALLS and dotted == "jax.lax":
+        scan.add("GL-A2", node, name,
+                 f"lax.{name} in a kernel-layer module serializes the "
+                 "graph into an XLA while; express the computation as "
+                 "an unrolled/batched formulation")
+
+
+def _rule_a3(scan: _ModuleScan, node: ast.AST,
+             stack: List[ast.AST]) -> None:
+    """GL-A3: host-sync calls in device-hot modules."""
+    if not scan.in_scope(HOST_SYNC_SCOPE) or not isinstance(node,
+                                                            ast.Call):
+        return
+    msg = ("host-device synchronization in a device-hot module blocks "
+           "the dispatch pipeline; move it to a bench/telemetry/CLI "
+           "layer or fetch explicitly via jax.device_get there")
+    if isinstance(node.func, ast.Attribute):
+        if node.func.attr == "item" and not node.args:
+            scan.add("GL-A3", node, ".item()", msg)
+            return
+        if node.func.attr == "block_until_ready":
+            scan.add("GL-A3", node, ".block_until_ready()", msg)
+            return
+    dotted, name = _call_target(scan, node)
+    if dotted == "numpy" and name in ("asarray", "array"):
+        scan.add("GL-A3", node, f"np.{name}", msg)
+        return
+    if (isinstance(node.func, ast.Name) and node.func.id in ("float",
+                                                             "int")
+            and len(node.args) == 1
+            and _is_jax_rooted(scan, node.args[0])):
+        scan.add("GL-A3", node, f"{node.func.id}(jax expression)", msg)
+
+
+def _contains_call_named(nodes, names) -> bool:
+    for n in nodes if isinstance(nodes, list) else [nodes]:
+        for sub in ast.walk(n):
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                if (isinstance(f, ast.Attribute) and f.attr in names) or \
+                        (isinstance(f, ast.Name) and f.id in names):
+                    return True
+    return False
+
+
+def _rule_a4(scan: _ModuleScan, node: ast.AST,
+             stack: List[ast.AST]) -> None:
+    """GL-A4: resource acquisitions without a guaranteed release."""
+    if not isinstance(node, ast.Call):
+        return
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else "")
+    for acquire, release in RESOURCE_PAIRS:
+        if name != acquire:
+            continue
+        func = next((n for n in reversed(stack)
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))), None)
+        container: ast.AST = func if func is not None else scan.tree
+        ok = False
+        for t in ast.walk(container):
+            if not isinstance(t, ast.Try) or not t.finalbody:
+                continue
+            if not _contains_call_named(t.finalbody, {release}):
+                continue
+            # guaranteed iff the acquire either runs inside the try
+            # (stack contains it) or strictly before it in the same
+            # function — both reach the finally on every exit path
+            if t in stack or node.lineno < t.lineno:
+                ok = True
+                break
+        if not ok and func is not None and func.name == "__enter__":
+            cls = next((n for n in reversed(stack)
+                        if isinstance(n, ast.ClassDef)), None)
+            if cls is not None:
+                exits = [m for m in cls.body
+                         if isinstance(m, ast.FunctionDef)
+                         and m.name == "__exit__"]
+                if exits and _contains_call_named(exits, {release}):
+                    ok = True
+        if not ok:
+            scan.add("GL-A4", node, acquire,
+                     f"{acquire} without a guaranteed {release} (the "
+                     "PR 2 unpaired-start_trace bug class): wrap in "
+                     "try/finally, or pair __enter__ with an __exit__ "
+                     "that releases")
+
+
+def _rule_a5(scan: _ModuleScan, node: ast.AST,
+             stack: List[ast.AST]) -> None:
+    """GL-A5: raw jnp reductions in models/ (ops.masked is mandated)."""
+    if not scan.in_scope(MASKED_SCOPE) or not isinstance(node, ast.Call):
+        return
+    dotted, name = _call_target(scan, node)
+    if dotted == "jax.numpy" and name in RAW_REDUCTIONS:
+        scan.add("GL-A5", node, f"jnp.{name}",
+                 f"raw jnp.{name} ignores the present-bar mask; "
+                 "models/ must use the ops.masked equivalent "
+                 "(masked_mean/masked_std/...) so missing bars match "
+                 "polars null semantics")
+
+
+_RULES = (_rule_a1, _rule_a2, _rule_a3, _rule_a4, _rule_a5)
+
+
+def _walk(node: ast.AST, stack: List[ast.AST], scan: _ModuleScan) -> None:
+    for rule in _RULES:
+        rule(scan, node, stack)
+    stack.append(node)
+    for child in ast.iter_child_nodes(node):
+        _walk(child, stack, scan)
+    stack.pop()
+
+
+def scan_file(file_path: str, display_path: str,
+              scope_rel: str) -> List[Violation]:
+    parts = tuple(scope_rel.replace(os.sep, "/").split("/"))
+    scan = _ModuleScan(file_path, display_path, parts)
+    _walk(scan.tree, [], scan)
+    return scan.violations
+
+
+def run_ast_tier(root: Optional[str] = None,
+                 display_base: Optional[str] = None
+                 ) -> Tuple[List[Violation], int]:
+    """Scan every ``.py`` under ``root`` (default: this package).
+
+    ``display_base`` anchors the repo-relative paths recorded on
+    violations (default: the package's parent, i.e. the repo root for
+    a source checkout). Returns (violations, files_scanned).
+    """
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if display_base is None:
+        display_base = os.path.dirname(root)
+    files = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__",))
+        files += [os.path.join(dirpath, f) for f in sorted(filenames)
+                  if f.endswith(".py")]
+    out: List[Violation] = []
+    for f in files:
+        display = os.path.relpath(f, display_base).replace(os.sep, "/")
+        scope = os.path.relpath(f, root)
+        out += scan_file(f, display, scope)
+    return out, len(files)

@@ -1,0 +1,124 @@
+"""graftlint CLI: ``python -m replication_of_minute_frequency_factor_tpu
+analyze``.
+
+Prints a one-line JSON verdict (the same convention as
+``telemetry/regress.py``) and exits 0 iff the tree is clean against
+the committed baseline. Default run: Tier A over the package + Tier B
+over every registered kernel, report written to
+``analysis_report.json`` at the repo root (diffable, committed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from .violations import BASELINE_PATH, Baseline
+from .report import build_report, repo_root, write_report
+
+
+def add_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--tier", choices=("ast", "jaxpr", "all"),
+                   default="all",
+                   help="which tier(s) to run (default: all; the jaxpr "
+                        "tier abstractly traces every registered "
+                        "kernel — run it under JAX_PLATFORMS=cpu "
+                        "locally, no accelerator needed)")
+    p.add_argument("--baseline", default=BASELINE_PATH,
+                   help="accepted-violations file (default: the "
+                        "committed package baseline)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="accept every NEW violation into --baseline; "
+                        "requires --justification")
+    p.add_argument("--justification", default="",
+                   help="written reason recorded on entries added by "
+                        "--update-baseline (mandatory with it)")
+    p.add_argument("--report", default=None, metavar="PATH",
+                   help="where to write the machine-readable report "
+                        "(default: <repo>/analysis_report.json; '-' "
+                        "skips writing)")
+    p.add_argument("--paths", nargs="*", default=None, metavar="DIR",
+                   help="AST-tier scan roots (default: the installed "
+                        "package); used by the fixture tests")
+    p.add_argument("--days", type=int, default=2,
+                   help="days extent of the canonical trace shape")
+    p.add_argument("--tickers", type=int, default=3,
+                   help="tickers extent of the canonical trace shape")
+    p.add_argument("--rolling-impl", default="conv",
+                   choices=("conv", "pallas", "pallas_interpret"),
+                   help="rolling backend traced by the jaxpr tier")
+
+
+def run(args: argparse.Namespace) -> int:
+    from .ast_tier import run_ast_tier
+    from .jaxpr_tier import SLOTS, run_jaxpr_tier
+
+    violations = []
+    n_files = 0
+    if args.tier in ("ast", "all"):
+        roots = args.paths if args.paths else [None]
+        for root in roots:
+            vs, nf = run_ast_tier(root)
+            violations += vs
+            n_files += nf
+    fingerprints = None
+    shape = None
+    if args.tier in ("jaxpr", "all"):
+        shape = (args.days, args.tickers, SLOTS)
+        vs, fingerprints = run_jaxpr_tier(
+            days=args.days, tickers=args.tickers,
+            rolling_impl=args.rolling_impl)
+        violations += vs
+
+    baseline = Baseline.load(args.baseline)
+    new, accepted, stale = baseline.split(violations)
+
+    if args.update_baseline and new:
+        if not args.justification.strip():
+            print("--update-baseline requires --justification "
+                  "(every accepted violation must say why)",
+                  file=sys.stderr)
+            return 2
+        baseline.extend(new, args.justification)
+        baseline.save(args.baseline)
+        new, accepted, stale = Baseline.load(args.baseline).split(
+            violations)
+
+    report = build_report(new, accepted, stale,
+                          fingerprints=fingerprints,
+                          files_scanned=n_files, shape=shape)
+    report_path = args.report
+    if report_path is None:
+        import os
+        report_path = os.path.join(repo_root(), "analysis_report.json")
+    if report_path != "-":
+        write_report(report_path, report)
+
+    for v in new:
+        print(f"{v.location()}: {v.code} [{v.symbol}] {v.message}",
+              file=sys.stderr)
+    for e in stale:
+        print(f"stale baseline entry (violation no longer occurs — "
+              f"delete it): {e}", file=sys.stderr)
+    verdict = {"ok": not new, "tier": args.tier, **report["verdict"]}
+    if fingerprints is not None:
+        verdict["kernels"] = len(fingerprints)
+    if report_path != "-":
+        verdict["report"] = report_path
+    print(json.dumps(verdict))
+    return 0 if not new else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m replication_of_minute_frequency_factor_tpu "
+             "analyze",
+        description=__doc__)
+    add_args(ap)
+    return run(ap.parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

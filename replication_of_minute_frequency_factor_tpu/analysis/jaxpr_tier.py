@@ -1,0 +1,155 @@
+"""Tier B: jaxpr contract checker over the registered kernels.
+
+Every kernel in ``models/registry.py`` is abstractly traced (no data,
+no compile — ``jax.make_jaxpr`` on ``ShapeDtypeStruct`` inputs) at the
+canonical ``(days, tickers, 240)`` layout, and the closed jaxpr is
+walked recursively (through cond branches, custom_jvp call jaxprs,
+pjit bodies, ...) to enforce per-kernel contracts:
+
+GL-B1  zero ``while``/``scan`` primitives — a ``fori_loop`` traces to
+       ``scan`` (static trip count) or ``while``, and both lower to a
+       serial XLA ``while`` op: the exact pathology the PR 3 fused
+       rolling engine removed. This gate makes that win permanent.
+GL-B2  zero f64 ``convert_element_type`` — the f64 oracle lives in
+       ``oracle/`` only; an f64 promotion inside a kernel silently
+       doubles HBM traffic and diverges from the f32 policy.
+GL-B3  zero host callbacks (``pure_callback``/``io_callback``/
+       ``debug_callback``) — a kernel that calls back into Python
+       cannot be fused, donated, or sharded.
+
+Alongside the verdict, each kernel reports a primitive-count
+fingerprint ``{primitive: count}``; committed into
+``analysis_report.json``, a graph-shape drift (an op class appearing
+or a count jumping) shows up as a reviewable diff.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .violations import Violation
+
+#: canonical trailing layout: (days, tickers, SLOTS) with 5 bar fields
+SLOTS = 240
+N_FIELDS = 5
+
+#: serial loop primitives (both lower to an XLA ``while``)
+BANNED_LOOP_PRIMS = ("while", "scan")
+
+#: wide dtypes banned outside oracle/ (names as str(dtype))
+BANNED_WIDE_DTYPES = ("float64", "complex128")
+
+
+def _iter_jaxprs(obj):
+    """Yield every Jaxpr reachable from ``obj`` (params may hold
+    ClosedJaxpr, Jaxpr, or tuples/lists of either — e.g. cond's
+    ``branches``)."""
+    from jax._src import core  # stable across 0.4.x for these names
+
+    if isinstance(obj, core.ClosedJaxpr):
+        yield obj.jaxpr
+    elif isinstance(obj, core.Jaxpr):
+        yield obj
+    elif isinstance(obj, (tuple, list)):
+        for x in obj:
+            yield from _iter_jaxprs(x)
+
+
+def _walk_eqns(jaxpr):
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _iter_jaxprs(v):
+                yield from _walk_eqns(sub)
+
+
+def primitive_counts(closed) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for eqn in _walk_eqns(closed.jaxpr):
+        counts[eqn.primitive.name] = counts.get(eqn.primitive.name,
+                                                0) + 1
+    return counts
+
+
+def kernel_jaxpr(fn: Callable, days: int = 2, tickers: int = 3,
+                 rolling_impl: str = "conv"):
+    """Abstractly trace ``fn(ctx)`` at the canonical shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.context import DayContext
+
+    bars = jax.ShapeDtypeStruct((days, tickers, SLOTS, N_FIELDS),
+                                jnp.float32)
+    mask = jax.ShapeDtypeStruct((days, tickers, SLOTS), jnp.bool_)
+
+    def wrapped(b, m):
+        return fn(DayContext(b, m, rolling_impl=rolling_impl))
+
+    return jax.make_jaxpr(wrapped)(bars, mask)
+
+
+def check_kernel(name: str, fn: Callable, days: int = 2,
+                 tickers: int = 3, rolling_impl: str = "conv"
+                 ) -> Tuple[List[Violation], Dict]:
+    """Contracts + fingerprint for one kernel. A kernel that fails to
+    trace at all is itself a violation (GL-B0) — every registered
+    kernel must be jit-traceable at the canonical shape."""
+    try:
+        closed = kernel_jaxpr(fn, days, tickers, rolling_impl)
+    except Exception as e:  # noqa: BLE001 — the failure IS the finding
+        v = Violation(code="GL-B0", path="", line=0,
+                      symbol=f"{type(e).__name__}",
+                      message=f"kernel failed to trace at "
+                              f"({days}, {tickers}, {SLOTS}): {e}",
+                      kernel=name)
+        return [v], {"traced": False}
+    out: List[Violation] = []
+    counts = primitive_counts(closed)
+    for prim in BANNED_LOOP_PRIMS:
+        if counts.get(prim):
+            out.append(Violation(
+                code="GL-B1", path="", line=0, symbol=prim,
+                message=f"{counts[prim]}x '{prim}' primitive in the "
+                        "kernel jaxpr — lowers to a serial XLA while "
+                        "(the pre-PR-3 rolling pathology); use the "
+                        "unrolled/batched formulation", kernel=name))
+    for eqn in _walk_eqns(closed.jaxpr):
+        if eqn.primitive.name == "convert_element_type":
+            dt = str(eqn.params.get("new_dtype", ""))
+            if dt in BANNED_WIDE_DTYPES:
+                out.append(Violation(
+                    code="GL-B2", path="", line=0,
+                    symbol=f"convert_element_type[{dt}]",
+                    message="f64 promotion inside a kernel: wide "
+                            "dtypes belong to oracle/ only (f32 "
+                            "policy)", kernel=name))
+        if "callback" in eqn.primitive.name:
+            out.append(Violation(
+                code="GL-B3", path="", line=0,
+                symbol=eqn.primitive.name,
+                message="host callback inside a kernel defeats "
+                        "fusion/donation/sharding; kernels must be "
+                        "pure device graphs", kernel=name))
+    fingerprint = {"traced": True,
+                   "n_eqns": sum(counts.values()),
+                   "primitives": dict(sorted(counts.items()))}
+    return out, fingerprint
+
+
+def run_jaxpr_tier(names: Optional[Sequence[str]] = None, days: int = 2,
+                   tickers: int = 3, rolling_impl: str = "conv"
+                   ) -> Tuple[List[Violation], Dict[str, Dict]]:
+    """Check every registered kernel (default: the canonical 58)."""
+    from ..models import registry
+
+    if names is None:
+        names = registry.factor_names()
+    violations: List[Violation] = []
+    fingerprints: Dict[str, Dict] = {}
+    for n in names:
+        vs, fp = check_kernel(n, registry.resolve(n), days=days,
+                              tickers=tickers, rolling_impl=rolling_impl)
+        violations += vs
+        fingerprints[n] = fp
+    return violations, fingerprints

@@ -1,0 +1,122 @@
+"""Report assembly: the machine-readable graftlint verdict.
+
+``analysis_report.json`` (committed at the repo root) is the durable
+artifact: verdict, per-rule counts, and the per-kernel primitive
+fingerprints whose diffs make graph drift reviewable. The condensed
+``manifest_block`` rides in every telemetry run manifest so "was the
+tree contract-clean when these numbers were produced" is answerable
+from the bundle alone.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from .violations import BASELINE_PATH, Baseline, Violation
+
+SCHEMA = "graftlint/1"
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _rule_counts(violations: List[Violation]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for v in violations:
+        out[v.code] = out.get(v.code, 0) + 1
+    return dict(sorted(out.items()))
+
+
+def build_report(new: List[Violation], accepted: List[Violation],
+                 stale: List[dict],
+                 fingerprints: Optional[Dict[str, Dict]] = None,
+                 files_scanned: int = 0,
+                 shape: Optional[tuple] = None) -> dict:
+    try:
+        import jax
+        jax_version = jax.__version__
+    except Exception:  # noqa: BLE001 — report must build without jax
+        jax_version = None
+    report = {
+        "schema": SCHEMA,
+        "created_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                     time.gmtime()),
+        "jax_version": jax_version,
+        "verdict": {
+            "clean": not new,
+            "new": len(new),
+            "baselined": len(accepted),
+            "stale_baseline": len(stale),
+            "by_rule": _rule_counts(new),
+        },
+        "files_scanned": files_scanned,
+        "violations": [v.to_dict() for v in new],
+        "baselined": [v.to_dict() for v in accepted],
+        "stale_baseline_entries": stale,
+    }
+    if fingerprints is not None:
+        report["jaxpr"] = {
+            "shape": list(shape) if shape else None,
+            "kernels": len(fingerprints),
+            "fingerprints": {k: fingerprints[k]
+                             for k in sorted(fingerprints)},
+        }
+    return report
+
+
+def write_report(path: str, report: dict) -> str:
+    with open(path, "w") as fh:
+        json.dump(report, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    return path
+
+
+_manifest_memo: Optional[dict] = None
+
+
+def manifest_block(refresh: bool = False) -> dict:
+    """Condensed verdict for the telemetry run manifest.
+
+    Re-runs the (fast, parse-only) AST tier live against the committed
+    baseline, and condenses the committed ``analysis_report.json`` for
+    the jaxpr side — re-tracing 58 kernels per telemetry write would
+    not be. Memoised per process: the tree does not change mid-run.
+    """
+    global _manifest_memo
+    if _manifest_memo is not None and not refresh:
+        return _manifest_memo
+    from .ast_tier import run_ast_tier
+
+    violations, n_files = run_ast_tier()
+    baseline = Baseline.load(BASELINE_PATH)
+    new, accepted, stale = baseline.split(violations)
+    block = {
+        "ast": {"clean": not new, "new": len(new),
+                "baselined": len(accepted),
+                "stale_baseline": len(stale),
+                "files_scanned": n_files,
+                "by_rule": _rule_counts(new)},
+    }
+    report_path = os.path.join(repo_root(), "analysis_report.json")
+    if os.path.exists(report_path):
+        try:
+            with open(report_path) as fh:
+                rep = json.load(fh)
+            block["report"] = {
+                "present": True,
+                "created_utc": rep.get("created_utc"),
+                "clean": rep.get("verdict", {}).get("clean"),
+                "kernels": rep.get("jaxpr", {}).get("kernels"),
+            }
+        except (OSError, ValueError) as e:
+            block["report"] = {"present": False,
+                               "error": f"{type(e).__name__}: {e}"}
+    else:
+        block["report"] = {"present": False}
+    _manifest_memo = block
+    return block

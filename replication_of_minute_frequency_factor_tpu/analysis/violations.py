@@ -1,0 +1,121 @@
+"""Violation records and the committed acceptance baseline.
+
+A baseline entry deliberately matches on ``(code, path, symbol, kernel)``
+and NOT on line numbers — accepted violations must survive unrelated
+edits to the same file, and a *new* occurrence of the same symbol in the
+same file is the same accepted fact, not a regression. Every entry
+carries a mandatory human-written ``justification``; loading a baseline
+with an empty one fails, so "baseline it" can never silently become
+"ignore it".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+#: the committed repo baseline, shipped inside the package
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.json")
+
+
+@dataclasses.dataclass
+class Violation:
+    """One rule firing. ``path``/``line`` locate Tier-A findings in
+    source; Tier-B findings locate by ``kernel`` instead (path='')."""
+
+    code: str          # rule id, e.g. "GL-A1" / "GL-B1"
+    path: str          # repo-relative posix path ('' for jaxpr tier)
+    line: int          # 1-based source line (0 for jaxpr tier)
+    symbol: str        # the offending symbol / primitive / call
+    message: str       # human-readable explanation
+    kernel: str = ""   # registered kernel name (jaxpr tier)
+
+    def key(self) -> Tuple[str, str, str, str]:
+        return (self.code, self.path, self.symbol, self.kernel)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def location(self) -> str:
+        if self.kernel:
+            return f"kernel:{self.kernel}"
+        return f"{self.path}:{self.line}"
+
+
+class Baseline:
+    """The committed set of accepted violations."""
+
+    def __init__(self, entries: Optional[List[dict]] = None):
+        self.entries = entries or []
+        for e in self.entries:
+            if not str(e.get("justification", "")).strip():
+                raise ValueError(
+                    "baseline entry without a written justification: "
+                    f"{e!r} — every accepted violation must say why")
+        self._keys = {self._entry_key(e) for e in self.entries}
+
+    @staticmethod
+    def _entry_key(e: dict) -> Tuple[str, str, str, str]:
+        return (e.get("code", ""), e.get("path", ""),
+                e.get("symbol", ""), e.get("kernel", ""))
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls([])
+        with open(path) as fh:
+            text = fh.read()
+        if not text.strip():  # /dev/null or a just-touched file
+            return cls([])
+        data = json.loads(text)
+        if data.get("version") != 1:
+            raise ValueError(f"unknown baseline version in {path}: "
+                             f"{data.get('version')!r}")
+        return cls(data.get("entries", []))
+
+    def save(self, path: str) -> None:
+        data = {"version": 1,
+                "entries": sorted(self.entries,
+                                  key=lambda e: self._entry_key(e))}
+        with open(path, "w") as fh:
+            json.dump(data, fh, indent=1)
+            fh.write("\n")
+
+    def split(self, violations: Iterable[Violation]
+              ) -> Tuple[List[Violation], List[Violation], List[dict]]:
+        """Partition into (new, accepted) and report stale entries.
+
+        A stale entry matched nothing this run — usually the violation
+        was fixed and the entry should be deleted; reported, not fatal.
+        """
+        new: List[Violation] = []
+        accepted: List[Violation] = []
+        hit: Dict[Tuple[str, str, str, str], bool] = {
+            k: False for k in self._keys}
+        for v in violations:
+            if v.key() in self._keys:
+                hit[v.key()] = True
+                accepted.append(v)
+            else:
+                new.append(v)
+        stale = [e for e in self.entries if not hit[self._entry_key(e)]]
+        return new, accepted, stale
+
+    def extend(self, violations: Iterable[Violation],
+               justification: str) -> int:
+        """Accept ``violations`` (deduped) under one justification."""
+        if not justification.strip():
+            raise ValueError("a justification is required to baseline "
+                             "violations")
+        added = 0
+        for v in violations:
+            if v.key() not in self._keys:
+                self.entries.append({
+                    "code": v.code, "path": v.path, "symbol": v.symbol,
+                    "kernel": v.kernel, "justification": justification})
+                self._keys.add(v.key())
+                added += 1
+        return added

@@ -138,11 +138,29 @@ def apply_compilation_cache(cfg: "Config") -> None:
             jax.config.update("jax_compilation_cache_dir",
                               _cache_prev["dir"])
             _cache_prev = None
+            _reset_compilation_cache()
         return
     if _cache_prev is None:
         _cache_prev = {"dir": jax.config.jax_compilation_cache_dir}
     jax.config.update("jax_compilation_cache_dir",
                       cfg.compilation_cache_dir)
+    _reset_compilation_cache()
+
+
+def _reset_compilation_cache() -> None:
+    """Drop jax's lazily-created cache object so a dir change takes.
+
+    jax initialises its persistent-cache backend ONCE, on the first
+    compile of the process; in a long-lived process (the driver after
+    warmup, the test suite) every compile before ``apply_compilation_cache``
+    has already frozen the cache as 'disabled', and the config update
+    above is silently ignored. ``reset_cache`` un-freezes it."""
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as cc)
+        cc.reset_cache()
+    except Exception:  # noqa: BLE001 — cache is an optimisation, not a need
+        pass
 
 
 _config: Optional[Config] = None

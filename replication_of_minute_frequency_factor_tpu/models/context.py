@@ -11,7 +11,6 @@ Field layout follows :mod:`..data.minute` (open, high, low, close, volume).
 from __future__ import annotations
 
 import jax.numpy as jnp
-import numpy as np
 
 from .. import sessions
 from ..data.minute import F_CLOSE, F_HIGH, F_LOW, F_OPEN, F_VOLUME
@@ -42,7 +41,7 @@ class DayContext:
         self.rolling_impl = rolling_impl  # None -> Config.rolling_impl
         self._memo = {}
         #: HHMMSSmmm per slot, broadcastable against [..., T, 240]
-        self.times = jnp.asarray(np.asarray(sessions.GRID_TIMES))
+        self.times = jnp.asarray(sessions.GRID_TIMES)
 
     # --- raw fields -----------------------------------------------------
     @property

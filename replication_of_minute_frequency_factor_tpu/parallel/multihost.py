@@ -25,6 +25,23 @@ from ..telemetry import get_telemetry
 from .mesh import day_batch_spec, mask_spec, make_mesh
 
 
+def _is_initialized() -> bool:
+    """Whether the distributed runtime is already up.
+
+    ``jax.distributed.is_initialized`` only exists on jax >= 0.5 (the
+    pinned 0.4.37 exposes just ``initialize``/``shutdown`` — graftlint
+    rule GL-A1 class); fall back to the runtime's own client handle,
+    which is what ``is_initialized`` reads on newer jax anyway."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:
+        from jax._src import distributed as _impl
+        return getattr(_impl.global_state, "client", None) is not None
+    except Exception:  # noqa: BLE001 — treat an unknown runtime as down
+        return False
+
+
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None) -> None:
@@ -34,10 +51,10 @@ def initialize(coordinator_address: Optional[str] = None,
 
     Must run before anything touches the XLA backend —
     ``jax.process_count()`` would itself initialise it, so the
-    already-initialised check uses ``jax.distributed.is_initialized``.
+    already-initialised check uses :func:`_is_initialized`.
     Errors are only swallowed on the implicit (env-discovery) path; a
     caller who names a coordinator gets the failure raised."""
-    if jax.distributed.is_initialized():
+    if _is_initialized():
         return
     # spanned: on a pod slice this blocks until every process dials the
     # coordinator, so its duration IS the cross-host startup skew

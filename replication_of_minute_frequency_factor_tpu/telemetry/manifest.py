@@ -92,10 +92,23 @@ def build_manifest(cfg=None, extra: Optional[dict] = None) -> dict:
         "host": platform.node(),
         "pid": os.getpid(),
         "argv": list(sys.argv),
+        "analysis": _analysis_block(),
     }
     if extra:
         manifest.update(extra)
     return manifest
+
+
+def _analysis_block() -> dict:
+    """Condensed graftlint verdict (docs/static-analysis.md): was the
+    tree contract-clean when this run's numbers were produced? The AST
+    tier re-runs live (parse-only, memoised per process); the jaxpr
+    verdict is condensed from the committed analysis_report.json."""
+    try:
+        from ..analysis.report import manifest_block
+        return manifest_block()
+    except Exception as e:  # noqa: BLE001 — provenance must not raise
+        return {"available": False, "error": f"{type(e).__name__}: {e}"}
 
 
 def write_manifest(path: str, cfg=None,

@@ -43,6 +43,13 @@ if [ "$1" = "--quick" ]; then
     run python -c "import json; \
 from replication_of_minute_frequency_factor_tpu.ops.rolling import _smoke; \
 print(json.dumps(_smoke()))"
+    # graftlint (ISSUE 4): AST rules over the whole package + jaxpr
+    # contracts over all 58 registered kernels (abstract trace on CPU),
+    # gated on the committed baseline — one JSON verdict line like
+    # telemetry/regress.py, nonzero on any new violation
+    # (docs/static-analysis.md); --report - keeps the tree clean here
+    run python -m replication_of_minute_frequency_factor_tpu analyze \
+        --report -
     exit $rc
 fi
 if [ "$#" -gt 0 ]; then
@@ -73,3 +80,7 @@ run python -m replication_of_minute_frequency_factor_tpu.telemetry.validate \
 # deviations are reported, only --strict/--check runs gate on them)
 run python -m replication_of_minute_frequency_factor_tpu.telemetry.regress \
     "$REPO"
+# graftlint gate (ISSUE 4, docs/static-analysis.md): AST + jaxpr tiers
+# against the committed baseline; nonzero on any new violation
+run python -m replication_of_minute_frequency_factor_tpu analyze \
+    --report -

@@ -34,3 +34,25 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+#: modules whose tests run under ``jax.transfer_guard("disallow")`` —
+#: the runtime twin of graftlint rule GL-A3 (docs/static-analysis.md):
+#: inside these, any IMPLICIT host<->device transfer (``float(arr)``,
+#: ``.item()``, a numpy operand silently shipped to device, a hidden
+#: sync inside a kernel) raises, while explicit ``jax.device_put``/
+#: ``device_get``/``jnp.asarray`` and jit-compiled constants stay
+#: legal. A test that legitimately transfers opts out with
+#: ``@pytest.mark.transfers``.
+TRANSFER_GUARDED_MODULES = {"test_kernel_purity"}
+
+
+@pytest.fixture(autouse=True)
+def _disallow_implicit_transfers(request):
+    mod = getattr(request.module, "__name__", "").rsplit(".", 1)[-1]
+    if (mod in TRANSFER_GUARDED_MODULES
+            and request.node.get_closest_marker("transfers") is None):
+        with jax.transfer_guard("disallow"):
+            yield
+    else:
+        yield

@@ -1,0 +1,21 @@
+"""GL-A1 fixture: jax attribute chains that do not exist on the pinned
+jax 0.4.37. Parsed by graftlint, never imported."""
+
+import jax
+import jax.numpy as jnp
+
+
+def cummax_rows(x):
+    # the exact incident that silently broke 25+ tier-1 tests (PR 3)
+    return jnp.maximum.accumulate(x, axis=-1)
+
+
+def runtime_is_up():
+    # jax.distributed.is_initialized only exists on jax >= 0.5 (the
+    # multihost failure this PR fixed)
+    return jax.distributed.is_initialized()
+
+
+def fine(x):
+    # resolvable chains must NOT fire
+    return jax.lax.cummax(jnp.asarray(x), axis=0)

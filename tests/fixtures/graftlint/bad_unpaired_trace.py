@@ -1,0 +1,12 @@
+"""GL-A4 fixture: start_trace with no guaranteed stop_trace — the PR 2
+bug class (a crash between start and stop leaks the profiler session).
+Parsed, never run."""
+
+import jax
+
+
+def profile_step(step, out_dir):
+    jax.profiler.start_trace(out_dir)
+    result = step()                    # a raise here leaks the trace
+    jax.profiler.stop_trace()
+    return result

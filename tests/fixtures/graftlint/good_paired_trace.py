@@ -1,0 +1,36 @@
+"""GL-A4 negative fixture: every accepted pairing shape — try/finally,
+contextmanager, and __enter__/__exit__. Must produce ZERO violations."""
+
+import contextlib
+
+import jax
+
+
+def profile_step_finally(step, out_dir):
+    jax.profiler.start_trace(out_dir)
+    try:
+        return step()
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def capture(out_dir):
+    jax.profiler.start_trace(out_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class Capture:
+    def __init__(self, out_dir):
+        self.out_dir = out_dir
+
+    def __enter__(self):
+        jax.profiler.start_trace(self.out_dir)
+        return self
+
+    def __exit__(self, *exc):
+        jax.profiler.stop_trace()
+        return False

@@ -1,0 +1,11 @@
+"""GL-A5 fixture: raw jnp reductions in a models/ module where the
+ops.masked equivalents are mandated. Parsed, never run."""
+
+import jax.numpy as jnp
+
+
+def bad_factor(ctx):
+    mu = jnp.mean(ctx.ret_co, axis=-1)      # ignores the bar mask
+    sd = jnp.std(ctx.ret_co, axis=-1)       # wrong ddof AND no mask
+    nm = jnp.nanmean(ctx.volume, axis=-1)   # NaN != null semantics
+    return mu / sd + nm

@@ -1,0 +1,14 @@
+"""GL-A3 fixture: host-sync calls in a device-hot (ops/) module.
+Parsed, never run."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def leaky_kernel(x, mask):
+    s = jnp.sum(jnp.where(mask, x, 0.0), axis=-1)
+    n = s.item()                       # device->host sync
+    s.block_until_ready()              # dispatch barrier
+    h = np.asarray(s)                  # implicit transfer
+    f = float(jnp.max(s))              # sync via float()
+    return n, h, f

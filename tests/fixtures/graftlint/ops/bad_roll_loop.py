@@ -1,0 +1,18 @@
+"""GL-A2 fixture: serial loop constructs in a kernel-layer (ops/)
+module — the pre-PR-3 rolling-moment pathology. Parsed, never run."""
+
+import jax
+import jax.numpy as jnp
+
+
+def serial_second_moment(x, window=50):
+    acc = jnp.zeros_like(x)
+    for j in range(window):            # python loop of dependent rolls
+        acc = acc + jnp.roll(x, j, axis=-1) * x
+    return acc
+
+
+def serial_fori(x, window=50):
+    def body(j, acc):
+        return acc + x * j
+    return jax.lax.fori_loop(0, window, body, jnp.zeros_like(x))

@@ -1,0 +1,296 @@
+"""graftlint (the static analyzer) — docs/static-analysis.md.
+
+Three layers of coverage:
+
+* every AST rule (GL-A1..A5) fires on its injected-violation fixture
+  under ``tests/fixtures/graftlint/`` with the exact code AND location,
+  and the paired-resource negative fixture stays silent;
+* every jaxpr contract (GL-B0..B3) fires on a deliberately-bad kernel —
+  including the PR 3 revert scenario (a ``fori_loop``-of-``roll``
+  moment pass) tripping the serial-loop gate;
+* the baseline workflow round-trips (new violation -> nonzero; accepted
+  into the baseline with a justification -> clean; justification
+  mandatory; stale entries reported), and the REPO ITSELF is clean:
+  the acceptance test runs the real CLI exactly as run_tests.sh does.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from replication_of_minute_frequency_factor_tpu.analysis import (
+    Baseline, Violation, run_ast_tier)
+from replication_of_minute_frequency_factor_tpu.analysis.jaxpr_tier import (
+    check_kernel)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "graftlint")
+
+
+def _codes_by_file(violations):
+    out = {}
+    for v in violations:
+        out.setdefault(os.path.basename(v.path), []).append(
+            (v.code, v.line, v.symbol))
+    return out
+
+
+@pytest.fixture(scope="module")
+def fixture_violations():
+    violations, n_files = run_ast_tier(FIXTURES, display_base=REPO)
+    assert n_files == 6
+    return violations
+
+
+# --------------------------------------------------------------------------
+# Tier A: one fixture per rule, exact codes + locations
+# --------------------------------------------------------------------------
+
+
+def test_a1_fires_on_missing_jax_attributes(fixture_violations):
+    hits = _codes_by_file(fixture_violations)["bad_a1.py"]
+    assert ("GL-A1", 10, "jnp.maximum.accumulate") in hits
+    assert ("GL-A1", 16, "jax.distributed.is_initialized") in hits
+    # the resolvable chain (lax.cummax) must NOT fire
+    assert not any(h[1] == 21 for h in hits)
+    assert all(c == "GL-A1" for c, _, _ in hits)
+
+
+def test_a2_fires_on_serial_loops_in_ops(fixture_violations):
+    hits = _codes_by_file(fixture_violations)["bad_roll_loop.py"]
+    assert ("GL-A2", 11, "roll in loop") in hits
+    assert ("GL-A2", 18, "fori_loop") in hits
+
+
+def test_a3_fires_on_host_syncs_in_ops(fixture_violations):
+    hits = _codes_by_file(fixture_violations)["bad_hostsync.py"]
+    symbols = {s for _, _, s in hits}
+    assert symbols == {".item()", ".block_until_ready()", "np.asarray",
+                       "float(jax expression)"}
+    assert [c for c, _, _ in hits] == ["GL-A3"] * 4
+    assert sorted(ln for _, ln, _ in hits) == [10, 11, 12, 13]
+
+
+def test_a4_fires_on_unpaired_start_trace(fixture_violations):
+    hits = _codes_by_file(fixture_violations)["bad_unpaired_trace.py"]
+    assert hits == [("GL-A4", 9, "start_trace")]
+
+
+def test_a4_accepts_all_pairing_shapes(fixture_violations):
+    """try/finally, @contextmanager, and __enter__/__exit__ are all
+    guaranteed-release shapes — zero violations in the good fixture."""
+    assert "good_paired_trace.py" not in _codes_by_file(
+        fixture_violations)
+
+
+def test_a5_fires_on_raw_reductions_in_models(fixture_violations):
+    hits = _codes_by_file(fixture_violations)["bad_rawmean.py"]
+    assert [(c, s) for c, _, s in hits] == [
+        ("GL-A5", "jnp.mean"), ("GL-A5", "jnp.std"),
+        ("GL-A5", "jnp.nanmean")]
+
+
+def test_scope_rules_do_not_leak_outside_their_layers(
+        fixture_violations):
+    """bad_a1.py sits at the fixture root (not ops/ or models/), so the
+    scoped rules A2/A3/A5 must not fire there even though it imports
+    jax — scoping is what keeps host-side layers legal."""
+    hits = _codes_by_file(fixture_violations)["bad_a1.py"]
+    assert {c for c, _, _ in hits} == {"GL-A1"}
+
+
+# --------------------------------------------------------------------------
+# Tier B: jaxpr contracts on deliberately-bad kernels
+# --------------------------------------------------------------------------
+
+
+def test_b1_trips_on_the_pr3_revert():
+    """Reintroducing the pre-PR-3 serial moment pass — a fori_loop of
+    jnp.roll accumulations — must trip the serial-loop gate."""
+    import jax
+    import jax.numpy as jnp
+
+    def reverted_kernel(ctx):
+        def body(j, acc):
+            return acc + jnp.roll(ctx.low, j, axis=-1) * ctx.high
+        acc = jax.lax.fori_loop(0, 50, body, jnp.zeros_like(ctx.low))
+        return jnp.sum(acc, axis=-1)
+
+    vs, fp = check_kernel("reverted", reverted_kernel)
+    assert fp["traced"]
+    codes = {v.code for v in vs}
+    assert "GL-B1" in codes
+    assert any(v.symbol in ("while", "scan") and v.kernel == "reverted"
+               for v in vs)
+
+
+def test_b2_trips_on_f64_promotion():
+    import jax
+    import jax.numpy as jnp
+
+    def f64_kernel(ctx):
+        wide = jax.lax.convert_element_type(ctx.close, jnp.float64)
+        return jnp.sum(wide, axis=-1).astype(jnp.float32)
+
+    from jax.experimental import enable_x64
+    with enable_x64():
+        vs, fp = check_kernel("f64", f64_kernel)
+    assert fp["traced"]
+    assert any(v.code == "GL-B2"
+               and v.symbol == "convert_element_type[float64]"
+               for v in vs)
+
+
+def test_b3_trips_on_host_callback():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def callback_kernel(ctx):
+        return jax.pure_callback(
+            lambda c: np.mean(c, axis=-1),
+            jax.ShapeDtypeStruct(ctx.close.shape[:-1], jnp.float32),
+            ctx.close)
+
+    vs, fp = check_kernel("cb", callback_kernel)
+    assert fp["traced"]
+    assert any(v.code == "GL-B3" and "callback" in v.symbol for v in vs)
+
+
+def test_b0_trips_on_untraceable_kernel():
+    def crashy_kernel(ctx):
+        raise RuntimeError("boom at trace time")
+
+    vs, fp = check_kernel("crashy", crashy_kernel)
+    assert not fp["traced"]
+    assert vs[0].code == "GL-B0" and "boom" in vs[0].message
+
+
+def test_fingerprints_are_stable_and_loop_free():
+    """A clean kernel yields a deterministic primitive-count
+    fingerprint with no serial primitives — the committed
+    analysis_report.json diffs are meaningful."""
+    from replication_of_minute_frequency_factor_tpu.models.registry \
+        import resolve
+
+    vs1, fp1 = check_kernel("mmt_ols_qrs", resolve("mmt_ols_qrs"))
+    vs2, fp2 = check_kernel("mmt_ols_qrs", resolve("mmt_ols_qrs"))
+    assert vs1 == [] and fp1 == fp2
+    assert "while" not in fp1["primitives"]
+    assert "scan" not in fp1["primitives"]
+    assert fp1["primitives"].get("conv_general_dilated", 0) >= 1
+
+
+# --------------------------------------------------------------------------
+# baseline workflow
+# --------------------------------------------------------------------------
+
+
+def _v(code="GL-A3", path="pkg/ops/x.py", symbol="np.asarray"):
+    return Violation(code=code, path=path, line=7, symbol=symbol,
+                     message="m")
+
+
+def test_baseline_roundtrip(tmp_path):
+    p = str(tmp_path / "baseline.json")
+    b = Baseline()
+    new, accepted, stale = b.split([_v()])
+    assert len(new) == 1 and not accepted
+    b.extend(new, "intentional: host-side oracle")
+    b.save(p)
+    # accepted after reload; line drift doesn't matter
+    b2 = Baseline.load(p)
+    drifted = _v()
+    drifted.line = 99
+    new, accepted, stale = b2.split([drifted])
+    assert not new and len(accepted) == 1 and not stale
+    # a different symbol is a NEW violation, not covered
+    new, accepted, stale = b2.split([_v(symbol=".item()")])
+    assert len(new) == 1
+    # nothing matched -> the entry is reported stale
+    new, accepted, stale = b2.split([])
+    assert len(stale) == 1
+
+
+def test_baseline_requires_justification(tmp_path):
+    with pytest.raises(ValueError, match="justification"):
+        Baseline([{"code": "GL-A1", "path": "x", "symbol": "y",
+                   "kernel": "", "justification": "  "}])
+    with pytest.raises(ValueError, match="justification"):
+        Baseline().extend([_v()], "")
+
+
+# --------------------------------------------------------------------------
+# CLI acceptance: the repo itself is clean, violations exit nonzero
+# --------------------------------------------------------------------------
+
+
+def _run_cli(*args):
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m",
+         "replication_of_minute_frequency_factor_tpu", "analyze",
+         *args], capture_output=True, text=True, cwd=REPO, env=env,
+        timeout=600)
+
+
+def test_repo_is_clean_against_committed_baseline(tmp_path):
+    """THE acceptance gate: the default invocation walks all 58
+    kernels + the whole package AST and exits 0 against the committed
+    baseline, writing the machine-readable report."""
+    report = str(tmp_path / "report.json")
+    out = _run_cli("--report", report)
+    assert out.returncode == 0, out.stderr[-2000:]
+    verdict = json.loads(out.stdout.strip().splitlines()[-1])
+    assert verdict["ok"] is True and verdict["kernels"] == 58
+    rep = json.load(open(report))
+    assert rep["verdict"]["clean"] is True
+    assert len(rep["jaxpr"]["fingerprints"]) == 58
+    assert all(fp["traced"] and "while" not in fp["primitives"]
+               and "scan" not in fp["primitives"]
+               for fp in rep["jaxpr"]["fingerprints"].values())
+
+
+def test_cli_flags_fixtures_then_baseline_clears_them(tmp_path):
+    """Baseline workflow end-to-end through the real CLI: violations ->
+    exit 1; --update-baseline with a justification -> exit 0; the
+    justification is mandatory."""
+    base = str(tmp_path / "b.json")
+    report = str(tmp_path / "r.json")
+    args = ("--tier", "ast", "--paths", FIXTURES, "--baseline", base,
+            "--report", report)
+    out = _run_cli(*args)
+    assert out.returncode == 1
+    assert json.loads(out.stdout.strip().splitlines()[-1])["new"] == 12
+    # refuse to baseline without a why
+    out = _run_cli(*args, "--update-baseline")
+    assert out.returncode == 2
+    out = _run_cli(*args, "--update-baseline", "--justification",
+                   "fixtures: deliberate violations under test")
+    assert out.returncode == 0
+    entries = json.load(open(base))["entries"]
+    assert entries and all(e["justification"] for e in entries)
+    # and the accepted state is durable
+    out = _run_cli(*args)
+    assert out.returncode == 0
+    assert json.loads(
+        out.stdout.strip().splitlines()[-1])["baselined"] == 12
+
+
+def test_manifest_carries_the_analysis_block(tmp_path):
+    from replication_of_minute_frequency_factor_tpu.telemetry.manifest \
+        import build_manifest
+
+    m = build_manifest()
+    blk = m["analysis"]
+    assert blk["ast"]["clean"] is True, blk
+    assert blk["ast"]["files_scanned"] > 40
+    # the committed repo report is condensed in, when present
+    if os.path.exists(os.path.join(REPO, "analysis_report.json")):
+        assert blk["report"]["present"] is True
+        assert blk["report"]["kernels"] == 58

@@ -1,0 +1,65 @@
+"""Kernel purity under ``jax.transfer_guard("disallow")`` — the runtime
+twin of graftlint rule GL-A3 (docs/static-analysis.md).
+
+The whole module runs with implicit host<->device transfers disallowed
+(conftest ``TRANSFER_GUARDED_MODULES``): inputs are placed explicitly
+with ``jax.device_put``, results fetched explicitly with
+``jax.device_get``, and the fused 58-factor graph computes in between.
+Any kernel that grows a hidden ``.item()``/``float()``/numpy round-trip
+— or any code path that silently ships a host array to device — raises
+``XlaRuntimeError`` here, the runtime complement to the AST rule's
+static view. ``@pytest.mark.transfers`` is the documented opt-out and
+is exercised below so the escape hatch stays working.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from replication_of_minute_frequency_factor_tpu.models.registry import (
+    compute_factors_jit, factor_names)
+
+
+def _device_day_batch(seed=0, days=2, tickers=3):
+    rng = np.random.default_rng(seed)
+    close = 10.0 * np.exp(np.cumsum(
+        rng.standard_normal((days, tickers, 240)) * 1e-3, axis=-1))
+    bars = np.stack([close * 0.999, close * 1.001, close * 0.998,
+                     close, rng.integers(0, 5000,
+                                         (days, tickers, 240))],
+                    axis=-1).astype(np.float32)
+    mask = rng.random((days, tickers, 240)) > 0.05
+    mask[:, 0] = True  # one full-coverage ticker per day
+    return jax.device_put(bars), jax.device_put(mask)
+
+
+def test_all_58_kernels_compute_without_implicit_transfers():
+    """The acceptance contract: every registered kernel traces,
+    compiles, and executes with the guard up — explicit placement in,
+    explicit fetch out, zero implicit syncs in between."""
+    bars, mask = _device_day_batch()
+    names = factor_names()
+    assert len(names) == 58
+    out = compute_factors_jit(bars, mask)
+    assert set(out) == set(names)
+    host = jax.device_get(out)  # explicit d2h: allowed by design
+    for name, v in host.items():
+        assert v.shape == (2, 3), name
+        assert np.isfinite(v).any() or np.isnan(v).all(), name
+
+
+def test_guard_is_actually_armed():
+    """An implicit device->host sync must raise inside this module —
+    otherwise the whole file is a placebo."""
+    x = jax.device_put(np.ones(4, np.float32))
+    with pytest.raises(Exception, match="[Dd]isallowed"):
+        float(x[0])  # float() of a device array = implicit sync
+
+
+@pytest.mark.transfers
+def test_transfers_marker_opts_out():
+    """The documented escape hatch: a marked test may transfer
+    implicitly (this is how bench/eval-layer tests coexist with the
+    guard if they ever join TRANSFER_GUARDED_MODULES)."""
+    x = jax.device_put(np.ones(4, np.float32))
+    assert float(x[0]) == 1.0
