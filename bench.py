@@ -55,6 +55,16 @@ WARMUP = 1
 # CPU fallback pins it).
 MODE = os.environ.get("BENCH_MODE", "resident")
 
+# r7: the resident scan goes mesh-native when more than one device is
+# visible — the year's packed buffers shard over the tickers axis of a
+# (1, n) mesh (pipeline.compute_packed_resident_sharded), ingest of
+# scan group g+1 double-buffers against the execution of group g, and
+# outputs stay sharded until one consolidated per-group fetch.
+# BENCH_SHARDS pins the shard count (0/unset = all local devices);
+# n_shards == 1 falls back to the single-device resident scan and the
+# record's ``n_shards``/``methodology`` fields say which one ran.
+N_SHARDS = int(os.environ.get("BENCH_SHARDS", "0"))
+
 _SUFFIX = os.environ.get("BENCH_METRIC_SUFFIX", "")
 
 
@@ -243,6 +253,70 @@ def encode_year(batches, use_wire, max_passes=4):
     return [p[0] for p in packs], packs[0][1], "raw"
 
 
+def encode_year_sharded(batches, use_wire, n_shards, max_passes=4,
+                        bucket=1):
+    """Sharded twin of :func:`encode_year`: same shared widen-only
+    floor + spec-convergence loop, then each batch splits into
+    ``n_shards`` contiguous ticker blocks packed as one ``[S, L]``
+    stack (wire.pack_sharded). The tickers axis pads with masked lanes
+    to a multiple of lcm(bucket, n_shards) first — the same
+    TICKER_BUCKET x shard_mult lcm rule the pipeline's grid uses
+    (pipeline._grid_batch); the headline passes
+    ``bucket=pipeline.TICKER_BUCKET``, tests keep bucket=1 so tiny
+    years don't pad 8x. Returns ``(stacks, spec, kind, t_pad)`` where
+    ``stacks[i]`` is batch i's ``[S, L]`` uint8 stack."""
+    mult = int(bucket * n_shards // np.gcd(bucket, n_shards))
+    t = batches[0][0].shape[1]
+    t_pad = -(-t // mult) * mult
+    if t_pad != t:
+        pad_b = [(0, 0), (0, t_pad - t), (0, 0), (0, 0)]
+        pad_m = [(0, 0), (0, t_pad - t), (0, 0)]
+        batches = [(np.pad(b, pad_b), np.pad(m, pad_m))
+                   for b, m in batches]
+    tel = get_telemetry()
+    if use_wire:
+        floor: dict = {}
+        encs = [wire.encode(b, m, floor=floor) for b, m in batches]
+        for _ in range(max_passes):
+            if not all(e is not None for e in encs):
+                break  # unrepresentable under wire: raw fallback
+            packs = [wire.pack_sharded(e.arrays, n_shards) for e in encs]
+            if len({p[1] for p in packs}) == 1:
+                tel.counter("bench.encode_kind", kind="wire")
+                return ([p[0] for p in packs], packs[0][1], "wire",
+                        t_pad)
+            encs = [wire.encode(b, m, floor=floor) for b, m in batches]
+    packs = [wire.pack_sharded((b, m.view(np.uint8)), n_shards)
+             for b, m in batches]
+    tel.counter("bench.encode_kind", kind="raw")
+    return [p[0] for p in packs], packs[0][1], "raw", t_pad
+
+
+#: AOT-compiled resident executables, keyed on everything that shapes
+#: the module — lowering re-traces the whole 58-kernel graph (seconds
+#: of host work), so a memo hit must skip the .lower() call itself,
+#: not just the .compile()
+_AOT_COMPILED: dict = {}
+
+
+def _aot_resident(label, key, lower_fn, phases):
+    """First build of a resident scan executable through
+    telemetry.attribution.compile_with_telemetry (AOT lower+compile),
+    memoised per module shape: the ``compile``/``compile_s`` stage then
+    MEANS compile (and agrees with the manifest's ``xla`` block), and
+    every later execute stage means execute — the old jit path folded
+    the real compile cost into the first execute's wall."""
+    t0 = time.perf_counter()
+    if key not in _AOT_COMPILED:
+        from replication_of_minute_frequency_factor_tpu.telemetry import (
+            attribution as _attr)
+        _AOT_COMPILED[key] = _attr.compile_with_telemetry(label,
+                                                          lower_fn())
+    phases["compile_s"] = round(
+        phases.get("compile_s", 0.0) + time.perf_counter() - t0, 3)
+    return _AOT_COMPILED[key]
+
+
 def run_resident(batches, names, use_wire, group, keep_results=False):
     """The whole year in O(1) host round trips (VERDICT r4 #2):
 
@@ -258,9 +332,17 @@ def run_resident(batches, names, use_wire, group, keep_results=False):
     don't, and a year of results held live would double host RSS).
     2 + ceil(N/group) host-blocking syncs per year vs the stream
     loop's 2 per batch; the ~12 s/round-trip fixed cost (TPU_SESSION
-    sweep) is paid once per scan group."""
+    sweep) is paid once per scan group.
+
+    The scan executable is AOT-built through
+    ``compile_with_telemetry`` (memoised per module shape — see
+    :func:`_aot_resident`), so ``phases['compile_s']`` is real compile
+    wall on the first run and ~0 on warm reruns, and ``compute_s``
+    always means execute."""
+    from replication_of_minute_frequency_factor_tpu.config import (
+        get_config)
     from replication_of_minute_frequency_factor_tpu.pipeline import (
-        compute_packed_resident)
+        lower_packed_resident)
     phases = {}
     t0 = time.perf_counter()
     bufs, spec, kind = encode_year(batches, use_wire)
@@ -271,15 +353,27 @@ def run_resident(batches, names, use_wire, group, keep_results=False):
     jax.block_until_ready(dbufs)
     phases["ingest_s"] = round(time.perf_counter() - t0, 3)
     phases["ingest_MB"] = round(sum(b.nbytes for b in bufs) / 1e6, 1)
-    t0 = time.perf_counter()
+    roll = get_config().rolling_impl
     outs = []
+    t0 = time.perf_counter()
+    compute_t0 = None
     for g0 in range(0, len(dbufs), group):
-        outs.append(compute_packed_resident(
-            tuple(dbufs[g0:g0 + group]), spec, kind, names=names,
-            replicate_quirks=True))
+        gbufs = tuple(dbufs[g0:g0 + group])
+        compiled = _aot_resident(
+            "bench_resident_scan",
+            ("resident", len(gbufs), gbufs[0].shape, spec, kind, names,
+             roll),
+            lambda: lower_packed_resident(gbufs, spec, kind,
+                                          names=names,
+                                          rolling_impl=roll),
+            phases)
+        if compute_t0 is None:  # compile attributed apart from execute
+            compute_t0 = time.perf_counter()
+        outs.append(compiled(gbufs))
     _count_sync("resident_compute")
     jax.block_until_ready(outs)
-    phases["compute_s"] = round(time.perf_counter() - t0, 3)
+    phases["compute_s"] = round(
+        time.perf_counter() - (compute_t0 or t0), 3)
     t0 = time.perf_counter()
     results = [] if keep_results else None
     fetched_mb = 0.0
@@ -289,6 +383,106 @@ def run_resident(batches, names, use_wire, group, keep_results=False):
         fetched_mb += h.nbytes
         if keep_results:
             results.extend(h)
+    phases["fetch_s"] = round(time.perf_counter() - t0, 3)
+    phases["fetch_MB"] = round(fetched_mb / 1e6, 1)
+    return phases, kind, results
+
+
+def run_resident_sharded(batches, names, use_wire, group, mesh,
+                         keep_results=False, bucket=1):
+    """The resident year, mesh-native AND ingest-overlapped:
+
+      encode  — host: shared-floor wire-encode + per-shard pack
+                (encode_year_sharded; tickers padded to the shard
+                multiple with masked lanes)
+      ingest  — scan group 0's ``[g, S, L]`` stack device_puts with a
+                NamedSharding over the mesh tickers axis; every LATER
+                group's put is dispatched while the previous group's
+                scan executes, so its transfer hides behind device
+                compute instead of serializing ahead of it. No ingest
+                ever blocks the host: the executable's data dependency
+                orders transfer before compute.
+      compute — one sharded scan executable per group
+                (pipeline.compute_packed_resident_sharded's module,
+                AOT-built via compile_with_telemetry), zero collectives
+                outside the doc_pdf* rank gather; outputs stay sharded
+                on device.
+      fetch   — one consolidated per-group ``np.asarray`` (gathers
+                each shard's contiguous block once).
+
+    Host-blocking syncs per year: 1 (the compute block) +
+    ceil(N/group) fetches — O(1), not O(batches), and ingest
+    contributes ZERO blocking syncs. ``phases['ingest_hidden_s']``
+    (also the ``resident.ingest_hidden_s`` gauge) is the host wall
+    spent dispatching puts while earlier groups' compute was in flight
+    — on async transports it is a lower bound of the hidden transfer
+    time; on the CPU backend the put IS the copy, so it is exact.
+    """
+    from replication_of_minute_frequency_factor_tpu.config import (
+        get_config)
+    from replication_of_minute_frequency_factor_tpu.parallel.mesh import (
+        put_packed_year)
+    from replication_of_minute_frequency_factor_tpu.pipeline import (
+        lower_packed_resident_sharded)
+    tel = get_telemetry()
+    n_shards = mesh.devices.size
+    # counts stay OUT of phases: reconcile() sums every bare numeric
+    # entry as seconds (the record carries n_shards/groups separately)
+    phases = {}
+    t0 = time.perf_counter()
+    stacks, spec, kind, t_pad = encode_year_sharded(
+        batches, use_wire, n_shards, bucket=bucket)
+    phases["encode_s"] = round(time.perf_counter() - t0, 3)
+    groups = [np.stack(stacks[g0:g0 + group])  # [g, S, L] per group
+              for g0 in range(0, len(stacks), group)]
+    phases["ingest_MB"] = round(
+        sum(g.nbytes for g in groups) / 1e6, 1)
+    roll = get_config().rolling_impl
+    t0 = time.perf_counter()
+    pend = put_packed_year(groups[0], mesh)
+    phases["ingest_s"] = round(time.perf_counter() - t0, 3)
+    outs = []
+    hidden = 0.0
+    compute_t0 = None
+    t0 = time.perf_counter()
+    for gi in range(len(groups)):
+        d = pend
+        compiled = _aot_resident(
+            "bench_resident_scan_sharded",
+            ("sharded", d.shape, spec, kind, names, roll, mesh),
+            lambda: lower_packed_resident_sharded(d, spec, kind, mesh,
+                                                  names=names,
+                                                  rolling_impl=roll),
+            phases)
+        if compute_t0 is None:
+            compute_t0 = time.perf_counter()
+        outs.append(compiled(d))
+        if gi + 1 < len(groups):
+            # double-buffer: group gi+1's transfer rides behind group
+            # gi's execution; dispatch only, never block
+            t1 = time.perf_counter()
+            pend = put_packed_year(groups[gi + 1], mesh)
+            hidden += time.perf_counter() - t1
+    _count_sync("resident_compute")
+    jax.block_until_ready(outs)
+    phases["compute_s"] = round(
+        time.perf_counter() - (compute_t0 or t0), 3)
+    # 6 decimals, not the usual 3: a small smoke's overlapped put
+    # dispatch is sub-millisecond, and "overlap happened at all" must
+    # survive the rounding
+    phases["ingest_hidden_s"] = round(hidden, 6)
+    tel.gauge("resident.ingest_hidden_s", round(hidden, 6),
+              n_shards=str(n_shards))
+    t0 = time.perf_counter()
+    results = [] if keep_results else None
+    fetched_mb = 0.0
+    n_tickers = batches[0][0].shape[1]
+    for o in outs:
+        _count_sync("resident_fetch")
+        h = np.asarray(o)  # [g, F, D, T_pad], one gather per shard
+        fetched_mb += h.nbytes
+        if keep_results:
+            results.extend(h[..., :n_tickers])
     phases["fetch_s"] = round(time.perf_counter() - t0, 3)
     phases["fetch_MB"] = round(fetched_mb / 1e6, 1)
     return phases, kind, results
@@ -341,6 +535,69 @@ def resident_diag(batches, names, use_wire, stream_results):
         return block
     except Exception as e:  # noqa: BLE001 — diagnostic only
         return {"equal": None, "error": f"{type(e).__name__}: {e}"[:300]}
+
+
+#: factors the sharded-resident smoke drives: one per family shape
+#: class — plain masked reduction, rolling-window (the scan-sensitive
+#: family), segment sort, top-k, the cross-sectional doc_pdf rank (the
+#: ONLY collective), and the two std-ratio kernels whose division XLA
+#: fuses shape-dependently (ulp-level, the documented non-bitwise pair)
+_SMOKE_FACTORS = ("vol_return1min", "mmt_ols_qrs", "doc_kurt",
+                  "doc_vol10_ratio", "doc_pdf60", "vol_upRatio",
+                  "trade_headRatio")
+
+#: kernels where sharded-vs-single equality is ulp-level, not bitwise:
+#: their sqrt/sqrt division fuses differently per module shape (XLA
+#: cost-model-dependent; observed 1-4 ulps on CPU). Everything else —
+#: including the collective-routed doc_pdf* — must be BITWISE.
+_ULP_FACTORS = frozenset({"vol_upRatio", "vol_downRatio"})
+
+
+def sharded_smoke(n_batches=2, days=2, tickers=32, names=None,
+                  group=None):
+    """run_tests.sh --quick smoke: the sharded resident scan vs the
+    single-device resident scan over every visible device, on a small
+    synthetic year. Returns ONE JSON-able verdict dict; ``ok`` is True
+    iff every factor matches bitwise (ulp-tolerance only for the
+    documented ``_ULP_FACTORS`` pair) AND the overlap metric fired when
+    more than one scan group ran."""
+    from replication_of_minute_frequency_factor_tpu.parallel import (
+        resident_mesh)
+    rng = np.random.default_rng(11)
+    names = tuple(names or _SMOKE_FACTORS)
+    batches = [make_batch(rng, n_days=days, n_tickers=tickers)
+               for _ in range(n_batches)]
+    use_wire = wire.encode(*batches[0]) is not None
+    group = group or max(1, -(-n_batches // 2))
+    mesh = resident_mesh()
+    n_shards = mesh.devices.size
+    _, _, single = run_resident(batches, names, use_wire,
+                                group=n_batches, keep_results=True)
+    phases, kind, sharded = run_resident_sharded(
+        batches, names, use_wire, group, mesh, keep_results=True)
+    bad, max_diff = [], 0.0
+    for i, (s, r) in enumerate(zip(single, sharded)):
+        for j, n in enumerate(names):
+            a, b = np.asarray(s[j]), np.asarray(r[j])
+            if np.array_equal(a, b, equal_nan=True):
+                continue
+            f = np.isfinite(a) & np.isfinite(b)
+            d = float(np.abs(a[f] - b[f]).max(initial=0.0))
+            max_diff = max(max_diff, d)
+            scale = float(np.abs(a[f]).max(initial=1.0)) or 1.0
+            if n in _ULP_FACTORS and np.array_equal(
+                    np.isfinite(a), np.isfinite(b)) \
+                    and d <= 16 * np.finfo(np.float32).eps * scale:
+                continue
+            bad.append(n)
+    groups = -(-n_batches // group)
+    overlap_ok = groups < 2 or phases.get("ingest_hidden_s", 0) > 0
+    return {"smoke": "sharded_resident", "n_shards": n_shards,
+            "batches": n_batches, "factors": len(names),
+            "encode_kind": kind, "scan_groups": groups,
+            "ingest_hidden_s": phases.get("ingest_hidden_s"),
+            "mismatched": sorted(set(bad)), "max_abs_diff": max_diff,
+            "ok": not bad and overlap_ok and n_shards > 1}
 
 
 def probe_latency(rng, n=3):
@@ -541,133 +798,18 @@ def main():
     # warmup ships its own batches so the timed loop's bytes are cold in
     # any transfer-path cache; it runs BEFORE the timed batches are
     # synthesized so an OOM retry doesn't waste a year's worth of synth
-    consolidate = os.environ.get("BENCH_CONSOLIDATE") == "1"
-    mode = "stream" if is_cpu_fallback else MODE
-    group = int(os.environ.get("BENCH_RESIDENT_GROUP", "0")) or iters
-    warm_info: dict = {}
-
-    class _ResidentOOM(RuntimeError):
-        """Resident scan still OOMs at group == 1 — signal for the
-        stream-mode fallback below (ADVICE r5: re-raising here lost the
-        hardware window with nothing banked)."""
-
-    def _warm_resident(group):
-        """Compile + first-execute the resident scan graph on DISTINCT
-        warm bytes (same caching rationale as the stream warmup), full
-        fetch included so every path the timed run takes is warm. OOM
-        halves ``group`` (smaller scan groups shrink the resident
-        input + output footprint) down to single-batch groups; an OOM
-        at group == 1 raises ``_ResidentOOM`` so the caller can fall
-        back to the stream loop instead of losing the window."""
-        wb = [make_batch(rng, n_days=days) for _ in range(iters)]
-        while True:
-            try:
-                t0 = time.perf_counter()
-                wp, _, _ = run_resident(wb, names, use_wire, group)
-                warm_info["warm_total_s"] = round(
-                    time.perf_counter() - t0, 1)
-                warm_info["warm_phases"] = wp
-                return group
-            except Exception as e:  # noqa: BLE001 — filtered to OOM
-                oom = any(s in str(e) for s in
-                          ("RESOURCE_EXHAUSTED", "Out of memory",
-                           "out of memory"))
-                if not oom:
-                    raise
-                if group <= 1:
-                    raise _ResidentOOM(str(e)[:300]) from e
-                group = max(1, group // 2)
-                print(f"# resident scan exhausted device memory; "
-                      f"retrying with group={group}",
-                      file=sys.stderr, flush=True)
-
-    def _warm(n_days):
-        # launch BOTH warm batches before blocking, with the result
-        # copies in flight — the timed loop keeps 2-3 batches' buffers
-        # live simultaneously, and an OOM that only manifests at the
-        # pipelined peak must fire HERE, inside the fallback's
-        # try/except, not mid-loop where it would lose the window
-        w = [make_batch(rng, n_days=n_days) for _ in range(2)]
-        for _ in range(warmup):
-            outs_w = [launch(encode_pack(*b)) for b in w]
-            for o in outs_w:
-                o.copy_to_host_async()
-            for o in outs_w:
-                jax.block_until_ready(o)
-            if consolidate:
-                # warm the consolidated path's device concat at the
-                # EXACT shape the timed loop uses (iters refs of
-                # [F, days, T] — XLA specializes on arity/shape), or
-                # its first compile lands inside the timed window and
-                # biases the A/B this mode exists to decide
-                import jax.numpy as jnp
-                refs = (outs_w * ((iters + 1) // 2))[:iters]
-                jax.block_until_ready(jnp.concatenate(refs, axis=1))
-
-    if mode == "resident":
-        try:
-            group = _warm_resident(group)
-        except _ResidentOOM as e:
-            # even single-batch scan groups exhaust HBM: keep the
-            # hardware window and bank a STREAM number at the proven
-            # 8-day shape instead of re-raising with nothing recorded
-            # (ADVICE r5); the record's mode/methodology fields flip
-            # with it, so the number can never be read as resident
-            print("# resident scan OOM at group=1; falling back to "
-                  "stream mode at the proven 8-day shape",
-                  file=sys.stderr, flush=True)
-            mode = "stream"
-            warm_info["resident_oom_fallback"] = str(e)[:200]
-            days, iters = 8, max(iters, 5)
-    if mode == "stream":
-        try:
-            _warm(days)
-        except Exception as e:  # noqa: BLE001 — filtered to OOM below
-            oom = any(s in str(e) for s in
-                      ("RESOURCE_EXHAUSTED", "Out of memory",
-                       "out of memory"))
-            if not oom or days <= 8:
-                raise
-            # the 32-day shape is this round's bet; a chip that can't
-            # hold it must not cost the up-window — fall back to the
-            # proven 8-day shape (r3's configuration) and keep going
-            print(f"# {days}-day batch exhausted device memory; retrying "
-                  "with 8-day batches", file=sys.stderr, flush=True)
-            days, iters = 8, max(iters, 5)
-            _warm(days)
-
-    # one DISTINCT batch per timed iteration: the real driver never ships
-    # the same bytes twice, and repeating a buffer would let any
-    # content-addressed caching in the transfer path (tunnel or
-    # otherwise) flatter the number — distinct batches cost nothing if
-    # no such layer exists
-    batches = [make_batch(rng, n_days=days) for _ in range(iters)]
-
-    # Link-quality probe, reported alongside the headline: the chip sits
-    # behind a tunnel whose bandwidth swings by >10x hour to hour, and
-    # the headline is transfer-bound — without these keys a slow-link
-    # run is indistinguishable from a slow-code run. Distinct bytes both
-    # ways (see the caching note above). Tunnel-attached runs only: on
-    # the CPU fallback (or any local platform) it would time memcpy.
-    # The latency floor comes first — it's the cheapest number and the
-    # one that decides the batch-size story (VERDICT r3 weak #2).
-    # BENCH_LINK=0 skips both probes (~1 min): a variant step fired in
-    # the same up-window as the main headline would only re-measure
-    # what the headline/link steps already banked.
-    link_down = link_up = link_wait = lat_put_ms = lat_get_ms = None
-    if ("PALLAS_AXON_POOL_IPS" in os.environ and not is_cpu_fallback
-            and os.environ.get("BENCH_LINK", "1") != "0"):
-        lat_put_ms, lat_get_ms = probe_latency(rng)
-        link_down, link_up, link_wait = measure_link(rng)
-
     # Stage attribution, now on EVERY backend (VERDICT r3 #1a: three
     # rounds of TPU headlines could not be decomposed into transfer vs
     # compute, so the optimization target was a guess). One serial
     # 8-day batch — always 8 regardless of the loop's batch size, so
     # the stage series stays comparable across configurations and with
-    # the r1-r3 fallback series; it runs BEFORE the timed loop so a
-    # tunnel window that closes mid-loop still never half-times it, and
-    # the 8-day graph is a persistent-cache hit from prior rounds.
+    # the r1-r3 fallback series; it runs BEFORE the warmup so its AOT
+    # lower+compile is the process's FIRST build of the packed graph —
+    # the ``compile`` stage therefore measures a real compile (and
+    # agrees with the manifest's xla block) instead of reading ~0.001 s
+    # off a cache the jit warmup already populated, with the real cost
+    # folded into device_exec_first; being pre-loop also means a tunnel
+    # window that closes mid-loop never half-times it.
     # BENCH_STAGES=0 skips it when an up-window is too short to spare.
     stages = None
     if os.environ.get("BENCH_STAGES", "1") != "0":
@@ -746,6 +888,197 @@ def main():
         # never tested, and an OOM mid-loop is uncatchable there
         del b, m, sbuf, dbuf, out, compiled
 
+    consolidate = os.environ.get("BENCH_CONSOLIDATE") == "1"
+    mode = "stream" if is_cpu_fallback else MODE
+    # r7 mesh resolution: the resident scan shards the tickers axis
+    # over every visible device (BENCH_SHARDS pins it; 1 device = the
+    # single-device r6 loop). The headline pads tickers to the
+    # TICKER_BUCKET x n_shards lcm like the pipeline grid; tiny
+    # BENCH_TICKERS smokes pad to the shard multiple only.
+    n_shards = 1
+    mesh = None
+    shard_bucket = 1
+    if mode == "resident" and not is_cpu_fallback:
+        avail = len(jax.devices())
+        n_shards = max(1, min(N_SHARDS or avail, avail))
+        if n_shards > 1:
+            from replication_of_minute_frequency_factor_tpu.parallel import (
+                resident_mesh)
+            from replication_of_minute_frequency_factor_tpu.pipeline import (
+                TICKER_BUCKET)
+            mesh = resident_mesh(n_shards)
+            if N_TICKERS >= TICKER_BUCKET:
+                shard_bucket = TICKER_BUCKET
+    # sharded default: two scan groups, so group 1's ingest genuinely
+    # double-buffers behind group 0's execution (ingest_hidden_s > 0);
+    # single-device default stays one group (the r6 3-sync shape)
+    group = int(os.environ.get("BENCH_RESIDENT_GROUP", "0")) or (
+        -(-iters // 2) if mesh is not None else iters)
+    warm_info: dict = {}
+
+    class _ResidentOOM(RuntimeError):
+        """Resident scan still OOMs at group == 1 — signal for the
+        stream-mode fallback below (ADVICE r5: re-raising here lost the
+        hardware window with nothing banked)."""
+
+    def _warm_resident(group):
+        """Compile + first-execute the resident scan graph on DISTINCT
+        warm bytes (same caching rationale as the stream warmup), full
+        fetch included so every path the timed run takes is warm. OOM
+        halves ``group`` (smaller scan groups shrink the resident
+        input + output footprint) down to single-batch groups; an OOM
+        at group == 1 raises ``_ResidentOOM`` so the caller can fall
+        back to the stream loop instead of losing the window."""
+        wb = [make_batch(rng, n_days=days) for _ in range(iters)]
+        while True:
+            try:
+                t0 = time.perf_counter()
+                wp, _, _ = run_resident(wb, names, use_wire, group)
+                warm_info["warm_total_s"] = round(
+                    time.perf_counter() - t0, 1)
+                warm_info["warm_phases"] = wp
+                return group
+            except Exception as e:  # noqa: BLE001 — filtered to OOM
+                oom = any(s in str(e) for s in
+                          ("RESOURCE_EXHAUSTED", "Out of memory",
+                           "out of memory"))
+                if not oom:
+                    raise
+                if group <= 1:
+                    raise _ResidentOOM(str(e)[:300]) from e
+                group = max(1, group // 2)
+                print(f"# resident scan exhausted device memory; "
+                      f"retrying with group={group}",
+                      file=sys.stderr, flush=True)
+
+    def _warm(n_days):
+        # launch BOTH warm batches before blocking, with the result
+        # copies in flight — the timed loop keeps 2-3 batches' buffers
+        # live simultaneously, and an OOM that only manifests at the
+        # pipelined peak must fire HERE, inside the fallback's
+        # try/except, not mid-loop where it would lose the window
+        w = [make_batch(rng, n_days=n_days) for _ in range(2)]
+        for _ in range(warmup):
+            outs_w = [launch(encode_pack(*b)) for b in w]
+            for o in outs_w:
+                o.copy_to_host_async()
+            for o in outs_w:
+                jax.block_until_ready(o)
+            if consolidate:
+                # warm the consolidated path's device concat at the
+                # EXACT shape the timed loop uses (iters refs of
+                # [F, days, T] — XLA specializes on arity/shape), or
+                # its first compile lands inside the timed window and
+                # biases the A/B this mode exists to decide
+                import jax.numpy as jnp
+                refs = (outs_w * ((iters + 1) // 2))[:iters]
+                jax.block_until_ready(jnp.concatenate(refs, axis=1))
+
+    def _warm_resident_sharded(group):
+        """Sharded twin of ``_warm_resident``: compile + first-execute
+        the SHARDED scan (AOT, so the compile lands in the registry)
+        on distinct warm bytes, full overlapped-ingest + fetch
+        included. OOM halves the scan group; an OOM at group == 1
+        raises ``_ResidentOOM`` and the caller steps DOWN THE LADDER to
+        the single-device resident scan (then stream) instead of
+        losing the window."""
+        wb = [make_batch(rng, n_days=days) for _ in range(iters)]
+        g = group
+        while True:
+            try:
+                t0 = time.perf_counter()
+                wp, _, _ = run_resident_sharded(wb, names, use_wire, g,
+                                                mesh,
+                                                bucket=shard_bucket)
+                warm_info["warm_total_s"] = round(
+                    time.perf_counter() - t0, 1)
+                warm_info["warm_phases"] = wp
+                return g
+            except Exception as e:  # noqa: BLE001 — filtered to OOM
+                oom = any(s in str(e) for s in
+                          ("RESOURCE_EXHAUSTED", "Out of memory",
+                           "out of memory"))
+                if not oom:
+                    raise
+                if g <= 1:
+                    raise _ResidentOOM(str(e)[:300]) from e
+                g = max(1, g // 2)
+                print(f"# sharded resident scan exhausted device "
+                      f"memory; retrying with group={g}",
+                      file=sys.stderr, flush=True)
+
+    if mode == "resident" and mesh is not None:
+        try:
+            group = _warm_resident_sharded(group)
+        except _ResidentOOM as e:
+            # first rung of the r7 ladder: sharded -> single-device
+            # resident. The record's n_shards/methodology fields flip
+            # with the fallback, so a single-device number can never
+            # be read as a sharded one.
+            print("# sharded resident scan OOM at group=1; falling "
+                  "back to the single-device resident scan",
+                  file=sys.stderr, flush=True)
+            warm_info["sharded_oom_fallback"] = str(e)[:200]
+            mesh = None
+            n_shards = 1
+            group = int(os.environ.get("BENCH_RESIDENT_GROUP",
+                                       "0")) or iters
+    if mode == "resident" and mesh is None:
+        try:
+            group = _warm_resident(group)
+        except _ResidentOOM as e:
+            # even single-batch scan groups exhaust HBM: keep the
+            # hardware window and bank a STREAM number at the proven
+            # 8-day shape instead of re-raising with nothing recorded
+            # (ADVICE r5); the record's mode/methodology fields flip
+            # with it, so the number can never be read as resident
+            print("# resident scan OOM at group=1; falling back to "
+                  "stream mode at the proven 8-day shape",
+                  file=sys.stderr, flush=True)
+            mode = "stream"
+            warm_info["resident_oom_fallback"] = str(e)[:200]
+            days, iters = 8, max(iters, 5)
+    if mode == "stream":
+        try:
+            _warm(days)
+        except Exception as e:  # noqa: BLE001 — filtered to OOM below
+            oom = any(s in str(e) for s in
+                      ("RESOURCE_EXHAUSTED", "Out of memory",
+                       "out of memory"))
+            if not oom or days <= 8:
+                raise
+            # the 32-day shape is this round's bet; a chip that can't
+            # hold it must not cost the up-window — fall back to the
+            # proven 8-day shape (r3's configuration) and keep going
+            print(f"# {days}-day batch exhausted device memory; retrying "
+                  "with 8-day batches", file=sys.stderr, flush=True)
+            days, iters = 8, max(iters, 5)
+            _warm(days)
+
+    # one DISTINCT batch per timed iteration: the real driver never ships
+    # the same bytes twice, and repeating a buffer would let any
+    # content-addressed caching in the transfer path (tunnel or
+    # otherwise) flatter the number — distinct batches cost nothing if
+    # no such layer exists
+    batches = [make_batch(rng, n_days=days) for _ in range(iters)]
+
+    # Link-quality probe, reported alongside the headline: the chip sits
+    # behind a tunnel whose bandwidth swings by >10x hour to hour, and
+    # the headline is transfer-bound — without these keys a slow-link
+    # run is indistinguishable from a slow-code run. Distinct bytes both
+    # ways (see the caching note above). Tunnel-attached runs only: on
+    # the CPU fallback (or any local platform) it would time memcpy.
+    # The latency floor comes first — it's the cheapest number and the
+    # one that decides the batch-size story (VERDICT r3 weak #2).
+    # BENCH_LINK=0 skips both probes (~1 min): a variant step fired in
+    # the same up-window as the main headline would only re-measure
+    # what the headline/link steps already banked.
+    link_down = link_up = link_wait = lat_put_ms = lat_get_ms = None
+    if ("PALLAS_AXON_POOL_IPS" in os.environ and not is_cpu_fallback
+            and os.environ.get("BENCH_LINK", "1") != "0"):
+        lat_put_ms, lat_get_ms = probe_latency(rng)
+        link_down, link_up, link_wait = measure_link(rng)
+
     # Steady state, double-buffered exactly like the real driver
     # (pipeline._run_device_pipeline): a producer thread encodes batch
     # i+1 while the device runs batch i, at most two batches in flight.
@@ -801,13 +1134,23 @@ def main():
     with loop_trace:
         if mode == "resident":
             t0 = time.perf_counter()
-            phases, _kind, _ = run_resident(batches, names, use_wire,
-                                            group)
+            if mesh is not None:
+                phases, _kind, _ = run_resident_sharded(
+                    batches, names, use_wire, group, mesh,
+                    bucket=shard_bucket)
+                # puts are per GROUP stack (none of them host-blocking;
+                # group >= 1 overlaps the previous group's execution)
+                round_trips = {"puts_async": -(-iters // group),
+                               "executes": -(-iters // group),
+                               "fetches": -(-iters // group)}
+            else:
+                phases, _kind, _ = run_resident(batches, names,
+                                                use_wire, group)
+                round_trips = {"puts_async": iters,
+                               "executes": -(-iters // group),
+                               "fetches": -(-iters // group)}
             wall = time.perf_counter() - t0
             per_batch = wall / iters
-            round_trips = {"puts_async": iters,
-                           "executes": -(-iters // group),
-                           "fetches": -(-iters // group)}
             recon_components = phases
         else:
             # serial consumer-side decomposition for the reconciliation
@@ -943,10 +1286,22 @@ def main():
         # pass) changes device compute on every backend, and the
         # packed/resident buffers are now donated on accelerators —
         # r5_resident_v1/r4_stream_v2 numbers are not comparable.
-        # docs/BENCHMARKS.md records the series history.
+        # docs/BENCHMARKS.md records the series history. r7 DECLARES
+        # "r7_resident_sharded_v1" for the mesh-native resident scan
+        # (tickers-sharded buffers + overlapped group ingest change
+        # both the module and the loop); a resident run whose mesh
+        # resolved to one device stays on the r6 series, and the
+        # record's n_shards field is the discriminator.
         "mode": mode,
-        "methodology": ("r6_resident_v2" if mode == "resident"
+        "methodology": ("r7_resident_sharded_v1"
+                        if mode == "resident" and n_shards > 1
+                        else "r6_resident_v2" if mode == "resident"
                         else "r6_stream_v3"),
+        # how many mesh shards the tickers axis actually resolved to
+        # (1 = single-device; tpu_session's resident_sharded step banks
+        # only n_shards > 1 — a silent single-device fallback cannot
+        # count as sharded validation)
+        "n_shards": n_shards if mode == "resident" else 1,
         # which rolling backend was REQUESTED (config) and which one
         # the graphs actually RESOLVED to at trace time (registry
         # counter; 'conv' under a 'pallas' request = the off-TPU

@@ -138,12 +138,24 @@ def drop_conv_only_rolling(steps):
       (round-5 ADVICE medium). Pre-stamp records have no ``tickers``
       key and are dropped — they re-run once under the new schema;
     * 'stream' entries must be ``mode: stream`` records (the r1-r4
-      series continuation under its own metric suffix).
+      series continuation under its own metric suffix);
+    * 'resident_sharded' entries must be records of the r7 mesh-native
+      resident scan that ACTUALLY sharded: a ``mode: resident`` record
+      under the ``_sharded`` metric suffix with ``n_shards > 1`` and
+      the 5000-ticker stamp — the same "silent fallback cannot bank"
+      rule as the pallas step (a single-device resolution banks
+      nothing; the next multi-device window must re-run it).
     """
     def keep(name, v):
         recs = [r for r in v.get("results") or [] if isinstance(r, dict)]
         if name in ("rolling", "headc"):
             return False  # steps removed in r4/r5
+        if name == "resident_sharded":
+            return any("_sharded" in str(r.get("metric", ""))
+                       and r.get("mode") == "resident"
+                       and isinstance(r.get("n_shards"), int)
+                       and r.get("n_shards") > 1
+                       and r.get("tickers") == 5000 for r in recs)
         if name == "pallas":
             # rolling_impl_resolved (not just requested): a record whose
             # graphs silently fell back to conv is NOT kernel validation
@@ -239,6 +251,34 @@ def step_pallas():
                              "BENCH_LINK": "0",
                              "MFF_PROFILE_DIR": os.path.join(
                                  REPO, ".bench_data", "profile_pallas")})
+
+
+def step_resident_sharded():
+    """The r7 mesh-native resident scan (ISSUE 5), SAME hardware window
+    as the headline and the still-unvalidated r5/r6 single-device
+    resident scan: bench in resident mode under the ``_sharded`` metric
+    suffix, banking ONLY when the tickers mesh actually resolved to
+    more than one device (``n_shards > 1`` in the record) — the mirror
+    of the pallas step's "silent fallback cannot bank" rule. On the
+    single attached chip this step fails loudly and keeps re-running
+    until a multi-device window exists; interpret-mode parity is
+    already gated in tier-1 on 8 virtual CPU devices
+    (tests/test_sharded_resident.py), this is the hardware half. Link
+    probes + the 8-day stage pass stay off — the headline banks those
+    diagnostics this window."""
+    r = _run_bench_gated({"BENCH_MODE": "resident",
+                          "BENCH_METRIC_SUFFIX": "_sharded",
+                          "BENCH_STAGES": "0", "BENCH_LINK": "0"})
+    if r.get("ok") and not any(
+            isinstance(rec, dict)
+            and isinstance(rec.get("n_shards"), int)
+            and rec.get("n_shards") > 1
+            for rec in r.get("results") or []):
+        r["ok"] = False
+        r["error"] = ("sharded resident resolved to n_shards<=1 "
+                      "(single-device fallback) — not sharded "
+                      "validation; cannot bank")
+    return r
 
 
 def step_ladder():
@@ -338,7 +378,12 @@ def main():
     # pallas rides directly behind the headline: the conv-vs-pallas A/B
     # is only meaningful inside ONE window, and the kernel's hardware
     # validation is this round's must-bank evidence (ISSUE 3)
-    ap.add_argument("--steps", default="headline,pallas,link,stream,"
+    # resident_sharded rides directly behind the headline: the r7
+    # sharded scan's hardware validation is this round's must-bank
+    # evidence, and it only banks when the mesh really resolved to
+    # multiple devices (ISSUE 5)
+    ap.add_argument("--steps", default="headline,resident_sharded,"
+                    "pallas,link,stream,"
                     "lad1,lad2,lad4,lad5,spot,sweep,pipeline")
     ap.add_argument("--one-step", default=None,
                     help="internal: run one step's body in-process and "
@@ -406,6 +451,7 @@ def main():
              "spot": step_graph_spotcheck, "sweep": step_sweep,
              "link": step_link, "pipeline": step_pipeline,
              "stream": step_stream, "pallas": step_pallas,
+             "resident_sharded": step_resident_sharded,
              "lad1": _step_ladder_one("1"), "lad2": _step_ladder_one("2"),
              "lad4": _step_ladder_one("4"), "lad5": _step_ladder_one("5")}
     want = [s.strip() for s in args.steps.split(",") if s.strip()]

@@ -64,10 +64,22 @@ def run(args: argparse.Namespace) -> int:
             violations += vs
             n_files += nf
     fingerprints = None
+    resident_fps = None
     shape = None
     if args.tier in ("jaxpr", "all"):
+        from .jaxpr_tier import run_resident_tier
+
         shape = (args.days, args.tickers, SLOTS)
         vs, fingerprints = run_jaxpr_tier(
+            days=args.days, tickers=args.tickers,
+            rolling_impl=args.rolling_impl)
+        violations += vs
+        # the resident scan wrappers (pipeline's year-in-one-executable
+        # loops, single-device + tickers-sharded) trace at the same
+        # canonical per-shard shape; their ONE driving scan is exempt
+        # from GL-B1 by symbol (jaxpr_tier.RESIDENT_WRAPPERS), never
+        # by baseline entry
+        vs, resident_fps = run_resident_tier(
             days=args.days, tickers=args.tickers,
             rolling_impl=args.rolling_impl)
         violations += vs
@@ -88,7 +100,8 @@ def run(args: argparse.Namespace) -> int:
 
     report = build_report(new, accepted, stale,
                           fingerprints=fingerprints,
-                          files_scanned=n_files, shape=shape)
+                          files_scanned=n_files, shape=shape,
+                          resident_fingerprints=resident_fps)
     report_path = args.report
     if report_path is None:
         import os
@@ -105,6 +118,8 @@ def run(args: argparse.Namespace) -> int:
     verdict = {"ok": not new, "tier": args.tier, **report["verdict"]}
     if fingerprints is not None:
         verdict["kernels"] = len(fingerprints)
+    if resident_fps is not None:
+        verdict["resident_wrappers"] = len(resident_fps)
     if report_path != "-":
         verdict["report"] = report_path
     print(json.dumps(verdict))

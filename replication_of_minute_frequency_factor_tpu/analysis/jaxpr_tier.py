@@ -153,3 +153,133 @@ def run_jaxpr_tier(names: Optional[Sequence[str]] = None, days: int = 2,
         violations += vs
         fingerprints[n] = fp
     return violations, fingerprints
+
+
+# --------------------------------------------------------------------------
+# resident scan wrappers (the pipeline's year-in-one-executable loops)
+# --------------------------------------------------------------------------
+
+#: wrapper symbols exempted from GL-B1's zero-scan rule BY SYMBOL, not
+#: by baseline entry: the driving ``scan`` over the year's batches IS
+#: the wrapper's loop shape (the O(1)-round-trip point of the resident
+#: mode). Exactly ONE scan is allowed — a second one means a serial
+#: loop leaked out of a kernel and through the wrapper, the exact
+#: regression GL-B1 guards against — and ``while`` stays banned.
+RESIDENT_WRAPPERS = ("__resident_scan__", "__resident_scan_sharded__")
+
+#: factor subset the wrapper traces drive: re-tracing all 58 kernels a
+#: third time per analyze run buys no new contract coverage (the kernel
+#: tier owns them); these cover the shape classes — a plain reduction,
+#: the rolling scan-free family, and the one cross-sectional collective
+RESIDENT_TRACE_NAMES = ("vol_return1min", "mmt_ols_qrs", "doc_pdf60")
+
+
+def resident_wrapper_jaxprs(n_batches: int = 2, days: int = 2,
+                            tickers: int = 3,
+                            rolling_impl: str = "conv") -> Dict[str, object]:
+    """Abstractly trace the resident scan entrypoints at the canonical
+    per-shard shape: the single-device ``_compute_packed_scan`` on a
+    tuple of packed-buffer ShapeDtypeStructs, and the sharded
+    ``_compute_packed_scan_sharded`` through its ``shard_map`` on a
+    one-device tickers mesh (the per-shard module is what every shard
+    runs, so one shard IS the canonical trace). The raw packed kind
+    keeps the trace free of wire-format coupling; the spec comes from
+    a real (zero-filled) ``pack_arrays`` call so it can never drift
+    from the packer."""
+    import jax
+    import numpy as np
+
+    from .. import pipeline
+    from ..data import wire
+    from ..parallel.mesh import make_mesh
+
+    bars = np.zeros((days, tickers, SLOTS, N_FIELDS), np.float32)
+    mask = np.zeros((days, tickers, SLOTS), np.uint8)
+    buf, spec = wire.pack_arrays((bars, mask))
+    names = RESIDENT_TRACE_NAMES
+    bufs = tuple(jax.ShapeDtypeStruct(buf.shape, buf.dtype)
+                 for _ in range(n_batches))
+    out = {"__resident_scan__": jax.make_jaxpr(
+        lambda b: pipeline._compute_packed_scan(
+            b, spec, "raw", names, True, rolling_impl))(bufs)}
+    mesh = make_mesh((1, 1), jax.devices()[:1])
+    stacked = jax.ShapeDtypeStruct((n_batches, 1, buf.shape[0]),
+                                   np.uint8)
+    out["__resident_scan_sharded__"] = jax.make_jaxpr(
+        lambda s: pipeline._compute_packed_scan_sharded(
+            s, spec, "raw", names, True, rolling_impl, mesh))(stacked)
+    return out
+
+
+def check_resident_wrapper(name: str, closed) -> Tuple[List[Violation],
+                                                       Dict]:
+    """Kernel contracts adapted to a resident wrapper: GL-B2/GL-B3
+    unchanged; GL-B1 becomes "zero ``while``, exactly one ``scan``"
+    (see :data:`RESIDENT_WRAPPERS`)."""
+    out: List[Violation] = []
+    counts = primitive_counts(closed)
+    if counts.get("while"):
+        out.append(Violation(
+            code="GL-B1", path="", line=0, symbol="while",
+            message=f"{counts['while']}x 'while' primitive in the "
+                    "resident wrapper jaxpr — only the single driving "
+                    "scan is exempt; a while is a serial loop leaking "
+                    "through", kernel=name))
+    n_scan = counts.get("scan", 0)
+    if n_scan != 1:
+        out.append(Violation(
+            code="GL-B1", path="", line=0, symbol="scan",
+            message=f"{n_scan}x 'scan' primitives in the resident "
+                    "wrapper jaxpr — the wrapper's exemption covers "
+                    "exactly the ONE driving scan over the year's "
+                    "batches", kernel=name))
+    for eqn in _walk_eqns(closed.jaxpr):
+        if eqn.primitive.name == "convert_element_type":
+            dt = str(eqn.params.get("new_dtype", ""))
+            if dt in BANNED_WIDE_DTYPES:
+                out.append(Violation(
+                    code="GL-B2", path="", line=0,
+                    symbol=f"convert_element_type[{dt}]",
+                    message="f64 promotion inside the resident "
+                            "wrapper: wide dtypes belong to oracle/ "
+                            "only (f32 policy)", kernel=name))
+        if "callback" in eqn.primitive.name:
+            out.append(Violation(
+                code="GL-B3", path="", line=0,
+                symbol=eqn.primitive.name,
+                message="host callback inside the resident wrapper "
+                        "defeats fusion/donation/sharding",
+                kernel=name))
+    fingerprint = {"traced": True,
+                   "n_eqns": sum(counts.values()),
+                   "primitives": dict(sorted(counts.items()))}
+    return out, fingerprint
+
+
+def run_resident_tier(n_batches: int = 2, days: int = 2,
+                      tickers: int = 3, rolling_impl: str = "conv"
+                      ) -> Tuple[List[Violation], Dict[str, Dict]]:
+    """Contracts + fingerprints for the resident scan wrappers. A
+    wrapper that fails to trace is a GL-B0 finding, same as a
+    kernel."""
+    violations: List[Violation] = []
+    fingerprints: Dict[str, Dict] = {}
+    try:
+        jaxprs = resident_wrapper_jaxprs(n_batches=n_batches, days=days,
+                                         tickers=tickers,
+                                         rolling_impl=rolling_impl)
+    except Exception as e:  # noqa: BLE001 — the failure IS the finding
+        for name in RESIDENT_WRAPPERS:
+            violations.append(Violation(
+                code="GL-B0", path="", line=0,
+                symbol=f"{type(e).__name__}",
+                message=f"resident wrapper failed to trace at "
+                        f"({days}, {tickers}, {SLOTS}): {e}",
+                kernel=name))
+            fingerprints[name] = {"traced": False}
+        return violations, fingerprints
+    for name, closed in jaxprs.items():
+        vs, fp = check_resident_wrapper(name, closed)
+        violations += vs
+        fingerprints[name] = fp
+    return violations, fingerprints

@@ -36,7 +36,9 @@ def build_report(new: List[Violation], accepted: List[Violation],
                  stale: List[dict],
                  fingerprints: Optional[Dict[str, Dict]] = None,
                  files_scanned: int = 0,
-                 shape: Optional[tuple] = None) -> dict:
+                 shape: Optional[tuple] = None,
+                 resident_fingerprints: Optional[Dict[str, Dict]] = None
+                 ) -> dict:
     try:
         import jax
         jax_version = jax.__version__
@@ -66,6 +68,13 @@ def build_report(new: List[Violation], accepted: List[Violation],
             "fingerprints": {k: fingerprints[k]
                              for k in sorted(fingerprints)},
         }
+        if resident_fingerprints:
+            # kept apart from the kernel fingerprints: the wrappers are
+            # not kernels, and their GL-B1 exemption (one driving scan)
+            # must never blur the kernels' zero-scan contract
+            report["jaxpr"]["resident_wrappers"] = {
+                k: resident_fingerprints[k]
+                for k in sorted(resident_fingerprints)}
     return report
 
 

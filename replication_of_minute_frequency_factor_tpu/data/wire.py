@@ -306,6 +306,60 @@ def unpack(buf, spec):
     return tuple(out)
 
 
+def shard_arrays(arrays, n_shards: int):
+    """Split a batch's arrays into ``n_shards`` contiguous ticker
+    blocks.
+
+    Works on wire arrays (``WireBatch.arrays``) and on the raw
+    fallback's ``(bars, mask_u8)`` alike: every array of rank >= 2
+    carries tickers on axis 1 and splits there; scalars (``vol_scale``)
+    replicate into every shard. The split happens AFTER the full-batch
+    encode, so per-shard narrowing decisions cannot diverge — shard s's
+    bytes are literally a slice of the single-device encoding, which is
+    what makes the sharded resident scan's decode bitwise.
+
+    The tickers extent must divide by ``n_shards`` (callers pad with
+    masked lanes first — see ``pipeline._grid_batch``'s lcm bucket and
+    ``bench.encode_year_sharded``).
+    """
+    arrays = [np.asarray(a) for a in arrays]
+    for a in arrays:
+        if a.ndim >= 2 and a.shape[1] % n_shards:
+            raise ValueError(
+                f"tickers extent {a.shape[1]} does not divide into "
+                f"{n_shards} shards — pad the batch first")
+    out = []
+    for s in range(n_shards):
+        parts = []
+        for a in arrays:
+            if a.ndim >= 2:
+                t = a.shape[1] // n_shards
+                parts.append(a[:, s * t:(s + 1) * t])
+            else:
+                parts.append(a)
+        out.append(tuple(parts))
+    return out
+
+
+def pack_sharded(arrays, n_shards: int) -> tuple:
+    """Pack a batch as ``n_shards`` per-shard single buffers, stacked
+    ``[S, L]``, plus the (shared) per-shard spec.
+
+    Each row is an independent :func:`pack_arrays` buffer of one ticker
+    shard, so a ``NamedSharding`` over the S axis lands shard s's bytes
+    on the device that owns tickers-shard s and the on-device
+    :func:`unpack` needs no cross-shard addressing. The spec is
+    identical across shards by construction (same dtypes, same
+    per-shard extents) and travels as ONE static jit argument.
+    """
+    packs = [pack_arrays(parts) for parts in shard_arrays(arrays,
+                                                          n_shards)]
+    specs = {spec for _, spec in packs}
+    if len(specs) != 1:  # cannot happen: equal extents + shared dtypes
+        raise AssertionError(f"per-shard specs diverged: {specs}")
+    return np.stack([buf for buf, _ in packs]), packs[0][1]
+
+
 def put(wire: WireBatch, shardings=None):
     """device_put the packed representation (decode happens device-side)."""
     if shardings is None:

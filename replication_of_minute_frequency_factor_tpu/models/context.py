@@ -34,11 +34,17 @@ class DayContext:
     """
 
     def __init__(self, bars, mask, replicate_quirks: bool = True,
-                 rolling_impl: str = None):
+                 rolling_impl: str = None, xs_axis_name: str = None):
         self.bars = bars
         self.mask = mask
         self.replicate_quirks = replicate_quirks
         self.rolling_impl = rolling_impl  # None -> Config.rolling_impl
+        #: mesh axis name the tickers dim is sharded over when this
+        #: context executes inside a shard_map body (the sharded
+        #: resident scan); None = the tickers axis is whole. Only the
+        #: cross-sectional intermediates consult it — every per-(ticker,
+        #: day) kernel is oblivious and stays collective-free.
+        self.xs_axis_name = xs_axis_name
         self._memo = {}
         #: HHMMSSmmm per slot, broadcastable against [..., T, 240]
         self.times = jnp.asarray(sessions.GRID_TIMES)
@@ -130,11 +136,28 @@ class DayContext:
         """Average-tie rank of ``eod_ret`` across the ENTIRE day file
         (all tickers x slots), matching the reference's whole-frame
         ``.rank()`` in the ``doc_pdf*`` kernels (:1016) — the rank there is
-        *not* per stock."""
+        *not* per stock.
+
+        Under a sharded tickers axis (``xs_axis_name`` set) this is the
+        ONE intermediate that needs communication: it routes through
+        :func:`..parallel.collectives.xs_global_rank_local` (all_gather
+        the tiny cross-section, rank the full frame locally — bitwise
+        the single-device rank — and slice this shard's lanes back
+        out)."""
         def f():
             v, m = self.eod_ret, self.mask
             flat_shape = v.shape[:-2] + (v.shape[-2] * v.shape[-1],)
-            r = rank_average(v.reshape(flat_shape), m.reshape(flat_shape))
+            if self.xs_axis_name is not None:
+                # lazy import: collectives imports the registry, which
+                # imports this module (cycle at import time, none at
+                # trace time)
+                from ..parallel.collectives import xs_global_rank_local
+                r = xs_global_rank_local(v.reshape(flat_shape),
+                                         m.reshape(flat_shape),
+                                         self.xs_axis_name)
+            else:
+                r = rank_average(v.reshape(flat_shape),
+                                 m.reshape(flat_shape))
             return r.reshape(v.shape)
         return self._get("eod_grank", f)
 

@@ -67,7 +67,8 @@ FACTOR_NAMES = _Lazy()
 
 def compute_factors(bars, mask, names: Optional[Sequence[str]] = None,
                     replicate_quirks: bool = True,
-                    rolling_impl: Optional[str] = None):
+                    rolling_impl: Optional[str] = None,
+                    xs_axis_name: Optional[str] = None):
     """Compute the named factors (default: all 58) over a day tensor.
 
     Pure function of ``(bars [..., T, 240, 5], mask [..., T, 240])``;
@@ -76,13 +77,16 @@ def compute_factors(bars, mask, names: Optional[Sequence[str]] = None,
     backend (``ops.rolling.ROLLING_IMPLS``: 'conv', 'pallas',
     'pallas_interpret'); keep it explicit under jit — a None falls
     back to the config value *at trace time*, which the jit cache key
-    cannot see.
+    cannot see. ``xs_axis_name`` names the mesh axis the tickers dim is
+    sharded over when tracing inside a ``shard_map`` body (the sharded
+    resident scan): per-(ticker, day) kernels are unaffected, only the
+    cross-sectional ``doc_pdf*`` rank gathers (DayContext).
     """
     _load_all()
     if names is None:
         names = tuple(FACTORS)
     ctx = DayContext(bars, mask, replicate_quirks=replicate_quirks,
-                     rolling_impl=rolling_impl)
+                     rolling_impl=rolling_impl, xs_axis_name=xs_axis_name)
     return {n: resolve(n)(ctx) for n in names}
 
 

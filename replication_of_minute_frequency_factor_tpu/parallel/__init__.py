@@ -18,10 +18,15 @@ from .mesh import (
     day_batch_spec,
     make_mesh,
     mask_spec,
+    packed_year_spec,
+    put_packed_year,
+    resident_mesh,
+    scan_output_spec,
     shard_day_batch,
 )
 from .collectives import (
     sharded_compute_factors,
+    xs_global_rank_local,
     xs_masked_mean,
     xs_masked_std,
     xs_pearson,
@@ -35,7 +40,12 @@ __all__ = [
     "make_mesh",
     "day_batch_spec",
     "mask_spec",
+    "packed_year_spec",
+    "put_packed_year",
+    "resident_mesh",
+    "scan_output_spec",
     "shard_day_batch",
+    "xs_global_rank_local",
     "sharded_compute_factors",
     "xs_masked_mean",
     "xs_masked_std",

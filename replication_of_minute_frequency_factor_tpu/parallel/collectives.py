@@ -145,6 +145,30 @@ def xs_rank_local(x, mask, axis_name=TICKERS_AXIS):
         r, idx * x.shape[-1], x.shape[-1], axis=-1)
 
 
+def xs_global_rank_local(x, mask, axis_name=TICKERS_AXIS):
+    """Average-tie rank of a FLATTENED sharded frame — the sharded twin
+    of ``DayContext.eod_ret_global_rank`` (the ``doc_pdf*`` family's
+    whole-day-frame rank, the ONE cross-ticker intermediate in the 58
+    kernels).
+
+    ``x``/``mask`` are ``[..., T_local * 240]`` — the local tickers
+    block flattened ticker-major, so the tiled ``all_gather`` along the
+    last axis reassembles exactly the single-device flatten order
+    (shard s's block lands at columns ``[s * cols_local, (s+1) *
+    cols_local)``). The gathered frame is ranked locally — bitwise the
+    single-device computation, since every shard ranks the identical
+    full frame — and this shard's lanes are sliced back out. Same
+    gather-compute-slice shape as :func:`xs_rank_local`, kept separate
+    because the resident scan calls it per scan step on a frame, not on
+    a ``[dates, tickers]`` matrix."""
+    full_x = jax.lax.all_gather(x, axis_name, axis=-1, tiled=True)
+    full_m = jax.lax.all_gather(mask, axis_name, axis=-1, tiled=True)
+    r = rank_average(full_x, full_m)
+    idx = jax.lax.axis_index(axis_name)
+    return jax.lax.dynamic_slice_in_dim(
+        r, idx * x.shape[-1], x.shape[-1], axis=-1)
+
+
 def xs_qcut_local(x, mask, group_num: int, axis_name=TICKERS_AXIS):
     """Per-date quantile-bucket labels over a SHARDED cross-section
     (group_test's qcut, Factor.py:284-292, under tickers-axis sharding —

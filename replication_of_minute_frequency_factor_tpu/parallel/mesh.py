@@ -45,6 +45,50 @@ def make_mesh(
     return Mesh(devices.reshape(shape), (DAYS_AXIS, TICKERS_AXIS))
 
 
+def resident_mesh(
+    n_shards: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """A ``(1, n)`` tickers-only mesh for the resident-scan callers.
+
+    The streaming pipeline's mesh guard rejects any days dimension
+    (batch day counts vary there); the resident scan's batch list is
+    fixed up front, but it shards the TICKERS axis only too — the scan
+    axis is batches, the wide data-parallel axis is tickers, and the
+    per-shard bodies need zero collectives outside the ``doc_pdf*``
+    rank gather. ``n_shards=None`` uses every local device.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_shards is None:
+        n_shards = len(devices)
+    return make_mesh((1, n_shards), devices[:n_shards])
+
+
+def packed_year_spec() -> P:
+    """PartitionSpec for a stacked packed-buffer year ``[N, S, L]``
+    (batches x shards x per-shard packed bytes): the shard axis maps
+    onto the mesh tickers axis, batches and bytes stay whole. The
+    host-side twin of :func:`..data.wire.pack_sharded`."""
+    return P(None, TICKERS_AXIS, None)
+
+
+def scan_output_spec() -> P:
+    """PartitionSpec of the sharded resident scan's ``[N, F, D, T]``
+    output: only the trailing tickers axis is sharded, so the single
+    consolidated fetch gathers one contiguous block per shard."""
+    return P(None, None, None, TICKERS_AXIS)
+
+
+def put_packed_year(stacked, mesh: Mesh):
+    """device_put a host ``[N, S, L]`` stacked packed year onto the
+    mesh, shard s to the device owning tickers-shard s. Dispatch is
+    async — callers overlap it against in-flight compute (the bench's
+    double-buffered group ingest) and never need to block: the
+    consuming executable's data dependency orders the transfer."""
+    return jax.device_put(stacked, NamedSharding(mesh, packed_year_spec()))
+
+
 def day_batch_spec(batched: bool = True) -> P:
     """PartitionSpec for ``bars [D, T, 240, 5]`` (or ``[T, 240, 5]``)."""
     if batched:

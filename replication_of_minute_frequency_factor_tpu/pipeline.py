@@ -187,6 +187,57 @@ _compute_packed_scan_jit_donated = functools.partial(
     donate_argnums=(0,))(_compute_packed_scan)
 
 
+class DonatedBufferError(RuntimeError):
+    """A device buffer handle was reused after a packed/resident launch
+    donated it (``Config.donate_buffers`` on an accelerator backend).
+    Raised by the ``Config.debug_validate`` guard with a clear message;
+    without the guard the same mistake surfaces as jax's terse
+    "Array has been deleted" at first use."""
+
+
+def _guard_donated_args(arrs, caller: str,
+                        cfg: Optional["Config"] = None) -> None:
+    """``Config.debug_validate`` twin of the donation docstring: a
+    buffer that an earlier launch donated is marked deleted by jax the
+    moment the dispatch consumed it — catch it at the NEXT entry point
+    with a message that names the contract instead of XLA's/jax's
+    generic deletion error. Zero cost beyond an ``is_deleted`` flag
+    check per array, but gated anyway: the hot path must not grow
+    per-launch python work by default."""
+    cfg = cfg or get_config()
+    if not cfg.debug_validate:
+        return
+    for i, a in enumerate(arrs):
+        deleted = getattr(a, "is_deleted", None)
+        if callable(deleted) and deleted():
+            raise DonatedBufferError(
+                f"{caller}: argument {i} is a dead buffer — an earlier "
+                "launch donated it to its executable "
+                "(Config.donate_buffers; the buffer is dead to the "
+                "caller, see compute_packed_resident's docstring). "
+                "device_put a fresh buffer instead of reusing the "
+                "donated handle.")
+
+
+def _invalidate_donated(arrs) -> None:
+    """Make "dead to the caller" TRUE on every backend: jax marks a
+    flat donated argument deleted at dispatch, but the leaves of a
+    donated TUPLE (the resident scan's buffer year) and backends that
+    drop the donation are left live — a caller reuse would then work on
+    CPU and explode only on hardware. Deleting the handles here makes
+    the contract uniform and loud everywhere (jax raises its typed
+    "Array has been deleted" RuntimeError on any later use; PJRT defers
+    the actual deallocation past in-flight consumers, so the async
+    dispatch is unaffected)."""
+    for a in arrs:
+        try:
+            deleted = getattr(a, "is_deleted", None)
+            if callable(deleted) and not deleted():
+                a.delete()
+        except Exception:  # noqa: BLE001 — invalidation is best-effort
+            pass
+
+
 def compute_packed_resident(dbufs, spec, kind, names,
                             replicate_quirks=True, rolling_impl=None):
     """Run N device-resident packed buffers through one fused scan
@@ -195,14 +246,137 @@ def compute_packed_resident(dbufs, spec, kind, names,
     all share ``spec`` (encode with a shared widen-only ``floor`` to
     guarantee that; see bench.py's encode_year). On accelerator
     backends (``Config.donate_buffers``) the buffers are DONATED — they
-    are dead to the caller after this call; re-``device_put`` fresh ones
-    rather than reusing a donated handle."""
+    are dead to the caller after this call (enforced: the handles are
+    invalidated, so any reuse raises jax's typed deletion error on
+    every backend); re-``device_put`` fresh ones rather than reusing a
+    donated handle (``Config.debug_validate`` turns that mistake into a
+    :class:`DonatedBufferError` with the contract spelled out at the
+    next launch, instead of the generic error at first use)."""
+    if rolling_impl is None:
+        rolling_impl = get_config().rolling_impl
+    _guard_donated_args(dbufs, "compute_packed_resident")
+    donating = _donate_device_buffers()
+    fn = (_compute_packed_scan_jit_donated if donating
+          else _compute_packed_scan_jit)
+    out = fn(tuple(dbufs), spec, kind, names,
+             replicate_quirks, rolling_impl)
+    if donating:
+        _invalidate_donated(dbufs)
+    return out
+
+
+def lower_packed_resident(dbufs, spec, kind, names,
+                          replicate_quirks=True, rolling_impl=None):
+    """AOT lowering of the resident scan executable (same twin
+    selection as :func:`compute_packed_resident`). bench routes the
+    first build through ``telemetry.attribution.compile_with_telemetry``
+    so its ``compile`` stage measures lower+compile and
+    ``device_exec_first`` means execute; the compiled executable is
+    then called with ``compiled(tuple(dbufs))``."""
     if rolling_impl is None:
         rolling_impl = get_config().rolling_impl
     fn = (_compute_packed_scan_jit_donated if _donate_device_buffers()
           else _compute_packed_scan_jit)
-    return fn(tuple(dbufs), spec, kind, names,
-              replicate_quirks, rolling_impl)
+    return fn.lower(tuple(dbufs), spec, kind, names,
+                    replicate_quirks, rolling_impl)
+
+
+def _compute_packed_scan_sharded(stacked, spec, kind, names,
+                                 replicate_quirks, rolling_impl, mesh):
+    """Mesh-native twin of :func:`_compute_packed_scan`: the resident
+    year as ONE scan executable whose data parallelism spans the
+    tickers axis of a ``(days=1, tickers=n)`` mesh.
+
+    ``stacked`` is ``[N, S, L]`` uint8 — N batches x S per-shard packed
+    buffers (:func:`..data.wire.pack_sharded`), placed with
+    ``parallel.mesh.packed_year_spec()`` so shard s's bytes live on the
+    device owning tickers-shard s. Inside ``shard_map`` each device
+    scans its OWN ``[N, 1, L]`` block: per-shard unpack + decode + the
+    fused factor graph, zero collectives for the per-(ticker, day)
+    kernels (``parallel/collectives.py``'s contract) — only the
+    ``doc_pdf*`` global rank gathers, via ``xs_axis_name`` (a 20 KB/day
+    cross-section). Outputs stay sharded ``[N, F, D, T]`` over the
+    trailing tickers axis (``scan_output_spec``) until the caller's one
+    consolidated fetch, preserving the O(1)
+    host-blocking-syncs-per-year property the resident mode exists
+    for."""
+    from .parallel.collectives import shard_map
+    from .parallel.mesh import (TICKERS_AXIS, packed_year_spec,
+                                scan_output_spec)
+
+    def per_shard(bufs):  # local [N, 1, L]
+        def body(_, buf):
+            arrs = wire.unpack(buf[0], spec)
+            if kind == "wire":
+                bars, m = wire.decode(*arrs)
+            else:
+                bars, m = arrs
+                m = m.astype(bool)
+            out = compute_factors(bars, m, names=names,
+                                  replicate_quirks=replicate_quirks,
+                                  rolling_impl=rolling_impl,
+                                  xs_axis_name=TICKERS_AXIS)
+            return None, jnp.stack([out[n] for n in names])
+
+        _, ys = jax.lax.scan(body, None, bufs)
+        return ys  # local [N, F, D, T_local]
+
+    fn = shard_map(per_shard, mesh=mesh,
+                   in_specs=(packed_year_spec(),),
+                   out_specs=scan_output_spec())
+    return fn(stacked)
+
+
+_SHARDED_STATIC = _PACKED_STATIC + ("mesh",)
+_compute_packed_scan_sharded_jit = functools.partial(
+    jax.jit, static_argnames=_SHARDED_STATIC)(_compute_packed_scan_sharded)
+#: donated twin — same HBM rationale as the single-device scan, per
+#: shard: each device's [N, 1, L] slice of the year dies at its scan
+#: step's unpack
+_compute_packed_scan_sharded_jit_donated = functools.partial(
+    jax.jit, static_argnames=_SHARDED_STATIC,
+    donate_argnums=(0,))(_compute_packed_scan_sharded)
+
+
+def compute_packed_resident_sharded(stacked, spec, kind, mesh, names,
+                                    replicate_quirks=True,
+                                    rolling_impl=None):
+    """Sharded resident scan over a mesh-placed ``[N, S, L]`` packed
+    year (see :func:`_compute_packed_scan_sharded`); returns
+    ``[N, F, D, T]`` STILL SHARDED on device — fetch once per scan
+    group. Accepts any tickers-only mesh (``parallel.mesh.resident_mesh``);
+    the streaming pipeline's days-dimension guard does not apply to
+    resident callers. Donation contract matches
+    :func:`compute_packed_resident`: on accelerator backends ``stacked``
+    is dead to the caller after this call."""
+    if rolling_impl is None:
+        rolling_impl = get_config().rolling_impl
+    _guard_donated_args((stacked,), "compute_packed_resident_sharded")
+    donating = _donate_device_buffers()
+    fn = (_compute_packed_scan_sharded_jit_donated if donating
+          else _compute_packed_scan_sharded_jit)
+    out = fn(stacked, spec, kind, names, replicate_quirks,
+             rolling_impl, mesh)
+    if donating:
+        _invalidate_donated((stacked,))
+    return out
+
+
+def lower_packed_resident_sharded(stacked, spec, kind, mesh, names,
+                                  replicate_quirks=True,
+                                  rolling_impl=None):
+    """AOT lowering of the SHARDED resident scan (twin selection as
+    :func:`compute_packed_resident_sharded`); call the compiled
+    executable with ``compiled(stacked)``. See
+    :func:`lower_packed_resident` for why bench compiles through
+    this."""
+    if rolling_impl is None:
+        rolling_impl = get_config().rolling_impl
+    fn = (_compute_packed_scan_sharded_jit_donated
+          if _donate_device_buffers()
+          else _compute_packed_scan_sharded_jit)
+    return fn.lower(stacked, spec, kind, names, replicate_quirks,
+                    rolling_impl, mesh)
 from .telemetry import Telemetry, TraceCapture, get_telemetry
 from .telemetry import attribution as _attribution
 from .utils.logging import get_logger, FailureReport
@@ -444,12 +618,19 @@ def _run_device_pipeline(batches, names, cfg: Config, timer: Timer,
         from jax.sharding import NamedSharding
         from .parallel.mesh import day_batch_spec, make_mesh, mask_spec
         if cfg.mesh_shape[0] != 1:
+            # this guard binds the STREAMING pipeline only: batch day
+            # counts vary here, so the last batch would not divide a
+            # days axis. Resident callers are not routed through it —
+            # compute_packed_resident_sharded takes any tickers-only
+            # mesh (parallel.mesh.resident_mesh) directly.
             raise ValueError(
                 f"mesh_shape {cfg.mesh_shape}: the streaming pipeline "
                 "shards the tickers axis only (batch day counts vary, the "
                 "last batch would not divide a days axis) — use "
                 "mesh_shape=(1, n); the days axis is for "
-                "parallel.sharded_compute_factors on fixed batches")
+                "parallel.sharded_compute_factors on fixed batches, and "
+                "the resident scan path shards via "
+                "compute_packed_resident_sharded + parallel.resident_mesh")
         n_shards = cfg.mesh_shape[1]
         mesh = make_mesh(cfg.mesh_shape, jax.devices()[:n_shards])
         shardings = wire.mesh_shardings(mesh)

@@ -20,6 +20,13 @@ smeared):
   they join the declared ``r4_stream_v2`` series rather than forming a
   phantom "undeclared" one. This is the ONE inference the gate makes,
   and it is pinned here so it cannot drift.
+* declared series to date: ``r4_stream_v2`` (legacy + stream),
+  ``r5_resident_v1`` (first resident scan), ``r6_resident_v2`` /
+  ``r6_stream_v3`` (fused rolling engine + donation),
+  ``r7_resident_sharded_v1`` (mesh-native resident scan:
+  tickers-sharded wire buffers, overlapped group ingest, sharded
+  fetch — bench stamps it only when ``n_shards > 1`` actually
+  resolved; single-device resident runs stay on ``r6_resident_v2``).
 
 Baseline = median of every record in the group EXCEPT the latest; the
 latest is the record under test. ``--check FILE`` instead gates a fresh

@@ -43,11 +43,20 @@ if [ "$1" = "--quick" ]; then
     run python -c "import json; \
 from replication_of_minute_frequency_factor_tpu.ops.rolling import _smoke; \
 print(json.dumps(_smoke()))"
+    # sharded-resident smoke (ISSUE 5): the mesh-native resident scan
+    # vs the single-device one on 8 virtual CPU devices over a small
+    # synthetic year — exposure equality (bitwise outside the two
+    # documented ulp-level ratio kernels) plus the overlapped-ingest
+    # metric firing; one JSON verdict line, nonzero on any mismatch
+    run env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -c "import json, sys, bench; r = bench.sharded_smoke(); \
+print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     # graftlint (ISSUE 4): AST rules over the whole package + jaxpr
-    # contracts over all 58 registered kernels (abstract trace on CPU),
-    # gated on the committed baseline — one JSON verdict line like
-    # telemetry/regress.py, nonzero on any new violation
-    # (docs/static-analysis.md); --report - keeps the tree clean here
+    # contracts over all 58 registered kernels AND the resident scan
+    # wrappers (abstract trace on CPU), gated on the committed baseline
+    # — one JSON verdict line like telemetry/regress.py, nonzero on any
+    # new violation (docs/static-analysis.md); --report - keeps the
+    # tree clean here
     run python -m replication_of_minute_frequency_factor_tpu analyze \
         --report -
     exit $rc

@@ -294,3 +294,67 @@ def test_manifest_carries_the_analysis_block(tmp_path):
     if os.path.exists(os.path.join(REPO, "analysis_report.json")):
         assert blk["report"]["present"] is True
         assert blk["report"]["kernels"] == 58
+
+
+def test_resident_wrappers_trace_clean_and_scan_exempt_by_symbol():
+    """ISSUE 5: Tier B abstractly traces the resident scan entrypoints
+    (single-device + sharded, canonical per-shard shape). Their ONE
+    driving scan is exempt from GL-B1 by SYMBOL — the wrapper names are
+    reserved in jaxpr_tier.RESIDENT_WRAPPERS, no baseline entry exists
+    for them — while the kernel tier's zero-scan rule is untouched."""
+    from replication_of_minute_frequency_factor_tpu.analysis import (
+        jaxpr_tier)
+    from replication_of_minute_frequency_factor_tpu.analysis.violations import (
+        BASELINE_PATH, Baseline)
+
+    violations, fps = jaxpr_tier.run_resident_tier()
+    assert violations == []
+    assert set(fps) == set(jaxpr_tier.RESIDENT_WRAPPERS)
+    for name, fp in fps.items():
+        assert fp["traced"] is True
+        assert fp["primitives"].get("scan") == 1, name
+        assert "while" not in fp["primitives"], name
+    # exemption is by symbol, NOT by baseline entry
+    entries = Baseline.load(BASELINE_PATH).entries
+    assert not any(e.get("kernel", "").startswith("__resident")
+                   for e in entries)
+
+
+def test_resident_wrapper_second_scan_flags():
+    """A second scan inside the wrapper (a serial loop leaking out of a
+    kernel and through the exemption) must flag GL-B1."""
+    import jax
+    import jax.numpy as jnp
+
+    from replication_of_minute_frequency_factor_tpu.analysis import (
+        jaxpr_tier)
+
+    def double_scan(xs):
+        def inner(_, x):
+            _, ys = jax.lax.scan(lambda c, v: (c, v * 2.0), None, x)
+            return None, jnp.sum(ys)
+        _, out = jax.lax.scan(inner, None, xs)
+        return out
+
+    closed = jax.make_jaxpr(double_scan)(
+        jax.ShapeDtypeStruct((3, 4), jnp.float32))
+    vs, fp = jaxpr_tier.check_resident_wrapper("__resident_scan__",
+                                               closed)
+    assert any(v.code == "GL-B1" and v.symbol == "scan" for v in vs)
+
+
+def test_report_carries_resident_wrapper_fingerprints():
+    """The committed analysis_report.json carries the wrapper
+    fingerprints apart from the 58 kernel ones (the kernels' zero-scan
+    contract must stay visually unblurred in the diffable artifact)."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(repo, "analysis_report.json")) as fh:
+        rep = json.load(fh)
+    assert len(rep["jaxpr"]["fingerprints"]) == 58
+    wrappers = rep["jaxpr"]["resident_wrappers"]
+    assert set(wrappers) == {"__resident_scan__",
+                             "__resident_scan_sharded__"}
+    for fp in wrappers.values():
+        assert fp["primitives"]["scan"] == 1

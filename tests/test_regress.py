@@ -218,3 +218,22 @@ def test_cli_no_input_exits_two(tmp_path):
     rc, verdict = _cli(str(tmp_path))
     assert rc == 2
     assert not verdict["ok"]
+
+
+def test_cli_check_r7_sharded_break_is_declared(tmp_path):
+    """ISSUE 5: a fresh record under the r7 mesh-native resident
+    methodology gates against the REAL banked trajectory as a declared
+    break — reported with an empty baseline, never flagged, exit 0 —
+    while the same value smeared onto the banked r6 resident series
+    would have flagged. The n_shards discriminator rides the record."""
+    cand = tmp_path / "candidate.json"
+    with open(cand, "w") as fh:
+        json.dump({"metric": "cicc58_5000tickers_1yr_wall",
+                   "value": 84.8, "n_shards": 8,
+                   "methodology": "r7_resident_sharded_v1"}, fh)
+    rc, verdict = _cli(REPO, "--check", str(cand))
+    assert rc == 0 and verdict["ok"]
+    (g,) = [g for g in verdict["groups"]
+            if g["methodology"] == "r7_resident_sharded_v1"]
+    assert g["n_baseline"] == 0 and g["flagged"] is False
+    assert "declared break" in g.get("note", "")

@@ -147,11 +147,13 @@ def test_donated_and_plain_packed_paths_share_one_function():
 
 
 def test_bench_resident_oom_falls_back_to_stream(monkeypatch, capsys):
-    """ADVICE r5 (bench.py:430): a resident warmup that still OOMs at
-    group == 1 must fall back to the stream loop at the proven 8-day
-    shape and print a record — not re-raise and lose the hardware
-    window. The emitted record must say so (mode/methodology flip,
-    warm.resident_oom_fallback carries the error)."""
+    """ADVICE r5 (bench.py:430) + ISSUE 5 ladder: a resident warmup
+    that still OOMs at group == 1 must walk the WHOLE fallback ladder —
+    sharded -> single-device resident -> stream at the proven 8-day
+    shape — and print a record, not re-raise and lose the hardware
+    window. The emitted record must say so (mode/methodology/n_shards
+    flip, warm.sharded_oom_fallback + warm.resident_oom_fallback carry
+    the errors)."""
     import sys
     import types
 
@@ -177,13 +179,18 @@ def test_bench_resident_oom_falls_back_to_stream(monkeypatch, capsys):
                            "(synthetic, injected by test)")
 
     monkeypatch.setattr(bench, "run_resident", oom)
+    monkeypatch.setattr(bench, "run_resident_sharded", oom)
     bench.main()
     lines = [ln for ln in capsys.readouterr().out.splitlines()
              if ln.startswith("{")]
     rec = json.loads(lines[-1])
     assert rec["mode"] == "stream"
     assert rec["methodology"] == "r6_stream_v3"
+    assert rec["n_shards"] == 1
     assert rec["days_per_batch"] == 8
+    # both ladder rungs recorded: sharded scan OOM'd first (the test
+    # harness exposes 8 virtual devices), then the single-device scan
+    assert "RESOURCE_EXHAUSTED" in rec["warm"]["sharded_oom_fallback"]
     assert "RESOURCE_EXHAUSTED" in rec["warm"]["resident_oom_fallback"]
     assert rec["round_trips"]["host_blocking_syncs"] > 0
     assert set(rec["round_trips"]["predicted_fields"]) == {

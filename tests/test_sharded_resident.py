@@ -1,0 +1,221 @@
+"""ISSUE 5 acceptance gates: the mesh-native resident scan.
+
+On the 8-virtual-CPU-device mesh (conftest), the tickers-sharded
+resident scan must reproduce the single-device resident scan for all
+58 factors — bitwise for every collective-free kernel (including the
+``doc_pdf*`` family, whose global rank routes through the all_gather
+collective and ranks the identical full frame on every shard). The
+ONLY exception is the ``vol_upRatio``/``vol_downRatio`` pair, whose
+``sqrt/sqrt`` division XLA fuses shape-dependently (observed between
+ANY two module shapes, sharded or not) — pinned at <= 16 f32 ulps.
+
+Also gated here: the overlapped-ingest loop's O(1) sync budget and its
+``resident.ingest_hidden_s`` metric, and the donation contract
+("dead to the caller" is machine-checked, loud and typed).
+"""
+
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+import bench
+from replication_of_minute_frequency_factor_tpu import pipeline
+from replication_of_minute_frequency_factor_tpu.config import get_config
+from replication_of_minute_frequency_factor_tpu.data import wire
+from replication_of_minute_frequency_factor_tpu.models.registry import (
+    factor_names)
+from replication_of_minute_frequency_factor_tpu.parallel import (
+    put_packed_year, resident_mesh)
+from replication_of_minute_frequency_factor_tpu.telemetry import (
+    get_telemetry)
+
+N_SHARDS = 8
+
+
+def _make_year(n_batches=3, days=2, tickers=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return [bench.make_batch(rng, n_days=days, n_tickers=tickers)
+            for _ in range(n_batches)]
+
+
+def test_sharded_resident_matches_single_device_all_58():
+    """THE parity gate: all 58 factors, sharded vs single-device
+    resident scan, on 8 virtual CPU devices."""
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+    names = tuple(factor_names())
+    assert len(names) == 58
+    batches = _make_year()
+    bufs, spec, kind = bench.encode_year(batches, use_wire=True)
+    assert kind == "wire"
+    want = np.asarray(pipeline.compute_packed_resident(
+        tuple(jax.device_put(b) for b in bufs), spec, kind,
+        names=names))
+
+    stacks, sspec, skind, t_pad = bench.encode_year_sharded(
+        batches, use_wire=True, n_shards=N_SHARDS)
+    assert skind == "wire" and t_pad == batches[0][0].shape[1]
+    mesh = resident_mesh(N_SHARDS)
+    d = put_packed_year(np.stack(stacks), mesh)
+    got = np.asarray(pipeline.compute_packed_resident_sharded(
+        d, sspec, skind, mesh, names))
+    assert got.shape == want.shape
+
+    ulp_pair = bench._ULP_FACTORS
+    for j, n in enumerate(names):
+        a, b = want[:, j], got[:, j]
+        if n in ulp_pair:
+            # XLA fuses this kernel's sqrt/sqrt division differently
+            # per module shape (ulp-level, not a sharding artifact);
+            # NaN pattern must still match exactly
+            assert np.array_equal(np.isnan(a), np.isnan(b)), n
+            f = np.isfinite(a)
+            scale = np.abs(a[f]).max(initial=1.0) or 1.0
+            assert np.abs(a[f] - b[f]).max(initial=0.0) \
+                <= 16 * np.finfo(np.float32).eps * scale, n
+        else:
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"factor {n} diverged under sharding")
+
+
+def test_sharded_resident_pads_nondividing_tickers():
+    """30 tickers over 8 shards: encode_year_sharded pads with masked
+    lanes to the shard multiple and keep_results slices back — values
+    must equal the single-device run on the UNPADDED batches."""
+    names = ("vol_return1min", "doc_pdf60", "trade_headRatio")
+    batches = _make_year(n_batches=2, tickers=30, seed=3)
+    mesh = resident_mesh(N_SHARDS)
+    _, _, single = bench.run_resident(batches, names, True,
+                                      group=2, keep_results=True)
+    _, _, sharded = bench.run_resident_sharded(
+        batches, names, True, 2, mesh, keep_results=True)
+    assert len(sharded) == len(single) == 2
+    for s, r in zip(single, sharded):
+        assert np.asarray(r).shape == np.asarray(s).shape  # sliced back
+        np.testing.assert_array_equal(np.asarray(s), np.asarray(r))
+
+
+def test_overlapped_ingest_syncs_and_hidden_metric():
+    """Sync budget: 1 + number of scan groups host-blocking syncs per
+    year (NOT O(batches)) — ingest contributes ZERO blocking syncs —
+    and the overlap metric fires when more than one group runs."""
+    names = ("vol_return1min", "mmt_am")
+    batches = _make_year(n_batches=4, tickers=16, seed=5)
+    mesh = resident_mesh(N_SHARDS)
+    reg = get_telemetry().registry
+    before = reg.counter_total("bench.host_blocking_syncs")
+    phases, _kind, _ = bench.run_resident_sharded(
+        batches, names, True, 2, mesh)
+    syncs = int(reg.counter_total("bench.host_blocking_syncs") - before)
+    n_groups = 2
+    assert syncs <= 1 + n_groups, syncs
+    assert phases["ingest_hidden_s"] > 0
+    assert phases["compile_s"] >= 0 and phases["compute_s"] > 0
+    # the gauge is the bench-record-independent surface of the same
+    # number (docs/observability.md)
+    assert reg.gauge_value("resident.ingest_hidden_s",
+                           n_shards=str(N_SHARDS)) > 0
+
+
+def test_sharded_smoke_verdict():
+    """The run_tests.sh --quick smoke's one-line verdict is green on
+    the virtual mesh."""
+    r = bench.sharded_smoke()
+    assert r["ok"] is True, r
+    assert r["n_shards"] == N_SHARDS
+    assert r["scan_groups"] >= 2 and r["ingest_hidden_s"] > 0
+    assert r["mismatched"] == []
+
+
+def test_sharded_and_plain_scan_twins_share_one_function():
+    """Same pin as the r6 twins: a graph fix must land in both the
+    donated and plain sharded executables."""
+    assert (pipeline._compute_packed_scan_sharded_jit.__wrapped__
+            is pipeline._compute_packed_scan_sharded_jit_donated
+            .__wrapped__)
+
+
+# --------------------------------------------------------------------------
+# donation contract (ISSUE 5 satellite: pipeline.py:196-199 docstring,
+# machine-checked)
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture
+def _force_donation(monkeypatch):
+    """CPU backends never donate (pipeline._donate_device_buffers);
+    force the donated twins so the contract is testable hermetically.
+    jax emits a per-compile 'donation not implemented' warning on CPU —
+    expected here."""
+    monkeypatch.setattr(pipeline, "_donate_device_buffers",
+                        lambda cfg=None: True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        yield
+
+
+def _packed_batch(tickers=8):
+    b, m = bench.make_batch(np.random.default_rng(0), n_days=1,
+                            n_tickers=tickers)
+    buf, spec = wire.pack_arrays((b, m.view(np.uint8)))
+    return buf, spec
+
+
+def test_donated_resident_buffer_reuse_is_loud_and_typed(
+        _force_donation):
+    """After compute_packed_resident donated the buffers, the handles
+    are dead to the caller ON EVERY BACKEND: any reuse raises jax's
+    typed deletion RuntimeError (not a silent wrong answer, not an
+    XLA-internal crash on hardware only)."""
+    buf, spec = _packed_batch()
+    names = ("vol_return1min",)
+    d = jax.device_put(buf)
+    out = np.asarray(pipeline.compute_packed_resident(
+        (d,), spec, "raw", names))
+    assert out.shape[0] == 1
+    assert d.is_deleted()  # the docstring's "dead to the caller", true
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(jax.jit(lambda x: x)(d))
+
+
+def test_debug_validate_guard_names_the_contract(_force_donation):
+    """With Config.debug_validate, reusing a donated handle in the
+    packed entry points raises DonatedBufferError with the contract
+    spelled out — instead of jax's generic message at first use."""
+    buf, spec = _packed_batch()
+    names = ("vol_return1min",)
+    d = jax.device_put(buf)
+    pipeline.compute_packed_resident((d,), spec, "raw", names)
+    cfg = get_config()
+    old = cfg.debug_validate
+    cfg.debug_validate = True
+    try:
+        with pytest.raises(pipeline.DonatedBufferError,
+                           match="donated.*device_put a fresh"):
+            pipeline.compute_packed_resident((d,), spec, "raw", names)
+    finally:
+        cfg.debug_validate = old
+
+
+def test_sharded_resident_donation_contract(_force_donation):
+    """The sharded twin enforces the same contract on its stacked
+    year."""
+    names = ("vol_return1min",)
+    batches = _make_year(n_batches=2, tickers=16, seed=7)
+    stacks, spec, kind, _ = bench.encode_year_sharded(
+        batches, use_wire=True, n_shards=N_SHARDS)
+    mesh = resident_mesh(N_SHARDS)
+    d = put_packed_year(np.stack(stacks), mesh)
+    np.asarray(pipeline.compute_packed_resident_sharded(
+        d, spec, kind, mesh, names))
+    assert d.is_deleted()
+    cfg = get_config()
+    old = cfg.debug_validate
+    cfg.debug_validate = True
+    try:
+        with pytest.raises(pipeline.DonatedBufferError):
+            pipeline.compute_packed_resident_sharded(
+                d, spec, kind, mesh, names)
+    finally:
+        cfg.debug_validate = old

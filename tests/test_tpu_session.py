@@ -225,3 +225,67 @@ def test_stale_tpu_headline_reader(tmp_path):
     # missing / garbage artifact
     assert bench.stale_tpu_headline(str(tmp_path / "nope.json")) == \
         (None, None)
+
+
+def test_resident_sharded_carry_requires_real_sharding(tpu_session):
+    """ISSUE 5: a 'resident_sharded' entry only carries when it is a
+    record of the r7 mesh-native loop that ACTUALLY sharded — mode
+    resident, the ``_sharded`` metric suffix, ``n_shards > 1`` and the
+    5000-ticker stamp. A single-device resolution (the silent
+    fallback), a missing n_shards (pre-r7 schema), or a small-ticker
+    A/B must re-run — the pallas step's "silent fallback cannot bank"
+    rule."""
+    good = {"resident_sharded": {"ok": True, "results": [
+        {"metric": "cicc58_5000tickers_1yr_wall_sharded", "value": 60.0,
+         "mode": "resident", "n_shards": 8, "tickers": 5000,
+         "methodology": "r7_resident_sharded_v1"}]}}
+    assert tpu_session.drop_conv_only_rolling(good) == good
+    fell_back = {"resident_sharded": {"ok": True, "results": [
+        {"metric": "cicc58_5000tickers_1yr_wall_sharded", "value": 60.0,
+         "mode": "resident", "n_shards": 1, "tickers": 5000}]}}
+    assert tpu_session.drop_conv_only_rolling(fell_back) == {}
+    no_stamp = {"resident_sharded": {"ok": True, "results": [
+        {"metric": "cicc58_5000tickers_1yr_wall_sharded", "value": 60.0,
+         "mode": "resident", "tickers": 5000}]}}
+    assert tpu_session.drop_conv_only_rolling(no_stamp) == {}
+    small = {"resident_sharded": {"ok": True, "results": [
+        {"metric": "cicc58_500tickers_1yr_wall_sharded", "value": 6.0,
+         "mode": "resident", "n_shards": 8, "tickers": 500}]}}
+    assert tpu_session.drop_conv_only_rolling(small) == {}
+    wrong_mode = {"resident_sharded": {"ok": True, "results": [
+        {"metric": "cicc58_5000tickers_1yr_wall_sharded", "value": 60.0,
+         "mode": "stream", "n_shards": 8, "tickers": 5000}]}}
+    assert tpu_session.drop_conv_only_rolling(wrong_mode) == {}
+
+
+def test_resident_sharded_step_refuses_single_device(tpu_session,
+                                                     monkeypatch):
+    """The step itself must flip ok=False when the bench record shows
+    the mesh resolved to one device — green-but-not-sharded banking is
+    exactly what the carry rule above cannot repair after the fact."""
+    def fake_gated(extra_env):
+        assert extra_env["BENCH_METRIC_SUFFIX"] == "_sharded"
+        return {"ok": True, "rc": 0, "results": [
+            {"metric": "cicc58_5000tickers_1yr_wall_sharded",
+             "mode": "resident", "n_shards": 1, "tickers": 5000}]}
+    monkeypatch.setattr(tpu_session, "_run_bench_gated", fake_gated)
+    r = tpu_session.step_resident_sharded()
+    assert r["ok"] is False and "n_shards" in r["error"]
+
+    def fake_gated_sharded(extra_env):
+        return {"ok": True, "rc": 0, "results": [
+            {"metric": "cicc58_5000tickers_1yr_wall_sharded",
+             "mode": "resident", "n_shards": 8, "tickers": 5000}]}
+    monkeypatch.setattr(tpu_session, "_run_bench_gated",
+                        fake_gated_sharded)
+    assert tpu_session.step_resident_sharded()["ok"] is True
+
+
+def test_resident_sharded_in_default_steps(tpu_session):
+    """The next tunnel window must validate the r7 sharded loop and
+    the still-unvalidated single-device resident scan in ONE capture:
+    both steps ride the default list, sharded directly behind the
+    headline."""
+    src = open(os.path.join(REPO, "benchmarks", "tpu_session.py")).read()
+    assert '"headline,resident_sharded,"' in src
+    assert "resident_sharded" in src.split("steps = {")[1]
