@@ -111,6 +111,117 @@ def _add_doctor(sub: "argparse._SubParsersAction") -> None:
         " native encoder status, config")
 
 
+def _add_serve(sub: "argparse._SubParsersAction") -> None:
+    p = sub.add_parser(
+        "serve", help="long-lived factor service: warm AOT executables, "
+        "device-resident exposure cache, async batching queue "
+        "(docs/serving.md); HTTP/JSON on --port, or --demo N for an "
+        "in-process smoke")
+    p.add_argument("--minute-dir", default=None,
+                   help="serve a directory of day files (default: a "
+                        "synthetic source)")
+    p.add_argument("--synthetic-days", type=int, default=32)
+    p.add_argument("--synthetic-tickers", type=int, default=64)
+    p.add_argument("--factors", default="all",
+                   help="comma-separated factor names, or 'all' (default)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8787,
+                   help="HTTP port (0 = ephemeral; printed on startup)")
+    p.add_argument("--cache-mb", type=int, default=256,
+                   help="device-byte budget of the exposure cache")
+    p.add_argument("--batch-window-ms", type=float, default=2.0,
+                   help="micro-batch collection window")
+    p.add_argument("--demo", type=int, default=None, metavar="N",
+                   help="answer N in-process queries (factors/IC/decile "
+                        "cycle), print a JSON summary, exit — no HTTP")
+    p.add_argument("--telemetry-dir", default=argparse.SUPPRESS,
+                   metavar="DIR",
+                   help="write the run's telemetry bundle into DIR on "
+                        "shutdown")
+
+
+def cmd_serve(args: argparse.Namespace) -> int:
+    import os
+
+    from .models.registry import factor_names
+    from .serve import (FactorServer, MinuteDirSource, ServeConfig,
+                        SyntheticSource, serve_http)
+    from .telemetry import Telemetry, set_telemetry
+
+    all_names = factor_names()
+    names = (all_names if args.factors == "all"
+             else tuple(s.strip() for s in args.factors.split(",")
+                        if s.strip()))
+    unknown = [n for n in names if n not in all_names]
+    if unknown:
+        print(f"unknown factor(s): {', '.join(unknown)} "
+              "(see list-factors)", file=sys.stderr)
+        return 2
+    tel = set_telemetry(Telemetry())
+    if args.minute_dir:
+        source = MinuteDirSource(args.minute_dir)
+    else:
+        source = SyntheticSource(n_days=args.synthetic_days,
+                                 n_tickers=args.synthetic_tickers)
+    scfg = ServeConfig(batch_window_s=args.batch_window_ms / 1e3,
+                       cache_bytes=args.cache_mb * 1024 * 1024)
+    telemetry_dir = getattr(args, "telemetry_dir", None)
+
+    def _write_bundle():
+        if telemetry_dir:
+            tel.write(telemetry_dir,
+                      manifest_extra={"run_kind": "serve"})
+            print(tel.summary(), file=sys.stderr)
+
+    with FactorServer(source, names=names, serve_cfg=scfg,
+                      telemetry=tel) as server:
+        if args.demo is not None:
+            client = server.client()
+            w = max(2, min(8, source.n_days))
+            n_ranges = max(1, source.n_days // w)
+            for i in range(args.demo):
+                start = (i % n_ranges) * w
+                kind = ("factors", "ic", "decile")[i % 3]
+                if kind == "factors":
+                    client.factors(start, start + w,
+                                   names=(names[i % len(names)],))
+                elif kind == "ic":
+                    client.ic(names[i % len(names)], start, start + w)
+                else:
+                    client.decile(names[i % len(names)], start, start + w)
+            reg = tel.registry
+            lat = reg.histogram_stats("serve.request_seconds",
+                                      kind="ic") or {}
+            _write_bundle()
+            print(json.dumps({
+                "demo_requests": args.demo,
+                "factors": len(names),
+                "days": source.n_days,
+                "tickers": source.n_tickers,
+                "dispatches": int(reg.counter_total("serve.dispatches")),
+                "cache_hits": int(reg.counter_value("serve.cache",
+                                                    outcome="hit")),
+                "compiles": int(reg.counter_total("xla.compiles")),
+                "ic_p50_s": lat.get("p50"),
+            }))
+            return 0
+        httpd, _thread = serve_http(server, host=args.host,
+                                    port=args.port)
+        print(json.dumps({"serving": True, "host": args.host,
+                          "port": httpd.server_address[1],
+                          "factors": len(names),
+                          "days": source.n_days,
+                          "pid": os.getpid()}), flush=True)
+        try:
+            _thread.join()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            httpd.shutdown()
+            _write_bundle()
+    return 0
+
+
 def _add_analyze(sub: "argparse._SubParsersAction") -> None:
     from .analysis import cli as analysis_cli
     p = sub.add_parser(
@@ -406,6 +517,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     _add_list(sub)
     _add_doctor(sub)
     _add_analyze(sub)
+    _add_serve(sub)
     args = ap.parse_args(argv)
     if args.cmd is None:
         if args.telemetry_dir:
@@ -415,7 +527,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                  "the synthetic telemetry demo)")
     return {"compute": cmd_compute, "evaluate": cmd_evaluate,
             "list-factors": cmd_list_factors,
-            "doctor": cmd_doctor, "analyze": cmd_analyze}[args.cmd](args)
+            "doctor": cmd_doctor, "analyze": cmd_analyze,
+            "serve": cmd_serve}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -40,9 +40,22 @@ from .violations import Violation
 #: layers whose modules must stay free of serial loops (GL-A2)
 LOOP_SCOPE = ("ops", "models")
 #: layers whose modules must stay free of host syncs (GL-A3)
-HOST_SYNC_SCOPE = ("ops", "models", "parallel")
+HOST_SYNC_SCOPE = ("ops", "models", "parallel", "serve")
 #: layer where raw jnp reductions are banned in favour of ops.masked (GL-A5)
 MASKED_SCOPE = ("models",)
+
+#: GL-A3 boundary-module policy (docs/static-analysis.md): a device-hot
+#: layer's HOST-SIDE boundary modules declare their allowed sync points
+#: here, per (package-relative module path -> allowed symbols). This is
+#: deliberately NOT a path exclusion: any sync symbol a boundary module
+#: uses beyond its listed set still flags, and every other module in
+#: the layer keeps the full rule. The one current entry is the serving
+#: request loop, whose single declared sync is the ``np.asarray`` that
+#: materializes a query's answer from the device block
+#: (serve/service.py — the serve layer's host/device boundary).
+GLA3_BOUNDARY_SYNCS = {
+    "serve/service.py": frozenset({"np.asarray"}),
+}
 
 #: (acquire, release) method-name pairs for GL-A4
 RESOURCE_PAIRS = (("start_trace", "stop_trace"),)
@@ -249,6 +262,17 @@ def _rule_a2(scan: _ModuleScan, node: ast.AST,
                  "an unrolled/batched formulation")
 
 
+def _a3_add(scan: _ModuleScan, node: ast.AST, symbol: str,
+            msg: str) -> None:
+    """Record a GL-A3 hit unless the module's boundary policy allows
+    exactly this symbol (GLA3_BOUNDARY_SYNCS — per-symbol, never a
+    blanket module exclusion)."""
+    allowed = GLA3_BOUNDARY_SYNCS.get("/".join(scan.scope_parts), ())
+    if symbol in allowed:
+        return
+    scan.add("GL-A3", node, symbol, msg)
+
+
 def _rule_a3(scan: _ModuleScan, node: ast.AST,
              stack: List[ast.AST]) -> None:
     """GL-A3: host-sync calls in device-hot modules."""
@@ -260,20 +284,20 @@ def _rule_a3(scan: _ModuleScan, node: ast.AST,
            "layer or fetch explicitly via jax.device_get there")
     if isinstance(node.func, ast.Attribute):
         if node.func.attr == "item" and not node.args:
-            scan.add("GL-A3", node, ".item()", msg)
+            _a3_add(scan, node, ".item()", msg)
             return
         if node.func.attr == "block_until_ready":
-            scan.add("GL-A3", node, ".block_until_ready()", msg)
+            _a3_add(scan, node, ".block_until_ready()", msg)
             return
     dotted, name = _call_target(scan, node)
     if dotted == "numpy" and name in ("asarray", "array"):
-        scan.add("GL-A3", node, f"np.{name}", msg)
+        _a3_add(scan, node, f"np.{name}", msg)
         return
     if (isinstance(node.func, ast.Name) and node.func.id in ("float",
                                                              "int")
             and len(node.args) == 1
             and _is_jax_rooted(scan, node.args[0])):
-        scan.add("GL-A3", node, f"{node.func.id}(jax expression)", msg)
+        _a3_add(scan, node, f"{node.func.id}(jax expression)", msg)
 
 
 def _contains_call_named(nodes, names) -> bool:

@@ -1,0 +1,47 @@
+"""serve/ — the long-lived factor service (ISSUE 6).
+
+Every other entry point in this repo is a one-shot CLI: each invocation
+pays compile + ingest + teardown to answer a single question. A system
+serving "heavy traffic from millions of users" (ROADMAP north star) is a
+*resident process*; this package is that process, built on the batch
+engine (`pipeline.py`) and the observability stack (PRs 1-2) that was
+designed for exactly this request loop:
+
+* :mod:`.executables` — :class:`ExecutableCache`, the keyed AOT
+  executable cache generalizing bench's ``_aot_resident`` memo:
+  compile-once semantics, every build attributed through
+  ``telemetry.attribution.compile_with_telemetry`` (so "did this
+  request compile anything" is a registry counter, not a guess);
+* :mod:`.expcache` — :class:`DeviceExposureCache`, computed
+  ``[F, days, tickers]`` exposure blocks held in device memory under an
+  explicit byte budget with LRU eviction and hit/miss/eviction counters;
+* :mod:`.engine` — the device-facing compute: fused
+  wire-decode + 58-kernel + daily-close graph per day-range block, and
+  the IC / decile query graphs, all dispatched through the executable
+  cache;
+* :mod:`.source` — data sources (:class:`SyntheticSource` for
+  bench/tests, :class:`MinuteDirSource` over a directory of day files);
+* :mod:`.service` — :class:`FactorServer`: the async request queue that
+  micro-batches concurrent queries and COALESCES same-day-range ones
+  into one device dispatch, with per-request latency histograms,
+  queue-depth/in-flight gauges and a load-shedding circuit breaker;
+* :mod:`.http` — a stdlib-only HTTP/JSON binding (``serve_http``).
+
+Run it: ``python -m replication_of_minute_frequency_factor_tpu serve``
+(see docs/serving.md); load-bench it: ``python bench.py serve``.
+"""
+
+from __future__ import annotations
+
+from .executables import ExecutableCache
+from .expcache import DeviceExposureCache
+from .source import MinuteDirSource, SyntheticSource
+from .service import (FactorServer, LoadShedError, Query, ServeConfig,
+                      ServeClient)
+from .http import serve_http
+
+__all__ = [
+    "DeviceExposureCache", "ExecutableCache", "FactorServer",
+    "LoadShedError", "MinuteDirSource", "Query", "ServeClient",
+    "ServeConfig", "SyntheticSource", "serve_http",
+]

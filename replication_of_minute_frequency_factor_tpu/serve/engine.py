@@ -1,0 +1,185 @@
+"""Device-facing serve compute: block builds + query graphs, all AOT.
+
+One *block* is everything the service needs to answer any query over a
+day-range: the stacked ``[F, D, T]`` exposures of the server's factor
+set plus the per-(day, ticker) daily close and validity planes the IC
+and decile queries derive forward returns from. A block is built by ONE
+fused executable (wire unpack + decode + all factors + close extraction
+in a single XLA module — the same single-dispatch shape as
+``pipeline._compute_packed``) and stays on device; the service's
+exposure cache owns its lifetime.
+
+Every device entry point here dispatches through the
+:class:`..serve.executables.ExecutableCache`, so a warm server compiles
+NOTHING on a repeat request shape — asserted by the serving tests via
+the ``xla.compiles`` registry counter, not by reading this docstring.
+
+This module is device-hot (graftlint GL-A3 scope): results leave as
+device arrays; the request loop in :mod:`.service` is the boundary
+module that materializes them.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data import wire
+from ..eval_ops import _qcut_labels_jit, ic_series
+from ..models.registry import compute_factors
+from .executables import ExecutableCache
+
+
+def _block_fn(buf, spec, kind, names, replicate_quirks, rolling_impl):
+    """The fused block graph: one packed uint8 buffer in, the whole
+    query-answering state out. ``close`` is each (day, ticker)'s last
+    valid bar's close (NaN when the day has no valid bar) — the basis
+    for the forward returns IC/decile queries correlate against."""
+    arrs = wire.unpack(buf, spec)
+    if kind == "wire":
+        bars, m = wire.decode(*arrs)
+    else:
+        bars, m = arrs
+        m = m.astype(bool)
+    out = compute_factors(bars, m, names=names,
+                          replicate_quirks=replicate_quirks,
+                          rolling_impl=rolling_impl)
+    exposures = jnp.stack([out[n] for n in names])  # [F, D, T]
+    slots = jnp.arange(m.shape[-1])
+    last = jnp.max(jnp.where(m, slots, -1), axis=-1)  # [D, T]
+    valid = last >= 0
+    close = jnp.take_along_axis(
+        bars[..., 3], jnp.maximum(last, 0)[..., None], axis=-1)[..., 0]
+    close = jnp.where(valid, close, jnp.nan)
+    return exposures, close, valid
+
+
+_BLOCK_STATIC = ("spec", "kind", "names", "replicate_quirks",
+                 "rolling_impl")
+_block_jit = functools.partial(jax.jit,
+                               static_argnames=_BLOCK_STATIC)(_block_fn)
+
+
+def _fwd_returns(close, valid, horizon: int):
+    """``ret[d] = close[d+h]/close[d] - 1`` with the last ``h`` days
+    invalid (no forward close inside the block)."""
+    pad_c = jnp.full((horizon,) + close.shape[1:], jnp.nan, close.dtype)
+    pad_v = jnp.zeros((horizon,) + valid.shape[1:], bool)
+    fwd_close = jnp.concatenate([close[horizon:], pad_c])
+    fwd_ok = jnp.concatenate([valid[horizon:], pad_v])
+    ret = fwd_close / close - 1.0
+    return ret, fwd_ok & valid
+
+
+def _ic_fn(exposures, close, valid, row, horizon):
+    """Per-date Pearson IC + Spearman rank-IC of factor ``row`` against
+    ``horizon``-day forward close returns, inside the block."""
+    exp = exposures[row]
+    ret, ok = _fwd_returns(close, valid, horizon)
+    v = ok & jnp.isfinite(exp) & jnp.isfinite(ret)
+    return ic_series(jnp.where(v, exp, 0.0), jnp.where(v, ret, 0.0), v)
+
+
+_ic_jit = functools.partial(
+    jax.jit, static_argnames=("row", "horizon"))(_ic_fn)
+
+
+def _decile_fn(exposures, close, valid, row, horizon, group_num):
+    """Per-date quantile buckets of factor ``row`` (polars-qcut
+    semantics via eval_ops) with per-bucket counts and mean forward
+    returns."""
+    exp = exposures[row]
+    v = valid & jnp.isfinite(exp)
+    labels = _qcut_labels_jit(exp, v, group_num)  # [D, T], -1 invalid
+    ret, ok = _fwd_returns(close, valid, horizon)
+    onehot = labels[..., None] == jnp.arange(group_num)  # [D, T, G]
+    counts = jnp.sum(onehot & v[..., None], axis=1)
+    okr = onehot & (ok & jnp.isfinite(ret) & v)[..., None]
+    n_ret = jnp.sum(okr, axis=1)
+    ret_sum = jnp.sum(jnp.where(okr, ret[..., None], 0.0), axis=1)
+    mean_ret = jnp.where(n_ret > 0, ret_sum / n_ret, jnp.nan)
+    return labels, counts, mean_ret
+
+
+_decile_jit = functools.partial(
+    jax.jit, static_argnames=("row", "horizon", "group_num"))(_decile_fn)
+
+
+class ServeEngine:
+    """Builds and queries blocks for one server's factor set.
+
+    Holds the widen-only wire ``floor`` across blocks (so same-extent
+    day-ranges converge on one spec — and therefore ONE compiled block
+    executable) and the :class:`ExecutableCache` all dispatches go
+    through.
+    """
+
+    def __init__(self, names: Sequence[str], replicate_quirks: bool = True,
+                 rolling_impl: Optional[str] = None, telemetry=None,
+                 executables: Optional[ExecutableCache] = None):
+        from ..config import get_config
+        self.names: Tuple[str, ...] = tuple(names)
+        self.replicate_quirks = replicate_quirks
+        self.rolling_impl = (rolling_impl if rolling_impl is not None
+                             else get_config().rolling_impl)
+        self.executables = (executables if executables is not None
+                            else ExecutableCache(telemetry=telemetry))
+        self._floor: dict = {}
+
+    # --- block build ----------------------------------------------------
+    def build_block(self, bars: np.ndarray,
+                    mask: np.ndarray) -> Dict[str, object]:
+        """Encode + transfer + one fused dispatch; returns the block as
+        DEVICE arrays ``{exposures, close, valid}``. The result is
+        dispatched asynchronously — errors surface when the service
+        materializes an answer from it."""
+        w = wire.encode(bars, mask, floor=self._floor)
+        if w is not None:
+            buf, spec = wire.pack_arrays(w.arrays)
+            kind = "wire"
+        else:
+            buf, spec = wire.pack_arrays((bars, mask.view(np.uint8)))
+            kind = "raw"
+        dbuf = jax.device_put(buf)
+        key = ("block", len(buf), spec, kind, self.names,
+               self.replicate_quirks, self.rolling_impl)
+        compiled = self.executables.get(
+            "serve_block", key,
+            lambda: _block_jit.lower(dbuf, spec, kind, self.names,
+                                     self.replicate_quirks,
+                                     self.rolling_impl))
+        exposures, close, valid = compiled(dbuf)
+        return {"exposures": exposures, "close": close, "valid": valid}
+
+    # --- queries (device in, device out) --------------------------------
+    def row(self, name: str) -> int:
+        return self.names.index(name)
+
+    def ic(self, block: Dict[str, object], name: str, horizon: int):
+        """Device ``(ic [D], rank_ic [D])`` for one factor."""
+        exposures = block["exposures"]
+        row = self.row(name)
+        key = ("ic", exposures.shape, row, horizon)
+        compiled = self.executables.get(
+            "serve_ic", key,
+            lambda: _ic_jit.lower(exposures, block["close"],
+                                  block["valid"], row, horizon))
+        return compiled(exposures, block["close"], block["valid"])
+
+    def decile(self, block: Dict[str, object], name: str, horizon: int,
+               group_num: int):
+        """Device ``(labels [D, T], counts [D, G], mean_fwd_ret
+        [D, G])`` for one factor."""
+        exposures = block["exposures"]
+        row = self.row(name)
+        key = ("decile", exposures.shape, row, horizon, group_num)
+        compiled = self.executables.get(
+            "serve_decile", key,
+            lambda: _decile_jit.lower(exposures, block["close"],
+                                      block["valid"], row, horizon,
+                                      group_num))
+        return compiled(exposures, block["close"], block["valid"])

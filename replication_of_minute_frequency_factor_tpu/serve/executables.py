@@ -1,0 +1,77 @@
+"""Keyed AOT executable cache — compile-once semantics for the service.
+
+bench.py's ``_aot_resident`` memo proved the shape: lowering re-traces
+the whole 58-kernel graph (seconds of host work), so a warm hit must
+skip the ``.lower()`` call itself, not just the ``.compile()``. This
+generalizes that memo into an injectable object the serving layer keys
+on everything that shapes a module (buffer length, wire spec, factor
+names, quirks, rolling backend, query-static params), with every build
+routed through ``telemetry.attribution.compile_with_telemetry`` so the
+``xla.compiles{fn=...}`` counter is the ground truth for "did this
+request compile anything" — the serving acceptance gate reads it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Hashable, Optional
+
+
+class ExecutableCache:
+    """Hashable-key -> compiled-executable map with compile-once
+    semantics.
+
+    ``get(label, key, lower_fn)`` returns the cached executable for
+    ``key`` or builds it once: ``lower_fn()`` must return a
+    ``jax.jit(...).lower(...)`` result, which is compiled through
+    ``compile_with_telemetry(label, ...)``. Builds are serialized under
+    one lock (the request loop is single-threaded; concurrent callers
+    must not duplicate a seconds-scale compile), hits are lock-scoped
+    dict reads. Counters: ``serve.executables{outcome=hit|miss}``;
+    gauge: ``serve.executables_resident``.
+    """
+
+    def __init__(self, telemetry=None):
+        self._lock = threading.Lock()
+        self._exes: Dict[Hashable, object] = {}
+        self._telemetry = telemetry
+
+    def _tel(self):
+        if self._telemetry is not None:
+            return self._telemetry
+        from ..telemetry import get_telemetry
+        return get_telemetry()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._exes)
+
+    def get(self, label: str, key: Hashable,
+            lower_fn: Callable[[], object],
+            compile_cost: Optional[dict] = None):
+        """The compiled executable for ``key``; built once via
+        ``compile_with_telemetry(label, lower_fn())``. ``compile_cost``
+        (a mutable dict) receives the build's wall seconds under
+        ``"compile_s"`` (accumulated — bench's phases contract)."""
+        import time
+
+        tel = self._tel()
+        with self._lock:
+            exe = self._exes.get(key)
+            if exe is not None:
+                tel.counter("serve.executables", outcome="hit")
+                return exe
+            # build under the lock: a second caller with the same key
+            # must wait for one compile, not start its own
+            from ..telemetry import attribution as _attr
+            tel.counter("serve.executables", outcome="miss")
+            t0 = time.perf_counter()
+            exe = _attr.compile_with_telemetry(label, lower_fn(),
+                                               telemetry=self._telemetry)
+            if compile_cost is not None:
+                compile_cost["compile_s"] = round(
+                    compile_cost.get("compile_s", 0.0)
+                    + time.perf_counter() - t0, 3)
+            self._exes[key] = exe
+            tel.gauge("serve.executables_resident", len(self._exes))
+            return exe

@@ -1,0 +1,135 @@
+"""Device-resident exposure cache: LRU under an explicit byte budget.
+
+A served day-range's computed block (``[F, days, tickers]`` exposures
+plus the daily close / validity planes the IC and decile queries
+derive from) stays in device memory so a repeat query costs a cache
+lookup instead of an encode + transfer + fused-graph dispatch. HBM is
+the scarce resource: entries are accounted by their device ``nbytes``
+and evicted least-recently-used when the budget would overflow, with
+the evicted handles deleted so the backend reclaims the memory
+immediately instead of at GC time.
+
+Single-consumer contract: the request loop (one worker thread) is the
+only reader — an entry returned by ``get``/``put`` is used before the
+next ``put``, so eviction-time deletion can never pull a buffer out
+from under a live query. Counters: ``serve.cache{outcome=hit|miss}``,
+``serve.cache_evictions``, ``serve.cache_oversize``; gauges:
+``serve.cache_bytes``, ``serve.cache_entries``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, Hashable, Optional
+
+
+def entry_nbytes(entry: Dict[str, object]) -> int:
+    """Device bytes held by a block entry (sum over its arrays)."""
+    return int(sum(int(getattr(v, "nbytes", 0) or 0)
+                   for v in entry.values()))
+
+
+class DeviceExposureCache:
+    """LRU ``key -> {name: device array}`` map bounded by device bytes.
+
+    ``byte_budget <= 0`` disables caching entirely (every ``get`` is a
+    miss, ``put`` stores nothing) — the knob for a measurement run that
+    wants every request to pay the dispatch.
+    """
+
+    def __init__(self, byte_budget: int, telemetry=None,
+                 free_on_evict: bool = True):
+        self.byte_budget = int(byte_budget)
+        self.free_on_evict = free_on_evict
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Hashable, tuple]" = OrderedDict()
+        self._bytes = 0
+        self._telemetry = telemetry
+
+    def _tel(self):
+        if self._telemetry is not None:
+            return self._telemetry
+        from ..telemetry import get_telemetry
+        return get_telemetry()
+
+    # --- stats ----------------------------------------------------------
+    @property
+    def nbytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def _gauges(self) -> None:
+        tel = self._tel()
+        tel.gauge("serve.cache_bytes", self._bytes)
+        tel.gauge("serve.cache_entries", len(self._entries))
+
+    # --- read/write -----------------------------------------------------
+    def get(self, key: Hashable) -> Optional[Dict[str, object]]:
+        tel = self._tel()
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+        if hit is None:
+            tel.counter("serve.cache", outcome="miss")
+            return None
+        tel.counter("serve.cache", outcome="hit")
+        return hit[0]
+
+    def put(self, key: Hashable,
+            entry: Dict[str, object]) -> Dict[str, object]:
+        """Insert (or refresh) ``entry``, evicting LRU entries until it
+        fits. An entry larger than the whole budget is returned
+        UNCACHED (``serve.cache_oversize``) — caching it would evict
+        everything and still overflow."""
+        tel = self._tel()
+        nbytes = entry_nbytes(entry)
+        evicted = []
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            if nbytes > self.byte_budget:
+                tel.counter("serve.cache_oversize")
+                self._gauges()
+                return entry
+            while self._entries and self._bytes + nbytes > self.byte_budget:
+                _, (dead, dead_bytes) = self._entries.popitem(last=False)
+                self._bytes -= dead_bytes
+                evicted.append(dead)
+            self._entries[key] = (entry, nbytes)
+            self._bytes += nbytes
+            self._gauges()
+        for dead in evicted:
+            tel.counter("serve.cache_evictions")
+            if self.free_on_evict:
+                _delete_entry(dead)
+        return entry
+
+    def clear(self) -> None:
+        with self._lock:
+            dead = [e for e, _ in self._entries.values()]
+            self._entries.clear()
+            self._bytes = 0
+            self._gauges()
+        if self.free_on_evict:
+            for e in dead:
+                _delete_entry(e)
+
+
+def _delete_entry(entry: Dict[str, object]) -> None:
+    """Release an evicted block's device buffers now (best-effort): the
+    LRU exists to bound HBM, so reclamation must not wait for Python
+    GC of whatever references linger."""
+    for v in entry.values():
+        try:
+            deleted = getattr(v, "is_deleted", None)
+            if callable(deleted) and not deleted():
+                v.delete()
+        except Exception:  # noqa: BLE001 — freeing is best-effort
+            pass

@@ -1,0 +1,115 @@
+"""Stdlib-only HTTP/JSON binding for :class:`.service.FactorServer`.
+
+Protocol-agnostic by construction: the handler only translates JSON to
+:class:`..serve.service.Query` objects and futures back to JSON — every
+serving semantic (batching, coalescing, caching, shedding) lives in the
+server. ``ThreadingHTTPServer`` gives one thread per connection, which
+is exactly what the micro-batching queue wants: concurrent HTTP clients
+land in one collection window and coalesce.
+
+Endpoints:
+
+* ``POST /v1/query`` — body ``{"kind": "factors"|"ic"|"decile",
+  "start": int, "end": int, "names"?: [..], "factor"?: str,
+  "horizon"?: int, "group_num"?: int}`` -> the answer dict.
+  400 on a malformed query, 503 when the server sheds (breaker open /
+  queue full) — the HTTP face of backpressure, 500 on a failed dispatch.
+* ``GET /healthz`` — liveness + breaker state.
+* ``GET /v1/metrics`` — the telemetry registry snapshot (JSON).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from .service import FactorServer, LoadShedError, Query
+
+#: request-body bound (a factors query is a few hundred bytes)
+MAX_BODY_BYTES = 1 << 20
+
+
+def _make_handler(server: FactorServer, timeout: Optional[float]):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            if self.path == "/healthz":
+                with server._state_lock:
+                    open_until = server._open_until
+                    consecutive = server._consecutive
+                self._reply(200, {
+                    "ok": True, "factors": len(server.names),
+                    "days": server.source.n_days,
+                    "breaker_open": open_until is not None,
+                    "breaker_consecutive_failures": consecutive})
+                return
+            if self.path == "/v1/metrics":
+                self._reply(200, server.telemetry.registry.snapshot())
+                return
+            self._reply(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            if self.path != "/v1/query":
+                self._reply(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                if length > MAX_BODY_BYTES:
+                    self._reply(413, {"error": "body too large"})
+                    return
+                doc = json.loads(self.rfile.read(length) or b"{}")
+                q = Query(
+                    kind=doc.get("kind", ""),
+                    start=int(doc.get("start", 0)),
+                    end=int(doc.get("end", 0)),
+                    names=(tuple(doc["names"]) if doc.get("names")
+                           else None),
+                    factor=doc.get("factor"),
+                    horizon=int(doc.get("horizon", 1)),
+                    group_num=int(doc.get("group_num", 5)))
+            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                self._reply(400, {"error": f"malformed request: {e}"})
+                return
+            try:
+                fut = server.submit(q)
+            except LoadShedError as e:
+                self._reply(503, {"error": str(e), "shed": True})
+                return
+            except ValueError as e:
+                self._reply(400, {"error": str(e)})
+                return
+            try:
+                self._reply(200, fut.result(timeout))
+            except Exception as e:  # noqa: BLE001 — dispatch failure
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+    return Handler
+
+
+def serve_http(server: FactorServer, host: str = "127.0.0.1",
+               port: int = 0, timeout: Optional[float] = 60.0,
+               ) -> Tuple[ThreadingHTTPServer, threading.Thread]:
+    """Bind ``server`` on ``host:port`` (0 = ephemeral) and serve from a
+    daemon thread. Returns ``(httpd, thread)``; the bound port is
+    ``httpd.server_address[1]``; stop with ``httpd.shutdown()``."""
+    httpd = ThreadingHTTPServer((host, port),
+                                _make_handler(server, timeout))
+    httpd.daemon_threads = True
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True,
+                              name="factor-serve-http")
+    thread.start()
+    return httpd, thread

@@ -1,0 +1,386 @@
+"""The resident request loop: async batching, coalescing, load shedding.
+
+``FactorServer`` is the process a notebook (or the HTTP binding) talks
+to. Requests enqueue as futures; ONE worker thread drains the queue in
+micro-batches (``batch_window_s`` collection window, ``max_batch``
+bound), groups each batch by day-range, and answers every group from
+ONE device block — concurrent queries over the same range therefore
+coalesce into a single fused dispatch (or a single exposure-cache hit),
+which is the scaling property the whole serving layer exists for.
+
+Failure containment mirrors the batch pipeline's breaker: consecutive
+failed dispatches open the circuit and subsequent submits are SHED
+(fail fast with :class:`LoadShedError`) until a cooldown lapses; the
+first request after the cooldown is the half-open probe. A full queue
+sheds too — backpressure must reach the caller as an error, not as an
+unbounded latency tail.
+
+graftlint note (docs/static-analysis.md): this file is the declared
+GL-A3 *boundary module* of the ``serve/`` layer — its one allowed host
+sync is the ``np.asarray`` fetch that materializes a query's answer.
+Everything device-side stays in :mod:`.engine`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .engine import ServeEngine
+from .executables import ExecutableCache
+from .expcache import DeviceExposureCache
+
+_SENTINEL = None  # queue poison pill (requests are _Pending objects)
+
+QUERY_KINDS = ("factors", "ic", "decile")
+
+
+class LoadShedError(RuntimeError):
+    """The server refused the request up front: breaker open after
+    sustained dispatch failure, or the bounded queue is full. Callers
+    retry later (or against another replica) — the error IS the
+    backpressure signal."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Query:
+    """One question over a day-range ``[start, end)`` (indices into the
+    source's day axis — the coalescing key is ``(start, end)``)."""
+    kind: str                                  # factors | ic | decile
+    start: int
+    end: int
+    names: Optional[Tuple[str, ...]] = None    # factors: subset (None=all)
+    factor: Optional[str] = None               # ic / decile
+    horizon: int = 1                           # forward-return horizon
+    group_num: int = 5                         # decile buckets
+
+
+@dataclasses.dataclass
+class _Pending:
+    query: Query
+    future: Future
+    t_enqueue: float
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Serving knobs (the compute knobs stay on ``config.Config``)."""
+    #: micro-batch collection window after the first dequeued request
+    batch_window_s: float = 0.002
+    #: most requests drained into one micro-batch
+    max_batch: int = 64
+    #: bounded request queue; a full queue sheds (backpressure)
+    queue_limit: int = 1024
+    #: device-byte budget of the exposure cache (LRU past it)
+    cache_bytes: int = 256 * 1024 * 1024
+    #: consecutive failed dispatches before the breaker opens
+    breaker_threshold: int = 3
+    #: seconds the open breaker sheds before the half-open probe
+    breaker_cooldown_s: float = 1.0
+
+
+class FactorServer:
+    """The long-lived factor service over one data source.
+
+    ``start=False`` constructs the server with the worker paused —
+    submitted requests queue up and are drained on :meth:`start` (the
+    deterministic way to exercise coalescing in tests and smokes).
+    """
+
+    def __init__(self, source, names: Optional[Sequence[str]] = None,
+                 serve_cfg: Optional[ServeConfig] = None,
+                 replicate_quirks: bool = True,
+                 rolling_impl: Optional[str] = None,
+                 telemetry=None, start: bool = True):
+        from ..models.registry import factor_names
+        from ..telemetry import get_telemetry
+        self.source = source
+        self.names: Tuple[str, ...] = tuple(names) if names is not None \
+            else factor_names()
+        self.scfg = serve_cfg or ServeConfig()
+        self.telemetry = telemetry if telemetry is not None \
+            else get_telemetry()
+        self.executables = ExecutableCache(telemetry=self.telemetry)
+        self.engine = ServeEngine(self.names,
+                                  replicate_quirks=replicate_quirks,
+                                  rolling_impl=rolling_impl,
+                                  telemetry=self.telemetry,
+                                  executables=self.executables)
+        self.cache = DeviceExposureCache(self.scfg.cache_bytes,
+                                         telemetry=self.telemetry)
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.scfg.queue_limit)
+        self._state_lock = threading.Lock()
+        self._consecutive = 0
+        self._open_until: Optional[float] = None
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    # --- lifecycle ------------------------------------------------------
+    def start(self) -> "FactorServer":
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(target=self._worker,
+                                            daemon=True,
+                                            name="factor-serve-worker")
+            self._thread.start()
+        return self
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Drain-and-stop: queued requests are still answered; new
+        submits are refused."""
+        self._closed = True
+        if self._thread is not None and self._thread.is_alive():
+            self._q.put(_SENTINEL)
+            self._thread.join(timeout)
+
+    def __enter__(self) -> "FactorServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # --- client side ----------------------------------------------------
+    def client(self, timeout: Optional[float] = 60.0) -> "ServeClient":
+        return ServeClient(self, timeout=timeout)
+
+    def _validate(self, q: Query) -> None:
+        if q.kind not in QUERY_KINDS:
+            raise ValueError(f"unknown query kind {q.kind!r} "
+                             f"(one of {QUERY_KINDS})")
+        n_days = self.source.n_days
+        if not (0 <= q.start < q.end <= n_days):
+            raise ValueError(f"day range [{q.start}, {q.end}) outside "
+                             f"the source's {n_days} days")
+        if q.kind == "factors":
+            unknown = [n for n in (q.names or ()) if n not in self.names]
+            if unknown:
+                raise ValueError(f"unknown factor(s) {unknown}; server "
+                                 f"holds {len(self.names)}")
+        else:
+            if q.factor not in self.names:
+                raise ValueError(f"unknown factor {q.factor!r}")
+            if not (1 <= q.horizon < q.end - q.start):
+                raise ValueError(
+                    f"horizon {q.horizon} needs a range longer than "
+                    f"itself (got {q.end - q.start} days)")
+            if q.kind == "decile" and q.group_num < 2:
+                raise ValueError("group_num must be >= 2")
+
+    def submit(self, q: Query) -> Future:
+        """Enqueue; returns a Future resolving to the answer dict.
+        Raises :class:`LoadShedError` immediately when shedding (open
+        breaker / full queue) and ``ValueError`` on a malformed query —
+        validation cost stays on the caller's thread."""
+        if self._closed:
+            raise RuntimeError("server is closed")
+        self._validate(q)
+        tel = self.telemetry
+        now = time.monotonic()
+        with self._state_lock:
+            if self._open_until is not None:
+                if now < self._open_until:
+                    tel.counter("serve.load_shed", reason="breaker")
+                    raise LoadShedError(
+                        "breaker open after "
+                        f"{self._consecutive} consecutive dispatch "
+                        "failures; retry after the cooldown")
+                # half-open: this request is the probe; keep the gate up
+                # for everyone else until it succeeds
+                self._open_until = now + self.scfg.breaker_cooldown_s
+        pending = _Pending(q, Future(), now)
+        try:
+            self._q.put_nowait(pending)
+        except queue.Full:
+            tel.counter("serve.load_shed", reason="queue_full")
+            raise LoadShedError(
+                f"request queue full ({self.scfg.queue_limit})") from None
+        tel.counter("serve.requests", kind=q.kind)
+        self._note_depth()
+        return pending.future
+
+    def _note_depth(self) -> None:
+        depth = self._q.qsize()
+        self.telemetry.gauge("serve.queue_depth", depth)
+        self.telemetry.observe("serve.queue_depth", depth)
+
+    # --- breaker --------------------------------------------------------
+    def _breaker_failure(self) -> None:
+        tel = self.telemetry
+        with self._state_lock:
+            self._consecutive += 1
+            tel.gauge("serve.breaker_consecutive_failures",
+                      self._consecutive)
+            if self._consecutive >= self.scfg.breaker_threshold:
+                self._open_until = (time.monotonic()
+                                    + self.scfg.breaker_cooldown_s)
+                tel.counter("serve.breaker_trips")
+
+    def _breaker_ok(self) -> None:
+        with self._state_lock:
+            self._consecutive = 0
+            self._open_until = None
+        self.telemetry.gauge("serve.breaker_consecutive_failures", 0)
+
+    # --- worker ---------------------------------------------------------
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _SENTINEL:
+                return
+            batch = [item]
+            deadline = time.monotonic() + self.scfg.batch_window_s
+            stop_after = False
+            while len(batch) < self.scfg.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is _SENTINEL:
+                    stop_after = True
+                    break
+                batch.append(nxt)
+            self._note_depth()
+            self.telemetry.observe("serve.batch_size", len(batch))
+            groups: Dict[Tuple[int, int], list] = {}
+            for p in batch:
+                groups.setdefault((p.query.start, p.query.end),
+                                  []).append(p)
+            self.telemetry.gauge("serve.inflight", len(batch))
+            for key, group in groups.items():
+                self._dispatch_group(key, group)
+            self.telemetry.gauge("serve.inflight", 0)
+            if stop_after:
+                return
+
+    def _dispatch_group(self, key: Tuple[int, int], group: list) -> None:
+        """One device block answers every request in ``group`` — the
+        coalescing contract. A block failure fails the whole group and
+        bumps the breaker once."""
+        tel = self.telemetry
+        t_dispatch = time.monotonic()
+        with tel.tracer("serve.dispatch"):
+            try:
+                t0 = time.perf_counter()
+                block = self.cache.get(key)
+                if block is None:
+                    bars, mask = self.source.slab(*key)
+                    block = self.engine.build_block(bars, mask)
+                    self.cache.put(key, block)
+                    tel.counter("serve.dispatches")
+                tel.observe("serve.stage_seconds",
+                            time.perf_counter() - t0, stage="block")
+            except Exception as e:  # noqa: BLE001 — fail the group, shed
+                for p in group:
+                    p.future.set_exception(e)
+                tel.counter("serve.failures", stage="block")
+                self._breaker_failure()
+                return
+            if len(group) > 1:
+                tel.counter("serve.coalesced_dispatches")
+                tel.counter("serve.coalesced_requests", len(group))
+            fetched: dict = {}
+            ok = True
+            for p in group:
+                t0 = time.perf_counter()
+                try:
+                    result = self._answer(block, p.query, fetched)
+                except Exception as e:  # noqa: BLE001 — per-request
+                    p.future.set_exception(e)
+                    tel.counter("serve.failures", stage="answer")
+                    ok = False
+                    continue
+                p.future.set_result(result)
+                now = time.monotonic()
+                tel.observe("serve.stage_seconds",
+                            time.perf_counter() - t0, stage="answer")
+                tel.observe("serve.stage_seconds",
+                            t_dispatch - p.t_enqueue, stage="queue_wait")
+                tel.observe("serve.request_seconds", now - p.t_enqueue,
+                            kind=p.query.kind)
+        if ok:
+            self._breaker_ok()
+        else:
+            self._breaker_failure()
+
+    # --- answers (the boundary: device block -> host JSON-able) ---------
+    def _days_codes(self, q: Query) -> dict:
+        return {"days": list(self.source.days[q.start:q.end]),
+                "start": q.start, "end": q.end}
+
+    def _host_exposures(self, block, fetched: dict) -> np.ndarray:
+        """The group's ONE host fetch of the stacked exposures (memoised
+        across the group's factors-queries) — the declared GL-A3
+        boundary sync of the request loop."""
+        if "exposures" not in fetched:
+            fetched["exposures"] = np.asarray(block["exposures"])
+        return fetched["exposures"]
+
+    def _answer(self, block, q: Query, fetched: dict) -> dict:
+        out = self._days_codes(q)
+        if q.kind == "factors":
+            exp = self._host_exposures(block, fetched)
+            names = q.names or self.names
+            out["codes"] = list(self.source.codes)
+            out["exposures"] = {
+                n: exp[self.names.index(n)].tolist() for n in names}
+            return out
+        if q.kind == "ic":
+            ic, rank_ic = self.engine.ic(block, q.factor, q.horizon)
+            ic = np.asarray(ic)
+            rank_ic = np.asarray(rank_ic)
+            out.update({
+                "factor": q.factor, "horizon": q.horizon,
+                "ic": ic.tolist(), "rank_ic": rank_ic.tolist(),
+                "mean_ic": _finite_mean(ic),
+                "mean_rank_ic": _finite_mean(rank_ic)})
+            return out
+        _labels, counts, mean_ret = self.engine.decile(
+            block, q.factor, q.horizon, q.group_num)
+        out.update({
+            "factor": q.factor, "horizon": q.horizon,
+            "group_num": q.group_num,
+            "counts": np.asarray(counts).tolist(),
+            "mean_fwd_ret": np.asarray(mean_ret).tolist()})
+        return out
+
+
+def _finite_mean(x: np.ndarray):
+    f = x[np.isfinite(x)]
+    return round(f.mean().tolist(), 8) if f.size else None
+
+
+class ServeClient:
+    """In-process client API — the notebook-facing surface. Each method
+    submits one :class:`Query` and blocks on its future."""
+
+    def __init__(self, server: FactorServer,
+                 timeout: Optional[float] = 60.0):
+        self._server = server
+        self._timeout = timeout
+
+    def factors(self, start: int, end: int,
+                names: Optional[Sequence[str]] = None) -> dict:
+        q = Query("factors", start, end,
+                  names=tuple(names) if names else None)
+        return self._server.submit(q).result(self._timeout)
+
+    def ic(self, factor: str, start: int, end: int,
+           horizon: int = 1) -> dict:
+        q = Query("ic", start, end, factor=factor, horizon=horizon)
+        return self._server.submit(q).result(self._timeout)
+
+    def decile(self, factor: str, start: int, end: int,
+               horizon: int = 1, group_num: int = 5) -> dict:
+        q = Query("decile", start, end, factor=factor, horizon=horizon,
+                  group_num=group_num)
+        return self._server.submit(q).result(self._timeout)

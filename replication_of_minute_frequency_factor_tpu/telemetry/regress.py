@@ -26,7 +26,13 @@ smeared):
   ``r7_resident_sharded_v1`` (mesh-native resident scan:
   tickers-sharded wire buffers, overlapped group ingest, sharded
   fetch — bench stamps it only when ``n_shards > 1`` actually
-  resolved; single-device resident runs stay on ``r6_resident_v2``).
+  resolved; single-device resident runs stay on ``r6_resident_v2``),
+  ``r8_serve_v1`` (the serving layer, ``bench.py serve``: steady QPS
+  of the resident FactorServer at the record's highest concurrency
+  level is the ``value``, with per-level p50/p99/QPS under
+  ``levels`` and the serving counters — exposure-cache hits,
+  coalesced dispatches, compiles-during-load — under ``serve``; a
+  new workload, so its records never smear onto the batch series).
 
 Baseline = median of every record in the group EXCEPT the latest; the
 latest is the record under test. ``--check FILE`` instead gates a fresh

@@ -51,6 +51,13 @@ print(json.dumps(_smoke()))"
     run env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python -c "import json, sys, bench; r = bench.sharded_smoke(); \
 print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
+    # serve smoke (ISSUE 6): an in-process FactorServer on CPU under a
+    # handful of concurrent synthetic queries — second identical
+    # request compiles nothing, >=1 coalesced multi-request dispatch,
+    # exposure-cache hits > 0, p50/p99/QPS stamped under the declared
+    # r8_serve_v1 methodology; one JSON verdict line, nonzero on drift
+    run python -c "import json, sys, bench; r = bench.serve_smoke(); \
+print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     # graftlint (ISSUE 4): AST rules over the whole package + jaxpr
     # contracts over all 58 registered kernels AND the resident scan
     # wrappers (abstract trace on CPU), gated on the committed baseline

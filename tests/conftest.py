@@ -44,7 +44,12 @@ def rng():
 #: ``device_get``/``jnp.asarray`` and jit-compiled constants stay
 #: legal. A test that legitimately transfers opts out with
 #: ``@pytest.mark.transfers``.
-TRANSFER_GUARDED_MODULES = {"test_kernel_purity"}
+#: test_serve joins (ISSUE 6): the serving layer's device-facing half
+#: (engine/expcache) must move data only by explicit put/get — the
+#: in-process client returns HOST results, so the calling thread never
+#: transfers implicitly; serve tests that do transfer on the test
+#: thread opt out per test.
+TRANSFER_GUARDED_MODULES = {"test_kernel_purity", "test_serve"}
 
 
 @pytest.fixture(autouse=True)

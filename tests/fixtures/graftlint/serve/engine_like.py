@@ -1,0 +1,8 @@
+"""GL-A3 serve-scope fixture: a non-boundary module under serve/ gets
+the full rule — np.asarray flags here even though the boundary module
+next door is allowed it."""
+import numpy as np
+
+
+def fetch(block):
+    return np.asarray(block)  # flags: only serve/service.py may sync

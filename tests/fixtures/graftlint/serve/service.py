@@ -1,0 +1,14 @@
+"""GL-A3 boundary-policy fixture: this path matches the policy key
+``serve/service.py`` (ast_tier.GLA3_BOUNDARY_SYNCS), whose allowed set
+is exactly ``{"np.asarray"}`` — the allowed symbol must NOT flag, every
+other sync symbol still must (a boundary module is not a blanket
+exclusion)."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def answer(block):
+    host = np.asarray(block)            # allowed by the boundary policy
+    x = jnp.sum(block)
+    x.block_until_ready()               # NOT allowed: still flags
+    return host, x.item()               # NOT allowed: still flags

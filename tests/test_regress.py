@@ -220,6 +220,29 @@ def test_cli_no_input_exits_two(tmp_path):
     assert not verdict["ok"]
 
 
+def test_cli_check_r8_serve_break_is_declared(tmp_path):
+    """ISSUE 6: the serving layer's first ``bench.py serve`` record
+    (QPS under ``r8_serve_v1``) gates against the REAL banked
+    trajectory as a declared break — its own fresh series, reported
+    with an empty baseline, never flagged, exit 0. The serve counters
+    ride the record for the session carry rule (cache_hits > 0)."""
+    cand = tmp_path / "candidate.json"
+    with open(cand, "w") as fh:
+        json.dump({"metric": "serve58_1024tickers_qps", "value": 512.4,
+                   "unit": "req/s", "methodology": "r8_serve_v1",
+                   "p50_ms": 41.0, "p99_ms": 120.0,
+                   "levels": {"1": {"qps": 88.0}, "32": {"qps": 512.4}},
+                   "serve": {"cache_hits": 180,
+                             "coalesced_dispatches": 12,
+                             "compiles_during_load": 0}}, fh)
+    rc, verdict = _cli(REPO, "--check", str(cand))
+    assert rc == 0 and verdict["ok"]
+    (g,) = [g for g in verdict["groups"]
+            if g["methodology"] == "r8_serve_v1"]
+    assert g["n_baseline"] == 0 and g["flagged"] is False
+    assert "declared break" in g.get("note", "")
+
+
 def test_cli_check_r7_sharded_break_is_declared(tmp_path):
     """ISSUE 5: a fresh record under the r7 mesh-native resident
     methodology gates against the REAL banked trajectory as a declared
