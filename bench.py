@@ -952,6 +952,249 @@ def serve_main():
     return 0
 
 
+# --------------------------------------------------------------------------
+# stream mode (ISSUE 7): online intraday ingest against the streaming engine
+# --------------------------------------------------------------------------
+
+#: stream-mode knobs (python bench.py stream). Cohort sizes are the
+#: TICKERS-PER-UPDATE ingest shapes (the live-feed path's executable
+#: shapes); defaults size for the TPU session, the CPU smoke overrides.
+STREAM_COHORTS = os.environ.get("BENCH_STREAM_COHORTS", "1,8,64")
+STREAM_TICKERS = int(os.environ.get("BENCH_STREAM_TICKERS", "1024"))
+#: per-cohort-level update budget: bounds the load phase's wall clock
+#: independently of the universe size (1024 tickers at K=1 would
+#: otherwise be 245k dispatches per streamed day)
+STREAM_UPDATES = int(os.environ.get("BENCH_STREAM_UPDATES", "960"))
+
+
+def stream_bench(cohorts=None, tickers=None, updates=None, names=None,
+                 telemetry=None):
+    """Ingest-load the online intraday engine (stream/) and return the
+    ``r9_stream_intraday_v1`` record: bars/sec + per-update p50/p99
+    latency at each cohort ingest shape, the streaming counters, and
+    the parity + compile evidence the acceptance gate reads (streamed
+    day == full-day batch exposures; ZERO compiles after warmup).
+
+    Three phases, each a ``stages`` column:
+
+      warm   — compile every executable the load shapes need (scan
+               micro-batch, each cohort size, advance, snapshot);
+      parity — one seeded synthetic day streamed through the scan path
+               in 16-minute micro-batches, snapshot vs the jitted
+               full-day batch graph (bitwise outside the documented
+               ``_ULP_FACTORS`` pair — the same pin policy as the
+               sharded smoke, and the bench-side twin of the tier-1
+               tests/test_stream.py gate);
+      load   — per cohort size K: minute-by-minute cohort ingest
+               (K tickers per dispatch, cursor advance at each minute
+               boundary), per-update wall collected host-side.
+    """
+    from replication_of_minute_frequency_factor_tpu.models.registry import (
+        compute_factors_jit, factor_names as _fnames)
+    from replication_of_minute_frequency_factor_tpu.stream.engine import (
+        StreamEngine)
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        Telemetry, set_telemetry)
+
+    cohorts = tuple(cohorts if cohorts is not None else
+                    (int(s) for s in STREAM_COHORTS.split(",")
+                     if s.strip()))
+    tickers = tickers or STREAM_TICKERS
+    updates = updates or STREAM_UPDATES
+    if names is None:
+        factors_env = os.environ.get("BENCH_FACTORS")
+        names = (tuple(s.strip() for s in factors_env.split(",")
+                       if s.strip()) if factors_env else _fnames())
+    names = tuple(names)
+    tel = telemetry if telemetry is not None else set_telemetry(Telemetry())
+    reg = tel.registry
+    stages = {}
+
+    rng = np.random.default_rng(9)
+    bars4, mask4 = make_batch(rng, n_days=1, n_tickers=tickers)
+    day_bars, day_mask = bars4[0], mask4[0]     # [T, 240, 5], [T, 240]
+
+    engine = StreamEngine(tickers, names=names, telemetry=tel)
+    # --- warm: all compiles land here (micro-batch scan, cohorts,
+    # advance, snapshot)
+    t0 = time.perf_counter()
+    engine.warmup(micro_batches=(16,), cohorts=cohorts)
+    stages["warm_s"] = round(time.perf_counter() - t0, 3)
+
+    # --- parity: the streamed fold must reproduce the full-day batch
+    # exposures on the SAME warmed executables
+    t0 = time.perf_counter()
+    for s in range(0, 240, 16):
+        engine.ingest_minutes(
+            np.ascontiguousarray(np.swapaxes(day_bars[:, s:s + 16], 0, 1)),
+            np.ascontiguousarray(day_mask[:, s:s + 16].T))
+    streamed, _ready = engine.snapshot()
+    streamed = np.asarray(streamed)
+    want = compute_factors_jit(day_bars, day_mask, names=names)
+    mismatched = []
+    for j, n in enumerate(names):
+        a, b = np.asarray(want[n]), streamed[j]
+        if np.array_equal(a, b, equal_nan=True):
+            continue
+        f = np.isfinite(a) & np.isfinite(b)
+        d = float(np.abs(a[f] - b[f]).max(initial=0.0))
+        scale = float(np.abs(a[f]).max(initial=1.0)) or 1.0
+        if n in _ULP_FACTORS and np.array_equal(
+                np.isfinite(a), np.isfinite(b)) \
+                and d <= 16 * np.finfo(np.float32).eps * scale:
+            continue
+        mismatched.append(n)
+    stages["parity_s"] = round(time.perf_counter() - t0, 3)
+
+    # --- load: per cohort shape, minute-by-minute live-feed ingest
+    compiles_before = reg.counter_total("xla.compiles")
+    level_stats = {}
+    for k in cohorts:
+        engine.reset()
+        lat = []
+        n_bars = 0
+        done = False
+        t0 = time.perf_counter()
+        for t in range(240):
+            if done:
+                break
+            for c0 in range(0, tickers, k):
+                sel = np.arange(c0, min(c0 + k, tickers))
+                present = day_mask[sel, t]
+                idx = np.where(present, sel, tickers).astype(np.int32)
+                rows = np.ascontiguousarray(day_bars[sel, t])
+                if len(sel) < k:    # ragged tail: pad with dropped rows
+                    pad = k - len(sel)
+                    idx = np.concatenate(
+                        [idx, np.full(pad, tickers, np.int32)])
+                    rows = np.concatenate(
+                        [rows, np.zeros((pad, 5), np.float32)])
+                t_u = time.perf_counter()
+                engine.ingest_cohort(rows, idx)
+                lat.append(time.perf_counter() - t_u)
+                n_bars += int(present.sum())
+                if len(lat) >= updates:
+                    done = True
+                    break
+            else:
+                engine.advance()
+        wall = time.perf_counter() - t0
+        a = np.sort(np.asarray(lat))
+        level_stats[str(k)] = {
+            "updates": len(a),
+            "p50_ms": round(float(np.percentile(a, 50)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(a, 99)) * 1e3, 3),
+            "bars_per_s": round(n_bars / wall, 1),
+        }
+        stages[f"load_{k}_s"] = round(wall, 3)
+    # one warm snapshot after load: the intraday query the live feed
+    # interleaves (also proves snapshot stayed compiled)
+    t0 = time.perf_counter()
+    engine.snapshot()
+    stages["snapshot_s"] = round(time.perf_counter() - t0, 3)
+
+    top = str(cohorts[-1])
+    stream_counters = {
+        "updates": int(reg.counter_total("stream.updates")),
+        "bars": int(reg.counter_total("stream.bars")),
+        "snapshots": int(reg.counter_total("stream.snapshots")),
+        "carry_bytes": int(reg.gauge_value("stream.carry_bytes")),
+        "compiles_total": int(reg.counter_total("xla.compiles")),
+        "compiles_during_load": int(reg.counter_total("xla.compiles")
+                                    - compiles_before),
+        "parity_checked": len(names),
+        "parity_mismatched": sorted(mismatched),
+    }
+    return {
+        # metric name derives from the ACTUAL factor/ticker counts, like
+        # the headline (a restricted smoke can never print under the
+        # full-set name)
+        "metric": f"stream{len(names)}_{tickers}tickers_bars_per_s"
+                  + _SUFFIX,
+        "value": level_stats[top]["bars_per_s"],
+        "unit": "bars/s",
+        "tickers": tickers,
+        "factors": len(names),
+        "cohorts": list(cohorts),
+        # DECLARED series (telemetry/regress.py): per-bar intraday
+        # ingest is a new workload — its records start their own
+        # baseline
+        "methodology": "r9_stream_intraday_v1",
+        "p50_ms": level_stats[top]["p50_ms"],
+        "p99_ms": level_stats[top]["p99_ms"],
+        "levels": level_stats,
+        "stream": stream_counters,
+        "stages": stages,
+    }
+
+
+def stream_smoke():
+    """run_tests.sh --quick smoke (and the CPU acceptance demo): a tiny
+    stream_bench on CPU. ``ok`` iff the acceptance signals hold — zero
+    compiles after warmup (warm executables across every ingest shape)
+    and streamed-vs-full-day parity on the seeded day (the full-58
+    sweep lives in tier-1 tests/test_stream.py; this drives the same
+    restricted family set as the serve smoke)."""
+    record = stream_bench(cohorts=(1, 8), tickers=32, updates=96,
+                          names=("vol_return1min", "mmt_am",
+                                 "liq_openvol"))
+    s = record["stream"]
+    return {
+        "smoke": "stream",
+        "compiles_during_load": s["compiles_during_load"],
+        "parity_mismatched": s["parity_mismatched"],
+        "updates": s["updates"],
+        "bars": s["bars"],
+        "p50_ms": record["p50_ms"], "p99_ms": record["p99_ms"],
+        "bars_per_s": record["value"],
+        "methodology": record["methodology"],
+        "ok": (s["compiles_during_load"] == 0
+               and s["parity_mismatched"] == []
+               and s["updates"] > 0 and s["bars"] > 0),
+    }
+
+
+def stream_main():
+    """``python bench.py stream`` — the intraday-stream entry point.
+    Tunnel handling mirrors serve_main: preserve the ``stream`` argv
+    through the CPU-fallback execve and flip the metric suffix so a CPU
+    number can never be read as a TPU one. (BENCH_MODE=stream remains
+    the UNRELATED r1-r4 per-batch year loop of the default entry
+    point.)"""
+    if "PALLAS_AXON_POOL_IPS" in os.environ and not _tunnel_alive():
+        if os.environ.get("BENCH_REQUIRE_TPU"):
+            print("# BENCH_REQUIRE_TPU set and tunnel unreachable; "
+                  "aborting instead of CPU fallback", file=sys.stderr,
+                  flush=True)
+            return 17
+        env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}
+        env["JAX_PLATFORMS"] = "cpu"
+        env["BENCH_METRIC_SUFFIX"] = "_cpu_fallback_tunnel_down"
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__), "stream"],
+                  env)
+    if os.environ.get("BENCH_REQUIRE_TPU") \
+            and jax.devices()[0].platform == "cpu":
+        print("# BENCH_REQUIRE_TPU set but jax resolved to CPU; aborting",
+              file=sys.stderr, flush=True)
+        return 17
+    _wait_host_quiet()
+    from replication_of_minute_frequency_factor_tpu.config import (
+        apply_compilation_cache, get_config)
+    apply_compilation_cache(get_config())
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        Telemetry, set_telemetry, get_telemetry)
+    set_telemetry(Telemetry())
+    record = stream_bench(telemetry=get_telemetry())
+    print(json.dumps(record))
+    tdir = os.environ.get("BENCH_TELEMETRY_DIR")
+    if tdir:
+        get_telemetry().write(tdir,
+                              manifest_extra={"run_kind": "bench_stream"})
+    return 0
+
+
 def main():
     _ensure_device_reachable()  # may exec into a CPU-fallback run
     if os.environ.get("BENCH_REQUIRE_TPU") \
@@ -1590,4 +1833,6 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "serve":
         sys.exit(serve_main())
+    if len(sys.argv) > 1 and sys.argv[1] == "stream":
+        sys.exit(stream_main())
     main()

@@ -144,7 +144,11 @@ def drop_conv_only_rolling(steps):
       under the ``_sharded`` metric suffix with ``n_shards > 1`` and
       the 5000-ticker stamp — the same "silent fallback cannot bank"
       rule as the pallas step (a single-device resolution banks
-      nothing; the next multi-device window must re-run it).
+      nothing; the next multi-device window must re-run it);
+    * 'stream_intraday' entries must be r9 records that actually
+      streamed warm and faithfully: ``r9_stream_intraday_v1`` with
+      ``stream.updates > 0``, zero compiles during load and an empty
+      parity-mismatch list (ISSUE 7).
     """
     def keep(name, v):
         recs = [r for r in v.get("results") or [] if isinstance(r, dict)]
@@ -173,6 +177,13 @@ def drop_conv_only_rolling(steps):
             # answered warm — the record measured cold dispatch, not
             # serving; it re-runs
             return any(_serve_record_banks(r) for r in recs)
+        if name == "stream_intraday":
+            # ISSUE 7: zero streamed updates means the ingest loop
+            # never dispatched (measured nothing), a load-phase compile
+            # means the executables were not warm, and a non-empty
+            # parity list means the streamed fold diverged on hardware
+            # — none of those may bank
+            return any(_stream_record_banks(r) for r in recs)
         return True
 
     return {k: v for k, v in steps.items() if keep(k, v)}
@@ -322,6 +333,44 @@ def _serve_record_banks(rec) -> bool:
             and serve["cache_hits"] > 0)
 
 
+def step_stream_intraday():
+    """The r9 online intraday engine (ISSUE 7) on the chip: ``bench.py
+    stream`` ingest-loads the streaming carry at the declared cohort
+    shapes (1/8/64 tickers per update) and banks bars/sec + per-update
+    p50/p99 under ``r9_stream_intraday_v1``, with the on-hardware
+    streamed-vs-full-day parity verdict riding the record. The carry
+    rule (:func:`_stream_record_banks`) rejects records with zero
+    streamed updates, any load-phase compile, or a parity mismatch."""
+    r = _run_json_lines(
+        [sys.executable, "bench.py", "stream"], timeout=1800,
+        env=dict(os.environ, BENCH_REQUIRE_TPU="1"))
+    if r.get("ok"):
+        recs = [rec for rec in r.get("results") or []
+                if isinstance(rec, dict)]
+        if any("_cpu_fallback" in str(rec.get("metric", ""))
+               for rec in recs):
+            r["ok"] = False
+            r["error"] = "stream bench printed a CPU-fallback metric"
+        elif not any(_stream_record_banks(rec) for rec in recs):
+            r["ok"] = False
+            r["error"] = ("no r9_stream_intraday_v1 record with "
+                          "updates > 0, zero load compiles and clean "
+                          "parity — cannot bank")
+    return r
+
+
+def _stream_record_banks(rec) -> bool:
+    """A stream record banks only when the engine actually streamed
+    warm and faithfully: declared methodology, streamed updates > 0,
+    no compiles during load, empty parity-mismatch list."""
+    stream = rec.get("stream") or {}
+    return (rec.get("methodology") == "r9_stream_intraday_v1"
+            and isinstance(stream.get("updates"), int)
+            and stream["updates"] > 0
+            and stream.get("compiles_during_load") == 0
+            and stream.get("parity_mismatched") == [])
+
+
 def step_ladder():
     return _run_json_lines(
         [sys.executable, "benchmarks/ladder.py", "--configs", "1,2,4,5"],
@@ -427,8 +476,11 @@ def main():
     # hardware p50/p99/QPS is this round's must-bank evidence (ISSUE 6),
     # but the headline/link/stream trio still buys the most
     # comparability per second of window
+    # stream_intraday rides directly behind serve: the r9 online
+    # intraday engine's hardware bars/sec + on-chip streamed parity is
+    # this round's must-bank evidence (ISSUE 7)
     ap.add_argument("--steps", default="headline,resident_sharded,"
-                    "pallas,link,stream,serve,"
+                    "pallas,link,stream,serve,stream_intraday,"
                     "lad1,lad2,lad4,lad5,spot,sweep,pipeline")
     ap.add_argument("--one-step", default=None,
                     help="internal: run one step's body in-process and "
@@ -498,6 +550,7 @@ def main():
              "stream": step_stream, "pallas": step_pallas,
              "resident_sharded": step_resident_sharded,
              "serve": step_serve,
+             "stream_intraday": step_stream_intraday,
              "lad1": _step_ladder_one("1"), "lad2": _step_ladder_one("2"),
              "lad4": _step_ladder_one("4"), "lad5": _step_ladder_one("5")}
     want = [s.strip() for s in args.steps.split(",") if s.strip()]

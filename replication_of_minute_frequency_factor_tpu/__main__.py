@@ -131,6 +131,14 @@ def _add_serve(sub: "argparse._SubParsersAction") -> None:
                    help="device-byte budget of the exposure cache")
     p.add_argument("--batch-window-ms", type=float, default=2.0,
                    help="micro-batch collection window")
+    p.add_argument("--stream", action="store_true",
+                   help="also host the online intraday engine (ISSUE "
+                        "7): POST /v1/ingest advances the streaming "
+                        "carry, query kind 'intraday' serves "
+                        "partial-day exposures (docs/streaming.md)")
+    p.add_argument("--stream-batches", default="1",
+                   help="comma-separated ingest micro-batch minute "
+                        "counts warmed at startup (default: 1)")
     p.add_argument("--demo", type=int, default=None, metavar="N",
                    help="answer N in-process queries (factors/IC/decile "
                         "cycle), print a JSON summary, exit — no HTTP")
@@ -173,8 +181,12 @@ def cmd_serve(args: argparse.Namespace) -> int:
                       manifest_extra={"run_kind": "serve"})
             print(tel.summary(), file=sys.stderr)
 
+    stream_batches = tuple(int(s) for s in
+                           str(args.stream_batches).split(",")
+                           if s.strip())
     with FactorServer(source, names=names, serve_cfg=scfg,
-                      telemetry=tel) as server:
+                      telemetry=tel, stream=args.stream,
+                      stream_batches=stream_batches or (1,)) as server:
         if args.demo is not None:
             client = server.client()
             w = max(2, min(8, source.n_days))

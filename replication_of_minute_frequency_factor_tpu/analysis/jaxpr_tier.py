@@ -156,16 +156,19 @@ def run_jaxpr_tier(names: Optional[Sequence[str]] = None, days: int = 2,
 
 
 # --------------------------------------------------------------------------
-# resident scan wrappers (the pipeline's year-in-one-executable loops)
+# driving-scan wrappers (the pipeline's year-in-one-executable loops +
+# the streaming minute fold)
 # --------------------------------------------------------------------------
 
 #: wrapper symbols exempted from GL-B1's zero-scan rule BY SYMBOL, not
-#: by baseline entry: the driving ``scan`` over the year's batches IS
-#: the wrapper's loop shape (the O(1)-round-trip point of the resident
-#: mode). Exactly ONE scan is allowed — a second one means a serial
-#: loop leaked out of a kernel and through the wrapper, the exact
-#: regression GL-B1 guards against — and ``while`` stays banned.
-RESIDENT_WRAPPERS = ("__resident_scan__", "__resident_scan_sharded__")
+#: by baseline entry: the driving ``scan`` — over the year's batches
+#: (resident mode, the O(1)-round-trip point) or over a micro-batch's
+#: minutes (``stream/engine.scan_update``, ISSUE 7) — IS the wrapper's
+#: loop shape. Exactly ONE scan is allowed — a second one means a
+#: serial loop leaked out of a kernel and through the wrapper, the
+#: exact regression GL-B1 guards against — and ``while`` stays banned.
+RESIDENT_WRAPPERS = ("__resident_scan__", "__resident_scan_sharded__",
+                     "__stream_update__")
 
 #: factor subset the wrapper traces drive: re-tracing all 58 kernels a
 #: third time per analyze run buys no new contract coverage (the kernel
@@ -185,13 +188,21 @@ def resident_wrapper_jaxprs(n_batches: int = 2, days: int = 2,
     runs, so one shard IS the canonical trace). The raw packed kind
     keeps the trace free of wire-format coupling; the spec comes from
     a real (zero-filled) ``pack_arrays`` call so it can never drift
-    from the packer."""
+    from the packer.
+
+    ``__stream_update__`` (ISSUE 7) is the streaming engine's
+    minutes-fold ``scan_update`` traced over the canonical carry at
+    ``n_batches`` minutes: its driving scan advances the carry one bar
+    column per step, and the SAME one-scan/zero-f64/zero-callback
+    contract applies."""
     import jax
     import numpy as np
 
     from .. import pipeline
     from ..data import wire
     from ..parallel.mesh import make_mesh
+    from ..stream import carry as stream_carry
+    from ..stream.engine import scan_update
 
     bars = np.zeros((days, tickers, SLOTS, N_FIELDS), np.float32)
     mask = np.zeros((days, tickers, SLOTS), np.uint8)
@@ -208,6 +219,15 @@ def resident_wrapper_jaxprs(n_batches: int = 2, days: int = 2,
     out["__resident_scan_sharded__"] = jax.make_jaxpr(
         lambda s: pipeline._compute_packed_scan_sharded(
             s, spec, "raw", names, True, rolling_impl, mesh))(stacked)
+    carry_sds = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x),
+                                       np.asarray(x).dtype),
+        stream_carry.init_carry(tickers))
+    out["__stream_update__"] = jax.make_jaxpr(scan_update)(
+        carry_sds,
+        jax.ShapeDtypeStruct((n_batches, tickers, N_FIELDS),
+                             np.float32),
+        jax.ShapeDtypeStruct((n_batches, tickers), np.bool_))
     return out
 
 

@@ -13,7 +13,7 @@ from __future__ import annotations
 from ..ops import segment_stats_by_value, pdf_quantile_rank
 from ..ops.ranking import topk_sum
 from .context import DayContext
-from .registry import register
+from .registry import register, stream_requirement
 
 
 def _seg_moments(ctx: DayContext):
@@ -93,3 +93,14 @@ def doc_vol50_ratio(ctx: DayContext):
     to doc_vol5_ratio. ``replicate_quirks=False`` uses 50."""
     return topk_sum(ctx.vol_share, ctx.mask,
                     5 if ctx.replicate_quirks else 50)
+
+
+# --- streaming readiness (ISSUE 7): the whole family is anchored on the
+# END-OF-DAY close, so every bar retroactively reprices history — these
+# kernels are the mathematically non-foldable class whose partial values
+# come from the carried bar buffer, never from O(1) accumulators
+# (docs/streaming.md); the group itself exists from the first bar --------
+for _n in ("doc_kurt", "doc_skew", "doc_std", "doc_pdf60", "doc_pdf70",
+           "doc_pdf80", "doc_pdf90", "doc_pdf95", "doc_vol10_ratio",
+           "doc_vol5_ratio", "doc_vol50_ratio"):
+    stream_requirement(_n, "bars")

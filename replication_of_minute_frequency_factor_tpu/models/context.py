@@ -34,7 +34,8 @@ class DayContext:
     """
 
     def __init__(self, bars, mask, replicate_quirks: bool = True,
-                 rolling_impl: str = None, xs_axis_name: str = None):
+                 rolling_impl: str = None, xs_axis_name: str = None,
+                 inject: dict = None):
         self.bars = bars
         self.mask = mask
         self.replicate_quirks = replicate_quirks
@@ -45,7 +46,15 @@ class DayContext:
         #: cross-sectional intermediates consult it — every per-(ticker,
         #: day) kernel is oblivious and stays collective-free.
         self.xs_axis_name = xs_axis_name
-        self._memo = {}
+        #: ``inject`` seeds the memo with intermediates computed
+        #: elsewhere — the streaming finalize's carry-native values
+        #: (stream/carry.py). The contract is strict: an injected value
+        #: must be BITWISE-equal to what the batch formulation would
+        #: compute from (bars, mask), which restricts injection to the
+        #: reorder-exact class (integer counts, pure selections — see
+        #: ops/incremental.py); the 240-increment parity gate enforces
+        #: it end to end.
+        self._memo = dict(inject) if inject else {}
         #: HHMMSSmmm per slot, broadcastable against [..., T, 240]
         self.times = jnp.asarray(sessions.GRID_TIMES)
 
@@ -123,13 +132,21 @@ class DayContext:
             "vol_share", lambda: self.volume / self.vol_sum[..., None])
 
     @property
+    def last_close(self):
+        """Last present bar's close, ``[..., T]`` — the end-of-day
+        anchor of the chip family. Memoised under its own key so the
+        streaming finalize can inject the carry-tracked value (a pure
+        selection, bitwise-equal by construction — see
+        ops/incremental.py)."""
+        return self._get("last_close",
+                         lambda: masked_last(self.close, self.mask))
+
+    @property
     def eod_ret(self):
         """last present close / close per bar — the chip factors' 'return'
         (reference MinuteFrequentFactorCalculateMethodsCICC.py:946-947)."""
-        def f():
-            last = masked_last(self.close, self.mask)
-            return last[..., None] / self.close
-        return self._get("eod_ret", f)
+        return self._get("eod_ret",
+                         lambda: self.last_close[..., None] / self.close)
 
     @property
     def eod_ret_global_rank(self):

@@ -11,7 +11,7 @@ import jax.numpy as jnp
 from .. import sessions as S
 from ..ops import masked_first, masked_sum
 from .context import DayContext
-from .registry import register
+from .registry import register, stream_requirement
 
 _NAN = jnp.nan
 
@@ -66,3 +66,13 @@ def liq_lastCallR(ctx: DayContext):
 def liq_openvol(ctx: DayContext):
     """First bar's volume. Ref :823-831."""
     return masked_first(ctx.volume, ctx.mask)
+
+
+# --- streaming readiness (ISSUE 7): the two auction-window kernels wait
+# for their window; everything else exists with the first bar ------------
+stream_requirement("liq_amihud_1min", "bars")
+stream_requirement("liq_closeprevol", "pre_auction")
+stream_requirement("liq_closevol", "auction")
+stream_requirement("liq_firstCallR", "bars")
+stream_requirement("liq_lastCallR", "bars")
+stream_requirement("liq_openvol", "bars")

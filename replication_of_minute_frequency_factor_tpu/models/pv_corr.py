@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from ..ops import masked_corr, pct_change_valid, shift_valid
 from .context import DayContext
-from .registry import register
+from .registry import register, stream_requirement
 
 
 @register("corr_prv")
@@ -60,3 +60,11 @@ def corr_pvr(ctx: DayContext):
     base = ctx.mask & (ctx.volume != 0)
     pv, ok = pct_change_valid(ctx.volume, base)
     return masked_corr(ctx.close, pv, ok)
+
+
+# --- streaming readiness (ISSUE 7): Pearson needs >1 pairwise-valid
+# lane; the shift/pct variants lose their first present bar, so they
+# need a third -----------------------------------------------------------
+stream_requirement("corr_pv", "bars", 2)
+for _n in ("corr_prv", "corr_prvr", "corr_pvd", "corr_pvl", "corr_pvr"):
+    stream_requirement(_n, "bars", 3)

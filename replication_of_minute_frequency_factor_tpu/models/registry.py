@@ -16,12 +16,46 @@ FACTORS: Dict[str, Callable] = {}
 #: :func:`factor_names` (keeps the canonical set closed for parity suites)
 ALIASES: Dict[str, Callable] = {}
 
+#: kernel name -> (window counter, minimum count): the streaming
+#: readiness contract (ISSUE 7). The claim is one-directional and
+#: SOUND: while ``inc[counter] < minimum`` the kernel's defining group
+#: is empty, so its partial-day exposure is NaN; a ready kernel may
+#: still be NaN through degenerate data (a constant window, zero
+#: variance). Counters are the integer accumulators of
+#: ``ops/incremental.py`` — monotone over the day, so readiness is
+#: monotone too (gated by tests/test_stream.py). Every family module
+#: declares its kernels' requirements next to the kernels themselves.
+STREAM_REQUIREMENTS: Dict[str, Tuple[str, int]] = {}
+
 
 def register(name: str):
     def deco(fn):
         FACTORS[name] = fn
         return fn
     return deco
+
+
+def stream_requirement(name: str, counter: str, minimum: int = 1) -> None:
+    """Declare the readiness requirement of a registered kernel (see
+    :data:`STREAM_REQUIREMENTS`). ``counter`` must name a window
+    counter of ``ops.incremental.WINDOW_COUNTERS``."""
+    from ..ops.incremental import WINDOW_COUNTERS
+    if counter not in WINDOW_COUNTERS:
+        raise ValueError(f"unknown window counter {counter!r} for "
+                         f"kernel {name!r}")
+    STREAM_REQUIREMENTS[name] = (counter, int(minimum))
+
+
+def stream_requirements() -> Dict[str, Tuple[str, int]]:
+    """The full readiness map; loading asserts every canonical kernel
+    declared one (a new kernel without a streaming contract is a bug,
+    not a silent gap in the intraday surface)."""
+    _load_all()
+    missing = [n for n in FACTORS if n not in STREAM_REQUIREMENTS]
+    if missing:
+        raise RuntimeError(
+            f"kernels with no stream readiness requirement: {missing}")
+    return dict(STREAM_REQUIREMENTS)
 
 
 def register_alias(name: str, kernel) -> None:
@@ -68,7 +102,8 @@ FACTOR_NAMES = _Lazy()
 def compute_factors(bars, mask, names: Optional[Sequence[str]] = None,
                     replicate_quirks: bool = True,
                     rolling_impl: Optional[str] = None,
-                    xs_axis_name: Optional[str] = None):
+                    xs_axis_name: Optional[str] = None,
+                    inject: Optional[dict] = None):
     """Compute the named factors (default: all 58) over a day tensor.
 
     Pure function of ``(bars [..., T, 240, 5], mask [..., T, 240])``;
@@ -81,12 +116,16 @@ def compute_factors(bars, mask, names: Optional[Sequence[str]] = None,
     sharded over when tracing inside a ``shard_map`` body (the sharded
     resident scan): per-(ticker, day) kernels are unaffected, only the
     cross-sectional ``doc_pdf*`` rank gathers (DayContext).
+    ``inject`` seeds the DayContext memo with carry-native
+    intermediates (the streaming finalize; see DayContext's bitwise
+    injection contract).
     """
     _load_all()
     if names is None:
         names = tuple(FACTORS)
     ctx = DayContext(bars, mask, replicate_quirks=replicate_quirks,
-                     rolling_impl=rolling_impl, xs_axis_name=xs_axis_name)
+                     rolling_impl=rolling_impl, xs_axis_name=xs_axis_name,
+                     inject=inject)
     return {n: resolve(n)(ctx) for n in names}
 
 

@@ -8,7 +8,7 @@ from __future__ import annotations
 
 from ..ops import masked_kurtosis, masked_skew
 from .context import DayContext
-from .registry import register
+from .registry import register, stream_requirement
 
 
 @register("shape_skew")
@@ -46,3 +46,10 @@ def shape_skratioVol(ctx: DayContext):
     """skew/kurtosis of volume share. Ref :716-729."""
     return masked_skew(ctx.vol_share, ctx.mask) / masked_kurtosis(
         ctx.vol_share, ctx.mask)
+
+
+# --- streaming readiness (ISSUE 7): moments exist with the group (one
+# bar already yields the 0/0 NaN the reference computes, not a gap) ----
+for _n in ("shape_skew", "shape_kurt", "shape_skratio", "shape_skewVol",
+           "shape_kurtVol", "shape_skratioVol"):
+    stream_requirement(_n, "bars")

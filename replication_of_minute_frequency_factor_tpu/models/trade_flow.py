@@ -14,7 +14,7 @@ import jax.numpy as jnp
 from .. import sessions as S
 from ..ops import masked_mean, masked_sum
 from .context import DayContext
-from .registry import register
+from .registry import register, stream_requirement
 
 _NAN = jnp.nan
 
@@ -104,3 +104,15 @@ def trade_topNeg20retRatio(ctx: DayContext):
 def trade_topPos20retRatio(ctx: DayContext):
     """Positive-return variant over bars <= 09:50. Ref :1381-1406."""
     return _ret_over_share(ctx, S.T_TOP20_END, 1)
+
+
+# --- streaming readiness (ISSUE 7): each window kernel waits for its
+# own window's first bar; the day-share ratios exist with the day -------
+stream_requirement("trade_bottom20retRatio", "tail20")
+stream_requirement("trade_bottom50retRatio", "tail50")
+stream_requirement("trade_headRatio", "bars")
+stream_requirement("trade_tailRatio", "bars")
+stream_requirement("trade_top20retRatio", "top20")
+stream_requirement("trade_top50retRatio", "top50")
+stream_requirement("trade_topNeg20retRatio", "top20")
+stream_requirement("trade_topPos20retRatio", "top20")

@@ -11,7 +11,7 @@ import jax.numpy as jnp
 
 from ..ops import masked_std
 from .context import DayContext
-from .registry import register
+from .registry import register, stream_requirement
 
 _NAN = jnp.nan
 
@@ -70,3 +70,13 @@ def vol_downVol(ctx: DayContext):
 def vol_downRatio(ctx: DayContext):
     """Downside volatility / total volatility. Ref :617-642."""
     return _signed_vol(ctx, False) / masked_std(ctx.ret_co, ctx.mask)
+
+
+# --- streaming readiness (ISSUE 7) ----------------------------------------
+# ddof=1 reductions are NaN below 2 bars; the signed variants clamp the
+# degenerate case to 0 and only need the group to exist.
+for _n in ("vol_volume1min", "vol_range1min", "vol_return1min",
+           "vol_upRatio", "vol_downRatio"):
+    stream_requirement(_n, "bars", 2)
+for _n in ("vol_upVol", "vol_downVol"):
+    stream_requirement(_n, "bars")

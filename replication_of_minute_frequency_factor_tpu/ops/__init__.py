@@ -34,3 +34,10 @@ from .ranking import (  # noqa: F401
 )
 from .rolling import rolling_window_stats  # noqa: F401
 from .segments import segment_stats_by_value, pdf_quantile_rank  # noqa: F401
+from .incremental import (  # noqa: F401
+    WINDOW_COUNTERS,
+    init_inc,
+    update_inc,
+    update_inc_at,
+    window_contains,
+)

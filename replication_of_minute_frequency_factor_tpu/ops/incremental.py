@@ -1,0 +1,147 @@
+"""Incremental (per-minute fold) forms of the masked reductions.
+
+The streaming carry (``stream/carry.py``) advances per arriving bar; the
+accumulators here are the fold-step twins of the batch reductions in
+:mod:`.masked`. They split into two exactness classes, and the split is
+the load-bearing design decision of the whole streaming subsystem:
+
+* **Exact under reordering** — integer window counters (associative
+  integer adds of 0/1) and pure selections (``first_open``/
+  ``last_close`` pick a stored f32 value, no arithmetic). Folding these
+  minute-by-minute is *bitwise identical* to the batch reduction over
+  the completed mask, so the streaming finalize may inject them into
+  :class:`..models.context.DayContext`'s memo and skip the batch
+  recompute without perturbing parity.
+* **Order-sensitive** — f32 accumulators (``vol_sum`` here). A
+  sequential left fold does not reproduce XLA's tree reduce bitwise,
+  so these NEVER feed the finalize graph: they exist for telemetry and
+  readiness only, and every f32 reduction a kernel consumes is
+  recomputed from the carried bar buffer by the batch formulation.
+  That asymmetry is what lets the 240-increment parity gate
+  (tests/test_stream.py) demand bitwise equality.
+
+Window membership mirrors :meth:`..models.context.DayContext.time_mask`
+over the HHMMSSmmm grid of :mod:`..sessions` — the counters are the
+incremental form of the per-window bar counts every NaN-gating
+``jnp.any(sel)`` / ``count(sel)`` in the kernel library reduces to.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+
+from .. import sessions as S
+from ..data.minute import F_CLOSE, F_OPEN, F_VOLUME
+
+_NAN = jnp.nan
+
+#: counter name -> window spec. ``("range", lo, hi, lo_strict,
+#: hi_strict)`` bounds the slot time like ``DayContext.time_mask``
+#: (None = unbounded); ``("exact", times)`` matches the sentinel-bar
+#: kernels' 2-slot candidate sets. The per-kernel readiness
+#: requirements (``models.registry.STREAM_REQUIREMENTS``) name these
+#: counters.
+WINDOW_COUNTERS: Dict[str, Tuple] = {
+    "bars": ("range", None, None, False, False),
+    "am": ("range", None, S.T_NOON, False, False),
+    "pm": ("range", S.T_NOON, None, True, False),
+    "pre_auction": ("range", None, S.T_CLOSE_AUCTION, False, True),
+    "auction": ("range", S.T_CLOSE_AUCTION, None, False, False),
+    "head": ("range", None, S.T_HEAD_END, False, False),
+    "top20": ("range", None, S.T_TOP20_END, False, False),
+    "top50": ("range", None, S.T_TOP50_END, False, False),
+    "tail20": ("range", S.T_TAIL20, None, False, False),
+    "tail30": ("range", S.T_LAST30_OPEN, None, False, False),
+    "tail50": ("range", S.T_TAIL50, None, False, False),
+    "sent_pm": ("exact", (S.T_PM_OPEN, S.T_PM_CLOSE)),
+    "sent_last30": ("exact", (S.T_LAST30_OPEN, S.T_PM_CLOSE)),
+    "sent_am": ("exact", (S.T_AM_OPEN, S.T_AM_CLOSE)),
+    "sent_between": ("exact", (S.T_BETWEEN_OPEN, S.T_BETWEEN_CLOSE)),
+}
+
+
+def window_contains(spec: Tuple, time):
+    """Traced bool: does the (scalar) HHMMSSmmm ``time`` fall inside
+    the static window ``spec``? The spec is static, so the comparison
+    chain is built at trace time — no masks materialize."""
+    kind = spec[0]
+    if kind == "exact":
+        hit = False
+        for t in spec[1]:
+            hit = hit | (time == t)
+        return hit
+    _, lo, hi, lo_strict, hi_strict = spec
+    ok = True
+    if lo is not None:
+        ok = ok & ((time > lo) if lo_strict else (time >= lo))
+    if hi is not None:
+        ok = ok & ((time < hi) if hi_strict else (time <= hi))
+    return ok
+
+
+def init_inc(n_tickers: int) -> Dict[str, object]:
+    """Zero-state accumulators for ``n_tickers`` lanes (host numpy —
+    the engine device_puts the whole carry explicitly once)."""
+    import numpy as np
+
+    out: Dict[str, object] = {
+        name: np.zeros((n_tickers,), np.int32) for name in WINDOW_COUNTERS}
+    out["vol_sum"] = np.zeros((n_tickers,), np.float32)
+    out["first_open"] = np.full((n_tickers,), np.nan, np.float32)
+    out["last_close"] = np.full((n_tickers,), np.nan, np.float32)
+    return out
+
+
+def update_inc(inc, t, values, present):
+    """One-minute fold step: bump every window counter for the present
+    lanes and advance the selection trackers.
+
+    ``t`` is the (traced) slot index of this minute, ``values [T, 5]``
+    the bar fields, ``present [T]`` which tickers traded this minute.
+    Integer counters and first/last selections stay bitwise-equal to
+    their batch forms (module docstring); ``vol_sum`` is the
+    order-sensitive diagnostic accumulator.
+    """
+    time = jnp.asarray(S.GRID_TIMES)[t]
+    out = dict(inc)
+    one = jnp.int32(1)
+    for name, spec in WINDOW_COUNTERS.items():
+        out[name] = inc[name] + jnp.where(
+            present & window_contains(spec, time), one, jnp.int32(0))
+    out["vol_sum"] = inc["vol_sum"] + jnp.where(
+        present, values[..., F_VOLUME], 0.0)
+    out["last_close"] = jnp.where(present, values[..., F_CLOSE],
+                                  inc["last_close"])
+    never_seen = inc["bars"] == 0
+    out["first_open"] = jnp.where(never_seen & present,
+                                  values[..., F_OPEN], inc["first_open"])
+    return out
+
+
+def update_inc_at(inc, t, rows, idx):
+    """Cohort (scatter) twin of :func:`update_inc`: ``rows [K, 5]`` are
+    bars for tickers ``idx [K]`` at slot ``t``. Padding rows carry an
+    out-of-bounds index (``idx == n_tickers``) and are dropped by the
+    scatter. Each ticker appears at most once per call (live feeds
+    deliver one bar per ticker per minute); duplicates are undefined.
+    """
+    time = jnp.asarray(S.GRID_TIMES)[t]
+    out = dict(inc)
+    for name, spec in WINDOW_COUNTERS.items():
+        bump = jnp.where(window_contains(spec, time), jnp.int32(1),
+                         jnp.int32(0))
+        bump = jnp.broadcast_to(bump, idx.shape)
+        out[name] = inc[name].at[idx].add(bump, mode="drop")
+    out["vol_sum"] = inc["vol_sum"].at[idx].add(rows[..., F_VOLUME],
+                                                mode="drop")
+    out["last_close"] = inc["last_close"].at[idx].set(rows[..., F_CLOSE],
+                                                      mode="drop")
+    # gather-then-scatter: padding lanes gather clamped garbage, but
+    # the drop-mode scatter never writes it back
+    seen = inc["bars"].at[idx].get(mode="clip") > 0
+    first = jnp.where(seen, inc["first_open"].at[idx].get(mode="clip"),
+                      rows[..., F_OPEN])
+    out["first_open"] = inc["first_open"].at[idx].set(first, mode="drop")
+    return out

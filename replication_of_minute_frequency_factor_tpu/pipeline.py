@@ -377,6 +377,41 @@ def lower_packed_resident_sharded(stacked, spec, kind, mesh, names,
           else _compute_packed_scan_sharded_jit)
     return fn.lower(stacked, spec, kind, names, replicate_quirks,
                     rolling_impl, mesh)
+
+
+def compute_exposures_streamed(bars, mask, names=None, micro_batch=16,
+                               replicate_quirks=True, rolling_impl=None,
+                               engine=None):
+    """One day of minute bars folded through the streaming engine
+    (ISSUE 7): ``bars [T, 240, 5]`` / ``mask [T, 240]`` host arrays in,
+    ``{name: np [T]}`` out — the batch pipeline's answer by way of 240
+    incremental carries instead of one full-day dispatch (bitwise; the
+    r9 parity gate). ``micro_batch`` minutes advance per scan dispatch;
+    an injected ``engine`` reuses its warm executables (and must match
+    the universe size)."""
+    import numpy as np
+
+    from .stream.engine import StreamEngine
+
+    t_total = mask.shape[-1]
+    if engine is None:
+        engine = StreamEngine(mask.shape[0], names=names,
+                              replicate_quirks=replicate_quirks,
+                              rolling_impl=rolling_impl)
+    else:
+        engine.reset()
+    s = 0
+    while s < t_total:
+        e = min(s + micro_batch, t_total)
+        engine.ingest_minutes(
+            np.ascontiguousarray(np.swapaxes(bars[:, s:e], 0, 1)),
+            np.ascontiguousarray(mask[:, s:e].T))
+        s = e
+    exposures, _ready = engine.snapshot()
+    host = jax.device_get(exposures)  # the one explicit fetch
+    return {n: host[j] for j, n in enumerate(engine.names)}
+
+
 from .telemetry import Telemetry, TraceCapture, get_telemetry
 from .telemetry import attribution as _attribution
 from .utils.logging import get_logger, FailureReport

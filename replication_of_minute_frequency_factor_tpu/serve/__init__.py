@@ -27,6 +27,12 @@ designed for exactly this request loop:
   queue-depth/in-flight gauges and a load-shedding circuit breaker;
 * :mod:`.http` — a stdlib-only HTTP/JSON binding (``serve_http``).
 
+Streaming (ISSUE 7): ``FactorServer(stream=True)`` additionally owns a
+:class:`..stream.engine.StreamEngine` — minute bars ingest through the
+same request queue (:class:`Ingest`, ``POST /v1/ingest``) and
+``Query(kind="intraday")`` serves the carry's partial-day exposures;
+see docs/streaming.md.
+
 Run it: ``python -m replication_of_minute_frequency_factor_tpu serve``
 (see docs/serving.md); load-bench it: ``python bench.py serve``.
 """
@@ -36,12 +42,12 @@ from __future__ import annotations
 from .executables import ExecutableCache
 from .expcache import DeviceExposureCache
 from .source import MinuteDirSource, SyntheticSource
-from .service import (FactorServer, LoadShedError, Query, ServeConfig,
-                      ServeClient)
+from .service import (FactorServer, Ingest, LoadShedError, Query,
+                      ServeConfig, ServeClient)
 from .http import serve_http
 
 __all__ = [
-    "DeviceExposureCache", "ExecutableCache", "FactorServer",
+    "DeviceExposureCache", "ExecutableCache", "FactorServer", "Ingest",
     "LoadShedError", "MinuteDirSource", "Query", "ServeClient",
     "ServeConfig", "SyntheticSource", "serve_http",
 ]

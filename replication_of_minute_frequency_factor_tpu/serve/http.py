@@ -9,12 +9,19 @@ land in one collection window and coalesce.
 
 Endpoints:
 
-* ``POST /v1/query`` — body ``{"kind": "factors"|"ic"|"decile",
-  "start": int, "end": int, "names"?: [..], "factor"?: str,
-  "horizon"?: int, "group_num"?: int}`` -> the answer dict.
+* ``POST /v1/query`` — body ``{"kind": "factors"|"ic"|"decile"|
+  "intraday", "start": int, "end": int, "names"?: [..], "factor"?:
+  str, "horizon"?: int, "group_num"?: int}`` -> the answer dict
+  (``intraday`` ignores the range and reads the live streaming carry;
+  needs a ``stream=True`` server).
   400 on a malformed query, 503 when the server sheds (breaker open /
   queue full) — the HTTP face of backpressure, 500 on a failed dispatch.
-* ``GET /healthz`` — liveness + breaker state.
+* ``POST /v1/ingest`` — body ``{"bars": [[[o,h,l,c,v]×T]×B],
+  "present": [[bool×T]×B]}`` advances the streaming carry by ``B``
+  minutes; -> ``{"minute", "bars"}``. Same error mapping as query
+  (the JSON body bound is wider: a full universe-minute is big).
+* ``GET /healthz`` — liveness + breaker state (+ the stream carry's
+  minute cursor when streaming is on).
 * ``GET /v1/metrics`` — the telemetry registry snapshot (JSON).
 """
 
@@ -29,6 +36,11 @@ from .service import FactorServer, LoadShedError, Query
 
 #: request-body bound (a factors query is a few hundred bytes)
 MAX_BODY_BYTES = 1 << 20
+
+#: ingest-body bound: B minutes × T tickers × 5 fields as JSON text
+#: (~16 bytes/number puts a 64-minute × 5000-ticker micro-batch well
+#: inside 64 MiB)
+MAX_INGEST_BODY_BYTES = 64 << 20
 
 
 def _make_handler(server: FactorServer, timeout: Optional[float]):
@@ -51,11 +63,15 @@ def _make_handler(server: FactorServer, timeout: Optional[float]):
                 with server._state_lock:
                     open_until = server._open_until
                     consecutive = server._consecutive
-                self._reply(200, {
+                payload = {
                     "ok": True, "factors": len(server.names),
                     "days": server.source.n_days,
                     "breaker_open": open_until is not None,
-                    "breaker_consecutive_failures": consecutive})
+                    "breaker_consecutive_failures": consecutive}
+                if server.stream_engine is not None:
+                    payload["stream_minute"] = \
+                        server.stream_engine.minutes
+                self._reply(200, payload)
                 return
             if self.path == "/v1/metrics":
                 self._reply(200, server.telemetry.registry.snapshot())
@@ -63,6 +79,9 @@ def _make_handler(server: FactorServer, timeout: Optional[float]):
             self._reply(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            if self.path == "/v1/ingest":
+                self._post_ingest()
+                return
             if self.path != "/v1/query":
                 self._reply(404, {"error": f"no route {self.path}"})
                 return
@@ -86,6 +105,34 @@ def _make_handler(server: FactorServer, timeout: Optional[float]):
                 return
             try:
                 fut = server.submit(q)
+            except LoadShedError as e:
+                self._reply(503, {"error": str(e), "shed": True})
+                return
+            except ValueError as e:
+                self._reply(400, {"error": str(e)})
+                return
+            try:
+                self._reply(200, fut.result(timeout))
+            except Exception as e:  # noqa: BLE001 — dispatch failure
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def _post_ingest(self):
+            # no numpy here: the JSON lists go to the server verbatim
+            # and service.py (the declared GL-A3 boundary module) owns
+            # the array conversion + shape validation
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                if length > MAX_INGEST_BODY_BYTES:
+                    self._reply(413, {"error": "body too large"})
+                    return
+                doc = json.loads(self.rfile.read(length) or b"{}")
+                bars, present = doc["bars"], doc["present"]
+            except (KeyError, ValueError, TypeError,
+                    json.JSONDecodeError) as e:
+                self._reply(400, {"error": f"malformed ingest: {e}"})
+                return
+            try:
+                fut = server.ingest(bars, present)
             except LoadShedError as e:
                 self._reply(503, {"error": str(e), "shed": True})
                 return

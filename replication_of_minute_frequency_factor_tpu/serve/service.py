@@ -8,6 +8,16 @@ ONE device block — concurrent queries over the same range therefore
 coalesce into a single fused dispatch (or a single exposure-cache hit),
 which is the scaling property the whole serving layer exists for.
 
+Streaming (ISSUE 7): a server constructed with ``stream=True`` also
+owns a :class:`..stream.engine.StreamEngine` over the source's ticker
+universe and accepts two more request shapes through the SAME queue —
+:meth:`FactorServer.ingest` (minute bars advancing the device-resident
+carry) and ``Query(kind="intraday")`` (the carry's partial-day
+exposures + readiness plane). Within one micro-batch every ingest
+applies in arrival order BEFORE any intraday query (latest-view
+semantics), and concurrent intraday queries coalesce onto ONE snapshot
+dispatch exactly like same-range block queries do.
+
 Failure containment mirrors the batch pipeline's breaker: consecutive
 failed dispatches open the circuit and subsequent submits are SHED
 (fail fast with :class:`LoadShedError`) until a cooldown lapses; the
@@ -38,7 +48,7 @@ from .expcache import DeviceExposureCache
 
 _SENTINEL = None  # queue poison pill (requests are _Pending objects)
 
-QUERY_KINDS = ("factors", "ic", "decile")
+QUERY_KINDS = ("factors", "ic", "decile", "intraday")
 
 
 class LoadShedError(RuntimeError):
@@ -51,14 +61,27 @@ class LoadShedError(RuntimeError):
 @dataclasses.dataclass(frozen=True)
 class Query:
     """One question over a day-range ``[start, end)`` (indices into the
-    source's day axis — the coalescing key is ``(start, end)``)."""
-    kind: str                                  # factors | ic | decile
-    start: int
-    end: int
+    source's day axis — the coalescing key is ``(start, end)``). The
+    ``intraday`` kind (ISSUE 7) instead reads the live streaming
+    carry's partial-day exposures; its range is ignored (use 0, 0)."""
+    kind: str                         # factors | ic | decile | intraday
+    start: int = 0
+    end: int = 0
     names: Optional[Tuple[str, ...]] = None    # factors: subset (None=all)
     factor: Optional[str] = None               # ic / decile
     horizon: int = 1                           # forward-return horizon
     group_num: int = 5                         # decile buckets
+
+
+@dataclasses.dataclass(frozen=True)
+class Ingest:
+    """Minute bars for the streaming carry (ISSUE 7): ``bars
+    [B, T, 5]`` f32 / ``present [B, T]`` bool host arrays advance the
+    resident day by ``B`` minutes. Within a micro-batch every ingest
+    applies IN ARRIVAL ORDER and BEFORE any intraday query —
+    latest-view semantics."""
+    bars: object
+    present: object
 
 
 @dataclasses.dataclass
@@ -97,7 +120,9 @@ class FactorServer:
                  serve_cfg: Optional[ServeConfig] = None,
                  replicate_quirks: bool = True,
                  rolling_impl: Optional[str] = None,
-                 telemetry=None, start: bool = True):
+                 telemetry=None, start: bool = True,
+                 stream: bool = False,
+                 stream_batches: Sequence[int] = (1,)):
         from ..models.registry import factor_names
         from ..telemetry import get_telemetry
         self.source = source
@@ -114,6 +139,20 @@ class FactorServer:
                                   executables=self.executables)
         self.cache = DeviceExposureCache(self.scfg.cache_bytes,
                                          telemetry=self.telemetry)
+        #: ISSUE 7: the live intraday engine over the source's ticker
+        #: universe, sharing THE executable cache (one compile-count
+        #: ground truth). Warmed at construction for the declared
+        #: ingest micro-batch shapes, so steady-state ingest/intraday
+        #: traffic compiles nothing.
+        self.stream_engine = None
+        if stream:
+            from ..stream.engine import StreamEngine
+            self.stream_engine = StreamEngine(
+                source.n_tickers, names=self.names,
+                replicate_quirks=replicate_quirks,
+                rolling_impl=rolling_impl, telemetry=self.telemetry,
+                executables=self.executables)
+            self.stream_engine.warmup(micro_batches=stream_batches)
         self._q: "queue.Queue" = queue.Queue(maxsize=self.scfg.queue_limit)
         self._state_lock = threading.Lock()
         self._consecutive = 0
@@ -154,6 +193,15 @@ class FactorServer:
         if q.kind not in QUERY_KINDS:
             raise ValueError(f"unknown query kind {q.kind!r} "
                              f"(one of {QUERY_KINDS})")
+        if q.kind == "intraday":
+            if self.stream_engine is None:
+                raise ValueError("intraday queries need a server "
+                                 "constructed with stream=True")
+            unknown = [n for n in (q.names or ()) if n not in self.names]
+            if unknown:
+                raise ValueError(f"unknown factor(s) {unknown}; server "
+                                 f"holds {len(self.names)}")
+            return
         n_days = self.source.n_days
         if not (0 <= q.start < q.end <= n_days):
             raise ValueError(f"day range [{q.start}, {q.end}) outside "
@@ -181,6 +229,35 @@ class FactorServer:
         if self._closed:
             raise RuntimeError("server is closed")
         self._validate(q)
+        return self._enqueue(q, q.kind)
+
+    def ingest(self, bars, present) -> Future:
+        """Enqueue minute bars for the streaming carry: ``bars
+        [B, T, 5]`` f32 / ``present [B, T]`` bool advance the resident
+        day by ``B`` minutes through the request queue (so ordering
+        against intraday queries is the worker's, not the caller's).
+        Returns a Future resolving to ``{"minute", "bars"}``; sheds and
+        validates exactly like :meth:`submit`."""
+        if self._closed:
+            raise RuntimeError("server is closed")
+        if self.stream_engine is None:
+            raise ValueError("ingest needs a server constructed with "
+                             "stream=True")
+        bars = np.ascontiguousarray(bars, np.float32)
+        present = np.ascontiguousarray(present, bool)
+        if bars.ndim != 3 or bars.shape[-1] != 5 \
+                or present.shape != bars.shape[:2]:
+            raise ValueError(
+                f"ingest wants bars [B, T, 5] with present [B, T]; got "
+                f"{bars.shape} / {present.shape}")
+        if present.shape[1] != self.stream_engine.n_tickers:
+            raise ValueError(
+                f"got {present.shape[1]} tickers; the stream engine "
+                f"holds {self.stream_engine.n_tickers}")
+        return self._enqueue(Ingest(bars, present), "ingest")
+
+    def _enqueue(self, item, kind: str) -> Future:
+        """Shed gate + enqueue shared by queries and ingests."""
         tel = self.telemetry
         now = time.monotonic()
         with self._state_lock:
@@ -194,14 +271,14 @@ class FactorServer:
                 # half-open: this request is the probe; keep the gate up
                 # for everyone else until it succeeds
                 self._open_until = now + self.scfg.breaker_cooldown_s
-        pending = _Pending(q, Future(), now)
+        pending = _Pending(item, Future(), now)
         try:
             self._q.put_nowait(pending)
         except queue.Full:
             tel.counter("serve.load_shed", reason="queue_full")
             raise LoadShedError(
                 f"request queue full ({self.scfg.queue_limit})") from None
-        tel.counter("serve.requests", kind=q.kind)
+        tel.counter("serve.requests", kind=kind)
         self._note_depth()
         return pending.future
 
@@ -251,16 +328,116 @@ class FactorServer:
                 batch.append(nxt)
             self._note_depth()
             self.telemetry.observe("serve.batch_size", len(batch))
+            # ingests first, in arrival order (latest-view semantics:
+            # every intraday answer in this micro-batch sees every bar
+            # that arrived before the batch was drained)
+            ingests = [p for p in batch if isinstance(p.query, Ingest)]
+            queries = [p for p in batch if not isinstance(p.query,
+                                                          Ingest)]
             groups: Dict[Tuple[int, int], list] = {}
-            for p in batch:
-                groups.setdefault((p.query.start, p.query.end),
-                                  []).append(p)
+            for p in queries:
+                key = ("intraday" if p.query.kind == "intraday"
+                       else (p.query.start, p.query.end))
+                groups.setdefault(key, []).append(p)
             self.telemetry.gauge("serve.inflight", len(batch))
+            for p in ingests:
+                self._apply_ingest(p)
             for key, group in groups.items():
-                self._dispatch_group(key, group)
+                if key == "intraday":
+                    self._dispatch_intraday(group)
+                else:
+                    self._dispatch_group(key, group)
             self.telemetry.gauge("serve.inflight", 0)
             if stop_after:
                 return
+
+    def _apply_ingest(self, p: _Pending) -> None:
+        """Advance the streaming carry by one Ingest (one scan
+        dispatch). A failed ingest fails only its own future but bumps
+        the breaker — a stuck feed must shed, not queue unboundedly."""
+        tel = self.telemetry
+        with tel.tracer("serve.ingest"):
+            try:
+                t0 = time.perf_counter()
+                self.stream_engine.ingest_minutes(p.query.bars,
+                                                  p.query.present)
+                tel.observe("serve.stage_seconds",
+                            time.perf_counter() - t0, stage="ingest")
+            except Exception as e:  # noqa: BLE001 — per-request + breaker
+                p.future.set_exception(e)
+                tel.counter("serve.failures", stage="ingest")
+                self._breaker_failure()
+                return
+            p.future.set_result({
+                "minute": self.stream_engine.minutes,
+                "bars": int(p.query.present.sum())})
+            tel.observe("serve.request_seconds",
+                        time.monotonic() - p.t_enqueue, kind="ingest")
+        self._breaker_ok()
+
+    def _dispatch_intraday(self, group: list) -> None:
+        """ONE warm snapshot dispatch (+ one host fetch) answers every
+        intraday request in ``group`` — the same coalescing contract as
+        the block path, over the live carry instead of a cached
+        block."""
+        tel = self.telemetry
+        t_dispatch = time.monotonic()
+        with tel.tracer("serve.dispatch"):
+            try:
+                t0 = time.perf_counter()
+                exposures, ready = self.stream_engine.snapshot()
+                exp = np.asarray(exposures)   # the boundary sync
+                rdy = np.asarray(ready)
+                tel.observe("serve.stage_seconds",
+                            time.perf_counter() - t0, stage="block")
+            except Exception as e:  # noqa: BLE001 — fail the group, shed
+                for p in group:
+                    p.future.set_exception(e)
+                tel.counter("serve.failures", stage="block")
+                self._breaker_failure()
+                return
+            if len(group) > 1:
+                tel.counter("serve.coalesced_dispatches")
+                tel.counter("serve.coalesced_requests", len(group))
+            minute = self.stream_engine.minutes
+            ok = True
+            for p in group:
+                t0 = time.perf_counter()
+                try:
+                    result = self._answer_intraday(exp, rdy, minute,
+                                                   p.query)
+                except Exception as e:  # noqa: BLE001 — per-request
+                    p.future.set_exception(e)
+                    tel.counter("serve.failures", stage="answer")
+                    ok = False
+                    continue
+                p.future.set_result(result)
+                now = time.monotonic()
+                tel.observe("serve.stage_seconds",
+                            time.perf_counter() - t0, stage="answer")
+                tel.observe("serve.stage_seconds",
+                            t_dispatch - p.t_enqueue, stage="queue_wait")
+                tel.observe("serve.request_seconds", now - p.t_enqueue,
+                            kind="intraday")
+        if ok:
+            self._breaker_ok()
+        else:
+            self._breaker_failure()
+
+    def _answer_intraday(self, exp: np.ndarray, rdy: np.ndarray,
+                         minute: int, q: Query) -> dict:
+        names = q.names or self.names
+        idx = [self.names.index(n) for n in names]
+        return {
+            "minute": minute,
+            "codes": list(self.source.codes),
+            "exposures": {n: exp[i].tolist()
+                          for n, i in zip(names, idx)},
+            # readiness is the SOUND gate (docs/streaming.md): False
+            # means the kernel's defining group is still empty at this
+            # minute; True with NaN means degenerate data, not absence
+            "ready": {n: rdy[i].tolist() for n, i in zip(names, idx)},
+        }
 
     def _dispatch_group(self, key: Tuple[int, int], group: list) -> None:
         """One device block answers every request in ``group`` — the
@@ -383,4 +560,15 @@ class ServeClient:
                horizon: int = 1, group_num: int = 5) -> dict:
         q = Query("decile", start, end, factor=factor, horizon=horizon,
                   group_num=group_num)
+        return self._server.submit(q).result(self._timeout)
+
+    def ingest(self, bars, present) -> dict:
+        """Advance the streaming carry by ``B`` minutes of bars;
+        returns ``{"minute", "bars"}`` once applied (ISSUE 7)."""
+        return self._server.ingest(bars, present).result(self._timeout)
+
+    def intraday(self, names: Optional[Sequence[str]] = None) -> dict:
+        """The live partial-day exposures + readiness plane (ISSUE
+        7)."""
+        q = Query("intraday", names=tuple(names) if names else None)
         return self._server.submit(q).result(self._timeout)

@@ -1,0 +1,28 @@
+"""Online intraday factor engine (ISSUE 7): stream minutes, not days.
+
+Everything else in this repo computes exposures from COMPLETE
+240-minute days; this package advances them per arriving bar. The
+incremental kernel contract lives in :mod:`.carry`
+(``init_carry / update / finalize``), the ``lax.scan``-over-minutes
+engine with warm AOT executables in :mod:`.engine`, and the serving
+integration (ingest endpoint + intraday-partial queries) in
+:mod:`..serve.service`.
+
+Device-hot package (graftlint GL-A3 scope): nothing here blocks or
+materializes; the serve request loop and bench.py own the host
+boundary.
+"""
+
+from .carry import (  # noqa: F401
+    carry_from_host,
+    carry_nbytes,
+    carry_to_host,
+    finalize,
+    finalize_with_readiness,
+    init_carry,
+    readiness,
+    update_minute,
+    update_tickers,
+    advance,
+)
+from .engine import StreamEngine  # noqa: F401

@@ -1,0 +1,183 @@
+"""The incremental kernel contract: ``init_carry / update / finalize``.
+
+A :data:`carry` is the complete streaming state of one trading day over
+a ``T``-ticker universe, held device-resident and advanced as a pure
+fold over minutes:
+
+``bars [T, 240, 5]``
+    the day buffer, filled one minute-column per update (absent lanes
+    stay 0 — kernels never read a masked lane's value, a property the
+    parity gate proves end to end);
+``mask [T, 240]``
+    which (ticker, slot) lanes hold a bar;
+``t`` (i32 scalar)
+    the minute cursor — the next slot an update writes;
+``inc {...}``
+    the incremental accumulators of :mod:`..ops.incremental`: integer
+    window counters + first/last selections (reorder-exact, injectable
+    into the finalize graph) and the f32 diagnostics (never injected).
+
+Why the buffer is part of the carry: 29 of the 58 kernels are anchored
+on end-of-day state (``eod_ret = last_close / close`` reprices EVERY
+past bar when a new bar arrives; ``vol_share`` re-normalizes history on
+every traded share; the ``doc_pdf*`` walk re-ranks the whole frame), so
+no O(1)-per-ticker sufficient statistic exists for them —
+``finalize`` must re-read the prefix. The carry therefore keeps the
+prefix authoritative in HBM, ``update`` costs one column write + the
+O(T) accumulator bumps, and ``finalize`` runs the SAME batch kernel
+formulations over the masked partial buffer with the reorder-exact
+accumulators injected. That construction is what makes the
+240-increment parity gate *bitwise*: at minute 240 the carry's
+``(bars, mask)`` bit-equal the full-day inputs and every reduction is
+the batch reduction (docs/streaming.md walks the argument).
+
+All functions here are pure jax (device-hot, GL-A3 scope); the engine
+owns compilation and residency.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..data.minute import FIELDS
+from ..sessions import N_SLOTS
+from ..models.registry import (
+    compute_factors,
+    factor_names,
+    stream_requirements,
+)
+from ..ops import incremental as inc_ops
+
+#: carry pytree keys, in serialization order
+CARRY_KEYS = ("bars", "mask", "t", "inc")
+
+
+def init_carry(n_tickers: int) -> Dict[str, object]:
+    """Empty-day carry as HOST numpy (the engine device_puts it whole —
+    one explicit transfer, transfer-guard clean)."""
+    import numpy as np
+
+    return {
+        "bars": np.zeros((n_tickers, N_SLOTS, len(FIELDS)), np.float32),
+        "mask": np.zeros((n_tickers, N_SLOTS), bool),
+        "t": np.int32(0),
+        "inc": inc_ops.init_inc(n_tickers),
+    }
+
+
+def update_minute(carry, values, present):
+    """One fold step: write minute ``t``'s bars and advance the cursor.
+
+    ``values [T, 5]`` are the bar fields for every ticker (garbage
+    where absent), ``present [T]`` marks which tickers traded. Absent
+    lanes write 0 into the buffer — deterministic, and invisible to the
+    kernels' masked reductions.
+    """
+    t = carry["t"]
+    vals = jnp.where(present[:, None], values, 0.0)
+    bars = jax.lax.dynamic_update_slice(
+        carry["bars"], vals[:, None, :], (0, t, 0))
+    mask = jax.lax.dynamic_update_slice(
+        carry["mask"], present[:, None], (0, t))
+    return {"bars": bars, "mask": mask, "t": t + 1,
+            "inc": inc_ops.update_inc(carry["inc"], t, values, present)}
+
+
+def update_tickers(carry, rows, idx):
+    """Cohort fold step: bars for ``K`` tickers at the CURRENT minute.
+
+    ``rows [K, 5]`` land at ``(idx[k], t)``; the cursor does not move
+    (call :func:`advance` at the minute boundary). Padding rows use
+    ``idx == n_tickers`` (out of bounds — the scatters drop them), so
+    one executable serves every cohort of size K regardless of how many
+    real bars it carries. Streaming the same minutes through cohorts or
+    through :func:`update_minute` yields a bit-identical carry: both
+    write the same values and bump the same integer counters.
+    """
+    t = carry["t"]
+    bars = carry["bars"].at[idx, t].set(rows, mode="drop")
+    mask = carry["mask"].at[idx, t].set(True, mode="drop")
+    return {"bars": bars, "mask": mask, "t": t,
+            "inc": inc_ops.update_inc_at(carry["inc"], t, rows, idx)}
+
+
+def advance(carry, minutes: int = 1):
+    """Move the minute cursor (a minute with zero cohort deliveries is
+    a legal, fully-absent minute)."""
+    return {**carry, "t": carry["t"] + jnp.int32(minutes)}
+
+
+def readiness(carry_inc, names: Sequence[str]):
+    """``[F, T]`` bool: which kernels' defining groups are non-empty at
+    this point of the day (registry.STREAM_REQUIREMENTS). Monotone in
+    the fold (counters only grow) and SOUND: a False lane's exposure is
+    NaN; a True lane may still be NaN through degenerate data."""
+    reqs = stream_requirements()
+    rows = []
+    for n in names:
+        counter, minimum = reqs[n]
+        rows.append(carry_inc[counter] >= minimum)
+    return jnp.stack(rows)
+
+
+def finalize(carry, names: Optional[Tuple[str, ...]] = None,
+             replicate_quirks: bool = True,
+             rolling_impl: Optional[str] = None) -> Dict[str, object]:
+    """Exposures of the partial day: ``{name: [T]}``.
+
+    Runs the batch kernel graph over the carried ``(bars, mask)``
+    prefix with the reorder-exact accumulators injected into the
+    DayContext memo (``n_bars``, ``last_close``) — those reductions are
+    skipped, everything f32 recomputes by the batch formulation, and
+    the result bit-equals the full-day path on the same prefix.
+    """
+    if names is None:
+        names = factor_names()
+    inject = {"n_bars": carry["inc"]["bars"],
+              "last_close": carry["inc"]["last_close"]}
+    return compute_factors(carry["bars"], carry["mask"], names=names,
+                           replicate_quirks=replicate_quirks,
+                           rolling_impl=rolling_impl, inject=inject)
+
+
+def finalize_with_readiness(carry, names: Tuple[str, ...],
+                            replicate_quirks: bool = True,
+                            rolling_impl: Optional[str] = None):
+    """The engine's snapshot graph: stacked exposures ``[F, T]`` plus
+    the readiness plane ``[F, T]`` in one dispatch."""
+    out = finalize(carry, names, replicate_quirks, rolling_impl)
+    exposures = jnp.stack([out[n] for n in names])
+    return exposures, readiness(carry["inc"], names)
+
+
+# --------------------------------------------------------------------------
+# serialization (mid-day restart: serialize -> restore -> identical tail)
+# --------------------------------------------------------------------------
+
+
+def carry_to_host(carry) -> Dict[str, object]:
+    """Flat ``{path: np.ndarray}`` snapshot of the carry (one explicit
+    device_get). Restoring with :func:`carry_from_host` and continuing
+    the fold is bit-identical to never having stopped — the carry IS
+    the complete streaming state."""
+    flat = {f"inc/{k}": v for k, v in carry["inc"].items()}
+    flat.update({k: carry[k] for k in ("bars", "mask", "t")})
+    return jax.device_get(flat)
+
+
+def carry_from_host(snapshot: Dict[str, object]) -> Dict[str, object]:
+    """Rebuild the carry pytree from a :func:`carry_to_host` snapshot
+    (host-side restructure; the engine device_puts the result)."""
+    inc = {k.split("/", 1)[1]: v for k, v in snapshot.items()
+           if k.startswith("inc/")}
+    return {"bars": snapshot["bars"], "mask": snapshot["mask"],
+            "t": snapshot["t"], "inc": inc}
+
+
+def carry_nbytes(carry) -> int:
+    """Device bytes held by the carry (the ``stream.carry_bytes``
+    gauge)."""
+    return sum(x.nbytes for x in jax.tree_util.tree_leaves(carry))

@@ -1,0 +1,233 @@
+"""The ``lax.scan``-over-minutes engine: warm AOT executables over a
+device-resident carry.
+
+One :class:`StreamEngine` owns one day's carry for one ticker universe
+and advances it through three executable families, all AOT-compiled
+through ``compile_with_telemetry`` and cached in the serving layer's
+:class:`..serve.executables.ExecutableCache` (so a warm engine compiles
+NOTHING per bar — the ``xla.compiles`` counter is the acceptance gate,
+exactly as in serve):
+
+* ``stream_update_scan`` — B minutes in ONE dispatch: a ``lax.scan``
+  over the micro-batch's minute axis with :func:`..stream.carry.
+  update_minute` as the body (the catch-up/replay path, and the only
+  scan in the package — graftlint traces it under the reserved
+  ``__stream_update__`` symbol with a one-driving-scan exemption);
+* ``stream_update_cohort`` — K tickers' bars at the current minute in
+  one scatter dispatch (the live-feed path; K is the executable shape,
+  padding rows are dropped), plus the tiny ``stream_advance`` cursor
+  step at minute boundaries;
+* ``stream_snapshot`` — stacked ``[F, T]`` partial exposures + the
+  readiness plane in one dispatch (:func:`..stream.carry.
+  finalize_with_readiness`).
+
+Device-hot module (GL-A3): inputs arrive as HOST numpy and are
+``jax.device_put`` explicitly; nothing here blocks or materializes —
+the serve request loop / bench own the host boundary.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..serve.executables import ExecutableCache
+from . import carry as carry_mod
+
+
+def scan_update(carry, bars_seq, present_seq):
+    """The driving minutes-scan (reserved graftlint symbol
+    ``__stream_update__``): fold ``B`` minutes into the carry in one
+    executable. ``bars_seq [B, T, 5]``, ``present_seq [B, T]``."""
+    def body(c, xs):
+        values, present = xs
+        return carry_mod.update_minute(c, values, present), None
+
+    out, _ = jax.lax.scan(body, carry, (bars_seq, present_seq))
+    return out
+
+
+def _sds(tree):
+    """ShapeDtypeStruct skeleton of a pytree of (device or host)
+    arrays — lets every executable build from shapes alone, so warmup
+    moves zero data."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
+
+
+class StreamEngine:
+    """Streaming state + executables for one ticker universe.
+
+    ``executables`` is injectable so the serving layer shares ONE cache
+    (and one compile-count ground truth) between its block engine and
+    its stream engine; standalone use gets its own.
+    """
+
+    def __init__(self, n_tickers: int,
+                 names: Optional[Sequence[str]] = None,
+                 replicate_quirks: bool = True,
+                 rolling_impl: Optional[str] = None,
+                 telemetry=None,
+                 executables: Optional[ExecutableCache] = None):
+        from ..config import get_config
+        from ..models.registry import factor_names
+        from ..telemetry import get_telemetry
+
+        self.n_tickers = int(n_tickers)
+        self.names: Tuple[str, ...] = (tuple(names) if names is not None
+                                       else factor_names())
+        self.replicate_quirks = replicate_quirks
+        self.rolling_impl = (rolling_impl if rolling_impl is not None
+                             else get_config().rolling_impl)
+        self.telemetry = (telemetry if telemetry is not None
+                          else get_telemetry())
+        self.executables = (executables if executables is not None
+                            else ExecutableCache(telemetry=telemetry))
+        self._scan_jit = jax.jit(scan_update)
+        self._cohort_jit = jax.jit(carry_mod.update_tickers)
+        self._advance_jit = jax.jit(carry_mod.advance)
+        self._snapshot_jit = jax.jit(
+            lambda c: carry_mod.finalize_with_readiness(
+                c, self.names, self.replicate_quirks, self.rolling_impl))
+        self.carry = None
+        #: host-side minute cursor mirror (no device read needed for
+        #: gauges or over-ingest guards)
+        self.minutes = 0
+        self.reset()
+
+    # --- lifecycle ------------------------------------------------------
+    def _graph_key(self):
+        return (self.n_tickers, self.names, self.replicate_quirks,
+                self.rolling_impl)
+
+    def reset(self) -> "StreamEngine":
+        """Fresh empty-day carry (one explicit host->device put)."""
+        self.carry = jax.device_put(carry_mod.init_carry(self.n_tickers))
+        self.minutes = 0
+        self._note_carry()
+        return self
+
+    def _note_carry(self) -> None:
+        tel = self.telemetry
+        tel.gauge("stream.carry_bytes", carry_mod.carry_nbytes(self.carry))
+        tel.gauge("stream.minute", self.minutes)
+
+    def save(self) -> Dict[str, object]:
+        """Host snapshot of the carry (mid-day restart support)."""
+        return carry_mod.carry_to_host(self.carry)
+
+    def restore(self, snapshot: Dict[str, object]) -> "StreamEngine":
+        """Adopt a :meth:`save` snapshot; the continued fold is
+        bit-identical to the uninterrupted one (gated in tier-1)."""
+        host = carry_mod.carry_from_host(snapshot)
+        if host["mask"].shape[0] != self.n_tickers:
+            raise ValueError(
+                f"snapshot holds {host['mask'].shape[0]} tickers; engine "
+                f"is sized for {self.n_tickers}")
+        self.carry = jax.device_put(host)
+        self.minutes = int(snapshot["t"])
+        self._note_carry()
+        return self
+
+    # --- executables ----------------------------------------------------
+    def _exe(self, label: str, key_extra: tuple, jit_fn, *arg_trees):
+        key = (label,) + self._graph_key() + key_extra
+        return self.executables.get(
+            label, key, lambda: jit_fn.lower(*[_sds(a) for a in arg_trees]))
+
+    def warmup(self, micro_batches: Sequence[int] = (),
+               cohorts: Sequence[int] = (), snapshot: bool = True) -> None:
+        """Compile every executable the declared load shapes need —
+        after this, steady-state ingest/snapshot compiles nothing
+        (``xla.compiles`` delta == 0, the r9 acceptance gate)."""
+        T = self.n_tickers
+        for b in micro_batches:
+            bars = np.zeros((int(b), T, 5), np.float32)
+            present = np.zeros((int(b), T), bool)
+            self._exe("stream_update_scan", (int(b),), self._scan_jit,
+                      self.carry, bars, present)
+        for k in cohorts:
+            rows = np.zeros((int(k), 5), np.float32)
+            idx = np.zeros((int(k),), np.int32)
+            self._exe("stream_update_cohort", (int(k),), self._cohort_jit,
+                      self.carry, rows, idx)
+        self._exe("stream_advance", (), self._advance_jit, self.carry)
+        if snapshot:
+            self._exe("stream_snapshot", (), self._snapshot_jit,
+                      self.carry)
+
+    # --- ingest ---------------------------------------------------------
+    def ingest_minutes(self, bars: np.ndarray,
+                       present: np.ndarray) -> None:
+        """Fold ``B`` whole minutes (host arrays ``bars [B, T, 5]``,
+        ``present [B, T]``) into the carry in one scan dispatch."""
+        b, t = present.shape
+        if t != self.n_tickers:
+            raise ValueError(f"got {t} tickers, engine holds "
+                             f"{self.n_tickers}")
+        if self.minutes + b > carry_mod.N_SLOTS:
+            raise ValueError(
+                f"ingesting {b} minutes past slot {self.minutes} "
+                f"overruns the {carry_mod.N_SLOTS}-slot day")
+        n_bars = int(present.sum())
+        exe = self._exe("stream_update_scan", (b,), self._scan_jit,
+                        self.carry, bars, present)
+        t0 = time.perf_counter()
+        self.carry = exe(self.carry, jax.device_put(bars),
+                         jax.device_put(present))
+        tel = self.telemetry
+        tel.observe("stream.update_seconds",
+                    time.perf_counter() - t0, kind="scan")
+        tel.counter("stream.updates", kind="scan")
+        tel.counter("stream.bars", n_bars)
+        self.minutes += b
+        self._note_carry()
+
+    def ingest_cohort(self, rows: np.ndarray, idx: np.ndarray) -> None:
+        """Scatter ``K`` tickers' bars at the current minute (host
+        arrays ``rows [K, 5]`` f32, ``idx [K]`` int32; pad with
+        ``idx == n_tickers``). The cursor stays — call
+        :meth:`advance` at the minute boundary."""
+        if idx.dtype != np.int32:
+            raise TypeError(f"idx must be int32, got {idx.dtype}")
+        k = len(idx)
+        n_real = int((idx < self.n_tickers).sum())
+        exe = self._exe("stream_update_cohort", (k,), self._cohort_jit,
+                        self.carry, rows, idx)
+        t0 = time.perf_counter()
+        self.carry = exe(self.carry, jax.device_put(rows),
+                         jax.device_put(idx))
+        tel = self.telemetry
+        tel.observe("stream.update_seconds",
+                    time.perf_counter() - t0, kind="cohort")
+        tel.counter("stream.updates", kind="cohort")
+        tel.counter("stream.bars", n_real)
+
+    def advance(self) -> None:
+        """Close the current minute (cohort path's minute boundary)."""
+        if self.minutes + 1 > carry_mod.N_SLOTS:
+            raise ValueError(f"advancing past the {carry_mod.N_SLOTS}-slot"
+                             " day")
+        exe = self._exe("stream_advance", (), self._advance_jit,
+                        self.carry)
+        self.carry = exe(self.carry)
+        self.telemetry.counter("stream.updates", kind="advance")
+        self.minutes += 1
+        self._note_carry()
+
+    # --- snapshot -------------------------------------------------------
+    def snapshot(self):
+        """Partial-day view as DEVICE arrays: ``(exposures [F, T],
+        ready [F, T])`` in one warm dispatch. The caller (the serve
+        request loop's boundary module, or bench) materializes."""
+        exe = self._exe("stream_snapshot", (), self._snapshot_jit,
+                        self.carry)
+        t0 = time.perf_counter()
+        exposures, ready = exe(self.carry)
+        self.telemetry.observe("stream.snapshot_seconds",
+                               time.perf_counter() - t0)
+        self.telemetry.counter("stream.snapshots")
+        return exposures, ready

@@ -32,7 +32,15 @@ smeared):
   level is the ``value``, with per-level p50/p99/QPS under
   ``levels`` and the serving counters — exposure-cache hits,
   coalesced dispatches, compiles-during-load — under ``serve``; a
-  new workload, so its records never smear onto the batch series).
+  new workload, so its records never smear onto the batch series),
+  ``r9_stream_intraday_v1`` (the online intraday engine,
+  ``bench.py stream``: bars/sec at the record's largest cohort
+  ingest shape is the ``value``, per-shape per-update p50/p99 +
+  bars/sec under ``levels``, and the streaming counters —
+  updates/bars/snapshots, carry bytes, compiles-during-load, the
+  streamed-vs-full-day parity verdict — under ``stream``; per-bar
+  ingest is a new workload, so its records start their own
+  baseline).
 
 Baseline = median of every record in the group EXCEPT the latest; the
 latest is the record under test. ``--check FILE`` instead gates a fresh

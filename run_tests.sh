@@ -58,6 +58,14 @@ print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     # r8_serve_v1 methodology; one JSON verdict line, nonzero on drift
     run python -c "import json, sys, bench; r = bench.serve_smoke(); \
 print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
+    # stream smoke (ISSUE 7): the online intraday engine on CPU — a
+    # seeded day streamed through the warm executables must reproduce
+    # the full-day batch exposures (zero compiles after warmup, empty
+    # parity-mismatch list) with bars/sec + per-update p50/p99 stamped
+    # under the declared r9_stream_intraday_v1 methodology; one JSON
+    # verdict line, nonzero on drift
+    run python -c "import json, sys, bench; r = bench.stream_smoke(); \
+print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     # graftlint (ISSUE 4): AST rules over the whole package + jaxpr
     # contracts over all 58 registered kernels AND the resident scan
     # wrappers (abstract trace on CPU), gated on the committed baseline

@@ -49,7 +49,12 @@ def rng():
 #: in-process client returns HOST results, so the calling thread never
 #: transfers implicitly; serve tests that do transfer on the test
 #: thread opt out per test.
-TRANSFER_GUARDED_MODULES = {"test_kernel_purity", "test_serve"}
+#: test_stream joins (ISSUE 7): the streaming engine's carry lives
+#: device-resident and moves only by explicit put (reset/restore/
+#: ingest) and explicit get (save/the tests' device_get) — the whole
+#: carry contract is exercised under the guard.
+TRANSFER_GUARDED_MODULES = {"test_kernel_purity", "test_serve",
+                            "test_stream"}
 
 
 @pytest.fixture(autouse=True)

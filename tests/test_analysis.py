@@ -329,11 +329,13 @@ def test_manifest_carries_the_analysis_block(tmp_path):
 
 
 def test_resident_wrappers_trace_clean_and_scan_exempt_by_symbol():
-    """ISSUE 5: Tier B abstractly traces the resident scan entrypoints
-    (single-device + sharded, canonical per-shard shape). Their ONE
-    driving scan is exempt from GL-B1 by SYMBOL — the wrapper names are
-    reserved in jaxpr_tier.RESIDENT_WRAPPERS, no baseline entry exists
-    for them — while the kernel tier's zero-scan rule is untouched."""
+    """ISSUE 5 + ISSUE 7: Tier B abstractly traces the driving-scan
+    wrappers — the resident scan entrypoints (single-device + sharded,
+    canonical per-shard shape) and the streaming minute fold
+    (``__stream_update__``). Their ONE driving scan is exempt from
+    GL-B1 by SYMBOL — the wrapper names are reserved in
+    jaxpr_tier.RESIDENT_WRAPPERS, no baseline entry exists for them —
+    while the kernel tier's zero-scan rule is untouched."""
     from replication_of_minute_frequency_factor_tpu.analysis import (
         jaxpr_tier)
     from replication_of_minute_frequency_factor_tpu.analysis.violations import (
@@ -342,13 +344,15 @@ def test_resident_wrappers_trace_clean_and_scan_exempt_by_symbol():
     violations, fps = jaxpr_tier.run_resident_tier()
     assert violations == []
     assert set(fps) == set(jaxpr_tier.RESIDENT_WRAPPERS)
+    assert "__stream_update__" in fps
     for name, fp in fps.items():
         assert fp["traced"] is True
         assert fp["primitives"].get("scan") == 1, name
         assert "while" not in fp["primitives"], name
     # exemption is by symbol, NOT by baseline entry
     entries = Baseline.load(BASELINE_PATH).entries
-    assert not any(e.get("kernel", "").startswith("__resident")
+    assert not any(e.get("kernel", "").startswith(("__resident",
+                                                   "__stream"))
                    for e in entries)
 
 
@@ -387,6 +391,7 @@ def test_report_carries_resident_wrapper_fingerprints():
     assert len(rep["jaxpr"]["fingerprints"]) == 58
     wrappers = rep["jaxpr"]["resident_wrappers"]
     assert set(wrappers) == {"__resident_scan__",
-                             "__resident_scan_sharded__"}
+                             "__resident_scan_sharded__",
+                             "__stream_update__"}
     for fp in wrappers.values():
         assert fp["primitives"]["scan"] == 1

@@ -243,6 +243,33 @@ def test_cli_check_r8_serve_break_is_declared(tmp_path):
     assert "declared break" in g.get("note", "")
 
 
+def test_cli_check_r9_stream_break_is_declared(tmp_path):
+    """ISSUE 7: the intraday engine's first ``bench.py stream`` record
+    (bars/sec under ``r9_stream_intraday_v1``) gates against the REAL
+    banked trajectory as a declared break — its own fresh series,
+    reported with an empty baseline, never flagged, exit 0. The stream
+    counters ride the record for the session carry rule (updates > 0)
+    and the acceptance gate (compiles_during_load == 0, empty
+    parity_mismatched)."""
+    cand = tmp_path / "candidate.json"
+    with open(cand, "w") as fh:
+        json.dump({"metric": "stream58_1024tickers_bars_per_s",
+                   "value": 83000.0, "unit": "bars/s",
+                   "methodology": "r9_stream_intraday_v1",
+                   "p50_ms": 0.7, "p99_ms": 2.4,
+                   "levels": {"1": {"bars_per_s": 1400.0},
+                              "64": {"bars_per_s": 83000.0}},
+                   "stream": {"updates": 2880, "bars": 170000,
+                              "compiles_during_load": 0,
+                              "parity_mismatched": []}}, fh)
+    rc, verdict = _cli(REPO, "--check", str(cand))
+    assert rc == 0 and verdict["ok"]
+    (g,) = [g for g in verdict["groups"]
+            if g["methodology"] == "r9_stream_intraday_v1"]
+    assert g["n_baseline"] == 0 and g["flagged"] is False
+    assert "declared break" in g.get("note", "")
+
+
 def test_cli_check_r7_sharded_break_is_declared(tmp_path):
     """ISSUE 5: a fresh record under the r7 mesh-native resident
     methodology gates against the REAL banked trajectory as a declared

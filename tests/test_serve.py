@@ -28,12 +28,22 @@ from replication_of_minute_frequency_factor_tpu.telemetry import Telemetry
 NAMES = ("vol_return1min", "mmt_am", "liq_openvol")
 
 
-def _server(n_days=8, n_tickers=32, names=NAMES, start=True, **scfg):
+def _server(n_days=8, n_tickers=32, names=NAMES, start=True,
+            stream=False, stream_batches=(1,), **scfg):
     tel = Telemetry()
     src = SyntheticSource(n_days=n_days, n_tickers=n_tickers, seed=3)
     srv = FactorServer(src, names=names, telemetry=tel,
-                       serve_cfg=ServeConfig(**scfg), start=start)
+                       serve_cfg=ServeConfig(**scfg), start=start,
+                       stream=stream, stream_batches=stream_batches)
     return srv, tel
+
+
+def _day_minutes(src, lo, hi):
+    """Host ``(bars [B, T, 5], present [B, T])`` for minutes
+    ``[lo, hi)`` of the source's day 0."""
+    bars, mask = src.slab(0, 1)
+    return (np.ascontiguousarray(np.swapaxes(bars[0][:, lo:hi], 0, 1)),
+            np.ascontiguousarray(mask[0][:, lo:hi].T))
 
 
 # --------------------------------------------------------------------------
@@ -438,3 +448,145 @@ def test_cli_serve_demo(capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["demo_requests"] == 6
     assert out["dispatches"] >= 1 and out["cache_hits"] >= 1
+
+
+# --------------------------------------------------------------------------
+# streaming integration (ISSUE 7): ingest + intraday through the queue
+# --------------------------------------------------------------------------
+
+
+def test_stream_ingest_then_intraday_roundtrip():
+    """Minute bars ingested through the queue advance the carry; an
+    intraday query returns host exposures + the readiness plane at the
+    carry's minute, and the SECOND snapshot compiles nothing (the
+    stream engine shares the server's executable cache)."""
+    srv, tel = _server(stream=True, stream_batches=(8,))
+    try:
+        c = srv.client()
+        bars, present = _day_minutes(srv.source, 0, 8)
+        r = c.ingest(bars, present)
+        assert r["minute"] == 8
+        assert r["bars"] == int(present.sum())
+        snap = c.intraday()
+        assert snap["minute"] == 8
+        assert set(snap["exposures"]) == set(NAMES)
+        assert set(snap["ready"]) == set(NAMES)
+        assert len(snap["exposures"]["mmt_am"]) == srv.source.n_tickers
+        reg = tel.registry
+        before = reg.counter_total("xla.compiles")
+        sub = c.intraday(names=("mmt_am",))
+        assert list(sub["exposures"]) == ["mmt_am"]
+        assert reg.counter_total("xla.compiles") == before
+        assert reg.counter_total("stream.snapshots") == 2
+    finally:
+        srv.close()
+
+
+def test_stream_ingest_applies_before_intraday_in_one_microbatch():
+    """Latest-view semantics: with the worker paused, an intraday
+    query enqueued BEFORE an ingest still answers from the advanced
+    carry once the batch drains — ingests apply first."""
+    srv, _ = _server(stream=True, stream_batches=(4,), start=False)
+    try:
+        bars, present = _day_minutes(srv.source, 0, 4)
+        f_q = srv.submit(Query("intraday"))
+        f_i = srv.ingest(bars, present)
+        srv.start()
+        assert f_i.result(60)["minute"] == 4
+        assert f_q.result(60)["minute"] == 4
+    finally:
+        srv.close()
+
+
+def test_concurrent_intraday_queries_coalesce_to_one_snapshot():
+    """K intraday queries in one micro-batch → ONE snapshot dispatch
+    (counter-asserted, the same coalescing contract as block
+    queries)."""
+    srv, tel = _server(stream=True, start=False)
+    try:
+        futures = [srv.submit(Query("intraday")) for _ in range(6)]
+        srv.start()
+        answers = [f.result(60) for f in futures]
+        assert all(a["minute"] == 0 for a in answers)
+        reg = tel.registry
+        assert reg.counter_total("stream.snapshots") == 1
+        assert reg.counter_total("serve.coalesced_dispatches") == 1
+        assert reg.counter_value("serve.coalesced_requests") == 6
+    finally:
+        srv.close()
+
+
+def test_stream_validation_errors():
+    """intraday/ingest against a non-streaming server and malformed
+    ingest shapes fail fast on the caller's thread."""
+    srv, _ = _server()
+    try:
+        with pytest.raises(ValueError, match="stream=True"):
+            srv.submit(Query("intraday"))
+        with pytest.raises(ValueError, match="stream=True"):
+            srv.ingest(np.zeros((1, 32, 5), np.float32),
+                       np.zeros((1, 32), bool))
+    finally:
+        srv.close()
+    srv2, _ = _server(stream=True)
+    try:
+        with pytest.raises(ValueError, match="bars \\[B, T, 5\\]"):
+            srv2.ingest(np.zeros((1, 32, 4), np.float32),
+                        np.zeros((1, 32), bool))
+        with pytest.raises(ValueError, match="stream engine"):
+            srv2.ingest(np.zeros((1, 16, 5), np.float32),
+                        np.zeros((1, 16), bool))
+        with pytest.raises(ValueError, match="unknown factor"):
+            srv2.submit(Query("intraday", names=("nope",)))
+    finally:
+        srv2.close()
+
+
+def test_http_ingest_and_intraday_roundtrip():
+    """POST /v1/ingest advances the carry; kind=intraday via
+    /v1/query reads it back; /healthz reports the minute cursor."""
+    srv, _ = _server(stream=True, stream_batches=(2,))
+    httpd = None
+    try:
+        httpd, _t = serve_http(srv)
+        port = httpd.server_address[1]
+        bars, present = _day_minutes(srv.source, 0, 2)
+        status, r = _post(port, {"bars": bars.tolist(),
+                                 "present": present.tolist()},
+                          path="/v1/ingest")
+        assert status == 200 and r["minute"] == 2
+        status, snap = _post(port, {"kind": "intraday",
+                                    "names": ["mmt_am"]})
+        assert status == 200 and snap["minute"] == 2
+        assert len(snap["ready"]["mmt_am"]) == srv.source.n_tickers
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30) as resp:
+            h = json.loads(resp.read())
+        assert h["stream_minute"] == 2
+        # malformed ingest → 400, not a worker-thread crash
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(port, {"bars": [[1, 2]]}, path="/v1/ingest")
+        assert e.value.code == 400
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        srv.close()
+
+
+def test_stream_ingest_failure_bumps_breaker_and_sheds():
+    """A failing carry update fails its own future, opens the breaker
+    after the threshold, and subsequent ingests shed — backpressure
+    reaches the feed as an error."""
+    srv, tel = _server(stream=True, breaker_threshold=1,
+                       breaker_cooldown_s=30.0)
+    try:
+        srv.stream_engine.ingest_minutes = _boom
+        bars, present = _day_minutes(srv.source, 0, 1)
+        with pytest.raises(RuntimeError, match="injected"):
+            srv.ingest(bars, present).result(60)
+        with pytest.raises(LoadShedError):
+            srv.ingest(bars, present)
+        assert tel.registry.counter_value("serve.failures",
+                                          stage="ingest") == 1
+    finally:
+        srv.close()

@@ -289,3 +289,72 @@ def test_resident_sharded_in_default_steps(tpu_session):
     src = open(os.path.join(REPO, "benchmarks", "tpu_session.py")).read()
     assert '"headline,resident_sharded,"' in src
     assert "resident_sharded" in src.split("steps = {")[1]
+
+
+def test_stream_intraday_carry_requires_real_streaming(tpu_session):
+    """ISSUE 7: a 'stream_intraday' entry only carries when it is an
+    r9 record that actually streamed warm and faithfully — updates >
+    0, zero compiles during load, empty parity-mismatch list. A
+    zero-update record, a cold (compiling) load, or an on-hardware
+    parity failure must re-run."""
+    def entry(**stream):
+        base = {"updates": 2880, "compiles_during_load": 0,
+                "parity_mismatched": []}
+        base.update(stream)
+        return {"stream_intraday": {"ok": True, "results": [
+            {"metric": "stream58_1024tickers_bars_per_s",
+             "value": 83000.0,
+             "methodology": "r9_stream_intraday_v1",
+             "stream": base}]}}
+
+    good = entry()
+    assert tpu_session.drop_conv_only_rolling(good) == good
+    assert tpu_session.drop_conv_only_rolling(entry(updates=0)) == {}
+    assert tpu_session.drop_conv_only_rolling(
+        entry(compiles_during_load=3)) == {}
+    assert tpu_session.drop_conv_only_rolling(
+        entry(parity_mismatched=["vol_upRatio"])) == {}
+    wrong_series = entry()
+    wrong_series["stream_intraday"]["results"][0]["methodology"] = \
+        "r4_stream_v2"
+    assert tpu_session.drop_conv_only_rolling(wrong_series) == {}
+    # the UNRELATED legacy 'stream' step (r1-r4 batch loop) still
+    # carries on its own mode rule — the two must not interfere
+    legacy = {"stream": {"ok": True,
+                         "results": [{"mode": "stream"}]}}
+    assert tpu_session.drop_conv_only_rolling(legacy) == legacy
+
+
+def test_stream_intraday_step_refuses_unbankable_records(
+        tpu_session, monkeypatch):
+    """The step itself flips ok=False when the record shows a CPU
+    fallback or an unbankable stream block — green-but-not-streamed
+    banking is what the carry rule cannot repair after the fact."""
+    def fake_lines(cmd, timeout, env=None):
+        assert cmd[1:] == ["bench.py", "stream"]
+        assert env["BENCH_REQUIRE_TPU"] == "1"
+        return {"ok": True, "rc": 0, "results": [
+            {"metric": "stream58_1024tickers_bars_per_s",
+             "methodology": "r9_stream_intraday_v1",
+             "stream": {"updates": 0, "compiles_during_load": 0,
+                        "parity_mismatched": []}}]}
+    monkeypatch.setattr(tpu_session, "_run_json_lines", fake_lines)
+    r = tpu_session.step_stream_intraday()
+    assert r["ok"] is False and "cannot bank" in r["error"]
+
+    def fake_good(cmd, timeout, env=None):
+        return {"ok": True, "rc": 0, "results": [
+            {"metric": "stream58_1024tickers_bars_per_s",
+             "methodology": "r9_stream_intraday_v1",
+             "stream": {"updates": 99, "compiles_during_load": 0,
+                        "parity_mismatched": []}}]}
+    monkeypatch.setattr(tpu_session, "_run_json_lines", fake_good)
+    assert tpu_session.step_stream_intraday()["ok"] is True
+
+
+def test_stream_intraday_in_default_steps(tpu_session):
+    """The r9 intraday engine's hardware validation rides the default
+    list, directly behind serve."""
+    src = open(os.path.join(REPO, "benchmarks", "tpu_session.py")).read()
+    assert "serve,stream_intraday," in src
+    assert "stream_intraday" in src.split("steps = {")[1]
