@@ -303,6 +303,27 @@ from replication_of_minute_frequency_factor_tpu.serve.executables import (  # no
 
 _AOT_COMPILED = ExecutableCache()
 
+_FLIGHT = None
+
+
+def _flight_note(trigger, **detail):
+    """Anomaly hook for the OOM ladder (ISSUE 8): capture a
+    flight-recorder dump at each demotion so the decision — which rung,
+    what error, what the registry said — is diagnosable after the
+    tunnel window closes. Dump files land in BENCH_TELEMETRY_DIR when
+    set (the counter/event record lands regardless); never raises."""
+    global _FLIGHT
+    try:
+        from replication_of_minute_frequency_factor_tpu.telemetry import (
+            FlightRecorder)
+        if _FLIGHT is None:
+            _FLIGHT = FlightRecorder(
+                telemetry=get_telemetry(),
+                dump_dir=os.environ.get("BENCH_TELEMETRY_DIR") or None)
+        _FLIGHT.dump(trigger, extra=detail, force=True)
+    except Exception:  # noqa: BLE001 — diagnostics must not cost a run
+        pass
+
 
 def _aot_resident(label, key, lower_fn, phases):
     """First build of a resident scan executable through
@@ -457,6 +478,11 @@ def run_resident_sharded(batches, names, use_wire, group, mesh,
         if compute_t0 is None:
             compute_t0 = time.perf_counter()
         outs.append(compiled(d))
+        # HBM watermark per scan group (ISSUE 8): the first measured
+        # signal the OOM ladder's group-halving gets, sampled while
+        # this group's buffers and the double-buffered next put are
+        # both live (the resident loop's peak shape)
+        tel.hbm.sample("resident.group")
         if gi + 1 < len(groups):
             # double-buffer: group gi+1's transfer rides behind group
             # gi's execution; dispatch only, never block
@@ -844,6 +870,7 @@ def serve_bench(levels=None, total_requests=None, tickers=None,
             "qps": round(len(lat) / wall, 1),
         }
         stages[f"load_{level}_s"] = round(wall, 3)
+        tel.hbm.sample(f"serve.load_{level}", force=True)
     server.close()
 
     top = str(levels[-1])
@@ -882,6 +909,11 @@ def serve_bench(levels=None, total_requests=None, tickers=None,
         "p99_ms": level_stats[top]["p99_ms"],
         "levels": level_stats,
         "serve": serve_counters,
+        # HBM watermarks over the loaded window (ISSUE 8): real
+        # memory_stats on accelerator backends, the live-arrays
+        # estimate with the explicit `available: false` marker on CPU;
+        # regress derives the `<metric>.hbm_peak_bytes` series from it
+        "hbm": tel.hbm.summary(),
         "stages": stages,
     }
 
@@ -1092,6 +1124,7 @@ def stream_bench(cohorts=None, tickers=None, updates=None, names=None,
     t0 = time.perf_counter()
     engine.snapshot()
     stages["snapshot_s"] = round(time.perf_counter() - t0, 3)
+    tel.hbm.sample("stream.load_end", force=True)
 
     top = str(cohorts[-1])
     stream_counters = {
@@ -1124,6 +1157,8 @@ def stream_bench(cohorts=None, tickers=None, updates=None, names=None,
         "p99_ms": level_stats[top]["p99_ms"],
         "levels": level_stats,
         "stream": stream_counters,
+        # HBM watermarks (ISSUE 8) — same contract as the serve record
+        "hbm": tel.hbm.summary(),
         "stages": stages,
     }
 
@@ -1193,6 +1228,171 @@ def stream_main():
         get_telemetry().write(tdir,
                               manifest_extra={"run_kind": "bench_stream"})
     return 0
+
+
+# --------------------------------------------------------------------------
+# ops-plane smoke (ISSUE 8): tracing + flight recorder + watermarks +
+# Prometheus, end to end
+# --------------------------------------------------------------------------
+
+
+def opsplane_smoke():
+    """run_tests.sh --quick smoke: the live ops plane end to end on
+    CPU. Starts a streaming FactorServer with its HTTP binding, drives
+    mixed ingest+query load (one request with a propagated
+    ``X-Trace-Id``), scrapes the Prometheus exposition, trips the
+    breaker, and checks that:
+
+      * the propagated trace ID round-trips (response header == body)
+        and the written bundle holds a schema-v2 ``request`` record
+        reconstructing that request's lifecycle (queue-wait, dispatch
+        id, group size, device-time share, total);
+      * the Prometheus text scrape carries the serving counters AND
+        the ``device_hbm_*`` watermark gauges with the explicit
+        availability marker;
+      * tripping the breaker writes a flight-recorder dump that
+        ``telemetry.validate`` accepts, holding the failed requests;
+      * ``POST /v1/debug/dump`` captures on demand and validates;
+      * the full bundle schema-validates (v2, request records included).
+    """
+    import tempfile
+    import threading as _th
+    import urllib.request
+
+    from replication_of_minute_frequency_factor_tpu.serve import (
+        FactorServer, Query, ServeConfig, SyntheticSource, serve_http)
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        Telemetry, set_telemetry)
+    from replication_of_minute_frequency_factor_tpu.telemetry.validate import (
+        validate_dir, validate_dump)
+
+    names = ("vol_return1min", "mmt_am")
+    tel = set_telemetry(Telemetry())
+    tmp = tempfile.mkdtemp(prefix="mff_opsplane_")
+    src = SyntheticSource(n_days=8, n_tickers=16, seed=11)
+    server = FactorServer(
+        src, names=names, telemetry=tel,
+        serve_cfg=ServeConfig(flight_dir=tmp, breaker_threshold=2,
+                              breaker_cooldown_s=30.0),
+        stream=True, stream_batches=(4,))
+    httpd, _t = serve_http(server)
+    port = httpd.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    checks = {}
+
+    def post(path, doc, headers=None):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(doc).encode(),
+            headers=headers or {})
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return resp.status, dict(resp.headers), \
+                json.loads(resp.read())
+
+    try:
+        # mixed load: a burst of concurrent queries (coalescing) + one
+        # streaming ingest, all through HTTP
+        bars, mask = src.slab(0, 1)
+        ing_bars = np.ascontiguousarray(
+            np.swapaxes(bars[0][:, :4], 0, 1))
+        ing_present = np.ascontiguousarray(mask[0][:, :4].T)
+        threads = [_th.Thread(target=post, args=(
+            "/v1/query", {"kind": "factors", "start": 0, "end": 4}))
+            for _ in range(6)]
+        threads.append(_th.Thread(target=post, args=(
+            "/v1/ingest", {"bars": ing_bars.tolist(),
+                           "present": ing_present.tolist()})))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        status, headers, body = post(
+            "/v1/query", {"kind": "factors", "start": 0, "end": 4},
+            headers={"X-Trace-Id": "smoke-trace-1"})
+        checks["trace_round_trip"] = (
+            status == 200
+            and headers.get("X-Trace-Id") == "smoke-trace-1"
+            and body.get("trace_id") == "smoke-trace-1")
+        _, _, snap = post("/v1/query", {"kind": "intraday"})
+        checks["intraday_minute"] = snap.get("minute") == 4
+        # Prometheus scrape (content-negotiated text exposition)
+        req = urllib.request.Request(base + "/v1/metrics",
+                                     headers={"Accept": "text/plain"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            prom = resp.read().decode()
+            ct = resp.headers.get("Content-Type", "")
+        checks["prometheus"] = (
+            "text/plain" in ct
+            and "serve_requests_total" in prom
+            and "device_hbm_bytes_in_use" in prom
+            and "device_hbm_stats_available" in prom)
+        with urllib.request.urlopen(base + "/healthz",
+                                    timeout=60) as resp:
+            h = json.loads(resp.read())
+        checks["healthz"] = all(
+            k in h for k in ("uptime_s", "queue_depth", "flight",
+                             "hbm_available", "stream_minute"))
+        # on-demand capture
+        req = urllib.request.Request(base + "/v1/debug/dump", data=b"")
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            dump = json.loads(resp.read())
+        checks["debug_dump_valid"] = validate_dump(dump["path"])["ok"]
+        # trip the breaker on a fresh range: the anomaly dump must
+        # fire and validate, holding the failed requests' traces
+        def _boom(bars, mask):
+            raise RuntimeError("injected opsplane smoke failure")
+        server.engine.build_block = _boom
+        for _ in range(2):
+            try:
+                server.submit(Query("factors", 4, 8)).result(120)
+            except RuntimeError:
+                pass
+        # the future fails before the worker writes the dump — poll
+        # briefly for the file instead of racing the worker thread
+        trip_dumps = []
+        deadline = time.monotonic() + 10.0
+        while not trip_dumps and time.monotonic() < deadline:
+            trip_dumps = [p for p in server.flight.dumps
+                          if "breaker_trip" in p]
+            if not trip_dumps:
+                time.sleep(0.05)
+        checks["breaker_dump_valid"] = (
+            bool(trip_dumps) and validate_dump(trip_dumps[-1])["ok"])
+        checks["dump_holds_errors"] = False
+        if trip_dumps:
+            with open(trip_dumps[-1]) as fh:
+                recs = [json.loads(ln) for ln in fh if ln.strip()]
+            checks["dump_holds_errors"] = any(
+                r.get("kind") == "request"
+                and r.get("status") == "error" for r in recs)
+    finally:
+        httpd.shutdown()
+        server.close()
+    bundle = os.path.join(tmp, "bundle")
+    tel.write(bundle)
+    checks["bundle_valid"] = validate_dir(bundle)["ok"]
+    # lifecycle reconstruction: the traced request, back out of the
+    # bundle with its full admission→answer story
+    rec = None
+    with open(os.path.join(bundle, "metrics.jsonl")) as fh:
+        for line in fh:
+            r = json.loads(line)
+            if r.get("kind") == "request" \
+                    and r.get("trace_id") == "smoke-trace-1":
+                rec = r
+    d = (rec or {}).get("data") or {}
+    checks["lifecycle_reconstructed"] = (
+        rec is not None and rec.get("status") == "ok"
+        and all(k in d for k in ("queue_wait_s", "dispatch_id",
+                                 "group_size", "device_share_s",
+                                 "answer_s", "total_s")))
+    gauges = tel.registry.snapshot()["gauges"]
+    checks["hbm_gauges"] = (
+        any(k.startswith("device.hbm_bytes_in_use") for k in gauges)
+        and any(k.startswith("device.hbm_peak_bytes") for k in gauges)
+        and any(k.startswith("device.hbm_stats_available")
+                for k in gauges))
+    return {"smoke": "opsplane", **checks,
+            "ok": all(checks.values())}
 
 
 def main():
@@ -1421,6 +1621,9 @@ def main():
                 if group <= 1:
                     raise _ResidentOOM(str(e)[:300]) from e
                 group = max(1, group // 2)
+                _flight_note("oom_ladder_demotion", rung="resident",
+                             action="halve_group", group=group,
+                             error=str(e)[:200])
                 print(f"# resident scan exhausted device memory; "
                       f"retrying with group={group}",
                       file=sys.stderr, flush=True)
@@ -1477,6 +1680,10 @@ def main():
                 if g <= 1:
                     raise _ResidentOOM(str(e)[:300]) from e
                 g = max(1, g // 2)
+                _flight_note("oom_ladder_demotion",
+                             rung="resident_sharded",
+                             action="halve_group", group=g,
+                             error=str(e)[:200])
                 print(f"# sharded resident scan exhausted device "
                       f"memory; retrying with group={g}",
                       file=sys.stderr, flush=True)
@@ -1492,6 +1699,9 @@ def main():
             print("# sharded resident scan OOM at group=1; falling "
                   "back to the single-device resident scan",
                   file=sys.stderr, flush=True)
+            _flight_note("oom_ladder_demotion", rung="resident_sharded",
+                         action="fallback_single_device",
+                         error=str(e)[:200])
             warm_info["sharded_oom_fallback"] = str(e)[:200]
             mesh = None
             n_shards = 1
@@ -1509,6 +1719,8 @@ def main():
             print("# resident scan OOM at group=1; falling back to "
                   "stream mode at the proven 8-day shape",
                   file=sys.stderr, flush=True)
+            _flight_note("oom_ladder_demotion", rung="resident",
+                         action="fallback_stream", error=str(e)[:200])
             mode = "stream"
             warm_info["resident_oom_fallback"] = str(e)[:200]
             days, iters = 8, max(iters, 5)
@@ -1526,6 +1738,8 @@ def main():
             # proven 8-day shape (r3's configuration) and keep going
             print(f"# {days}-day batch exhausted device memory; retrying "
                   "with 8-day batches", file=sys.stderr, flush=True)
+            _flight_note("oom_ladder_demotion", rung="stream",
+                         action="fallback_8day", error=str(e)[:200])
             days, iters = 8, max(iters, 5)
             _warm(days)
 

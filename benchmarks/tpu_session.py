@@ -148,7 +148,11 @@ def drop_conv_only_rolling(steps):
     * 'stream_intraday' entries must be r9 records that actually
       streamed warm and faithfully: ``r9_stream_intraday_v1`` with
       ``stream.updates > 0``, zero compiles during load and an empty
-      parity-mismatch list (ISSUE 7).
+      parity-mismatch list (ISSUE 7);
+    * since ISSUE 8 both serve and stream records must embed the HBM
+      watermark block (``hbm`` with the explicit ``available``
+      marker) — carried records feed the ``<metric>.hbm_peak_bytes``
+      regress series, so a watermark-less record cannot bank.
     """
     def keep(name, v):
         recs = [r for r in v.get("results") or [] if isinstance(r, dict)]
@@ -326,11 +330,18 @@ def step_serve():
 
 def _serve_record_banks(rec) -> bool:
     """A serve record banks only when the service actually served warm:
-    declared methodology AND exposure-cache hits > 0."""
+    declared methodology AND exposure-cache hits > 0. Since ISSUE 8 the
+    record must also carry the HBM watermark block (``hbm`` with its
+    explicit ``available`` marker) — the banked serve trajectory is the
+    series the ``<metric>.hbm_peak_bytes`` regress gate reads, so a
+    record without watermarks is a telemetry regression, not a bankable
+    measurement."""
     serve = rec.get("serve") or {}
+    hbm = rec.get("hbm")
     return (rec.get("methodology") == "r8_serve_v1"
             and isinstance(serve.get("cache_hits"), int)
-            and serve["cache_hits"] > 0)
+            and serve["cache_hits"] > 0
+            and isinstance(hbm, dict) and "available" in hbm)
 
 
 def step_stream_intraday():
@@ -362,13 +373,17 @@ def step_stream_intraday():
 def _stream_record_banks(rec) -> bool:
     """A stream record banks only when the engine actually streamed
     warm and faithfully: declared methodology, streamed updates > 0,
-    no compiles during load, empty parity-mismatch list."""
+    no compiles during load, empty parity-mismatch list — and, since
+    ISSUE 8, the embedded HBM watermark block (same rationale as
+    :func:`_serve_record_banks`)."""
     stream = rec.get("stream") or {}
+    hbm = rec.get("hbm")
     return (rec.get("methodology") == "r9_stream_intraday_v1"
             and isinstance(stream.get("updates"), int)
             and stream["updates"] > 0
             and stream.get("compiles_during_load") == 0
-            and stream.get("parity_mismatched") == [])
+            and stream.get("parity_mismatched") == []
+            and isinstance(hbm, dict) and "available" in hbm)
 
 
 def step_ladder():

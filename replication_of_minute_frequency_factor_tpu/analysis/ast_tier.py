@@ -39,8 +39,13 @@ from .violations import Violation
 
 #: layers whose modules must stay free of serial loops (GL-A2)
 LOOP_SCOPE = ("ops", "models")
-#: layers whose modules must stay free of host syncs (GL-A3)
-HOST_SYNC_SCOPE = ("ops", "models", "parallel", "serve")
+#: layers whose modules must stay free of host syncs (GL-A3).
+#: ``telemetry`` joined with ISSUE 8: the ops plane's sampler thread
+#: reads device memory from host code, and those reads
+#: (``.memory_stats()`` / ``jax.live_arrays``) must stay confined to
+#: its declared boundary module, not leak into instrumented hot paths.
+HOST_SYNC_SCOPE = ("ops", "models", "parallel", "serve", "stream",
+                   "telemetry")
 #: layer where raw jnp reductions are banned in favour of ops.masked (GL-A5)
 MASKED_SCOPE = ("models",)
 
@@ -49,12 +54,18 @@ MASKED_SCOPE = ("models",)
 #: here, per (package-relative module path -> allowed symbols). This is
 #: deliberately NOT a path exclusion: any sync symbol a boundary module
 #: uses beyond its listed set still flags, and every other module in
-#: the layer keeps the full rule. The one current entry is the serving
-#: request loop, whose single declared sync is the ``np.asarray`` that
+#: the layer keeps the full rule. Two entries: the serving request
+#: loop, whose single declared sync is the ``np.asarray`` that
 #: materializes a query's answer from the device block
-#: (serve/service.py — the serve layer's host/device boundary).
+#: (serve/service.py — the serve layer's host/device boundary), and
+#: the ops-plane watermark sampler (ISSUE 8), whose declared host
+#: reads are the device-memory introspection calls its sampler thread
+#: makes (telemetry/opsplane.py — the only module allowed to touch
+#: ``.memory_stats()`` / ``jax.live_arrays``).
 GLA3_BOUNDARY_SYNCS = {
     "serve/service.py": frozenset({"np.asarray"}),
+    "telemetry/opsplane.py": frozenset({".memory_stats()",
+                                        "jax.live_arrays"}),
 }
 
 #: (acquire, release) method-name pairs for GL-A4
@@ -282,6 +293,10 @@ def _rule_a3(scan: _ModuleScan, node: ast.AST,
     msg = ("host-device synchronization in a device-hot module blocks "
            "the dispatch pipeline; move it to a bench/telemetry/CLI "
            "layer or fetch explicitly via jax.device_get there")
+    mem_msg = ("device-memory introspection is a host read of backend "
+               "state; route it through telemetry.opsplane.HbmSampler "
+               "(the declared boundary module) so rate limiting and "
+               "graceful degradation are centralized")
     if isinstance(node.func, ast.Attribute):
         if node.func.attr == "item" and not node.args:
             _a3_add(scan, node, ".item()", msg)
@@ -289,9 +304,17 @@ def _rule_a3(scan: _ModuleScan, node: ast.AST,
         if node.func.attr == "block_until_ready":
             _a3_add(scan, node, ".block_until_ready()", msg)
             return
+        # ISSUE 8: device-memory host reads are boundary-module-only
+        if node.func.attr in ("memory_stats", "live_buffers") \
+                and not node.args:
+            _a3_add(scan, node, f".{node.func.attr}()", mem_msg)
+            return
     dotted, name = _call_target(scan, node)
     if dotted == "numpy" and name in ("asarray", "array"):
         _a3_add(scan, node, f"np.{name}", msg)
+        return
+    if dotted == "jax" and name == "live_arrays":
+        _a3_add(scan, node, "jax.live_arrays", mem_msg)
         return
     if (isinstance(node.func, ast.Name) and node.func.id in ("float",
                                                              "int")

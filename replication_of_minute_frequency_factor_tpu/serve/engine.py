@@ -126,9 +126,16 @@ class ServeEngine:
         self.replicate_quirks = replicate_quirks
         self.rolling_impl = (rolling_impl if rolling_impl is not None
                              else get_config().rolling_impl)
+        self.telemetry = telemetry
         self.executables = (executables if executables is not None
                             else ExecutableCache(telemetry=telemetry))
         self._floor: dict = {}
+
+    def _tel(self):
+        if self.telemetry is not None:
+            return self.telemetry
+        from ..telemetry import get_telemetry
+        return get_telemetry()
 
     # --- block build ----------------------------------------------------
     def build_block(self, bars: np.ndarray,
@@ -153,7 +160,12 @@ class ServeEngine:
                                      self.replicate_quirks,
                                      self.rolling_impl))
         exposures, close, valid = compiled(dbuf)
-        return {"exposures": exposures, "close": close, "valid": valid}
+        block = {"exposures": exposures, "close": close, "valid": valid}
+        # device bytes this block pins (shape metadata, not a sync):
+        # the HBM signal the exposure-cache LRU budget is set against
+        self._tel().gauge("serve.block_bytes", sum(
+            int(getattr(v, "nbytes", 0) or 0) for v in block.values()))
+        return block
 
     # --- queries (device in, device out) --------------------------------
     def row(self, name: str) -> int:

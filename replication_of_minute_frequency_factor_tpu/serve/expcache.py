@@ -67,6 +67,12 @@ class DeviceExposureCache:
         tel = self._tel()
         tel.gauge("serve.cache_bytes", self._bytes)
         tel.gauge("serve.cache_entries", len(self._entries))
+        # budget + headroom ride along (ISSUE 8): with the
+        # device.hbm_* watermarks they answer "is the LRU budget sized
+        # to the memory actually available" from one scrape
+        tel.gauge("serve.cache_budget_bytes", self.byte_budget)
+        tel.gauge("serve.cache_headroom_bytes",
+                  max(0, self.byte_budget - self._bytes))
 
     # --- read/write -----------------------------------------------------
     def get(self, key: Hashable) -> Optional[Dict[str, object]]:

@@ -20,18 +20,35 @@ Endpoints:
   "present": [[bool×T]×B]}`` advances the streaming carry by ``B``
   minutes; -> ``{"minute", "bars"}``. Same error mapping as query
   (the JSON body bound is wider: a full universe-minute is big).
-* ``GET /healthz`` — liveness + breaker state (+ the stream carry's
-  minute cursor when streaming is on).
-* ``GET /v1/metrics`` — the telemetry registry snapshot (JSON).
+* ``POST /v1/debug/dump`` — on-demand flight-recorder capture
+  (ISSUE 8): dumps the request ring + last-dispatch metadata +
+  registry counter deltas; -> ``{"path", "requests"}`` (409 when no
+  dump directory is configured anywhere).
+* ``GET /healthz`` — liveness: breaker state, uptime, queue depth,
+  flight-recorder counts, HBM-stats availability (+ the stream
+  carry's minute cursor when streaming is on).
+* ``GET /v1/metrics`` — the telemetry registry: JSON snapshot by
+  default; the standard Prometheus text format (v0.0.4) when the
+  request asks for it (``Accept: text/plain`` / ``application/
+  openmetrics-text``, or ``?format=prometheus``) — scrapeable by
+  stock tooling (ISSUE 8).
+
+Request tracing (ISSUE 8): ``POST /v1/query`` and ``POST /v1/ingest``
+accept an ``X-Trace-Id`` header (``[A-Za-z0-9._-]{1,64}``; anything
+else is replaced at admission) and every response — success or error —
+echoes the request's effective trace ID back in the same header, so a
+client can join its own logs to the server's span/request records.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
+from ..telemetry.opsplane import canonical_trace_id, to_prometheus
 from .service import FactorServer, LoadShedError, Query
 
 #: request-body bound (a factors query is a few hundred bytes)
@@ -50,45 +67,90 @@ def _make_handler(server: FactorServer, timeout: Optional[float]):
         def log_message(self, fmt, *args):  # quiet by default
             pass
 
-        def _reply(self, code: int, payload: dict) -> None:
-            body = json.dumps(payload).encode()
+        def _reply(self, code: int, payload: dict,
+                   trace_id: Optional[str] = None) -> None:
+            self._reply_bytes(code, json.dumps(payload).encode(),
+                              "application/json", trace_id)
+
+        def _reply_bytes(self, code: int, body: bytes,
+                         content_type: str,
+                         trace_id: Optional[str] = None) -> None:
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            if trace_id:
+                self.send_header("X-Trace-Id", trace_id)
             self.end_headers()
             self.wfile.write(body)
 
+        def _trace_id(self) -> str:
+            """The request's effective trace ID: the propagated
+            ``X-Trace-Id`` when well-formed, else freshly generated —
+            the SAME canonicalization the server applies at admission,
+            so the echoed header and the recorded ID always agree."""
+            return canonical_trace_id(self.headers.get("X-Trace-Id"))
+
         def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
-            if self.path == "/healthz":
-                with server._state_lock:
-                    open_until = server._open_until
-                    consecutive = server._consecutive
-                payload = {
-                    "ok": True, "factors": len(server.names),
-                    "days": server.source.n_days,
-                    "breaker_open": open_until is not None,
-                    "breaker_consecutive_failures": consecutive}
-                if server.stream_engine is not None:
-                    payload["stream_minute"] = \
-                        server.stream_engine.minutes
-                self._reply(200, payload)
+            parsed = urllib.parse.urlparse(self.path)
+            if parsed.path == "/healthz":
+                self._reply(200, self._health_payload())
                 return
-            if self.path == "/v1/metrics":
-                self._reply(200, server.telemetry.registry.snapshot())
+            if parsed.path == "/v1/metrics":
+                accept = self.headers.get("Accept", "")
+                query = urllib.parse.parse_qs(parsed.query)
+                want_text = ("text/plain" in accept
+                             or "openmetrics" in accept
+                             or query.get("format", [""])[0]
+                             == "prometheus")
+                if want_text:
+                    body = to_prometheus(
+                        server.telemetry.registry).encode()
+                    self._reply_bytes(
+                        200, body,
+                        "text/plain; version=0.0.4; charset=utf-8")
+                else:
+                    self._reply(200,
+                                server.telemetry.registry.snapshot())
                 return
             self._reply(404, {"error": f"no route {self.path}"})
+
+        def _health_payload(self) -> dict:
+            import time as _time
+            with server._state_lock:
+                open_until = server._open_until
+                consecutive = server._consecutive
+            hbm = server.telemetry.hbm.sample("healthz")
+            payload = {
+                "ok": True, "factors": len(server.names),
+                "days": server.source.n_days,
+                "breaker_open": open_until is not None,
+                "breaker_consecutive_failures": consecutive,
+                "uptime_s": round(_time.monotonic() - server._t_start,
+                                  3),
+                "queue_depth": server._q.qsize(),
+                "flight": {"requests": len(server.flight),
+                           "dumps": server.flight.dump_count},
+                "hbm_available": bool(hbm.get("available")),
+            }
+            if server.stream_engine is not None:
+                payload["stream_minute"] = server.stream_engine.minutes
+            return payload
 
         def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
             if self.path == "/v1/ingest":
                 self._post_ingest()
                 return
+            if self.path == "/v1/debug/dump":
+                self._post_dump()
+                return
             if self.path != "/v1/query":
                 self._reply(404, {"error": f"no route {self.path}"})
                 return
+            tid = self._trace_id()
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 if length > MAX_BODY_BYTES:
-                    self._reply(413, {"error": "body too large"})
+                    self._reply(413, {"error": "body too large"}, tid)
                     return
                 doc = json.loads(self.rfile.read(length) or b"{}")
                 q = Query(
@@ -101,48 +163,67 @@ def _make_handler(server: FactorServer, timeout: Optional[float]):
                     horizon=int(doc.get("horizon", 1)),
                     group_num=int(doc.get("group_num", 5)))
             except (ValueError, TypeError, json.JSONDecodeError) as e:
-                self._reply(400, {"error": f"malformed request: {e}"})
+                self._reply(400, {"error": f"malformed request: {e}"},
+                            tid)
                 return
             try:
-                fut = server.submit(q)
+                fut = server.submit(q, trace_id=tid)
             except LoadShedError as e:
-                self._reply(503, {"error": str(e), "shed": True})
+                self._reply(503, {"error": str(e), "shed": True}, tid)
                 return
             except ValueError as e:
-                self._reply(400, {"error": str(e)})
+                self._reply(400, {"error": str(e)}, tid)
                 return
             try:
-                self._reply(200, fut.result(timeout))
+                self._reply(200, fut.result(timeout), tid)
             except Exception as e:  # noqa: BLE001 — dispatch failure
-                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"},
+                            tid)
 
         def _post_ingest(self):
             # no numpy here: the JSON lists go to the server verbatim
             # and service.py (the declared GL-A3 boundary module) owns
             # the array conversion + shape validation
+            tid = self._trace_id()
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 if length > MAX_INGEST_BODY_BYTES:
-                    self._reply(413, {"error": "body too large"})
+                    self._reply(413, {"error": "body too large"}, tid)
                     return
                 doc = json.loads(self.rfile.read(length) or b"{}")
                 bars, present = doc["bars"], doc["present"]
             except (KeyError, ValueError, TypeError,
                     json.JSONDecodeError) as e:
-                self._reply(400, {"error": f"malformed ingest: {e}"})
+                self._reply(400, {"error": f"malformed ingest: {e}"},
+                            tid)
                 return
             try:
-                fut = server.ingest(bars, present)
+                fut = server.ingest(bars, present, trace_id=tid)
             except LoadShedError as e:
-                self._reply(503, {"error": str(e), "shed": True})
+                self._reply(503, {"error": str(e), "shed": True}, tid)
                 return
             except ValueError as e:
-                self._reply(400, {"error": str(e)})
+                self._reply(400, {"error": str(e)}, tid)
                 return
             try:
-                self._reply(200, fut.result(timeout))
+                self._reply(200, fut.result(timeout), tid)
             except Exception as e:  # noqa: BLE001 — dispatch failure
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"},
+                            tid)
+
+        def _post_dump(self):
+            try:
+                path = server.debug_dump()
+            except Exception as e:  # noqa: BLE001 — dump is best-effort
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            if path is None:
+                self._reply(409, {"error": "no flight dump directory "
+                                           "configured "
+                                           "(ServeConfig.flight_dir)"})
+                return
+            self._reply(200, {"path": path,
+                              "requests": len(server.flight)})
 
     return Handler
 

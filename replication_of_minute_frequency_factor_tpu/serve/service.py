@@ -42,6 +42,7 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..telemetry.opsplane import FlightRecorder, canonical_trace_id
 from .engine import ServeEngine
 from .executables import ExecutableCache
 from .expcache import DeviceExposureCache
@@ -89,6 +90,12 @@ class _Pending:
     query: Query
     future: Future
     t_enqueue: float
+    #: request-scoped trace ID (ISSUE 8): generated at admission or
+    #: propagated from the caller (``X-Trace-Id`` / ``trace_id=``)
+    trace_id: str = ""
+    #: admission timestamp on the perf_counter clock — the span
+    #: tracer's timebase, for explicit lifecycle span events
+    t_pc: float = 0.0
 
 
 @dataclasses.dataclass
@@ -106,6 +113,13 @@ class ServeConfig:
     breaker_threshold: int = 3
     #: seconds the open breaker sheds before the half-open probe
     breaker_cooldown_s: float = 1.0
+    #: flight-recorder ring bound (recent request traces; ISSUE 8)
+    flight_ring: int = 256
+    #: where anomaly dumps land (None = ring-only, no files written)
+    flight_dir: Optional[str] = None
+    #: HBM watermark sampler thread period (0 disables the thread;
+    #: dispatch-boundary sampling stays on either way)
+    hbm_sample_period_s: float = 0.5
 
 
 class FactorServer:
@@ -159,6 +173,15 @@ class FactorServer:
         self._open_until: Optional[float] = None
         self._closed = False
         self._thread: Optional[threading.Thread] = None
+        #: ops plane (ISSUE 8): flight recorder for anomaly capture +
+        #: the telemetry-bound HBM watermark sampler
+        self.flight = FlightRecorder(telemetry=self.telemetry,
+                                     ring=self.scfg.flight_ring,
+                                     dump_dir=self.scfg.flight_dir)
+        self._t_start = time.monotonic()
+        self._dispatch_seq = 0  # worker-thread-only; no lock needed
+        if self.scfg.hbm_sample_period_s > 0:
+            self.telemetry.hbm.start(self.scfg.hbm_sample_period_s)
         if start:
             self.start()
 
@@ -178,6 +201,14 @@ class FactorServer:
         if self._thread is not None and self._thread.is_alive():
             self._q.put(_SENTINEL)
             self._thread.join(timeout)
+        if self.scfg.hbm_sample_period_s > 0:
+            self.telemetry.hbm.stop()
+
+    def debug_dump(self, out_dir: Optional[str] = None) -> Optional[str]:
+        """On-demand flight-recorder capture (``POST /v1/debug/dump``):
+        dump the ring + last-dispatch metadata + counter deltas now.
+        Returns the dump path (None when no directory is configured)."""
+        return self.flight.dump("manual", out_dir=out_dir, force=True)
 
     def __enter__(self) -> "FactorServer":
         return self
@@ -221,17 +252,21 @@ class FactorServer:
             if q.kind == "decile" and q.group_num < 2:
                 raise ValueError("group_num must be >= 2")
 
-    def submit(self, q: Query) -> Future:
+    def submit(self, q: Query,
+               trace_id: Optional[str] = None) -> Future:
         """Enqueue; returns a Future resolving to the answer dict.
         Raises :class:`LoadShedError` immediately when shedding (open
         breaker / full queue) and ``ValueError`` on a malformed query —
-        validation cost stays on the caller's thread."""
+        validation cost stays on the caller's thread. ``trace_id``
+        propagates a caller-assigned request trace ID (ISSUE 8); None
+        generates one at admission. The answer dict carries it back."""
         if self._closed:
             raise RuntimeError("server is closed")
         self._validate(q)
-        return self._enqueue(q, q.kind)
+        return self._enqueue(q, q.kind, trace_id)
 
-    def ingest(self, bars, present) -> Future:
+    def ingest(self, bars, present,
+               trace_id: Optional[str] = None) -> Future:
         """Enqueue minute bars for the streaming carry: ``bars
         [B, T, 5]`` f32 / ``present [B, T]`` bool advance the resident
         day by ``B`` minutes through the request queue (so ordering
@@ -254,16 +289,22 @@ class FactorServer:
             raise ValueError(
                 f"got {present.shape[1]} tickers; the stream engine "
                 f"holds {self.stream_engine.n_tickers}")
-        return self._enqueue(Ingest(bars, present), "ingest")
+        return self._enqueue(Ingest(bars, present), "ingest", trace_id)
 
-    def _enqueue(self, item, kind: str) -> Future:
-        """Shed gate + enqueue shared by queries and ingests."""
+    def _enqueue(self, item, kind: str,
+                 trace_id: Optional[str] = None) -> Future:
+        """Shed gate + enqueue shared by queries and ingests. Every
+        admitted request gets its trace ID HERE (propagated when the
+        caller supplied a well-formed one, generated otherwise) — the
+        single admission point, so no request can cross the queue
+        anonymously."""
         tel = self.telemetry
         now = time.monotonic()
         with self._state_lock:
             if self._open_until is not None:
                 if now < self._open_until:
                     tel.counter("serve.load_shed", reason="breaker")
+                    self.flight.note_shed("breaker")
                     raise LoadShedError(
                         "breaker open after "
                         f"{self._consecutive} consecutive dispatch "
@@ -271,11 +312,14 @@ class FactorServer:
                 # half-open: this request is the probe; keep the gate up
                 # for everyone else until it succeeds
                 self._open_until = now + self.scfg.breaker_cooldown_s
-        pending = _Pending(item, Future(), now)
+        pending = _Pending(item, Future(), now,
+                           trace_id=canonical_trace_id(trace_id),
+                           t_pc=time.perf_counter())
         try:
             self._q.put_nowait(pending)
         except queue.Full:
             tel.counter("serve.load_shed", reason="queue_full")
+            self.flight.note_shed("queue_full")
             raise LoadShedError(
                 f"request queue full ({self.scfg.queue_limit})") from None
         tel.counter("serve.requests", kind=kind)
@@ -290,6 +334,7 @@ class FactorServer:
     # --- breaker --------------------------------------------------------
     def _breaker_failure(self) -> None:
         tel = self.telemetry
+        tripped = False
         with self._state_lock:
             self._consecutive += 1
             tel.gauge("serve.breaker_consecutive_failures",
@@ -298,6 +343,12 @@ class FactorServer:
                 self._open_until = (time.monotonic()
                                     + self.scfg.breaker_cooldown_s)
                 tel.counter("serve.breaker_trips")
+                tripped = True
+        if tripped:
+            # flight-recorder anomaly capture (ISSUE 8): the ring holds
+            # the failed requests' traces at this moment — dump outside
+            # the state lock, forced (trips are rare by construction)
+            self.flight.dump("breaker_trip", force=True)
 
     def _breaker_ok(self) -> None:
         with self._state_lock:
@@ -305,8 +356,60 @@ class FactorServer:
             self._open_until = None
         self.telemetry.gauge("serve.breaker_consecutive_failures", 0)
 
+    # --- request-lifecycle recording (ISSUE 8) --------------------------
+    def _complete(self, p: _Pending, op: str, status: str,
+                  dispatch_id: int, group_size: int, block_s: float,
+                  answer_s: float, t_dispatch: float,
+                  error: Optional[BaseException] = None) -> None:
+        """Close out one request's trace: emit the schema-v2 lifecycle
+        record (admission → queue-wait → dispatch → answer), fan the
+        coalesced dispatch's device time back to this member's trace ID
+        as explicit span events, and feed the flight-recorder ring."""
+        tel = self.telemetry
+        now = time.monotonic()
+        queue_wait = max(0.0, t_dispatch - p.t_enqueue)
+        total = now - p.t_enqueue
+        share = block_s / group_size if group_size else block_s
+        data = {
+            "queue_wait_s": round(queue_wait, 6),
+            "dispatch_id": dispatch_id,
+            "group_size": group_size,
+            "coalesced": group_size > 1,
+            "block_s": round(block_s, 6),
+            "device_share_s": round(share, 6),
+            "answer_s": round(answer_s, 6),
+            "total_s": round(total, 6),
+        }
+        if error is not None:
+            data["error"] = f"{type(error).__name__}: {error}"
+        trace = {"trace_id": p.trace_id, "op": op, "status": status,
+                 "data": data}
+        tel.request(trace)
+        self.flight.record_request(trace)
+        tr = tel.tracer
+        tr.add_span("serve.queue_wait", p.t_pc, queue_wait,
+                    trace_id=p.trace_id)
+        tr.add_span("serve.dispatch_share", p.t_pc + queue_wait, share,
+                    trace_id=p.trace_id)
+        tr.add_span("serve.request", p.t_pc, total,
+                    trace_id=p.trace_id, kind=op)
+
+    def _next_dispatch(self) -> int:
+        self._dispatch_seq += 1
+        return self._dispatch_seq
+
     # --- worker ---------------------------------------------------------
     def _worker(self) -> None:
+        try:
+            self._worker_loop()
+        except BaseException:
+            # an exception ESCAPING the loop (per-request failures are
+            # contained above) would kill the worker silently — capture
+            # the last moments first (ISSUE 8)
+            self.flight.dump("worker_exception", force=True)
+            raise
+
+    def _worker_loop(self) -> None:
         while True:
             item = self._q.get()
             if item is _SENTINEL:
@@ -356,23 +459,35 @@ class FactorServer:
         dispatch). A failed ingest fails only its own future but bumps
         the breaker — a stuck feed must shed, not queue unboundedly."""
         tel = self.telemetry
-        with tel.tracer("serve.ingest"):
+        did = self._next_dispatch()
+        t_dispatch = time.monotonic()
+        with tel.tracer("serve.ingest", trace_id=p.trace_id):
             try:
                 t0 = time.perf_counter()
                 self.stream_engine.ingest_minutes(p.query.bars,
                                                   p.query.present)
-                tel.observe("serve.stage_seconds",
-                            time.perf_counter() - t0, stage="ingest")
+                ingest_s = time.perf_counter() - t0
+                tel.observe("serve.stage_seconds", ingest_s,
+                            stage="ingest")
             except Exception as e:  # noqa: BLE001 — per-request + breaker
                 p.future.set_exception(e)
                 tel.counter("serve.failures", stage="ingest")
+                self._complete(p, "ingest", "error", did, 1,
+                               time.perf_counter() - t0, 0.0,
+                               t_dispatch, error=e)
                 self._breaker_failure()
                 return
             p.future.set_result({
+                "trace_id": p.trace_id,
                 "minute": self.stream_engine.minutes,
                 "bars": int(p.query.present.sum())})
             tel.observe("serve.request_seconds",
                         time.monotonic() - p.t_enqueue, kind="ingest")
+            self._complete(p, "ingest", "ok", did, 1, ingest_s, 0.0,
+                           t_dispatch)
+        self.flight.note_dispatch({"dispatch_id": did, "op": "ingest",
+                                   "minute": self.stream_engine.minutes})
+        tel.hbm.sample("serve.ingest")
         self._breaker_ok()
 
     def _dispatch_intraday(self, group: list) -> None:
@@ -381,18 +496,25 @@ class FactorServer:
         the block path, over the live carry instead of a cached
         block."""
         tel = self.telemetry
+        did = self._next_dispatch()
         t_dispatch = time.monotonic()
         with tel.tracer("serve.dispatch"):
+            block_s = 0.0
             try:
                 t0 = time.perf_counter()
                 exposures, ready = self.stream_engine.snapshot()
                 exp = np.asarray(exposures)   # the boundary sync
                 rdy = np.asarray(ready)
-                tel.observe("serve.stage_seconds",
-                            time.perf_counter() - t0, stage="block")
+                block_s = time.perf_counter() - t0
+                tel.observe("serve.stage_seconds", block_s,
+                            stage="block")
             except Exception as e:  # noqa: BLE001 — fail the group, shed
+                block_s = time.perf_counter() - t0
                 for p in group:
                     p.future.set_exception(e)
+                    self._complete(p, "intraday", "error", did,
+                                   len(group), block_s, 0.0, t_dispatch,
+                                   error=e)
                 tel.counter("serve.failures", stage="block")
                 self._breaker_failure()
                 return
@@ -409,16 +531,28 @@ class FactorServer:
                 except Exception as e:  # noqa: BLE001 — per-request
                     p.future.set_exception(e)
                     tel.counter("serve.failures", stage="answer")
+                    self._complete(p, "intraday", "error", did,
+                                   len(group), block_s,
+                                   time.perf_counter() - t0,
+                                   t_dispatch, error=e)
                     ok = False
                     continue
+                result["trace_id"] = p.trace_id
                 p.future.set_result(result)
                 now = time.monotonic()
-                tel.observe("serve.stage_seconds",
-                            time.perf_counter() - t0, stage="answer")
+                answer_s = time.perf_counter() - t0
+                tel.observe("serve.stage_seconds", answer_s,
+                            stage="answer")
                 tel.observe("serve.stage_seconds",
                             t_dispatch - p.t_enqueue, stage="queue_wait")
                 tel.observe("serve.request_seconds", now - p.t_enqueue,
                             kind="intraday")
+                self._complete(p, "intraday", "ok", did, len(group),
+                               block_s, answer_s, t_dispatch)
+        self.flight.note_dispatch({"dispatch_id": did, "op": "intraday",
+                                   "group_size": len(group),
+                                   "block_s": round(block_s, 6)})
+        tel.hbm.sample("serve.dispatch")
         if ok:
             self._breaker_ok()
         else:
@@ -444,21 +578,30 @@ class FactorServer:
         coalescing contract. A block failure fails the whole group and
         bumps the breaker once."""
         tel = self.telemetry
+        did = self._next_dispatch()
         t_dispatch = time.monotonic()
         with tel.tracer("serve.dispatch"):
+            block_s = 0.0
+            cached = False
             try:
                 t0 = time.perf_counter()
                 block = self.cache.get(key)
+                cached = block is not None
                 if block is None:
                     bars, mask = self.source.slab(*key)
                     block = self.engine.build_block(bars, mask)
                     self.cache.put(key, block)
                     tel.counter("serve.dispatches")
-                tel.observe("serve.stage_seconds",
-                            time.perf_counter() - t0, stage="block")
+                block_s = time.perf_counter() - t0
+                tel.observe("serve.stage_seconds", block_s,
+                            stage="block")
             except Exception as e:  # noqa: BLE001 — fail the group, shed
+                block_s = time.perf_counter() - t0
                 for p in group:
                     p.future.set_exception(e)
+                    self._complete(p, p.query.kind, "error", did,
+                                   len(group), block_s, 0.0, t_dispatch,
+                                   error=e)
                 tel.counter("serve.failures", stage="block")
                 self._breaker_failure()
                 return
@@ -474,16 +617,29 @@ class FactorServer:
                 except Exception as e:  # noqa: BLE001 — per-request
                     p.future.set_exception(e)
                     tel.counter("serve.failures", stage="answer")
+                    self._complete(p, p.query.kind, "error", did,
+                                   len(group), block_s,
+                                   time.perf_counter() - t0,
+                                   t_dispatch, error=e)
                     ok = False
                     continue
+                result["trace_id"] = p.trace_id
                 p.future.set_result(result)
                 now = time.monotonic()
-                tel.observe("serve.stage_seconds",
-                            time.perf_counter() - t0, stage="answer")
+                answer_s = time.perf_counter() - t0
+                tel.observe("serve.stage_seconds", answer_s,
+                            stage="answer")
                 tel.observe("serve.stage_seconds",
                             t_dispatch - p.t_enqueue, stage="queue_wait")
                 tel.observe("serve.request_seconds", now - p.t_enqueue,
                             kind=p.query.kind)
+                self._complete(p, p.query.kind, "ok", did, len(group),
+                               block_s, answer_s, t_dispatch)
+        self.flight.note_dispatch({
+            "dispatch_id": did, "op": "block", "key": list(key),
+            "group_size": len(group), "cache_hit": cached,
+            "block_s": round(block_s, 6)})
+        tel.hbm.sample("serve.dispatch")
         if ok:
             self._breaker_ok()
         else:

@@ -185,6 +185,9 @@ class StreamEngine:
         tel.counter("stream.bars", n_bars)
         self.minutes += b
         self._note_carry()
+        # HBM watermark at the ingest dispatch boundary (ISSUE 8;
+        # rate-limited inside the sampler, never raises)
+        tel.hbm.sample("stream.ingest")
 
     def ingest_cohort(self, rows: np.ndarray, idx: np.ndarray) -> None:
         """Scatter ``K`` tickers' bars at the current minute (host
@@ -205,6 +208,7 @@ class StreamEngine:
                     time.perf_counter() - t0, kind="cohort")
         tel.counter("stream.updates", kind="cohort")
         tel.counter("stream.bars", n_real)
+        tel.hbm.sample("stream.ingest")
 
     def advance(self) -> None:
         """Close the current minute (cohort path's minute boundary)."""
@@ -230,4 +234,5 @@ class StreamEngine:
         self.telemetry.observe("stream.snapshot_seconds",
                                time.perf_counter() - t0)
         self.telemetry.counter("stream.snapshots")
+        self.telemetry.hbm.sample("stream.snapshot")
         return exposures, ready

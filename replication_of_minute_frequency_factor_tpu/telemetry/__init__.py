@@ -31,19 +31,25 @@ from typing import Dict, List, Optional
 
 from ..utils.tracing import Timer
 from .attribution import TraceCapture, reconcile
+from .opsplane import (FlightRecorder, HbmSampler, canonical_trace_id,
+                       gen_trace_id, to_prometheus)
 from .registry import Histogram, MetricsRegistry, render_key
 from .sink import SCHEMA_VERSION, EventSink, validate_jsonl, validate_record
 from .spans import SpanTracer
 
 __all__ = [
-    "SCHEMA_VERSION", "EventSink", "Histogram", "MetricsRegistry",
-    "SpanTracer", "StageTimer", "Telemetry", "TraceCapture",
+    "SCHEMA_VERSION", "EventSink", "FlightRecorder", "HbmSampler",
+    "Histogram", "MetricsRegistry", "SpanTracer", "StageTimer",
+    "Telemetry", "TraceCapture", "canonical_trace_id", "gen_trace_id",
     "get_telemetry", "reconcile", "render_key", "set_telemetry",
-    "validate_jsonl", "validate_record",
+    "to_prometheus", "validate_jsonl", "validate_record",
 ]
 
 #: retained free-form events bound (events past it count, not retain)
 MAX_FREE_EVENTS = 5000
+
+#: retained request-lifecycle records bound (ISSUE 8)
+MAX_REQUEST_RECORDS = 20000
 
 
 class StageTimer(Timer):
@@ -86,7 +92,22 @@ class Telemetry:
                                  annotate=annotate_spans)
         self._events: List[dict] = []
         self._events_dropped = 0
+        self._requests: List[dict] = []
+        self._requests_dropped = 0
+        self._hbm: Optional[HbmSampler] = None
         self._lock = threading.Lock()
+
+    @property
+    def hbm(self) -> HbmSampler:
+        """The device-memory watermark sampler bound to this telemetry
+        (created on first use; ISSUE 8). Hot paths call
+        ``tel.hbm.sample("<boundary>")`` — rate-limited and
+        never-raising by contract."""
+        if self._hbm is None:
+            with self._lock:
+                if self._hbm is None:
+                    self._hbm = HbmSampler(telemetry=self)
+        return self._hbm
 
     # --- emit -----------------------------------------------------------
     def counter(self, name: str, value: float = 1.0, **labels) -> None:
@@ -115,6 +136,17 @@ class Telemetry:
                                      "data": data})
             else:
                 self._events_dropped += 1
+
+    def request(self, trace: dict) -> None:
+        """One request's lifecycle record (ISSUE 8): ``{"trace_id",
+        "op", "status", "data": {...}}`` — persisted as a schema-v2
+        ``request`` record by :meth:`write`, so a single slow request
+        is reconstructible from the bundle (bounded retention)."""
+        with self._lock:
+            if len(self._requests) < MAX_REQUEST_RECORDS:
+                self._requests.append(dict(trace))
+            else:
+                self._requests_dropped += 1
 
     # --- persist --------------------------------------------------------
     def write(self, out_dir: str, cfg=None,
@@ -154,8 +186,15 @@ class Telemetry:
                 sink.emit("span", **ev)
             with self._lock:
                 events = list(self._events)
+                requests = list(self._requests)
             for ev in events:
                 sink.emit("event", name=ev["name"], data=ev["data"])
+            for tr in requests:
+                sink.emit("request",
+                          trace_id=str(tr.get("trace_id", "")),
+                          op=str(tr.get("op", "")),
+                          status=str(tr.get("status", "")),
+                          data=dict(tr.get("data") or {}))
         self.tracer.write_chrome_trace(paths["trace"])
         return paths
 
@@ -178,7 +217,8 @@ class Telemetry:
                     lines.append(
                         f"    {k}: p50={st['p50']:.4g} p95={st['p95']:.4g}"
                         f" max={st['max']:.4g} n={st['count']}")
-        dropped = self.tracer.dropped_spans + self._events_dropped
+        dropped = (self.tracer.dropped_spans + self._events_dropped
+                   + self._requests_dropped)
         if dropped:
             lines.append(f"  ({dropped} spans/events dropped past "
                          "retention bounds)")

@@ -93,6 +93,20 @@ class Histogram:
                 "p50": self.percentile(0.50),
                 "p95": self.percentile(0.95)}
 
+    def copy(self) -> "Histogram":
+        """Independent snapshot of this histogram's state — taken under
+        the owning registry's lock so a concurrent ``observe`` on the
+        source cannot tear the copy (ISSUE 8 thread-safety audit)."""
+        h = Histogram(self.bound)
+        h.count = self.count
+        h.total = self.total
+        h.min = self.min
+        h.max = self.max
+        h._samples = list(self._samples)
+        h._stride = self._stride
+        h._seen = self._seen
+        return h
+
     def merge(self, other: "Histogram") -> None:
         self.count += other.count
         self.total += other.total
@@ -187,11 +201,15 @@ class MetricsRegistry:
 
     def merge(self, other: "MetricsRegistry") -> "MetricsRegistry":
         """Fold ``other`` into self: counters sum, gauges last-write-wins
-        (``other`` is the later writer), histograms combine."""
+        (``other`` is the later writer), histograms combine. Histogram
+        state is deep-copied under ``other``'s lock — the ISSUE 8
+        thread-safety audit found the previous shallow dict copy let a
+        concurrent ``observe`` on ``other`` mutate a histogram while
+        this side merged its sample list."""
         with other._lock:
             counters = dict(other._counters)
             gauges = dict(other._gauges)
-            hists = dict(other._hists)
+            hists = {k: h.copy() for k, h in other._hists.items()}
         with self._lock:
             for k, v in counters.items():
                 self._counters[k] = self._counters.get(k, 0.0) + v

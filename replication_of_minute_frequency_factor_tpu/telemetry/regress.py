@@ -42,6 +42,14 @@ smeared):
   ingest is a new workload, so its records start their own
   baseline).
 
+Derived sub-series (ISSUE 8): each bench record additionally
+contributes ``<metric>.request_p99_ms`` (its end-to-end request-latency
+tail) and, when the record's ``hbm.available`` is true,
+``<metric>.hbm_peak_bytes`` (the device-memory high watermark) as their
+own gateable groups under the parent's methodology — see
+:func:`derive_records`. A CPU fallback's live-arrays estimate
+(``available: false``) never seeds or gates an HBM baseline.
+
 Baseline = median of every record in the group EXCEPT the latest; the
 latest is the record under test. ``--check FILE`` instead gates a fresh
 candidate record against the baseline of the FULL banked group (the
@@ -134,6 +142,14 @@ def load_bench_series(root: str) -> List[dict]:
             n = int(m.group(1)) if m else 0
         entries.append({"n": n, "source": os.path.basename(path),
                         "record": rec})
+        # derived sub-series (ISSUE 8) join the trajectory as their own
+        # (metric, methodology) groups — same banked file, own baseline
+        for drec in derive_records(rec):
+            entries.append({
+                "n": n,
+                "source": (os.path.basename(path) + "#"
+                           + drec["derived_from"]),
+                "record": drec})
     entries.sort(key=lambda e: (e["n"], e["source"]))
     return entries
 
@@ -193,6 +209,47 @@ def find_metrics_jsonl(path: str, max_depth: int = 3) -> List[str]:
 def effective_methodology(record: dict) -> str:
     m = record.get("methodology")
     return str(m) if m else LEGACY_METHODOLOGY
+
+
+def derive_records(record: dict) -> List[dict]:
+    """Gateable sub-series lifted out of one bench record (ISSUE 8):
+
+    * ``<metric>.request_p99_ms`` — the record's ``p99_ms`` (the
+      serve/stream end-to-end request-latency distribution's tail; a
+      QPS headline that holds while p99 doubles is a regression the
+      top-line ``value`` cannot see);
+    * ``<metric>.hbm_peak_bytes`` — the record's ``hbm.peak_bytes``
+      watermark, ONLY when ``hbm.available`` is true (a live-arrays
+      estimate from a CPU fallback must never gate against — or seed —
+      a measured HBM baseline).
+
+    Derived records inherit the parent's methodology, so they ride the
+    existing per-(metric, methodology) machinery unchanged: the first
+    record of a new series is a declared break (reported, not
+    flagged), later ones gate at the same tolerance.
+    """
+    out: List[dict] = []
+    metric = record.get("metric")
+    if not isinstance(metric, str) or not metric:
+        return out
+    meth = effective_methodology(record)
+    p99 = record.get("p99_ms")
+    if isinstance(p99, (int, float)) and not isinstance(p99, bool):
+        out.append({"metric": f"{metric}.request_p99_ms",
+                    "value": float(p99), "unit": "ms",
+                    "methodology": meth,
+                    "derived_from": "p99_ms",
+                    "stages": record.get("stages")})
+    hbm = record.get("hbm")
+    if isinstance(hbm, dict) and hbm.get("available"):
+        peak = hbm.get("peak_bytes")
+        if isinstance(peak, (int, float)) and not isinstance(peak, bool) \
+                and peak > 0:
+            out.append({"metric": f"{metric}.hbm_peak_bytes",
+                        "value": float(peak), "unit": "bytes",
+                        "methodology": meth,
+                        "derived_from": "hbm.peak_bytes"})
+    return out
 
 
 def group_entries(entries: List[dict]) -> Dict[Tuple[str, str], List[dict]]:
@@ -290,19 +347,23 @@ def evaluate(entries: List[dict], tolerance: float = DEFAULT_TOLERANCE,
     groups = group_entries(entries)
     rows: List[dict] = []
     if candidate is not None:
-        key = (str(candidate.get("metric")),
-               effective_methodology(candidate))
-        row = _evaluate_group(key, groups.get(key, []), candidate,
-                              tolerance)
-        if row is None:
-            # no banked series for this (metric, methodology): a
-            # declared break — reported, never flagged
-            rows.append({"metric": key[0], "methodology": key[1],
-                         "n_baseline": 0, "flagged": False,
-                         "note": "no baseline series (declared break "
-                                 "or first record)"})
-        else:
-            rows.append(row)
+        # the candidate gates as itself AND as each derived sub-series
+        # (ISSUE 8): a steady headline with a doubled request p99 or
+        # HBM watermark flags on the derived group
+        for cand in [candidate] + derive_records(candidate):
+            key = (str(cand.get("metric")),
+                   effective_methodology(cand))
+            row = _evaluate_group(key, groups.get(key, []), cand,
+                                  tolerance)
+            if row is None:
+                # no banked series for this (metric, methodology): a
+                # declared break — reported, never flagged
+                rows.append({"metric": key[0], "methodology": key[1],
+                             "n_baseline": 0, "flagged": False,
+                             "note": "no baseline series (declared "
+                                     "break or first record)"})
+            else:
+                rows.append(row)
     else:
         for key in sorted(groups):
             row = _evaluate_group(key, groups[key], None, tolerance)

@@ -6,6 +6,18 @@ remaining fields are kind-specific. The validator below IS the schema —
 `run_tests.sh`'s telemetry smoke check and the unit suite both validate
 emitted streams through it, so producers and the schema cannot drift
 apart silently. Bump ``SCHEMA_VERSION`` on any breaking field change.
+
+Version history:
+
+* **v1** — manifest / counter / gauge / histogram / span / event.
+* **v2** (ISSUE 8, the live ops plane) — adds the ``request`` kind
+  (one serving request's full lifecycle, keyed by ``trace_id``) and
+  the ``dump`` kind (a flight-recorder dump header), and allows an
+  optional ``trace_id`` on ``span`` records. v1 records remain valid:
+  the validator accepts any schema in ``[1, SCHEMA_VERSION]`` and
+  rejects v2-only kinds/fields on records that declare ``schema: 1``,
+  so both directions are checkable (regression-tested in
+  tests/test_opsplane.py).
 """
 
 from __future__ import annotations
@@ -15,7 +27,7 @@ import threading
 import time
 from typing import IO, Iterator, List, Optional, Tuple
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 #: kind -> required fields beyond the envelope (field, allowed types).
 #: histogram stat fields admit None (an empty histogram has no min/max).
@@ -33,17 +45,39 @@ KIND_FIELDS = {
     "span": (("name", (str,)), ("ts_us", _NUM), ("dur_us", _NUM),
              ("tid", (int,)), ("depth", (int,))),
     "event": (("name", (str,)), ("data", (dict,))),
+    # v2: one request's lifecycle (``op`` is the query kind — the
+    # envelope's ``kind`` field names the record kind) and the
+    # flight-recorder dump header (telemetry/opsplane.py)
+    "request": (("trace_id", (str,)), ("op", (str,)),
+                ("status", (str,)), ("data", (dict,))),
+    "dump": (("trigger", (str,)), ("data", (dict,))),
+}
+
+#: kinds that did not exist before schema v2 — a record declaring
+#: ``schema: 1`` must not carry them
+V2_ONLY_KINDS = frozenset({"request", "dump"})
+
+#: (kind, field) -> (allowed types, minimum schema): optional fields
+#: that are type-checked when present and version-gated
+OPTIONAL_FIELDS = {
+    ("span", "trace_id"): ((str,), 2),
 }
 
 
 def validate_record(rec) -> List[str]:
-    """Problems with one decoded JSONL record; [] means schema-valid."""
+    """Problems with one decoded JSONL record; [] means schema-valid.
+    Accepts every schema version in ``[1, SCHEMA_VERSION]`` — old
+    bundles stay valid; version-gated kinds/fields flag on records
+    that declare an older schema."""
     if not isinstance(rec, dict):
         return [f"record is {type(rec).__name__}, not an object"]
     problems = []
-    if rec.get("schema") != SCHEMA_VERSION:
-        problems.append(f"schema={rec.get('schema')!r} "
-                        f"(expected {SCHEMA_VERSION})")
+    schema = rec.get("schema")
+    if not isinstance(schema, int) or isinstance(schema, bool) \
+            or not (1 <= schema <= SCHEMA_VERSION):
+        problems.append(f"schema={schema!r} "
+                        f"(expected 1..{SCHEMA_VERSION})")
+        schema = SCHEMA_VERSION  # field checks still run
     if not isinstance(rec.get("ts"), _NUM):
         problems.append(f"ts={rec.get('ts')!r} is not a number")
     kind = rec.get("kind")
@@ -51,11 +85,24 @@ def validate_record(rec) -> List[str]:
         problems.append(f"kind={kind!r} not one of "
                         f"{sorted(KIND_FIELDS)}")
         return problems
+    if kind in V2_ONLY_KINDS and schema < 2:
+        problems.append(f"kind={kind!r} needs schema>=2 "
+                        f"(record declares {schema})")
     for field, types in KIND_FIELDS[kind]:
         v = rec.get(field, _MISSING)
         if v is _MISSING:
             problems.append(f"{kind} record missing {field!r}")
         elif not isinstance(v, types) or isinstance(v, bool):
+            problems.append(
+                f"{kind}.{field}={v!r} has type {type(v).__name__}")
+    for (k, field), (types, min_schema) in OPTIONAL_FIELDS.items():
+        if k != kind or field not in rec:
+            continue
+        v = rec[field]
+        if schema < min_schema:
+            problems.append(f"{kind}.{field} needs schema"
+                            f">={min_schema} (record declares {schema})")
+        if not isinstance(v, types) or isinstance(v, bool):
             problems.append(
                 f"{kind}.{field}={v!r} has type {type(v).__name__}")
     return problems

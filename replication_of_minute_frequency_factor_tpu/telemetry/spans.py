@@ -67,12 +67,15 @@ class SpanTracer:
             yield
 
     @contextlib.contextmanager
-    def __call__(self, name: str, **labels):
+    def __call__(self, name: str, trace_id: Optional[str] = None,
+                 **labels):
         """Extra ``labels`` ride on the ``span_seconds`` histogram
         observation only (e.g. ``rolling_impl=``, so per-stage
         histograms say which backend a stage's time belongs to); the
         span name, totals and trace export are label-free — attribution
-        joins on the bare name."""
+        joins on the bare name. ``trace_id`` (schema v2, ISSUE 8) rides
+        the retained EVENT instead: request-scoped spans join their
+        request's lifecycle in the JSONL export."""
         self._tls.depth = depth = self._depth() + 1
         t0 = time.perf_counter()
         try:
@@ -81,23 +84,40 @@ class SpanTracer:
         finally:
             t1 = time.perf_counter()
             self._tls.depth = depth - 1
-            dt = t1 - t0
-            with self._lock:
-                self._totals[name] = self._totals.get(name, 0.0) + dt
-                self._counts[name] = self._counts.get(name, 0) + 1
-                if len(self._events) < self.max_events:
-                    self._events.append({
-                        "name": name,
-                        "ts_us": round((t0 - self._epoch) * 1e6, 1),
-                        "dur_us": round(dt * 1e6, 1),
-                        "tid": threading.get_ident() & 0x7FFFFFFF,
-                        "depth": depth - 1,
-                    })
-                else:
-                    self.dropped_spans += 1
-            if self.registry is not None:
-                self.registry.observe("span_seconds", dt, span=name,
-                                      **labels)
+            self._record(name, t0, t1 - t0, depth - 1, trace_id, labels)
+
+    def _record(self, name: str, t0: float, dt: float, depth: int,
+                trace_id: Optional[str], labels: dict) -> None:
+        with self._lock:
+            self._totals[name] = self._totals.get(name, 0.0) + dt
+            self._counts[name] = self._counts.get(name, 0) + 1
+            if len(self._events) < self.max_events:
+                event = {
+                    "name": name,
+                    "ts_us": round((t0 - self._epoch) * 1e6, 1),
+                    "dur_us": round(dt * 1e6, 1),
+                    "tid": threading.get_ident() & 0x7FFFFFFF,
+                    "depth": depth,
+                }
+                if trace_id is not None:
+                    event["trace_id"] = trace_id
+                self._events.append(event)
+            else:
+                self.dropped_spans += 1
+        if self.registry is not None:
+            self.registry.observe("span_seconds", dt, span=name,
+                                  **labels)
+
+    def add_span(self, name: str, start_s: float, dur_s: float,
+                 trace_id: Optional[str] = None, **labels) -> None:
+        """Record a span with EXPLICIT timing (``start_s`` on the
+        ``time.perf_counter`` clock, ``dur_s`` seconds) — for lifecycle
+        phases measured outside a ``with`` block, e.g. a request's
+        queue-wait (known only once the worker dequeues it) or a
+        coalesced dispatch's device-time share fanned back out to each
+        member request's ``trace_id`` (ISSUE 8)."""
+        self._record(name, start_s, max(0.0, float(dur_s)),
+                     self._depth(), trace_id, labels)
 
     # --- Timer parity ---------------------------------------------------
     def totals(self) -> Dict[str, float]:
@@ -124,7 +144,10 @@ class SpanTracer:
             "traceEvents": [
                 {"name": e["name"], "ph": "X", "pid": pid,
                  "tid": e["tid"], "ts": e["ts_us"], "dur": e["dur_us"],
-                 "args": {"depth": e["depth"]}}
+                 "args": ({"depth": e["depth"],
+                           "trace_id": e["trace_id"]}
+                          if "trace_id" in e else
+                          {"depth": e["depth"]})}
                 for e in self.events()
             ],
         }

@@ -66,6 +66,15 @@ print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     # verdict line, nonzero on drift
     run python -c "import json, sys, bench; r = bench.stream_smoke(); \
 print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
+    # ops-plane smoke (ISSUE 8): a streaming FactorServer + HTTP under
+    # mixed ingest+query load — X-Trace-Id round-trip with the request
+    # lifecycle reconstructible from the bundle, Prometheus scrape
+    # carrying serving counters + device_hbm_* watermark gauges, a
+    # breaker trip producing a flight-recorder dump that
+    # telemetry.validate accepts, and a schema-v2-valid bundle; one
+    # JSON verdict line, nonzero on any missing piece
+    run python -c "import json, sys, bench; r = bench.opsplane_smoke(); \
+print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     # graftlint (ISSUE 4): AST rules over the whole package + jaxpr
     # contracts over all 58 registered kernels AND the resident scan
     # wrappers (abstract trace on CPU), gated on the committed baseline

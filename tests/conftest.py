@@ -54,7 +54,7 @@ def rng():
 #: ingest) and explicit get (save/the tests' device_get) — the whole
 #: carry contract is exercised under the guard.
 TRANSFER_GUARDED_MODULES = {"test_kernel_purity", "test_serve",
-                            "test_stream"}
+                            "test_stream", "test_opsplane"}
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,13 @@
+"""GL-A3 boundary-policy fixture (ISSUE 8): this path matches the
+policy key ``telemetry/opsplane.py`` (ast_tier.GLA3_BOUNDARY_SYNCS),
+whose allowed set is exactly ``{".memory_stats()", "jax.live_arrays"}``
+— the sampler's device-memory host reads must NOT flag here, every
+other sync symbol still must."""
+import jax
+
+
+def sample(device, arr):
+    stats = device.memory_stats()       # allowed by the boundary policy
+    live = jax.live_arrays()            # allowed by the boundary policy
+    n = arr.item()                      # NOT allowed: still flags
+    return stats, live, n

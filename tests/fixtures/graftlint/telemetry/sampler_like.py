@@ -1,0 +1,12 @@
+"""GL-A3 telemetry-scope fixture (ISSUE 8): a non-boundary module
+under telemetry/ gets the full rule — device-memory host reads
+(``.memory_stats()`` / ``.live_buffers()`` / ``jax.live_arrays``) flag
+here even though the ops-plane sampler next door is allowed them."""
+import jax
+
+
+def leaky_sampler(device):
+    stats = device.memory_stats()       # flags: boundary-module-only
+    bufs = device.live_buffers()        # flags: boundary-module-only
+    live = jax.live_arrays()            # flags: boundary-module-only
+    return stats, bufs, live

@@ -41,7 +41,7 @@ def _codes_by_file(violations):
 @pytest.fixture(scope="module")
 def fixture_violations():
     violations, n_files = run_ast_tier(FIXTURES, display_base=REPO)
-    assert n_files == 8
+    assert n_files == 10
     return violations
 
 
@@ -114,15 +114,41 @@ def test_a3_boundary_policy_is_not_a_blanket_exclusion(
 
 
 def test_a3_policy_matches_the_real_request_loop():
-    """The committed policy has exactly one entry — the serving request
-    loop with its one declared sync — and scanning the real package
-    stays clean under it (the policy is load-bearing: docs list it)."""
+    """The committed policy has exactly two entries — the serving
+    request loop with its one declared sync and the ops-plane sampler
+    with its device-memory reads (ISSUE 8) — and scanning the real
+    package stays clean under it (the policy is load-bearing: docs
+    list it)."""
     from replication_of_minute_frequency_factor_tpu.analysis import (
         ast_tier)
     assert ast_tier.GLA3_BOUNDARY_SYNCS == {
-        "serve/service.py": frozenset({"np.asarray"})}
+        "serve/service.py": frozenset({"np.asarray"}),
+        "telemetry/opsplane.py": frozenset({".memory_stats()",
+                                            "jax.live_arrays"})}
     violations, _ = ast_tier.run_ast_tier()
     assert not [v for v in violations if "/serve/" in v.path]
+    assert not [v for v in violations if "/telemetry/" in v.path]
+
+
+def test_a3_memreads_flag_outside_the_opsplane_boundary(
+        fixture_violations):
+    """ISSUE 8: device-memory host reads (.memory_stats() /
+    .live_buffers() / jax.live_arrays) are GL-A3 syncs in the scanned
+    layers — a telemetry module that is not the declared sampler
+    boundary flags on all three."""
+    hits = _codes_by_file(fixture_violations)["sampler_like.py"]
+    assert {s for _, _, s in hits} == {".memory_stats()",
+                                      ".live_buffers()",
+                                      "jax.live_arrays"}
+    assert all(c == "GL-A3" for c, _, _ in hits)
+
+
+def test_a3_opsplane_boundary_allows_its_memreads_only(
+        fixture_violations):
+    """The opsplane boundary fixture uses its two allowed reads plus a
+    banned .item() — only the banned symbol flags."""
+    hits = _codes_by_file(fixture_violations)["opsplane.py"]
+    assert [(c, s) for c, _, s in hits] == [("GL-A3", ".item()")]
 
 
 def test_scope_rules_do_not_leak_outside_their_layers(
@@ -298,7 +324,7 @@ def test_cli_flags_fixtures_then_baseline_clears_them(tmp_path):
             "--report", report)
     out = _run_cli(*args)
     assert out.returncode == 1
-    assert json.loads(out.stdout.strip().splitlines()[-1])["new"] == 15
+    assert json.loads(out.stdout.strip().splitlines()[-1])["new"] == 19
     # refuse to baseline without a why
     out = _run_cli(*args, "--update-baseline")
     assert out.returncode == 2
@@ -311,7 +337,7 @@ def test_cli_flags_fixtures_then_baseline_clears_them(tmp_path):
     out = _run_cli(*args)
     assert out.returncode == 0
     assert json.loads(
-        out.stdout.strip().splitlines()[-1])["baselined"] == 15
+        out.stdout.strip().splitlines()[-1])["baselined"] == 19
 
 
 def test_manifest_carries_the_analysis_block(tmp_path):

@@ -1,0 +1,733 @@
+"""The live ops plane (ISSUE 8): request-scoped tracing, flight
+recorder, HBM watermarks, Prometheus exposition, and the schema-v2
+telemetry stream that carries them.
+
+Runs under ``jax.transfer_guard("disallow")``
+(conftest.TRANSFER_GUARDED_MODULES), like the serving tests it builds
+on: the ops plane instruments the device-hot paths and must never add
+an implicit transfer on a caller thread.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from replication_of_minute_frequency_factor_tpu.serve import (
+    FactorServer, LoadShedError, Query, ServeConfig, SyntheticSource,
+    serve_http)
+from replication_of_minute_frequency_factor_tpu.telemetry import (
+    FlightRecorder, HbmSampler, MetricsRegistry, Telemetry,
+    canonical_trace_id, gen_trace_id, to_prometheus, validate_record)
+from replication_of_minute_frequency_factor_tpu.telemetry.validate import (
+    validate_dir, validate_dump)
+
+NAMES = ("vol_return1min", "mmt_am")
+
+
+def _server(tmp_path=None, n_days=8, n_tickers=16, names=NAMES,
+            start=True, stream=False, **scfg):
+    tel = Telemetry()
+    if tmp_path is not None and "flight_dir" not in scfg:
+        scfg["flight_dir"] = str(tmp_path)
+    src = SyntheticSource(n_days=n_days, n_tickers=n_tickers, seed=5)
+    srv = FactorServer(src, names=names, telemetry=tel,
+                       serve_cfg=ServeConfig(**scfg), start=start,
+                       stream=stream, stream_batches=(4,))
+    return srv, tel
+
+
+def _get(port, path, headers=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return resp.status, dict(resp.headers), resp.read()
+
+
+def _post(port, path, doc, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(doc).encode(), headers=headers or {})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return resp.status, dict(resp.headers), json.loads(resp.read())
+
+
+# --------------------------------------------------------------------------
+# schema v2: both directions
+# --------------------------------------------------------------------------
+
+
+def _v(schema, kind, **fields):
+    return {"schema": schema, "ts": 1.0, "kind": kind, **fields}
+
+
+def test_schema_v1_records_still_validate():
+    """Old bundles stay checkable: every v1 kind at schema=1 passes."""
+    assert validate_record(_v(1, "counter", name="c", labels={},
+                              value=1)) == []
+    assert validate_record(_v(1, "span", name="s", ts_us=0.0,
+                              dur_us=1.0, tid=1, depth=0)) == []
+    assert validate_record(_v(1, "event", name="e", data={})) == []
+
+
+def test_schema_v2_request_and_dump_records_validate():
+    assert validate_record(_v(2, "request", trace_id="abc", op="ic",
+                              status="ok", data={"total_s": 0.1})) == []
+    assert validate_record(_v(2, "dump", trigger="breaker_trip",
+                              data={"requests": 3})) == []
+    assert validate_record(_v(2, "span", name="s", ts_us=0.0,
+                              dur_us=1.0, tid=1, depth=0,
+                              trace_id="abc")) == []
+
+
+def test_v2_only_kinds_and_fields_flag_on_v1_records():
+    """The other direction: a record claiming schema=1 cannot carry v2
+    kinds or fields."""
+    assert any("schema>=2" in p for p in validate_record(
+        _v(1, "request", trace_id="a", op="ic", status="ok", data={})))
+    assert any("schema>=2" in p for p in validate_record(
+        _v(1, "dump", trigger="manual", data={})))
+    assert any("schema>=2" in p for p in validate_record(
+        _v(1, "span", name="s", ts_us=0.0, dur_us=1.0, tid=1, depth=0,
+           trace_id="abc")))
+    # unknown / malformed versions flag too
+    assert any("schema" in p for p in validate_record(
+        _v(3, "event", name="e", data={})))
+    # type errors on v2 fields flag
+    assert any("trace_id" in p for p in validate_record(
+        _v(2, "request", trace_id=7, op="ic", status="ok", data={})))
+
+
+# --------------------------------------------------------------------------
+# trace IDs
+# --------------------------------------------------------------------------
+
+
+def test_canonical_trace_id_accepts_and_replaces():
+    assert canonical_trace_id("my-trace.01_X") == "my-trace.01_X"
+    generated = canonical_trace_id(None)
+    assert generated != canonical_trace_id("bad header\nvalue")
+    assert len(gen_trace_id()) == 16
+    assert canonical_trace_id("x" * 65) != "x" * 65  # too long
+
+
+def test_every_answer_carries_its_trace_id_and_records_lifecycle():
+    """In-process path: a coalesced group's answers each carry their
+    own trace ID; the telemetry request records reconstruct queue-wait
+    / dispatch / device-share / answer per member, and the dispatch's
+    device time fans out as equal shares summing to the block time."""
+    srv, tel = _server(start=False)
+    try:
+        futs = [srv.submit(Query("factors", 0, 4, names=("mmt_am",)))
+                for _ in range(5)]
+        srv.start()
+        answers = [f.result(120) for f in futs]
+        ids = [a["trace_id"] for a in answers]
+        assert len(set(ids)) == 5
+        srv.close()
+        with tel._lock:
+            recs = list(tel._requests)
+        by_id = {r["trace_id"]: r for r in recs}
+        assert set(ids) <= set(by_id)
+        for tid in ids:
+            d = by_id[tid]["data"]
+            assert by_id[tid]["status"] == "ok"
+            assert d["group_size"] == 5 and d["coalesced"] is True
+            assert d["dispatch_id"] >= 1
+            assert d["device_share_s"] == pytest.approx(
+                d["block_s"] / 5, rel=1e-3, abs=1e-6)
+            assert d["total_s"] >= d["queue_wait_s"]
+        # span events with the member trace IDs exist (the fan-out)
+        events = tel.tracer.events()
+        for tid in ids:
+            names = {e["name"] for e in events
+                     if e.get("trace_id") == tid}
+            assert {"serve.request", "serve.queue_wait",
+                    "serve.dispatch_share"} <= names
+    finally:
+        srv.close()
+
+
+def test_http_trace_id_round_trip(tmp_path):
+    srv, _ = _server(tmp_path)
+    httpd = None
+    try:
+        httpd, _t = serve_http(srv)
+        port = httpd.server_address[1]
+        # propagated: header echoes, body matches
+        status, headers, body = _post(
+            port, "/v1/query", {"kind": "factors", "start": 0, "end": 2},
+            headers={"X-Trace-Id": "client-trace-7"})
+        assert status == 200
+        assert headers.get("X-Trace-Id") == "client-trace-7"
+        assert body["trace_id"] == "client-trace-7"
+        # absent: generated, echoed, consistent
+        status, headers, body = _post(
+            port, "/v1/query", {"kind": "factors", "start": 0, "end": 2})
+        assert headers.get("X-Trace-Id") == body["trace_id"]
+        # malformed: replaced, not propagated verbatim
+        status, headers, body = _post(
+            port, "/v1/query", {"kind": "factors", "start": 0, "end": 2},
+            headers={"X-Trace-Id": "bad header!!"})
+        assert headers.get("X-Trace-Id") != "bad header!!"
+        assert headers.get("X-Trace-Id") == body["trace_id"]
+        # errors echo the trace ID too
+        try:
+            _post(port, "/v1/query",
+                  {"kind": "factors", "start": 0, "end": 99},
+                  headers={"X-Trace-Id": "err-trace-1"})
+            raise AssertionError("expected 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert e.headers.get("X-Trace-Id") == "err-trace-1"
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        srv.close()
+
+
+def test_ingest_future_carries_trace_id():
+    srv, tel = _server(stream=True)
+    try:
+        bars, mask = srv.source.slab(0, 1)
+        b = np.ascontiguousarray(np.swapaxes(bars[0][:, :4], 0, 1))
+        p = np.ascontiguousarray(mask[0][:, :4].T)
+        r = srv.ingest(b, p, trace_id="feed-0").result(120)
+        assert r["trace_id"] == "feed-0" and r["minute"] == 4
+        with tel._lock:
+            recs = [x for x in tel._requests
+                    if x["trace_id"] == "feed-0"]
+        assert recs and recs[0]["op"] == "ingest" \
+            and recs[0]["status"] == "ok"
+    finally:
+        srv.close()
+
+
+# --------------------------------------------------------------------------
+# HBM watermarks
+# --------------------------------------------------------------------------
+
+
+def test_hbm_sampler_cpu_fallback_publishes_marked_gauges():
+    """On the CPU backend memory_stats() is None: the sampler must
+    degrade to the live-arrays estimate, publish gauges for every
+    device, and carry the explicit unavailable marker — never crash."""
+    tel = Telemetry()
+    s = tel.hbm
+    assert isinstance(s, HbmSampler)
+    out = s.sample("test", force=True)
+    assert out["devices"]  # every jax device reported
+    gauges = tel.registry.snapshot()["gauges"]
+    in_use = [k for k in gauges if k.startswith("device.hbm_bytes_in_use")]
+    peak = [k for k in gauges if k.startswith("device.hbm_peak_bytes")]
+    avail = [k for k in gauges
+             if k.startswith("device.hbm_stats_available")]
+    assert in_use and peak and avail
+    if not out["available"]:  # CPU container: the explicit marker
+        assert all(gauges[k] == 0.0 for k in avail)
+        assert out["source"] == "live_arrays"
+        assert "source=live_arrays" in in_use[0]
+
+
+@pytest.mark.transfers  # owns device arrays on this thread
+def test_hbm_peak_is_monotone_and_rate_limited():
+    tel = Telemetry()
+    s = HbmSampler(telemetry=tel, min_interval_s=30.0)
+    first = s.sample("a", force=True)
+    # rate-limited second sample returns the cached summary
+    assert s.sample("b")["samples"] == first["samples"]
+    import jax.numpy as jnp
+    keep = jnp.zeros((1 << 16,), jnp.float32)  # grow live bytes
+    second = s.sample("c", force=True)
+    assert second["samples"] == first["samples"] + 1
+    assert second["peak_bytes"] >= first["peak_bytes"]
+    del keep
+    third = s.sample("d", force=True)
+    assert third["peak_bytes"] >= second["peak_bytes"]  # peak sticks
+
+
+@pytest.mark.transfers  # owns device arrays on this thread
+def test_hbm_background_thread_samples_and_stops():
+    tel = Telemetry()
+    s = HbmSampler(telemetry=tel, min_interval_s=0.0)
+    s.start(period_s=0.02)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if tel.registry.counter_value("device.hbm_samples",
+                                      boundary="background") >= 2:
+            break
+        time.sleep(0.02)
+    s.stop()
+    assert tel.registry.counter_value("device.hbm_samples",
+                                      boundary="background") >= 2
+    n = tel.registry.counter_value("device.hbm_samples",
+                                   boundary="background")
+    time.sleep(0.1)
+    assert tel.registry.counter_value("device.hbm_samples",
+                                      boundary="background") == n
+
+
+def test_stream_and_serve_dispatches_sample_watermarks():
+    # background thread off + rate limit zeroed: every dispatch
+    # boundary's sample must land, deterministically
+    srv, tel = _server(stream=True, hbm_sample_period_s=0)
+    tel.hbm.min_interval_s = 0.0
+    try:
+        c = srv.client()
+        bars, mask = srv.source.slab(0, 1)
+        c.ingest(np.ascontiguousarray(
+            np.swapaxes(bars[0][:, :4], 0, 1)),
+            np.ascontiguousarray(mask[0][:, :4].T))
+        c.factors(0, 2)
+        reg = tel.registry
+        assert reg.counter_value("device.hbm_samples",
+                                 boundary="serve.ingest") \
+            + reg.counter_value("device.hbm_samples",
+                                boundary="stream.ingest") >= 1
+        assert reg.counter_value("device.hbm_samples",
+                                 boundary="serve.dispatch") >= 1
+    finally:
+        srv.close()
+
+
+# --------------------------------------------------------------------------
+# Prometheus exposition
+# --------------------------------------------------------------------------
+
+
+def test_prometheus_text_format():
+    reg = MetricsRegistry()
+    reg.counter("serve.requests", 3, kind="ic")
+    reg.counter("serve.requests", 2, kind="factors")
+    reg.gauge("serve.queue_depth", 7)
+    reg.gauge("weird.name-with+chars", 1, label="a\"b\\c\nd")
+    for v in (0.1, 0.2, 0.3):
+        reg.observe("serve.request_seconds", v, kind="ic")
+    text = to_prometheus(reg)
+    lines = text.strip().splitlines()
+    assert "# TYPE serve_requests_total counter" in lines
+    assert 'serve_requests_total{kind="ic"} 3' in lines
+    assert 'serve_requests_total{kind="factors"} 2' in lines
+    assert "# TYPE serve_queue_depth gauge" in lines
+    assert "serve_queue_depth 7" in lines
+    # sanitized name + escaped label value
+    assert any(ln.startswith("weird_name_with_chars{") for ln in lines)
+    assert r"a\"b\\c\nd" in text
+    # histogram -> summary with quantiles + exact sum/count
+    assert "# TYPE serve_request_seconds summary" in lines
+    assert any('quantile="0.5"' in ln for ln in lines)
+    assert any('quantile="0.95"' in ln for ln in lines)
+    sum_line = [ln for ln in lines
+                if ln.startswith("serve_request_seconds_sum")][0]
+    assert float(sum_line.split()[-1]) == pytest.approx(0.6)
+    count_line = [ln for ln in lines
+                  if ln.startswith("serve_request_seconds_count")][0]
+    assert count_line.split()[-1] == "3"
+    # TYPE lines appear once per metric name
+    types = [ln for ln in lines if ln.startswith("# TYPE")]
+    assert len(types) == len(set(types))
+
+
+def test_metrics_endpoint_content_negotiation(tmp_path):
+    srv, _ = _server(tmp_path)
+    httpd = None
+    try:
+        srv.client().factors(0, 2)
+        httpd, _t = serve_http(srv)
+        port = httpd.server_address[1]
+        # default: the JSON snapshot (backward compatible)
+        status, headers, body = _get(port, "/v1/metrics")
+        assert "application/json" in headers.get("Content-Type", "")
+        snap = json.loads(body)
+        assert "serve.dispatches" in snap["counters"]
+        # Accept: text/plain -> Prometheus exposition
+        status, headers, body = _get(port, "/v1/metrics",
+                                     headers={"Accept": "text/plain"})
+        assert "text/plain" in headers.get("Content-Type", "")
+        text = body.decode()
+        assert "serve_dispatches_total" in text
+        assert "device_hbm_bytes_in_use" in text
+        # ?format=prometheus works without the header
+        status, headers, body = _get(port,
+                                     "/v1/metrics?format=prometheus")
+        assert "text/plain" in headers.get("Content-Type", "")
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        srv.close()
+
+
+# --------------------------------------------------------------------------
+# registry thread-safety: the hammer (ISSUE 8 satellite)
+# --------------------------------------------------------------------------
+
+
+def test_registry_hammer_no_torn_snapshots():
+    """N writer threads hammer one counter/histogram/gauge while a
+    scraper thread snapshots and renders Prometheus text: every
+    intermediate view must be internally consistent (histogram count
+    == sum for unit observations, counters monotone), and the final
+    totals exact."""
+    reg = MetricsRegistry()
+    N_THREADS, N_OPS = 8, 400
+    stop = threading.Event()
+    torn = []
+    last_counter = [0.0]
+
+    def writer():
+        for _ in range(N_OPS):
+            reg.counter("hammer.ops")
+            reg.observe("hammer.seconds", 1.0)
+            reg.gauge("hammer.depth", 1)
+
+    def scraper():
+        while not stop.is_set():
+            snap = reg.snapshot()
+            c = snap["counters"].get("hammer.ops", 0.0)
+            if c != int(c) or c < last_counter[0]:
+                torn.append(f"counter tore: {c}")
+            last_counter[0] = c
+            h = snap["histograms"].get("hammer.seconds")
+            if h and h["count"] != round(h["sum"]):
+                torn.append(f"hist tore: {h}")
+            text = to_prometheus(reg)
+            if "hammer_ops_total" not in text and c > 0:
+                torn.append("prometheus lost a live counter")
+
+    threads = [threading.Thread(target=writer) for _ in range(N_THREADS)]
+    s = threading.Thread(target=scraper)
+    s.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    s.join()
+    assert not torn, torn[:5]
+    snap = reg.snapshot()
+    assert snap["counters"]["hammer.ops"] == N_THREADS * N_OPS
+    assert snap["histograms"]["hammer.seconds"]["count"] \
+        == N_THREADS * N_OPS
+
+
+def test_registry_merge_is_safe_under_concurrent_observe():
+    """The audit fix: merge() deep-copies histogram state under the
+    source's lock, so a concurrent observe on the source can neither
+    tear the copy nor retroactively mutate the destination."""
+    src = MetricsRegistry()
+    stop = threading.Event()
+
+    def feeder():
+        while not stop.is_set():
+            src.observe("m", 1.0)
+
+    t = threading.Thread(target=feeder)
+    t.start()
+    try:
+        for _ in range(50):
+            merged = MetricsRegistry()
+            merged.merge(src)
+            st = merged.histogram_stats("m")
+            if st is not None:
+                assert st["count"] == round(st["sum"])
+                frozen = dict(st)
+                time.sleep(0.001)  # source keeps observing
+                assert merged.histogram_stats("m") == frozen
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_http_scrape_hammer_while_requests_drain(tmp_path):
+    """The satellite's exact ask: scrape /v1/metrics (both formats)
+    while a request load drains; every scrape parses and the request
+    counter is monotone across scrapes."""
+    srv, _ = _server(tmp_path, n_days=8, n_tickers=12)
+    httpd = None
+    errors = []
+    try:
+        httpd, _t = serve_http(srv)
+        port = httpd.server_address[1]
+        stop = threading.Event()
+        seen = [0.0]
+
+        def scraper():
+            while not stop.is_set():
+                try:
+                    _, _, body = _get(port, "/v1/metrics")
+                    snap = json.loads(body)
+                    total = sum(v for k, v in snap["counters"].items()
+                                if k.startswith("serve.requests"))
+                    if total < seen[0]:
+                        errors.append(f"requests went backwards: "
+                                      f"{total} < {seen[0]}")
+                    seen[0] = total
+                    _, _, text = _get(port, "/v1/metrics",
+                                      headers={"Accept": "text/plain"})
+                    text.decode()
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    errors.append(repr(e))
+
+        def client_loop(tid):
+            c = srv.client(timeout=120)
+            try:
+                for j in range(5):
+                    c.factors((tid + j) % 2 * 2, (tid + j) % 2 * 2 + 4,
+                              names=("mmt_am",))
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(repr(e))
+
+        s = threading.Thread(target=scraper)
+        s.start()
+        clients = [threading.Thread(target=client_loop, args=(i,))
+                   for i in range(6)]
+        for t in clients:
+            t.start()
+        for t in clients:
+            t.join()
+        stop.set()
+        s.join()
+        assert not errors, errors[:5]
+        assert seen[0] >= 6 * 5
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        srv.close()
+
+
+# --------------------------------------------------------------------------
+# flight recorder
+# --------------------------------------------------------------------------
+
+
+def test_flight_ring_is_bounded_and_dump_validates(tmp_path):
+    tel = Telemetry()
+    fr = FlightRecorder(telemetry=tel, ring=8, dump_dir=str(tmp_path))
+    for i in range(30):
+        fr.record_request({"trace_id": gen_trace_id(), "op": "ic",
+                           "status": "ok", "data": {"i": i}})
+    assert len(fr) == 8
+    fr.note_dispatch({"dispatch_id": 30, "op": "block"})
+    path = fr.dump("manual", force=True)
+    assert path and os.path.exists(path)
+    report = validate_dump(path)
+    assert report["ok"], report
+    assert report["kinds"] == {"dump": 1, "request": 8}
+    with open(path) as fh:
+        head = json.loads(fh.readline())
+    assert head["kind"] == "dump" and head["trigger"] == "manual"
+    assert head["data"]["last_dispatch"]["dispatch_id"] == 30
+    # the ring keeps only the LAST 8 requests
+    datas = [json.loads(ln)["data"]["i"] for ln in open(path)
+             if '"request"' in ln]
+    assert datas == list(range(22, 30))
+
+
+def test_flight_dump_rate_limit_and_counter_deltas(tmp_path):
+    tel = Telemetry()
+    fr = FlightRecorder(telemetry=tel, dump_dir=str(tmp_path),
+                        min_dump_interval_s=60.0)
+    tel.counter("some.counter", 5)
+    p1 = fr.dump("breaker_trip")
+    assert p1 is not None
+    assert fr.dump("breaker_trip") is None  # rate-limited
+    assert fr.dump("breaker_trip", force=True) is not None
+    tel.counter("some.counter", 2)
+    p3 = fr.dump("breaker_trip", force=True)
+    with open(p3) as fh:
+        head = json.loads(fh.readline())
+    assert head["data"]["counters_delta"].get("some.counter") == 2
+    assert head["data"]["counters"]["some.counter"] == 7
+
+
+def test_flight_without_dir_records_but_writes_nothing(tmp_path):
+    tel = Telemetry()
+    fr = FlightRecorder(telemetry=tel)  # no dump_dir
+    fr.record_request({"trace_id": "t", "op": "ic", "status": "ok",
+                       "data": {}})
+    assert fr.dump("manual", force=True) is None
+    assert tel.registry.counter_value("flight.dumps",
+                                      trigger="manual") == 1
+    # explicit out_dir still writes
+    assert fr.dump("manual", out_dir=str(tmp_path),
+                   force=True) is not None
+
+
+def test_shed_burst_triggers_dump(tmp_path):
+    tel = Telemetry()
+    fr = FlightRecorder(telemetry=tel, dump_dir=str(tmp_path),
+                        shed_burst=5, shed_window_s=10.0)
+    path = None
+    for _ in range(5):
+        path = fr.note_shed("queue_full") or path
+    assert path is not None and "load_shed_burst" in path
+    assert validate_dump(path)["ok"]
+
+
+def _boom(*a, **k):
+    raise RuntimeError("injected device failure")
+
+
+def _wait_for(predicate, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = predicate()
+        if v:
+            return v
+        time.sleep(0.05)
+    return predicate()
+
+
+def test_breaker_trip_dumps_and_dump_validates(tmp_path):
+    """The acceptance hook: consecutive dispatch failures open the
+    breaker AND capture a flight dump holding the failed requests'
+    traces; the dump passes telemetry.validate (dir mode sees it
+    too)."""
+    srv, tel = _server(tmp_path, breaker_threshold=2,
+                       breaker_cooldown_s=30.0)
+    try:
+        srv.engine.build_block = _boom
+        for _ in range(2):
+            with pytest.raises(RuntimeError, match="injected"):
+                srv.submit(Query("factors", 0, 2)).result(60)
+        dumps = _wait_for(lambda: [p for p in srv.flight.dumps
+                                   if "breaker_trip" in p])
+        assert dumps, "breaker trip produced no flight dump"
+        report = validate_dump(dumps[-1])
+        assert report["ok"], report
+        with open(dumps[-1]) as fh:
+            recs = [json.loads(ln) for ln in fh]
+        errs = [r for r in recs if r.get("kind") == "request"
+                and r["status"] == "error"]
+        assert len(errs) == 2
+        assert all("injected" in r["data"]["error"] for r in errs)
+        with pytest.raises(LoadShedError):
+            srv.submit(Query("factors", 0, 2))
+    finally:
+        srv.close()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_worker_exception_dumps(tmp_path):
+    """An exception ESCAPING the worker loop (not a contained
+    per-request failure) captures a dump before the thread dies."""
+    srv, _ = _server(tmp_path, start=False)
+    try:
+        srv._dispatch_group = _boom  # called from the worker loop only
+        srv.submit(Query("factors", 0, 2))
+        srv.start()
+        dumps = _wait_for(lambda: [p for p in srv.flight.dumps
+                                   if "worker_exception" in p])
+        assert dumps and validate_dump(dumps[-1])["ok"]
+    finally:
+        srv.close()
+
+
+def test_debug_dump_endpoint(tmp_path):
+    srv, _ = _server(tmp_path)
+    httpd = None
+    try:
+        srv.client().factors(0, 2)
+        httpd, _t = serve_http(srv)
+        port = httpd.server_address[1]
+        status, _, body = _post(port, "/v1/debug/dump", {})
+        assert status == 200
+        assert validate_dump(body["path"])["ok"]
+        # unconfigured recorder -> 409, not a crash
+        srv.flight.dump_dir = None
+        try:
+            _post(port, "/v1/debug/dump", {})
+            raise AssertionError("expected 409")
+        except urllib.error.HTTPError as e:
+            assert e.code == 409
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        srv.close()
+
+
+# --------------------------------------------------------------------------
+# HTTP observability surface
+# --------------------------------------------------------------------------
+
+
+def test_healthz_body_fields(tmp_path):
+    srv, _ = _server(tmp_path, stream=True)
+    httpd = None
+    try:
+        httpd, _t = serve_http(srv)
+        port = httpd.server_address[1]
+        _, _, body = _get(port, "/healthz")
+        h = json.loads(body)
+        assert h["ok"] is True and h["breaker_open"] is False
+        assert h["factors"] == len(NAMES) and h["days"] == 8
+        assert h["breaker_consecutive_failures"] == 0
+        assert h["uptime_s"] >= 0 and h["queue_depth"] == 0
+        assert h["flight"] == {"requests": 0, "dumps": 0}
+        assert isinstance(h["hbm_available"], bool)
+        assert h["stream_minute"] == 0
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        srv.close()
+
+
+# --------------------------------------------------------------------------
+# the acceptance gate: lifecycle reconstruction from a loaded bench run
+# --------------------------------------------------------------------------
+
+
+def test_serve_bench_bundle_reconstructs_a_request(tmp_path):
+    """A loaded ``bench.py serve`` run (small CPU shape) writes a
+    telemetry bundle from which ONE chosen request's full lifecycle —
+    admission, queue-wait, coalesced dispatch with its device-time
+    share, answer — is reconstructed by trace ID, and the HBM
+    watermark gauges ride both the record and the bundle with the
+    explicit availability marker (ISSUE 8 acceptance)."""
+    import bench
+    tel = Telemetry()
+    record = bench.serve_bench(levels=(1, 4), total_requests=24,
+                               tickers=24, days=8, window_days=4,
+                               names=NAMES, telemetry=tel)
+    # the record embeds the watermark block with the explicit marker
+    assert "hbm" in record and "available" in record["hbm"]
+    assert record["hbm"]["devices"]
+    out = tmp_path / "bundle"
+    tel.write(str(out))
+    assert validate_dir(str(out))["ok"]
+    requests, spans, hbm_gauges = [], [], []
+    with open(out / "metrics.jsonl") as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec.get("kind") == "request":
+                requests.append(rec)
+            elif rec.get("kind") == "span" and "trace_id" in rec:
+                spans.append(rec)
+            elif rec.get("kind") == "gauge" and \
+                    rec["name"] == "device.hbm_bytes_in_use":
+                hbm_gauges.append(rec)
+    assert hbm_gauges, "no HBM watermark gauges in the bundle"
+    # choose a coalesced request (the probe guarantees one exists)
+    chosen = next(r for r in requests
+                  if r["status"] == "ok" and r["data"]["group_size"] > 1)
+    d = chosen["data"]
+    # full lifecycle, reconstructed from the one record:
+    assert d["queue_wait_s"] >= 0.0
+    assert d["dispatch_id"] >= 1
+    assert d["device_share_s"] == pytest.approx(
+        d["block_s"] / d["group_size"], rel=1e-3, abs=1e-6)
+    assert d["total_s"] >= d["queue_wait_s"] + d["answer_s"]
+    # and its span events joined by trace_id
+    mine = [s for s in spans if s["trace_id"] == chosen["trace_id"]]
+    names = {s["name"] for s in mine}
+    assert {"serve.request", "serve.queue_wait",
+            "serve.dispatch_share"} <= names
+    share = next(s for s in mine if s["name"] == "serve.dispatch_share")
+    assert share["dur_us"] == pytest.approx(
+        d["device_share_s"] * 1e6, rel=0.05, abs=10.0)

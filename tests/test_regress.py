@@ -238,9 +238,14 @@ def test_cli_check_r8_serve_break_is_declared(tmp_path):
     rc, verdict = _cli(REPO, "--check", str(cand))
     assert rc == 0 and verdict["ok"]
     (g,) = [g for g in verdict["groups"]
-            if g["methodology"] == "r8_serve_v1"]
+            if g["metric"] == "serve58_1024tickers_qps"]
     assert g["n_baseline"] == 0 and g["flagged"] is False
     assert "declared break" in g.get("note", "")
+    # the derived request-p99 sub-series (ISSUE 8) rides the same
+    # check as its own declared break
+    (d,) = [g for g in verdict["groups"]
+            if g["metric"].endswith(".request_p99_ms")]
+    assert d["flagged"] is False
 
 
 def test_cli_check_r9_stream_break_is_declared(tmp_path):
@@ -265,7 +270,7 @@ def test_cli_check_r9_stream_break_is_declared(tmp_path):
     rc, verdict = _cli(REPO, "--check", str(cand))
     assert rc == 0 and verdict["ok"]
     (g,) = [g for g in verdict["groups"]
-            if g["methodology"] == "r9_stream_intraday_v1"]
+            if g["metric"] == "stream58_1024tickers_bars_per_s"]
     assert g["n_baseline"] == 0 and g["flagged"] is False
     assert "declared break" in g.get("note", "")
 
@@ -287,3 +292,69 @@ def test_cli_check_r7_sharded_break_is_declared(tmp_path):
             if g["methodology"] == "r7_resident_sharded_v1"]
     assert g["n_baseline"] == 0 and g["flagged"] is False
     assert "declared break" in g.get("note", "")
+
+# --------------------------------------------------------------------------
+# derived sub-series (ISSUE 8): request p99 + HBM watermarks
+# --------------------------------------------------------------------------
+
+
+def _serve_rec(value=50.0, p99=12.0, peak=1e9, available=True,
+               methodology="r8_serve_v1"):
+    rec = {"metric": "serveN_qps", "value": value,
+           "methodology": methodology, "p99_ms": p99}
+    if peak is not None:
+        rec["hbm"] = {"available": available, "peak_bytes": peak,
+                      "devices": {}}
+    return rec
+
+
+def test_derive_records_lifts_p99_and_available_hbm():
+    recs = regress.derive_records(_serve_rec())
+    assert [r["metric"] for r in recs] == [
+        "serveN_qps.request_p99_ms", "serveN_qps.hbm_peak_bytes"]
+    assert all(r["methodology"] == "r8_serve_v1" for r in recs)
+    assert recs[0]["value"] == 12.0 and recs[1]["value"] == 1e9
+
+
+def test_unavailable_hbm_never_seeds_a_baseline():
+    """A CPU fallback's live-arrays estimate (available: false) must
+    neither seed nor gate the hbm_peak_bytes series."""
+    recs = regress.derive_records(_serve_rec(available=False))
+    assert [r["metric"] for r in recs] == ["serveN_qps.request_p99_ms"]
+    assert regress.derive_records({"metric": "m", "value": 1.0}) == []
+
+
+def test_derived_series_gate_and_declared_break(tmp_path):
+    """The satellite's acceptance: derived series ride the existing
+    per-(metric, methodology) machinery — first record is a declared
+    break; later candidates with a steady headline but a doubled p99
+    or HBM watermark FLAG on the derived group."""
+    for i, peak in enumerate((1e9, 1.02e9)):
+        with open(tmp_path / f"BENCH_r{i + 1:02d}.json", "w") as fh:
+            json.dump({"n": i + 1,
+                       "parsed": _serve_rec(peak=peak)}, fh)
+    entries = regress.load_bench_series(str(tmp_path))
+    metrics = {e["record"]["metric"] for e in entries}
+    assert {"serveN_qps", "serveN_qps.request_p99_ms",
+            "serveN_qps.hbm_peak_bytes"} <= metrics
+    # in-band candidate: every group quiet
+    assert regress.evaluate(entries, candidate=_serve_rec())["ok"]
+    # steady QPS, doubled request p99: the derived group flags
+    v = regress.evaluate(entries, candidate=_serve_rec(p99=24.0))
+    assert not v["ok"]
+    assert any(f["metric"].endswith(".request_p99_ms")
+               for f in v["flagged"])
+    # steady QPS/p99, doubled HBM watermark: the watermark group flags
+    v = regress.evaluate(entries, candidate=_serve_rec(peak=2e9))
+    assert not v["ok"]
+    assert any(f["metric"].endswith(".hbm_peak_bytes")
+               for f in v["flagged"])
+    # a CPU-fallback candidate cannot trip the HBM gate at all
+    assert regress.evaluate(
+        entries, candidate=_serve_rec(peak=5e9, available=False))["ok"]
+    # a NEW methodology opens fresh derived series: declared break,
+    # reported with empty baselines, never flagged
+    v = regress.evaluate(entries,
+                         candidate=_serve_rec(methodology="r10_new"))
+    assert v["ok"]
+    assert all(g["n_baseline"] == 0 for g in v["groups"])

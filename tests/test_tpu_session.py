@@ -297,19 +297,24 @@ def test_stream_intraday_carry_requires_real_streaming(tpu_session):
     0, zero compiles during load, empty parity-mismatch list. A
     zero-update record, a cold (compiling) load, or an on-hardware
     parity failure must re-run."""
-    def entry(**stream):
+    def entry(hbm=True, **stream):
         base = {"updates": 2880, "compiles_during_load": 0,
                 "parity_mismatched": []}
         base.update(stream)
-        return {"stream_intraday": {"ok": True, "results": [
-            {"metric": "stream58_1024tickers_bars_per_s",
-             "value": 83000.0,
-             "methodology": "r9_stream_intraday_v1",
-             "stream": base}]}}
+        rec = {"metric": "stream58_1024tickers_bars_per_s",
+               "value": 83000.0,
+               "methodology": "r9_stream_intraday_v1",
+               "stream": base}
+        if hbm:
+            rec["hbm"] = {"available": True, "peak_bytes": 1 << 30}
+        return {"stream_intraday": {"ok": True, "results": [rec]}}
 
     good = entry()
     assert tpu_session.drop_conv_only_rolling(good) == good
     assert tpu_session.drop_conv_only_rolling(entry(updates=0)) == {}
+    # ISSUE 8: a record without the HBM watermark block cannot bank —
+    # the carried trajectory feeds the hbm_peak_bytes regress series
+    assert tpu_session.drop_conv_only_rolling(entry(hbm=False)) == {}
     assert tpu_session.drop_conv_only_rolling(
         entry(compiles_during_load=3)) == {}
     assert tpu_session.drop_conv_only_rolling(
@@ -336,6 +341,7 @@ def test_stream_intraday_step_refuses_unbankable_records(
         return {"ok": True, "rc": 0, "results": [
             {"metric": "stream58_1024tickers_bars_per_s",
              "methodology": "r9_stream_intraday_v1",
+             "hbm": {"available": True, "peak_bytes": 1 << 30},
              "stream": {"updates": 0, "compiles_during_load": 0,
                         "parity_mismatched": []}}]}
     monkeypatch.setattr(tpu_session, "_run_json_lines", fake_lines)
@@ -346,6 +352,7 @@ def test_stream_intraday_step_refuses_unbankable_records(
         return {"ok": True, "rc": 0, "results": [
             {"metric": "stream58_1024tickers_bars_per_s",
              "methodology": "r9_stream_intraday_v1",
+             "hbm": {"available": True, "peak_bytes": 1 << 30},
              "stream": {"updates": 99, "compiles_during_load": 0,
                         "parity_mismatched": []}}]}
     monkeypatch.setattr(tpu_session, "_run_json_lines", fake_good)
