@@ -454,6 +454,11 @@ def run_resident_sharded(batches, names, use_wire, group, mesh,
     stacks, spec, kind, t_pad = encode_year_sharded(
         batches, use_wire, n_shards, bucket=bucket)
     phases["encode_s"] = round(time.perf_counter() - t0, 3)
+    # lcm ticker-padding waste (ISSUE 9): dead lanes every shard still
+    # computes — the mesh.pad_waste_frac gauge + the record's mesh
+    # block carry it
+    tel.meshplane.record_pad_waste(batches[0][0].shape[1], t_pad,
+                                   axis="tickers")
     groups = [np.stack(stacks[g0:g0 + group])  # [g, S, L] per group
               for g0 in range(0, len(stacks), group)]
     phases["ingest_MB"] = round(
@@ -477,7 +482,15 @@ def run_resident_sharded(batches, names, use_wire, group, mesh,
             phases)
         if compute_t0 is None:
             compute_t0 = time.perf_counter()
+        t_dispatch = time.perf_counter()
         outs.append(compiled(d))
+        # shard-balance watermarks per scan group (ISSUE 9): a daemon
+        # watcher blocks on each shard of this group's output in the
+        # background and records its completion time since dispatch —
+        # the hot loop never blocks, so the measured sync counts and
+        # the double-buffered overlap are untouched
+        tel.meshplane.watch_async(outs[-1], boundary="resident.group",
+                                  t0=t_dispatch)
         # HBM watermark per scan group (ISSUE 8): the first measured
         # signal the OOM ladder's group-halving gets, sampled while
         # this group's buffers and the double-buffered next put are
@@ -493,6 +506,9 @@ def run_resident_sharded(batches, names, use_wire, group, mesh,
     jax.block_until_ready(outs)
     phases["compute_s"] = round(
         time.perf_counter() - (compute_t0 or t0), 3)
+    # join the shard watchers (their blocks resolved with the barrier
+    # above) so the mesh block read after this run is complete
+    tel.meshplane.drain()
     # 6 decimals, not the usual 3: a small smoke's overlapped put
     # dispatch is sub-millisecond, and "overlap happened at all" must
     # survive the rounding
@@ -914,6 +930,10 @@ def serve_bench(levels=None, total_requests=None, tickers=None,
         # estimate with the explicit `available: false` marker on CPU;
         # regress derives the `<metric>.hbm_peak_bytes` series from it
         "hbm": tel.hbm.summary(),
+        # mesh-plane block (ISSUE 9): micro-batch fill at the serve
+        # dispatch boundary rides the same summary shape as the
+        # sharded records
+        "mesh": tel.meshplane.summary(),
         "stages": stages,
     }
 
@@ -1159,6 +1179,11 @@ def stream_bench(cohorts=None, tickers=None, updates=None, names=None,
         "stream": stream_counters,
         # HBM watermarks (ISSUE 8) — same contract as the serve record
         "hbm": tel.hbm.summary(),
+        # mesh-plane balance block (ISSUE 9): for the single-device
+        # streaming carry this is cohort occupancy (real rows per
+        # K-row scatter) with available=False until the carry itself
+        # shards; tpu_session's stream carry rule requires the block
+        "mesh": tel.meshplane.summary(),
         "stages": stages,
     }
 
@@ -1392,6 +1417,112 @@ def opsplane_smoke():
         and any(k.startswith("device.hbm_stats_available")
                 for k in gauges))
     return {"smoke": "opsplane", **checks,
+            "ok": all(checks.values())}
+
+
+# --------------------------------------------------------------------------
+# meshplane smoke (ISSUE 9): shard-balance gauges + skew-burst flight
+# dump + multihost aggregation, end to end on the virtual mesh
+# --------------------------------------------------------------------------
+
+
+def meshplane_smoke():
+    """run_tests.sh --quick smoke: the mesh observability plane end to
+    end on 8 virtual CPU devices. Runs a sharded resident group run
+    and checks that:
+
+      * every shard of the mesh has a nonzero ``mesh.shard_time_s``
+        gauge and the record-level ``mesh`` block carries a computed
+        ``shard_skew_ratio`` + the lcm-padding ``pad_waste_frac``;
+      * an injected artificial straggler trips a skew-burst flight
+        dump that ``telemetry.validate`` accepts and whose header
+        names the slow shard;
+      * two synthetic per-host bundles (distinct ``process_index``
+        stamps) merge through the ``telemetry.aggregate`` CLI into one
+        schema-valid pod bundle whose counter totals equal the
+        per-host sums.
+    """
+    import tempfile
+
+    from replication_of_minute_frequency_factor_tpu.parallel import (
+        resident_mesh)
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        Telemetry, set_telemetry)
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        aggregate as _agg)
+    from replication_of_minute_frequency_factor_tpu.telemetry.validate import (
+        validate_dir, validate_dump)
+
+    tel = set_telemetry(Telemetry())
+    tmp = tempfile.mkdtemp(prefix="mff_meshplane_")
+    checks = {}
+
+    # --- sharded resident group run on every visible device
+    rng = np.random.default_rng(13)
+    names = ("vol_return1min", "mmt_am", "doc_pdf60")
+    batches = [make_batch(rng, n_days=2, n_tickers=32) for _ in range(2)]
+    use_wire = wire.encode(*batches[0]) is not None
+    mesh = resident_mesh()
+    n_shards = mesh.devices.size
+    run_resident_sharded(batches, names, use_wire, group=1, mesh=mesh)
+    summary = tel.meshplane.summary()
+    gauges = tel.registry.snapshot()["gauges"]
+    shard_gauges = {k: v for k, v in gauges.items()
+                    if k.startswith("mesh.shard_time_s")}
+    checks["per_shard_gauges"] = (
+        len(shard_gauges) == n_shards > 1
+        and all(v > 0 for v in shard_gauges.values()))
+    checks["skew_computed"] = (
+        summary["available"]
+        and isinstance(summary["shard_skew_ratio"], float)
+        and summary["shard_skew_ratio"] >= 1.0
+        and summary["samples"] >= 2)  # one per scan group
+    checks["pad_waste"] = isinstance(summary["pad_waste_frac"], float)
+
+    # --- injected straggler -> skew-burst dump that names the shard
+    tel.meshplane.configure(dump_dir=tmp)
+    slow = {f"cpu:{i}": 0.01 for i in range(n_shards)}
+    slow[f"cpu:{n_shards - 1}"] = 0.5
+    dump_path = None
+    for _ in range(tel.meshplane.burst):
+        r = tel.meshplane.record_shard_times(slow, boundary="injected")
+        dump_path = r.get("burst_dump") or dump_path
+    named = False
+    if dump_path:
+        with open(dump_path) as fh:
+            recs = [json.loads(ln) for ln in fh if ln.strip()]
+        named = any(
+            rec.get("kind") == "dump"
+            and (rec["data"].get("extra") or {}).get("slow_shard")
+            == f"cpu:{n_shards - 1}" for rec in recs)
+    checks["skew_burst_dump_valid"] = (
+        bool(dump_path) and validate_dump(dump_path)["ok"])
+    checks["skew_burst_names_slow_shard"] = named
+
+    # --- two synthetic host bundles -> one pod bundle via the CLI
+    host_dirs = []
+    for i, n_req in enumerate((3, 5)):
+        ht = Telemetry(annotate_spans=False)
+        ht.counter("pod.requests", n_req)
+        ht.observe("pod.latency_s", 0.01 * (i + 1))
+        with ht.tracer("pod.step"):
+            pass
+        d = os.path.join(tmp, f"host{i}")
+        ht.write(d, process_index=i, host=f"host{i}")
+        host_dirs.append(d)
+    pod = os.path.join(tmp, "pod")
+    agg_rc = _agg.main([*host_dirs, "--out", pod])
+    checks["aggregate_cli_ok"] = agg_rc == 0
+    checks["pod_bundle_valid"] = validate_dir(pod)["ok"]
+    total = None
+    with open(os.path.join(pod, "metrics.jsonl")) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec.get("kind") == "counter" \
+                    and rec.get("name") == "pod.requests":
+                total = rec["value"]
+    checks["pod_counters_sum"] = total == 8
+    return {"smoke": "meshplane", "n_shards": n_shards, **checks,
             "ok": all(checks.values())}
 
 
@@ -1940,6 +2071,23 @@ def main():
                   f"(max_abs_diff={diag.get('max_abs_diff')})",
                   file=sys.stderr, flush=True)
 
+    # per-op-class device-time breakdown (ISSUE 9, closing PR 3's
+    # pending item): whenever a profile capture ran around the timed
+    # loop, post-process the trace dir into the device_time block —
+    # class totals + the device.collective_time_s collective
+    # attribution, with available=False (never silence) on captures
+    # without device pids (the CPU backend)
+    device_time = None
+    if loop_trace.profile_dir:
+        from replication_of_minute_frequency_factor_tpu.telemetry import (
+            attribution as _devattr)
+        try:
+            device_time = _devattr.device_time_block(
+                pdir_loop, telemetry=get_telemetry())
+        except Exception as e:  # noqa: BLE001 — diagnostics only
+            device_time = {"available": False,
+                           "error": f"{type(e).__name__}: {e}"[:200]}
+
     target = 60.0
     record = {
         # the name is DERIVED from the ticker count (ADVICE r5 medium:
@@ -1990,6 +2138,17 @@ def main():
         # only n_shards > 1 — a silent single-device fallback cannot
         # count as sharded validation)
         "n_shards": n_shards if mode == "resident" else 1,
+        # shard-balance telemetry (ISSUE 9): per-shard completion
+        # watermarks, skew ratio, lcm-padding waste — present exactly
+        # when the run was actually sharded (tpu_session's
+        # resident_sharded carry rule requires it: a record with no
+        # shard-balance telemetry cannot bank); regress derives the
+        # <metric>.shard_skew_ratio / .pad_waste_frac series from it
+        "mesh": (get_telemetry().meshplane.summary()
+                 if mode == "resident" and n_shards > 1 else None),
+        # per-op-class device time from the loop's profiler capture
+        # (null when no profile dir was configured/captured)
+        "device_time": device_time,
         # which rolling backend was REQUESTED (config) and which one
         # the graphs actually RESOLVED to at trace time (registry
         # counter; 'conv' under a 'pallas' request = the off-TPU

@@ -152,7 +152,14 @@ def drop_conv_only_rolling(steps):
     * since ISSUE 8 both serve and stream records must embed the HBM
       watermark block (``hbm`` with the explicit ``available``
       marker) — carried records feed the ``<metric>.hbm_peak_bytes``
-      regress series, so a watermark-less record cannot bank.
+      regress series, so a watermark-less record cannot bank;
+    * since ISSUE 9 'resident_sharded' and 'stream_intraday' records
+      must additionally embed the ``mesh`` shard-balance block
+      (telemetry/meshplane.py — per-shard watermarks/skew for the
+      sharded scan, cohort occupancy for the stream): the banked
+      trajectory feeds the ``<metric>.shard_skew_ratio`` /
+      ``.pad_waste_frac`` regress series, so a record with no
+      shard-balance telemetry cannot bank.
     """
     def keep(name, v):
         recs = [r for r in v.get("results") or [] if isinstance(r, dict)]
@@ -163,7 +170,9 @@ def drop_conv_only_rolling(steps):
                        and r.get("mode") == "resident"
                        and isinstance(r.get("n_shards"), int)
                        and r.get("n_shards") > 1
-                       and r.get("tickers") == 5000 for r in recs)
+                       and r.get("tickers") == 5000
+                       and isinstance(r.get("mesh"), dict)
+                       for r in recs)
         if name == "pallas":
             # rolling_impl_resolved (not just requested): a record whose
             # graphs silently fell back to conv is NOT kernel validation
@@ -298,6 +307,14 @@ def step_resident_sharded():
         r["error"] = ("sharded resident resolved to n_shards<=1 "
                       "(single-device fallback) — not sharded "
                       "validation; cannot bank")
+    if r.get("ok") and not any(
+            isinstance(rec, dict) and isinstance(rec.get("mesh"), dict)
+            for rec in r.get("results") or []):
+        # ISSUE 9: the sharded trajectory feeds the shard-skew regress
+        # series — a record without the mesh balance block cannot bank
+        r["ok"] = False
+        r["error"] = ("sharded resident record has no mesh "
+                      "shard-balance block — cannot bank")
     return r
 
 
@@ -375,7 +392,9 @@ def _stream_record_banks(rec) -> bool:
     warm and faithfully: declared methodology, streamed updates > 0,
     no compiles during load, empty parity-mismatch list — and, since
     ISSUE 8, the embedded HBM watermark block (same rationale as
-    :func:`_serve_record_banks`)."""
+    :func:`_serve_record_banks`), and, since ISSUE 9, the ``mesh``
+    balance block (cohort-occupancy telemetry: a record with no
+    shard-balance telemetry cannot bank)."""
     stream = rec.get("stream") or {}
     hbm = rec.get("hbm")
     return (rec.get("methodology") == "r9_stream_intraday_v1"
@@ -383,7 +402,8 @@ def _stream_record_banks(rec) -> bool:
             and stream["updates"] > 0
             and stream.get("compiles_during_load") == 0
             and stream.get("parity_mismatched") == []
-            and isinstance(hbm, dict) and "available" in hbm)
+            and isinstance(hbm, dict) and "available" in hbm
+            and isinstance(rec.get("mesh"), dict))
 
 
 def step_ladder():

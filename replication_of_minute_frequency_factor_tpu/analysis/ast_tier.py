@@ -54,18 +54,23 @@ MASKED_SCOPE = ("models",)
 #: here, per (package-relative module path -> allowed symbols). This is
 #: deliberately NOT a path exclusion: any sync symbol a boundary module
 #: uses beyond its listed set still flags, and every other module in
-#: the layer keeps the full rule. Two entries: the serving request
+#: the layer keeps the full rule. Three entries: the serving request
 #: loop, whose single declared sync is the ``np.asarray`` that
 #: materializes a query's answer from the device block
-#: (serve/service.py — the serve layer's host/device boundary), and
-#: the ops-plane watermark sampler (ISSUE 8), whose declared host
-#: reads are the device-memory introspection calls its sampler thread
-#: makes (telemetry/opsplane.py — the only module allowed to touch
-#: ``.memory_stats()`` / ``jax.live_arrays``).
+#: (serve/service.py — the serve layer's host/device boundary); the
+#: ops-plane watermark sampler (ISSUE 8), whose declared host reads
+#: are the device-memory introspection calls its sampler thread makes
+#: (telemetry/opsplane.py — the only module allowed to touch
+#: ``.memory_stats()`` / ``jax.live_arrays``); and the mesh-plane
+#: shard-balance sampler (ISSUE 9), whose declared sync is the
+#: per-shard ``.block_until_ready()`` readiness probe its watcher
+#: threads run (telemetry/meshplane.py — watermark blocking stays
+#: centralized there, never in an instrumented hot path).
 GLA3_BOUNDARY_SYNCS = {
     "serve/service.py": frozenset({"np.asarray"}),
     "telemetry/opsplane.py": frozenset({".memory_stats()",
                                         "jax.live_arrays"}),
+    "telemetry/meshplane.py": frozenset({".block_until_ready()"}),
 }
 
 #: (acquire, release) method-name pairs for GL-A4

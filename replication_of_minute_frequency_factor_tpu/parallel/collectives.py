@@ -197,9 +197,16 @@ def _xs_wrap(body, label: str):
     """Wrap a local body into a jitted shard_map over P(None, 'tickers').
 
     The outer (non-jit) wrapper spans the dispatch as
-    ``collective.<label>`` — host-side time to trace/launch the
-    collective graph (JAX dispatch is async, so this is NOT on-device
-    collective time; see docs/observability.md on reading these)."""
+    ``collective.<label>`` with an EXPLICIT ``kind=host_dispatch``
+    label (ISSUE 9): JAX dispatch is async, so this span is host-side
+    time to trace/launch the collective graph, NOT on-device
+    collective time — the label rides the span's Perfetto args and its
+    JSONL record, so the two can no longer be conflated in a trace
+    view. On-device collective seconds live in the attribution
+    post-processor's ``device.collective_time_s`` block
+    (``telemetry.attribution.collective_breakdown``), built from a
+    profiler capture's device pids. Each dispatch also counts in
+    ``mesh.collective_dispatches{label=}`` (telemetry/meshplane.py)."""
 
     @functools.partial(jax.jit, static_argnames=("mesh",))
     def run_jit(mesh: Mesh, *arrays):
@@ -212,7 +219,9 @@ def _xs_wrap(body, label: str):
         return fn(*arrays)
 
     def run(mesh: Mesh, *arrays):
-        with get_telemetry().span(f"collective.{label}"):
+        tel = get_telemetry()
+        tel.meshplane.note_collective(label)
+        with tel.tracer(f"collective.{label}", kind="host_dispatch"):
             return run_jit(mesh, *arrays)
 
     run.jitted = run_jit
@@ -263,8 +272,11 @@ def _xs_qcut_jit(mesh: Mesh, x, m, group_num: int = 5):
 
 
 def xs_qcut(mesh: Mesh, x, m, group_num: int = 5):
-    """Sharded per-date quantile-bucket labels (see xs_qcut_local)."""
-    with get_telemetry().span("collective.xs_qcut"):
+    """Sharded per-date quantile-bucket labels (see xs_qcut_local).
+    Same host-dispatch span semantics as :func:`_xs_wrap`."""
+    tel = get_telemetry()
+    tel.meshplane.note_collective("xs_qcut")
+    with tel.tracer("collective.xs_qcut", kind="host_dispatch"):
         return _xs_qcut_jit(mesh, x, m, group_num)
 
 
@@ -308,5 +320,5 @@ def sharded_compute_factors(
                      rolling_impl)
     tel = get_telemetry()
     tel.counter("collective.sharded_factor_batches")
-    with tel.span("collective.sharded_factors"):
+    with tel.tracer("collective.sharded_factors", kind="host_dispatch"):
         return fn(bars, mask)

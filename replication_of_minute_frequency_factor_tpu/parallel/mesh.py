@@ -114,11 +114,16 @@ def _pad_to_multiple(a: np.ndarray, mult: int, axis: int) -> np.ndarray:
 def shard_day_batch(bars, mask, mesh: Mesh):
     """Place a host day-batch onto the mesh, zero-padding the tickers axis
     to a shard multiple (padding lanes have mask=False so every masked
-    reduction ignores them).
+    reduction ignores them). The padding waste lands in the
+    ``mesh.pad_waste_frac{axis=tickers}`` gauge (ISSUE 9) — dead lanes
+    cost device time on every shard, and a universe/shard-count change
+    that silently doubles them should be visible, not archaeological.
 
     Returns ``(bars, mask, n_tickers)`` — callers slice results back to
     ``n_tickers``.
     """
+    from ..telemetry import get_telemetry
+
     bars = np.asarray(bars)
     mask = np.asarray(mask)
     batched = bars.ndim == 4
@@ -127,6 +132,8 @@ def shard_day_batch(bars, mask, mesh: Mesh):
     t_shards = mesh.shape[TICKERS_AXIS]
     bars = _pad_to_multiple(bars, t_shards, t_axis)
     mask = _pad_to_multiple(mask, t_shards, t_axis)
+    get_telemetry().meshplane.record_pad_waste(
+        n_tickers, bars.shape[t_axis], axis="tickers")
     if batched:
         d_shards = mesh.shape[DAYS_AXIS]
         bars = _pad_to_multiple(bars, d_shards, 0)

@@ -58,7 +58,8 @@ def initialize(coordinator_address: Optional[str] = None,
         return
     # spanned: on a pod slice this blocks until every process dials the
     # coordinator, so its duration IS the cross-host startup skew
-    with get_telemetry().span("multihost.initialize"):
+    tel = get_telemetry()
+    with tel.span("multihost.initialize"):
         try:
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
@@ -68,6 +69,14 @@ def initialize(coordinator_address: Optional[str] = None,
                 raise
             # single-process run without a coordinator: local devices only
             pass
+    # topology gauges (ISSUE 9): the pod aggregation's sanity anchors —
+    # every merged host bundle must agree on process_count, and each
+    # bundle's own index must match its schema-v3 identity stamps
+    try:
+        tel.gauge("multihost.process_index", jax.process_index())
+        tel.gauge("multihost.process_count", jax.process_count())
+    except Exception:  # noqa: BLE001 — telemetry must not fail startup
+        pass
 
 
 def global_mesh(shape: Optional[Tuple[int, int]] = None):
@@ -98,4 +107,13 @@ def shard_from_host_local(bars: np.ndarray, mask: np.ndarray, mesh):
                 NamedSharding(mesh, mask_spec(batched)), mask),
         )
     tel.counter("multihost.shards_built", host=host)
+    # shard-balance occupancy at the multihost ingest boundary (ISSUE
+    # 9): the fraction of this host's lanes that are real bars — a
+    # host feeding mostly-masked filler shows up in the pod skew view
+    # (``mask`` is the caller's HOST array; no device sync here)
+    try:
+        tel.meshplane.record_occupancy(float(mask.mean()),
+                                       boundary="multihost.ingest")
+    except Exception:  # noqa: BLE001 — observation must not fail ingest
+        pass
     return out

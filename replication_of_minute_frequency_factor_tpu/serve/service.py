@@ -640,6 +640,11 @@ class FactorServer:
             "group_size": len(group), "cache_hit": cached,
             "block_s": round(block_s, 6)})
         tel.hbm.sample("serve.dispatch")
+        # micro-batch fill at the serve dispatch boundary (ISSUE 9):
+        # coalesced requests per dispatch vs the configured ceiling
+        tel.meshplane.record_occupancy(
+            len(group) / max(1, self.scfg.max_batch),
+            boundary="serve.dispatch")
         if ok:
             self._breaker_ok()
         else:

@@ -183,6 +183,9 @@ class StreamEngine:
                     time.perf_counter() - t0, kind="scan")
         tel.counter("stream.updates", kind="scan")
         tel.counter("stream.bars", n_bars)
+        # useful-lane fraction of the scan micro-batch (ISSUE 9)
+        tel.meshplane.record_occupancy(
+            n_bars / (b * t) if b * t else 0.0, boundary="stream.scan")
         self.minutes += b
         self._note_carry()
         # HBM watermark at the ingest dispatch boundary (ISSUE 8;
@@ -208,6 +211,12 @@ class StreamEngine:
                     time.perf_counter() - t0, kind="cohort")
         tel.counter("stream.updates", kind="cohort")
         tel.counter("stream.bars", n_real)
+        # cohort occupancy at the streaming dispatch boundary (ISSUE
+        # 9): real rows per K-row scatter — the cohort executable pays
+        # for K lanes regardless, so a mostly-padded feed wastes
+        # device time invisibly without this gauge
+        tel.meshplane.record_occupancy(n_real / k if k else 0.0,
+                                       boundary="stream.cohort")
         tel.hbm.sample("stream.ingest")
 
     def advance(self) -> None:

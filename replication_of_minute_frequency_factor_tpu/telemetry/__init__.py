@@ -31,6 +31,7 @@ from typing import Dict, List, Optional
 
 from ..utils.tracing import Timer
 from .attribution import TraceCapture, reconcile
+from .meshplane import MeshPlane
 from .opsplane import (FlightRecorder, HbmSampler, canonical_trace_id,
                        gen_trace_id, to_prometheus)
 from .registry import Histogram, MetricsRegistry, render_key
@@ -39,10 +40,11 @@ from .spans import SpanTracer
 
 __all__ = [
     "SCHEMA_VERSION", "EventSink", "FlightRecorder", "HbmSampler",
-    "Histogram", "MetricsRegistry", "SpanTracer", "StageTimer",
-    "Telemetry", "TraceCapture", "canonical_trace_id", "gen_trace_id",
-    "get_telemetry", "reconcile", "render_key", "set_telemetry",
-    "to_prometheus", "validate_jsonl", "validate_record",
+    "Histogram", "MeshPlane", "MetricsRegistry", "SpanTracer",
+    "StageTimer", "Telemetry", "TraceCapture", "canonical_trace_id",
+    "gen_trace_id", "get_telemetry", "reconcile", "render_key",
+    "set_telemetry", "to_prometheus", "validate_jsonl",
+    "validate_record",
 ]
 
 #: retained free-form events bound (events past it count, not retain)
@@ -95,6 +97,7 @@ class Telemetry:
         self._requests: List[dict] = []
         self._requests_dropped = 0
         self._hbm: Optional[HbmSampler] = None
+        self._meshplane: Optional[MeshPlane] = None
         self._lock = threading.Lock()
 
     @property
@@ -108,6 +111,18 @@ class Telemetry:
                 if self._hbm is None:
                     self._hbm = HbmSampler(telemetry=self)
         return self._hbm
+
+    @property
+    def meshplane(self) -> MeshPlane:
+        """The shard-balance sampler bound to this telemetry (created
+        on first use; ISSUE 9). Sharded hot paths call
+        ``tel.meshplane.watch_async(out, boundary, t0)`` at dispatch
+        boundaries — never-raising and non-blocking by contract."""
+        if self._meshplane is None:
+            with self._lock:
+                if self._meshplane is None:
+                    self._meshplane = MeshPlane(telemetry=self)
+        return self._meshplane
 
     # --- emit -----------------------------------------------------------
     def counter(self, name: str, value: float = 1.0, **labels) -> None:
@@ -150,7 +165,9 @@ class Telemetry:
 
     # --- persist --------------------------------------------------------
     def write(self, out_dir: str, cfg=None,
-              manifest_extra: Optional[dict] = None) -> Dict[str, str]:
+              manifest_extra: Optional[dict] = None,
+              process_index: Optional[int] = None,
+              host: Optional[str] = None) -> Dict[str, str]:
         """Write the run bundle into ``out_dir``:
 
         * ``manifest.json`` — provenance (once per run);
@@ -159,15 +176,26 @@ class Telemetry:
           free-form event;
         * ``trace.json`` — Chrome/Perfetto ``trace_events``.
 
+        Every record (and the manifest) carries the schema-v3
+        multihost identity stamps (ISSUE 9): ``process_index``/``host``
+        from :func:`..manifest.process_identity` unless overridden here
+        — in a multihost run each process writes its OWN bundle and
+        ``telemetry.aggregate`` merges them into the pod view.
+
         Returns ``{artifact: path}``.
         """
         from .attribution import xla_summary
-        from .manifest import build_manifest
+        from .manifest import build_manifest, process_identity
 
         os.makedirs(out_dir, exist_ok=True)
         paths = {"manifest": os.path.join(out_dir, "manifest.json"),
                  "metrics": os.path.join(out_dir, "metrics.jsonl"),
                  "trace": os.path.join(out_dir, "trace.json")}
+        identity = process_identity()
+        if process_index is not None:
+            identity["process_index"] = int(process_index)
+        if host is not None:
+            identity["host"] = str(host)
         # the compile/cost story is provenance: stamp it into the
         # manifest so "what did this run compile, and did the cache
         # help" is answerable without replaying the metrics stream
@@ -175,10 +203,11 @@ class Telemetry:
         if xla:
             manifest_extra = {"xla": xla, **(manifest_extra or {})}
         manifest = build_manifest(cfg, manifest_extra)
+        manifest.update(identity)
         import json
         with open(paths["manifest"], "w") as fh:
             json.dump(manifest, fh, indent=1)
-        with EventSink(paths["metrics"]) as sink:
+        with EventSink(paths["metrics"], common=identity) as sink:
             sink.emit("manifest", payload=manifest)
             for rec in self.registry.records():
                 sink.emit(**{k: v for k, v in rec.items()})

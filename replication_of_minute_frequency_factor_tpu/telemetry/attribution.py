@@ -184,6 +184,62 @@ def classify_op(name: str) -> str:
     return "other"
 
 
+#: canonical collective kinds WITHIN the 'collective' op class (ISSUE
+#: 9): the mesh's three primitives — ranking's tiled all-gather, the
+#: psum/pmin moment reductions (XLA lowers both to all-reduce), and
+#: the permute/scatter family. First match wins; anything the class
+#: pattern caught but these don't lands in ``other_collective``.
+COLLECTIVE_KIND_PATTERNS: Tuple[Tuple[str, "re.Pattern"], ...] = tuple(
+    (kind, re.compile(pat, re.IGNORECASE)) for kind, pat in (
+        ("all_gather", r"all-?gather"),
+        ("reduce_scatter", r"reduce-?scatter"),
+        ("all_reduce", r"all-?reduce|\bpsum\b|\bpmin\b|\bpmax\b"),
+        ("all_to_all", r"all-?to-?all"),
+        ("collective_permute", r"collective-?permute"),
+    ))
+
+
+def classify_collective(name: str) -> str:
+    """Canonical collective kind of one collective-class op name."""
+    for kind, pat in COLLECTIVE_KIND_PATTERNS:
+        if pat.search(name):
+            return kind
+    return "other_collective"
+
+
+def collective_breakdown(events: Sequence[dict],
+                         processes: Dict[int, str]) -> dict:
+    """On-device collective attribution (ISSUE 9): total + per-kind
+    device time of collective-class ops across the device pids — the
+    ON-DEVICE counterpart of the host-side ``collective.*`` dispatch
+    spans (which carry ``kind=host_dispatch`` exactly so the two are
+    never conflated; see parallel/collectives.py)."""
+    dev_pids = {pid for pid, name in processes.items()
+                if _is_device_process(name)}
+    by_kind: Dict[str, float] = {}
+    n = 0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        dur = e.get("dur")
+        name = e.get("name")
+        if not isinstance(dur, (int, float)) or not isinstance(name, str):
+            continue
+        if classify_op(name) != "collective":
+            continue
+        n += 1
+        kind = classify_collective(name)
+        by_kind[kind] = by_kind.get(kind, 0.0) + float(dur)
+    return {
+        "collective_events": n,
+        "total_collective_us": round(sum(by_kind.values()), 1),
+        "by_kind_us": {k: round(v, 1)
+                       for k, v in sorted(by_kind.items(),
+                                          key=lambda kv: kv[1],
+                                          reverse=True)},
+    }
+
+
 def find_trace_files(root: str) -> List[str]:
     """Chrome-trace files under ``root`` (recursive): the profiler's
     ``*.trace.json.gz``, plain ``*.trace.json``, and the span export's
@@ -301,8 +357,45 @@ def summarize_trace_dir(profile_dir: str) -> dict:
         "files": len(files),
         "events": len(all_events),
         "device_breakdown": device_op_breakdown(all_events, procs),
+        "collective_breakdown": collective_breakdown(all_events, procs),
         "stage_annotations_us": stage_annotation_totals(all_events),
     }
+
+
+def device_time_block(profile_dir: str, telemetry=None) -> dict:
+    """The per-op-class device-time block bench records embed whenever
+    a profile dir was captured (ISSUE 9, closing PR 3's pending item):
+    class totals in SECONDS plus the ``device.collective_time_s``
+    collective attribution. ``available`` is the explicit marker — a
+    capture with no device pids (the CPU backend puts XLA ops on the
+    host pid) yields ``available: false`` with zeroed totals, so a
+    CPU run can never be read as a measured device-time breakdown
+    (same contract as ``hbm.available``). With a ``telemetry``, the
+    totals also land as ``device.device_time_s{class=}`` /
+    ``device.collective_time_s{op=}`` gauges."""
+    s = summarize_trace_dir(profile_dir)
+    db = s["device_breakdown"]
+    cb = s["collective_breakdown"]
+    block = {
+        "profile_dir": profile_dir,
+        "files": s["files"],
+        "available": db["device_events"] > 0,
+        "device_events": db["device_events"],
+        "device_time_s": round(db["total_device_us"] / 1e6, 6),
+        "by_class_s": {k: round(v / 1e6, 6)
+                       for k, v in db["by_class_us"].items()},
+        "collective_time_s": round(cb["total_collective_us"] / 1e6, 6),
+        "collectives": {k: round(v / 1e6, 6)
+                        for k, v in cb["by_kind_us"].items()},
+    }
+    if telemetry is not None and block["available"]:
+        for cls, v in block["by_class_s"].items():
+            telemetry.gauge("device.device_time_s", v, **{"class": cls})
+        telemetry.gauge("device.collective_time_s",
+                        block["collective_time_s"])
+        for op, v in block["collectives"].items():
+            telemetry.gauge("device.collective_time_s", v, op=op)
+    return block
 
 
 # --------------------------------------------------------------------------

@@ -62,6 +62,31 @@ def _wire_spec() -> dict:
             "vol10_bytes": wire.VOL10_BYTES, "i16_max": wire._I16}
 
 
+def process_identity() -> dict:
+    """The multihost identity stamps (schema v3, ISSUE 9):
+    ``{"process_index", "host"}``. Resolution order for the index:
+    ``MFF_PROCESS_INDEX`` (the override simulated-multihost tests and
+    launch scripts use), then ``jax.process_index()`` — probed only
+    when jax is ALREADY imported, same wedged-tunnel rationale as
+    :func:`_device_topology` — else 0. The host label is
+    ``MFF_HOST_LABEL`` or the node name."""
+    idx = None
+    env = os.environ.get("MFF_PROCESS_INDEX")
+    if env is not None:
+        try:
+            idx = int(env)
+        except ValueError:
+            idx = None
+    if idx is None and "jax" in sys.modules:
+        try:
+            import jax
+            idx = jax.process_index()  # a plain Python int
+        except Exception:  # noqa: BLE001 — identity must not raise
+            idx = None
+    return {"process_index": idx if idx is not None else 0,
+            "host": os.environ.get("MFF_HOST_LABEL") or platform.node()}
+
+
 def config_hash(cfg) -> str:
     """sha256 of the sorted-JSON config; the manifest's join key back to
     a reproducible configuration."""
@@ -89,7 +114,7 @@ def build_manifest(cfg=None, extra: Optional[dict] = None) -> dict:
         "devices": _device_topology(),
         "wire_spec": _wire_spec(),
         "git_sha": _git_sha(),
-        "host": platform.node(),
+        **process_identity(),
         "pid": os.getpid(),
         "argv": list(sys.argv),
         "analysis": _analysis_block(),

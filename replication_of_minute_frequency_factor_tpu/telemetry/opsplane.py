@@ -377,12 +377,15 @@ class FlightRecorder:
         if target is None:
             return None
         try:
+            from .manifest import process_identity
             from .sink import EventSink
             os.makedirs(target, exist_ok=True)
             name = f"flight_{os.getpid()}_{seq:03d}_{trigger}.jsonl"
             path = os.path.join(target, name)
             tmp = path + ".tmp"
-            with EventSink(tmp) as sink:
+            # identity-stamped (schema v3): a pod aggregation can tell
+            # which host's anomaly each dump records
+            with EventSink(tmp, common=process_identity()) as sink:
                 sink.emit("dump", trigger=trigger, data={
                     "requests": len(requests),
                     "last_dispatch": last_dispatch,

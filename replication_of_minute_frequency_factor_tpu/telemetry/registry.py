@@ -93,6 +93,28 @@ class Histogram:
                 "p50": self.percentile(0.50),
                 "p95": self.percentile(0.95)}
 
+    @classmethod
+    def from_stats(cls, count, total, vmin=None, vmax=None,
+                   p50=None, p95=None, bound: int = HIST_BOUND
+                   ) -> "Histogram":
+        """Reconstitute a histogram from its persisted JSONL stats
+        (ISSUE 9: ``telemetry.aggregate`` rebuilding per-host
+        registries from their written bundles). ``count``/``sum``/
+        ``min``/``max`` are exact — merging reconstituted histograms
+        keeps pod counts and sums equal to the per-host sums by
+        construction; the reservoir is re-seeded from the four known
+        order statistics, so merged percentiles are APPROXIMATE (the
+        full sample stream is not persisted) and are documented as
+        such in the pod bundle."""
+        h = cls(bound)
+        h.count = int(count)
+        h.total = float(total)
+        h.min = None if vmin is None else float(vmin)
+        h.max = None if vmax is None else float(vmax)
+        h._samples = sorted(float(v) for v in (vmin, p50, p95, vmax)
+                            if v is not None)
+        return h
+
     def copy(self) -> "Histogram":
         """Independent snapshot of this histogram's state — taken under
         the owning registry's lock so a concurrent ``observe`` on the
@@ -198,6 +220,39 @@ class MetricsRegistry:
                 out.append({"kind": "histogram", "name": n,
                             "labels": dict(ls), **h.stats()})
         return out
+
+    def ingest_record(self, rec: dict) -> bool:
+        """Fold one persisted metric record (the :meth:`records` /
+        JSONL shape) back into this registry — the inverse direction,
+        used by ``telemetry.aggregate`` to reconstitute a per-host
+        registry from its written bundle before the deep-copy
+        :meth:`merge`. Counters ADD (re-ingesting twice double-counts
+        — aggregation reads each bundle once), gauges last-write-win,
+        histograms reconstitute via :class:`Histogram.from_stats`.
+        Returns False for non-metric kinds."""
+        kind = rec.get("kind")
+        name = rec.get("name")
+        labels = rec.get("labels") or {}
+        if not isinstance(name, str):
+            return False
+        if kind == "counter":
+            self.counter(name, float(rec["value"]), **labels)
+            return True
+        if kind == "gauge":
+            self.gauge(name, float(rec["value"]), **labels)
+            return True
+        if kind == "histogram":
+            h = Histogram.from_stats(rec["count"], rec["sum"],
+                                     rec.get("min"), rec.get("max"),
+                                     rec.get("p50"), rec.get("p95"))
+            k = _key(name, labels)
+            with self._lock:
+                mine = self._hists.get(k)
+                if mine is None:
+                    mine = self._hists[k] = Histogram(h.bound)
+                mine.merge(h)
+            return True
+        return False
 
     def merge(self, other: "MetricsRegistry") -> "MetricsRegistry":
         """Fold ``other`` into self: counters sum, gauges last-write-wins

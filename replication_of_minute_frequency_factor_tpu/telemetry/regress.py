@@ -50,6 +50,14 @@ own gateable groups under the parent's methodology — see
 :func:`derive_records`. A CPU fallback's live-arrays estimate
 (``available: false``) never seeds or gates an HBM baseline.
 
+Mesh sub-series (ISSUE 9, same availability contract): a sharded
+record whose ``mesh.available`` is true (real shard watermarks were
+sampled — occupancy/pad numbers alone never qualify) contributes
+``<metric>.shard_skew_ratio`` (per-shard balance drifting apart is a
+regression the wall-clock headline hides until it IS the wall) and
+``<metric>.pad_waste_frac`` (the lcm ticker-padding waste — a universe
+or shard-count change that silently doubles dead lanes flags here).
+
 Baseline = median of every record in the group EXCEPT the latest; the
 latest is the record under test. ``--check FILE`` instead gates a fresh
 candidate record against the baseline of the FULL banked group (the
@@ -249,6 +257,25 @@ def derive_records(record: dict) -> List[dict]:
                         "value": float(peak), "unit": "bytes",
                         "methodology": meth,
                         "derived_from": "hbm.peak_bytes"})
+    # mesh balance sub-series (ISSUE 9): gated on mesh.available — only
+    # records with REAL shard watermarks (telemetry/meshplane.py) seed
+    # or gate the balance baselines
+    mesh = record.get("mesh")
+    if isinstance(mesh, dict) and mesh.get("available"):
+        skew = mesh.get("shard_skew_ratio")
+        if isinstance(skew, (int, float)) and not isinstance(skew, bool) \
+                and skew > 0:
+            out.append({"metric": f"{metric}.shard_skew_ratio",
+                        "value": float(skew), "unit": "ratio",
+                        "methodology": meth,
+                        "derived_from": "mesh.shard_skew_ratio"})
+        waste = mesh.get("pad_waste_frac")
+        if isinstance(waste, (int, float)) \
+                and not isinstance(waste, bool) and waste >= 0:
+            out.append({"metric": f"{metric}.pad_waste_frac",
+                        "value": float(waste), "unit": "frac",
+                        "methodology": meth,
+                        "derived_from": "mesh.pad_waste_frac"})
     return out
 
 

@@ -18,6 +18,15 @@ Version history:
   rejects v2-only kinds/fields on records that declare ``schema: 1``,
   so both directions are checkable (regression-tested in
   tests/test_opsplane.py).
+* **v3** (ISSUE 9, the mesh observability plane) — every kind may
+  carry ``process_index`` (int) and ``host`` (str), the multihost
+  identity stamps ``Telemetry.write`` applies so
+  ``telemetry.aggregate`` can merge per-host bundles into one pod
+  bundle without guessing provenance; ``span`` records may carry
+  ``labels`` (the span's label dict, e.g. ``kind=host_dispatch`` on
+  the collective dispatch spans). Same both-direction contract: a
+  record declaring ``schema <= 2`` that carries any of these FLAGS
+  (regression-tested in tests/test_meshplane.py).
 """
 
 from __future__ import annotations
@@ -27,7 +36,7 @@ import threading
 import time
 from typing import IO, Iterator, List, Optional, Tuple
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 #: kind -> required fields beyond the envelope (field, allowed types).
 #: histogram stat fields admit None (an empty histogram has no min/max).
@@ -58,9 +67,13 @@ KIND_FIELDS = {
 V2_ONLY_KINDS = frozenset({"request", "dump"})
 
 #: (kind, field) -> (allowed types, minimum schema): optional fields
-#: that are type-checked when present and version-gated
+#: that are type-checked when present and version-gated. Kind ``"*"``
+#: applies to every kind — the v3 multihost identity stamps.
 OPTIONAL_FIELDS = {
     ("span", "trace_id"): ((str,), 2),
+    ("span", "labels"): ((dict,), 3),
+    ("*", "process_index"): ((int,), 3),
+    ("*", "host"): ((str,), 3),
 }
 
 
@@ -96,7 +109,7 @@ def validate_record(rec) -> List[str]:
             problems.append(
                 f"{kind}.{field}={v!r} has type {type(v).__name__}")
     for (k, field), (types, min_schema) in OPTIONAL_FIELDS.items():
-        if k != kind or field not in rec:
+        if k not in ("*", kind) or field not in rec:
             continue
         v = rec[field]
         if schema < min_schema:
@@ -133,16 +146,22 @@ def validate_jsonl(path: str) -> Iterator[Tuple[int, List[str]]]:
 class EventSink:
     """Append-only JSONL writer stamping the schema envelope on every
     record; thread-safe, line-buffered (one flush per record so a
-    crashed run keeps everything emitted before the crash)."""
+    crashed run keeps everything emitted before the crash).
 
-    def __init__(self, path: str):
+    ``common`` fields (the v3 multihost identity stamps —
+    ``process_index``/``host``) land on EVERY emitted record; explicit
+    per-record fields win over them, so an aggregator re-emitting a
+    foreign host's records keeps their original stamps."""
+
+    def __init__(self, path: str, common: Optional[dict] = None):
         self.path = path
+        self._common = dict(common or {})
         self._fh: Optional[IO[str]] = open(path, "a")
         self._lock = threading.Lock()
 
     def emit(self, kind: str, **fields) -> dict:
         rec = {"schema": SCHEMA_VERSION, "ts": round(time.time(), 3),
-               "kind": kind, **fields}
+               "kind": kind, **self._common, **fields}
         problems = validate_record(rec)
         if problems:
             raise ValueError(f"refusing to emit schema-invalid record: "

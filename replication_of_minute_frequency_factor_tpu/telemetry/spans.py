@@ -70,12 +70,14 @@ class SpanTracer:
     def __call__(self, name: str, trace_id: Optional[str] = None,
                  **labels):
         """Extra ``labels`` ride on the ``span_seconds`` histogram
-        observation only (e.g. ``rolling_impl=``, so per-stage
-        histograms say which backend a stage's time belongs to); the
-        span name, totals and trace export are label-free — attribution
-        joins on the bare name. ``trace_id`` (schema v2, ISSUE 8) rides
-        the retained EVENT instead: request-scoped spans join their
-        request's lifecycle in the JSONL export."""
+        observation AND the retained event (schema v3, ISSUE 9: the
+        Chrome/Perfetto export and the JSONL span records carry them
+        as args — e.g. ``kind=host_dispatch`` on collective dispatch
+        spans, so a host-side span can never be read as on-device
+        time); the span NAME, totals and attribution joins stay
+        label-free. ``trace_id`` (schema v2, ISSUE 8) rides the
+        retained event too: request-scoped spans join their request's
+        lifecycle in the JSONL export."""
         self._tls.depth = depth = self._depth() + 1
         t0 = time.perf_counter()
         try:
@@ -101,6 +103,9 @@ class SpanTracer:
                 }
                 if trace_id is not None:
                     event["trace_id"] = trace_id
+                if labels:
+                    event["labels"] = {str(k): str(v)
+                                       for k, v in labels.items()}
                 self._events.append(event)
             else:
                 self.dropped_spans += 1
@@ -144,10 +149,14 @@ class SpanTracer:
             "traceEvents": [
                 {"name": e["name"], "ph": "X", "pid": pid,
                  "tid": e["tid"], "ts": e["ts_us"], "dur": e["dur_us"],
-                 "args": ({"depth": e["depth"],
-                           "trace_id": e["trace_id"]}
-                          if "trace_id" in e else
-                          {"depth": e["depth"]})}
+                 "args": {
+                     "depth": e["depth"],
+                     **({"trace_id": e["trace_id"]}
+                        if "trace_id" in e else {}),
+                     # span labels surface in Perfetto's args pane, so
+                     # e.g. kind=host_dispatch is visible per slice
+                     **(e.get("labels") or {}),
+                 }}
                 for e in self.events()
             ],
         }

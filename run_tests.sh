@@ -75,6 +75,17 @@ print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     # JSON verdict line, nonzero on any missing piece
     run python -c "import json, sys, bench; r = bench.opsplane_smoke(); \
 print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
+    # meshplane smoke (ISSUE 9): the mesh observability plane on 8
+    # virtual CPU devices — a sharded resident group run must publish
+    # nonzero per-shard time gauges with a computed skew ratio, an
+    # injected straggler must trip a skew-burst flight dump that
+    # telemetry.validate accepts and that names the slow shard, and
+    # the aggregate CLI must merge two synthetic host bundles into one
+    # schema-valid pod bundle whose counter totals equal the per-host
+    # sums; one JSON verdict line, nonzero on any missing piece
+    run env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -c "import json, sys, bench; r = bench.meshplane_smoke(); \
+print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     # graftlint (ISSUE 4): AST rules over the whole package + jaxpr
     # contracts over all 58 registered kernels AND the resident scan
     # wrappers (abstract trace on CPU), gated on the committed baseline

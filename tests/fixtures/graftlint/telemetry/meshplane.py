@@ -1,0 +1,12 @@
+"""GL-A3 boundary-policy fixture (ISSUE 9): this path matches the
+policy key ``telemetry/meshplane.py`` (ast_tier.GLA3_BOUNDARY_SYNCS),
+whose allowed set is exactly ``{".block_until_ready()"}`` — the shard
+watermark probe's blocking must NOT flag here, every other sync symbol
+still must (a boundary module is not a blanket exclusion)."""
+import numpy as np
+
+
+def watermark(shard, t0, now):
+    shard.data.block_until_ready()      # allowed by the boundary policy
+    host = np.asarray(shard.data)       # NOT allowed: still flags
+    return host, now - t0

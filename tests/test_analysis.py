@@ -41,7 +41,7 @@ def _codes_by_file(violations):
 @pytest.fixture(scope="module")
 def fixture_violations():
     violations, n_files = run_ast_tier(FIXTURES, display_base=REPO)
-    assert n_files == 10
+    assert n_files == 11
     return violations
 
 
@@ -114,20 +114,32 @@ def test_a3_boundary_policy_is_not_a_blanket_exclusion(
 
 
 def test_a3_policy_matches_the_real_request_loop():
-    """The committed policy has exactly two entries — the serving
-    request loop with its one declared sync and the ops-plane sampler
-    with its device-memory reads (ISSUE 8) — and scanning the real
-    package stays clean under it (the policy is load-bearing: docs
-    list it)."""
+    """The committed policy has exactly three entries — the serving
+    request loop with its one declared sync, the ops-plane sampler
+    with its device-memory reads (ISSUE 8), and the mesh-plane
+    shard-watermark prober with its per-shard blocking (ISSUE 9) — and
+    scanning the real package stays clean under it (the policy is
+    load-bearing: docs list it)."""
     from replication_of_minute_frequency_factor_tpu.analysis import (
         ast_tier)
     assert ast_tier.GLA3_BOUNDARY_SYNCS == {
         "serve/service.py": frozenset({"np.asarray"}),
         "telemetry/opsplane.py": frozenset({".memory_stats()",
-                                            "jax.live_arrays"})}
+                                            "jax.live_arrays"}),
+        "telemetry/meshplane.py": frozenset({".block_until_ready()"})}
     violations, _ = ast_tier.run_ast_tier()
     assert not [v for v in violations if "/serve/" in v.path]
     assert not [v for v in violations if "/telemetry/" in v.path]
+
+
+def test_a3_meshplane_boundary_allows_blocking_only(
+        fixture_violations):
+    """ISSUE 9: the meshplane boundary fixture uses its one allowed
+    sync (.block_until_ready()) plus a banned np.asarray — only the
+    banned symbol flags, and blocking still flags in every OTHER
+    telemetry module (sampler_like's scope test covers the layer)."""
+    hits = _codes_by_file(fixture_violations)["meshplane.py"]
+    assert [(c, s) for c, _, s in hits] == [("GL-A3", "np.asarray")]
 
 
 def test_a3_memreads_flag_outside_the_opsplane_boundary(
@@ -324,7 +336,7 @@ def test_cli_flags_fixtures_then_baseline_clears_them(tmp_path):
             "--report", report)
     out = _run_cli(*args)
     assert out.returncode == 1
-    assert json.loads(out.stdout.strip().splitlines()[-1])["new"] == 19
+    assert json.loads(out.stdout.strip().splitlines()[-1])["new"] == 20
     # refuse to baseline without a why
     out = _run_cli(*args, "--update-baseline")
     assert out.returncode == 2
@@ -337,7 +349,7 @@ def test_cli_flags_fixtures_then_baseline_clears_them(tmp_path):
     out = _run_cli(*args)
     assert out.returncode == 0
     assert json.loads(
-        out.stdout.strip().splitlines()[-1])["baselined"] == 19
+        out.stdout.strip().splitlines()[-1])["baselined"] == 20
 
 
 def test_manifest_carries_the_analysis_block(tmp_path):

@@ -1,0 +1,438 @@
+"""Mesh observability plane (ISSUE 9): per-shard balance telemetry
+with skew-burst flight dumps, schema-v3 identity stamps (both
+directions), multihost bundle aggregation, on-device collective
+attribution, and the host-dispatch span relabeling."""
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from replication_of_minute_frequency_factor_tpu.parallel import (
+    resident_mesh, xs_masked_mean)
+from replication_of_minute_frequency_factor_tpu.telemetry import (
+    MeshPlane, SCHEMA_VERSION, Telemetry, get_telemetry, set_telemetry,
+    validate_record)
+from replication_of_minute_frequency_factor_tpu.telemetry import (
+    aggregate, attribution)
+from replication_of_minute_frequency_factor_tpu.telemetry.validate import (
+    validate_dir, validate_dump)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _tel():
+    return Telemetry(annotate_spans=False)
+
+
+# --------------------------------------------------------------------------
+# shard-balance sampling
+# --------------------------------------------------------------------------
+
+
+def test_record_shard_times_publishes_gauges_and_skew():
+    tel = _tel()
+    r = tel.meshplane.record_shard_times(
+        {"cpu:0": 0.1, "cpu:1": 0.1, "cpu:2": 0.3}, boundary="b")
+    assert r["skew_ratio"] == 3.0
+    assert r["slow_shard"] == "cpu:2"
+    g = tel.registry.snapshot()["gauges"]
+    assert g["mesh.shard_time_s{shard=cpu:0}"] == 0.1
+    assert g["mesh.shard_time_s{shard=cpu:2}"] == 0.3
+    assert g["mesh.shard_skew_ratio"] == 3.0
+    assert tel.registry.counter_value("mesh.samples", boundary="b") == 1
+    s = tel.meshplane.summary()
+    assert s["available"] and s["n_shards"] == 3
+    assert s["slow_shard"] == "cpu:2" and s["skew_bursts"] == 0
+
+
+def test_degenerate_input_never_raises():
+    tel = _tel()
+    assert tel.meshplane.record_shard_times({}) == {}
+    assert tel.meshplane.record_shard_times({"a": "xyz"}) == {}
+    assert tel.meshplane.record_pad_waste(-1, 4) is None
+    assert tel.meshplane.record_pad_waste(8, 0) is None
+    tel.meshplane.record_occupancy("not a number")
+    assert not tel.meshplane.summary()["available"]
+
+
+def test_skew_burst_dumps_and_names_the_slow_shard(tmp_path):
+    tel = _tel()
+    mp = MeshPlane(telemetry=tel, dump_dir=str(tmp_path),
+                   skew_threshold=2.0, burst=2)
+    skewed = {"cpu:0": 0.01, "cpu:1": 0.01, "cpu:2": 0.01, "cpu:3": 0.5}
+    # first over-threshold sample: armed, no dump yet
+    assert mp.record_shard_times(skewed, "g")["burst_dump"] is None
+    path = mp.record_shard_times(skewed, "g")["burst_dump"]
+    assert path and validate_dump(path)["ok"]
+    with open(path) as fh:
+        recs = [json.loads(ln) for ln in fh if ln.strip()]
+    header = next(r for r in recs if r["kind"] == "dump")
+    assert header["trigger"] == "shard_skew_burst"
+    extra = header["data"]["extra"]
+    assert extra["slow_shard"] == "cpu:3"
+    assert extra["skew_ratio"] == 50.0
+    assert extra["boundary"] == "g"
+    assert mp.summary()["skew_bursts"] == 1
+    assert tel.registry.counter_value("mesh.skew_bursts",
+                                      boundary="g") == 1
+
+
+def test_balanced_sample_resets_the_burst_counter(tmp_path):
+    # two shards bound max/median below 2, so use a lower threshold
+    mp = MeshPlane(telemetry=_tel(), dump_dir=str(tmp_path),
+                   skew_threshold=1.5, burst=2)
+    skewed = {"a": 0.01, "b": 0.5}
+    balanced = {"a": 0.1, "b": 0.1}
+    assert mp.record_shard_times(skewed)["burst_dump"] is None
+    assert mp.record_shard_times(balanced)["burst_dump"] is None
+    # the balanced sample reset the run: one more skewed sample must
+    # NOT dump (consecutive = 1 < burst)
+    assert mp.record_shard_times(skewed)["burst_dump"] is None
+    assert mp.summary()["skew_bursts"] == 0
+    assert not list(tmp_path.glob("flight_*.jsonl"))
+
+
+def test_measure_ready_watermarks_a_sharded_array():
+    tel = _tel()
+    mesh = resident_mesh()
+    n = mesh.devices.size
+    assert n == 8  # the conftest virtual mesh
+    arr = jax.device_put(np.ones((2, 16), np.float32),
+                         NamedSharding(mesh, P(None, "tickers")))
+    r = tel.meshplane.measure_ready(arr, boundary="test")
+    assert r["n_shards"] == n
+    s = tel.meshplane.summary()
+    assert s["available"] and s["n_shards"] == n
+    assert all(v >= 0 for v in s["shard_time_s"].values())
+    assert set(s["shard_time_s"]) == {f"cpu:{d.id}"
+                                      for d in mesh.devices.flat}
+
+
+def test_watch_async_does_not_block_and_drains():
+    tel = _tel()
+    arr = jax.device_put(np.arange(8.0))
+    t0 = time.perf_counter()
+    tel.meshplane.watch_async(arr, boundary="bg", t0=t0)
+    tel.meshplane.drain()
+    assert tel.meshplane.summary()["samples"] == 1
+    assert tel.registry.counter_value("mesh.samples", boundary="bg") == 1
+
+
+def test_pad_waste_and_occupancy_gauges():
+    tel = _tel()
+    frac = tel.meshplane.record_pad_waste(5000, 5120, axis="tickers")
+    assert frac == (1 - 5000 / 5120)
+    tel.meshplane.record_occupancy(0.75, boundary="stream.cohort")
+    g = tel.registry.snapshot()["gauges"]
+    assert g["mesh.pad_waste_frac{axis=tickers}"] == round(frac, 6)
+    assert g["mesh.occupancy_frac{boundary=stream.cohort}"] == 0.75
+    s = tel.meshplane.summary()
+    assert s["pad_waste_frac"] == round(frac, 6)
+    assert s["occupancy_frac"] == 0.75
+    assert not s["available"]  # occupancy/pad alone is not balance
+
+
+# --------------------------------------------------------------------------
+# host-dispatch span semantics (the collectives satellite)
+# --------------------------------------------------------------------------
+
+
+def test_collective_span_carries_host_dispatch_label():
+    tel = Telemetry(annotate_spans=False)
+    prev = get_telemetry()
+    set_telemetry(tel)
+    try:
+        mesh = resident_mesh(2)
+        x = np.arange(8.0, dtype=np.float32).reshape(2, 4)
+        m = np.ones((2, 4), bool)
+        np.asarray(xs_masked_mean(mesh, x, m))
+    finally:
+        set_telemetry(prev)
+    # the histogram carries the label...
+    snap = tel.registry.snapshot()["histograms"]
+    key = ("span_seconds{kind=host_dispatch,"
+           "span=collective.xs_masked_mean}")
+    assert key in snap and snap[key]["count"] == 1
+    # ...and so do the retained event and the Perfetto export, so the
+    # host-side span can never be conflated with on-device time
+    ev = next(e for e in tel.tracer.events()
+              if e["name"] == "collective.xs_masked_mean")
+    assert ev["labels"] == {"kind": "host_dispatch"}
+    ch = next(e for e in tel.tracer.to_chrome_trace()["traceEvents"]
+              if e["name"] == "collective.xs_masked_mean")
+    assert ch["args"]["kind"] == "host_dispatch"
+    assert tel.registry.counter_value("mesh.collective_dispatches",
+                                      label="xs_masked_mean") == 1
+
+
+# --------------------------------------------------------------------------
+# schema v3: both directions
+# --------------------------------------------------------------------------
+
+
+def _v(schema, kind, **fields):
+    return {"schema": schema, "ts": 1.0, "kind": kind, **fields}
+
+
+def test_schema_v3_identity_stamps_validate():
+    assert SCHEMA_VERSION == 3
+    for kind, fields in (
+            ("counter", {"name": "c", "labels": {}, "value": 1}),
+            ("event", {"name": "e", "data": {}}),
+            ("request", {"trace_id": "t", "op": "q", "status": "ok",
+                         "data": {}})):
+        rec = _v(3, kind, process_index=1, host="h0", **fields)
+        assert validate_record(rec) == [], rec
+
+
+def test_identity_stamps_flag_on_older_schemas():
+    """The other direction: a record declaring schema<=2 cannot carry
+    the v3 identity stamps or span labels."""
+    base = {"name": "c", "labels": {}, "value": 1}
+    assert any("schema>=3" in p for p in validate_record(
+        _v(2, "counter", process_index=0, **base)))
+    assert any("schema>=3" in p for p in validate_record(
+        _v(1, "counter", host="h", **base)))
+    span = {"name": "s", "ts_us": 0, "dur_us": 1, "tid": 1, "depth": 0}
+    assert any("schema>=3" in p for p in validate_record(
+        _v(2, "span", labels={"kind": "host_dispatch"}, **span)))
+    assert validate_record(
+        _v(3, "span", labels={"kind": "host_dispatch"}, **span)) == []
+    # type checks still apply at v3
+    assert validate_record(_v(3, "counter", process_index="zero",
+                              **base))
+    assert validate_record(_v(3, "counter", host=7, **base))
+
+
+def test_write_stamps_identity_on_manifest_and_every_record(tmp_path):
+    tel = _tel()
+    tel.counter("c", 2)
+    tel.event("e", x=1)
+    with tel.tracer("s"):
+        pass
+    out = tmp_path / "bundle"
+    tel.write(str(out), process_index=5, host="hostX")
+    with open(out / "manifest.json") as fh:
+        m = json.load(fh)
+    assert m["process_index"] == 5 and m["host"] == "hostX"
+    n = 0
+    with open(out / "metrics.jsonl") as fh:
+        for line in fh:
+            rec = json.loads(line)
+            n += 1
+            assert rec["process_index"] == 5 and rec["host"] == "hostX"
+            assert validate_record(rec) == [], rec
+    assert n >= 4  # manifest + counter + span + event at least
+    assert validate_dir(str(out))["ok"]
+
+
+def test_process_identity_env_override(monkeypatch):
+    from replication_of_minute_frequency_factor_tpu.telemetry.manifest import (
+        process_identity)
+    monkeypatch.setenv("MFF_PROCESS_INDEX", "7")
+    monkeypatch.setenv("MFF_HOST_LABEL", "podhost")
+    assert process_identity() == {"process_index": 7, "host": "podhost"}
+
+
+# --------------------------------------------------------------------------
+# multihost aggregation
+# --------------------------------------------------------------------------
+
+
+def _host_bundle(tmp_path, idx, requests, latency):
+    tel = _tel()
+    tel.counter("pod.requests", requests)
+    tel.counter("pod.errors", idx)  # differs per host
+    tel.gauge("pod.depth", 10 + idx)
+    for v in latency:
+        tel.observe("pod.latency_s", v)
+    with tel.tracer("pod.step"):
+        pass
+    tel.request({"trace_id": f"t{idx}", "op": "q", "status": "ok",
+                 "data": {"total_s": 0.1}})
+    d = str(tmp_path / f"host{idx}")
+    tel.write(d, process_index=idx, host=f"host{idx}")
+    return d
+
+
+def test_aggregate_merges_two_host_bundles(tmp_path):
+    dirs = [_host_bundle(tmp_path, 0, 3, [0.01, 0.02]),
+            _host_bundle(tmp_path, 1, 5, [0.03])]
+    pod = str(tmp_path / "pod")
+    verdict = aggregate.aggregate_dirs(dirs, pod)
+    assert verdict["ok"], verdict
+    assert verdict["hosts"] == 2
+    assert verdict["counter_totals"]["mismatched"] == 0
+    assert validate_dir(pod)["ok"]
+    counters, hists, stream_hosts = {}, {}, set()
+    with open(os.path.join(pod, "metrics.jsonl")) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            assert validate_record(rec) == [], rec
+            if rec["kind"] == "counter":
+                counters[rec["name"]] = counters.get(rec["name"], 0) \
+                    + rec["value"]
+            elif rec["kind"] == "histogram":
+                hists[rec["name"]] = rec
+            elif rec["kind"] in ("span", "event", "request"):
+                stream_hosts.add((rec.get("process_index"),
+                                  rec.get("host")))
+    # counters sum exactly; histograms keep exact counts/sums
+    assert counters["pod.requests"] == 8
+    assert counters["pod.errors"] == 1
+    lat = hists["pod.latency_s"]
+    assert lat["count"] == 3
+    assert abs(lat["sum"] - 0.06) < 1e-9
+    assert lat["min"] == 0.01 and lat["max"] == 0.03
+    # concatenated stream records carry both hosts' identity stamps
+    assert stream_hosts == {(0, "host0"), (1, "host1")}
+    # the pod manifest names both hosts and their per-host digests
+    with open(os.path.join(pod, "manifest.json")) as fh:
+        m = json.load(fh)
+    agg = m["aggregate"]
+    assert [h["process_index"] for h in agg["hosts"]] == [0, 1]
+    assert set(agg["per_host"]) == {"0:host0", "1:host1"}
+    # both hosts carry span data -> a host-skew summary is computed
+    assert agg["host_skew"] is not None
+    assert agg["host_skew"]["slow_host"] in agg["per_host"]
+    # merged traces: one track per (host, pid), named per host
+    with open(os.path.join(pod, "trace.json")) as fh:
+        events = json.load(fh)["traceEvents"]
+    names = {e["args"]["name"] for e in events if e.get("ph") == "M"}
+    assert any("host 0" in n for n in names)
+    assert any("host 1" in n for n in names)
+
+
+def test_aggregate_refuses_duplicate_process_index(tmp_path):
+    d = _host_bundle(tmp_path, 0, 3, [0.01])
+    import pytest
+    with pytest.raises(aggregate.AggregateError):
+        aggregate.aggregate_dirs([d, d], str(tmp_path / "pod"))
+
+
+def test_aggregate_cli_verdict_and_exit_codes(tmp_path, capsys):
+    dirs = [_host_bundle(tmp_path, 0, 1, [0.01]),
+            _host_bundle(tmp_path, 1, 2, [0.02])]
+    rc = aggregate.main([*dirs, "--out", str(tmp_path / "pod")])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    verdict = json.loads(out)
+    assert rc == 0 and verdict["ok"] and verdict["validate"]["ok"]
+    rc = aggregate.main([str(tmp_path / "nope"), "--out",
+                         str(tmp_path / "pod2")])
+    assert rc == 2
+    assert not json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])["ok"]
+
+
+def test_aggregate_carries_flight_dumps(tmp_path):
+    d0 = _host_bundle(tmp_path, 0, 1, [0.01])
+    d1 = _host_bundle(tmp_path, 1, 1, [0.01])
+    # host 1 dumped a flight record (e.g. a skew burst) into its bundle
+    mp = MeshPlane(telemetry=_tel(), dump_dir=d1, skew_threshold=1.5,
+                   burst=1)
+    assert mp.record_shard_times({"a": 0.01, "b": 0.9})["burst_dump"]
+    pod = str(tmp_path / "pod")
+    verdict = aggregate.aggregate_dirs([d0, d1], pod)
+    assert verdict["flight_dumps"] == 1
+    copied = [f for f in os.listdir(pod) if f.startswith("flight_h1_")]
+    assert len(copied) == 1
+    assert validate_dir(pod)["ok"]  # the copied dump validates too
+
+
+# --------------------------------------------------------------------------
+# on-device collective attribution (the trace fixture satellite)
+# --------------------------------------------------------------------------
+
+
+def test_collective_breakdown_classifies_the_fixture():
+    fdir = os.path.join(FIXTURES, "trace_collectives")
+    s = attribution.summarize_trace_dir(fdir)
+    cb = s["collective_breakdown"]
+    assert cb["collective_events"] == 4  # host-pid noise excluded
+    assert cb["total_collective_us"] == 230.0
+    assert cb["by_kind_us"] == {"all_gather": 120.0,
+                                "all_reduce": 80.0,
+                                "collective_permute": 30.0}
+
+
+def test_device_time_block_embeds_collective_seconds():
+    tel = _tel()
+    fdir = os.path.join(FIXTURES, "trace_collectives")
+    block = attribution.device_time_block(fdir, telemetry=tel)
+    assert block["available"]
+    assert block["device_time_s"] == 680e-6
+    assert block["collective_time_s"] == 230e-6
+    assert block["collectives"]["all_gather"] == 120e-6
+    assert block["by_class_s"]["collective"] == 230e-6
+    g = tel.registry.snapshot()["gauges"]
+    assert g["device.collective_time_s"] == 230e-6
+    assert g["device.collective_time_s{op=all_gather}"] == 120e-6
+    assert g["device.device_time_s{class=fusion}"] == 400e-6
+
+
+def test_device_time_block_is_explicitly_unavailable_without_device_pids(
+        tmp_path):
+    """A CPU capture (XLA ops on the host pid) must yield
+    available=False with zeroed totals — never a silent zero that
+    reads as 'no device time'."""
+    with open(tmp_path / "hostonly.trace.json", "w") as fh:
+        json.dump({"traceEvents": [
+            {"ph": "M", "pid": 2, "name": "process_name",
+             "args": {"name": "python"}},
+            {"ph": "X", "pid": 2, "tid": 1, "ts": 0, "dur": 5.0,
+             "name": "all-reduce.1"}]}, fh)
+    block = attribution.device_time_block(str(tmp_path))
+    assert block["available"] is False
+    assert block["device_time_s"] == 0.0
+    assert block["collective_time_s"] == 0.0
+
+
+def test_classify_collective_kinds():
+    assert attribution.classify_collective("all-gather.7") == "all_gather"
+    assert attribution.classify_collective("all-reduce.1") == "all_reduce"
+    assert attribution.classify_collective("psum") == "all_reduce"
+    assert attribution.classify_collective(
+        "collective-permute-start.2") == "collective_permute"
+    assert attribution.classify_collective(
+        "weird-collective") == "other_collective"
+
+
+# --------------------------------------------------------------------------
+# bench integration: the sharded record's mesh block
+# --------------------------------------------------------------------------
+
+
+def test_run_resident_sharded_publishes_the_mesh_block():
+    import bench
+    from replication_of_minute_frequency_factor_tpu.data import wire
+
+    tel = Telemetry(annotate_spans=False)
+    prev = get_telemetry()
+    set_telemetry(tel)
+    try:
+        rng = np.random.default_rng(3)
+        names = ("vol_return1min", "mmt_am")
+        batches = [bench.make_batch(rng, n_days=2, n_tickers=32)
+                   for _ in range(2)]
+        use_wire = wire.encode(*batches[0]) is not None
+        mesh = resident_mesh()
+        bench.run_resident_sharded(batches, names, use_wire, group=1,
+                                   mesh=mesh)
+    finally:
+        set_telemetry(prev)
+    s = tel.meshplane.summary()
+    assert s["available"], s
+    assert s["n_shards"] == mesh.devices.size
+    assert s["samples"] >= 2  # one per scan group
+    assert s["boundaries"].get("resident.group", 0) >= 2
+    assert s["pad_waste_frac"] is not None
+    assert s["shard_skew_ratio"] >= 1.0
+    gauges = tel.registry.snapshot()["gauges"]
+    per_shard = [v for k, v in gauges.items()
+                 if k.startswith("mesh.shard_time_s")]
+    assert len(per_shard) == mesh.devices.size
+    assert all(v > 0 for v in per_shard)

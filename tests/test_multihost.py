@@ -66,3 +66,41 @@ def test_two_process_global_mesh(tmp_path):
     # the success files record whether the cross-host psum actually ran
     marks = {(tmp_path / f"ok{i}").read_text() for i in range(2)}
     assert len(marks) == 1, marks
+    # ISSUE 9: each process wrote its own schema-v3 bundle with its
+    # jax.process_index() stamped; aggregating the two must yield one
+    # schema-valid pod bundle whose counter totals are the per-host
+    # sums and whose aggregate block names both hosts
+    import json
+
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        aggregate, validate_record)
+    from replication_of_minute_frequency_factor_tpu.telemetry.validate import (
+        validate_dir)
+    host_dirs = [str(tmp_path / f"telemetry{i}") for i in range(2)]
+    for i, d in enumerate(host_dirs):
+        with open(os.path.join(d, "manifest.json")) as fh:
+            assert json.load(fh)["process_index"] == i
+    pod = str(tmp_path / "pod")
+    verdict = aggregate.aggregate_dirs(host_dirs, pod)
+    assert verdict["ok"] and verdict["hosts"] == 2, verdict
+    assert verdict["counter_totals"]["mismatched"] == 0
+    assert validate_dir(pod)["ok"]
+    # the merged shards_built counter is the sum of the two hosts' own
+    per_host = []
+    for d in host_dirs:
+        with open(os.path.join(d, "metrics.jsonl")) as fh:
+            for line in fh:
+                rec = json.loads(line)
+                assert validate_record(rec) == [], rec
+                if rec.get("kind") == "counter" and \
+                        rec.get("name") == "multihost.shards_built":
+                    per_host.append(rec["value"])
+    assert len(per_host) == 2
+    pod_total = 0.0
+    with open(os.path.join(pod, "metrics.jsonl")) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec.get("kind") == "counter" and \
+                    rec.get("name") == "multihost.shards_built":
+                pod_total += rec["value"]
+    assert pod_total == sum(per_host)

@@ -22,8 +22,9 @@ from replication_of_minute_frequency_factor_tpu.serve import (
     FactorServer, LoadShedError, Query, ServeConfig, SyntheticSource,
     serve_http)
 from replication_of_minute_frequency_factor_tpu.telemetry import (
-    FlightRecorder, HbmSampler, MetricsRegistry, Telemetry,
-    canonical_trace_id, gen_trace_id, to_prometheus, validate_record)
+    SCHEMA_VERSION, FlightRecorder, HbmSampler, MetricsRegistry,
+    Telemetry, canonical_trace_id, gen_trace_id, to_prometheus,
+    validate_record)
 from replication_of_minute_frequency_factor_tpu.telemetry.validate import (
     validate_dir, validate_dump)
 
@@ -95,9 +96,9 @@ def test_v2_only_kinds_and_fields_flag_on_v1_records():
     assert any("schema>=2" in p for p in validate_record(
         _v(1, "span", name="s", ts_us=0.0, dur_us=1.0, tid=1, depth=0,
            trace_id="abc")))
-    # unknown / malformed versions flag too
+    # unknown / malformed versions flag too (one past the current)
     assert any("schema" in p for p in validate_record(
-        _v(3, "event", name="e", data={})))
+        _v(SCHEMA_VERSION + 1, "event", name="e", data={})))
     # type errors on v2 fields flag
     assert any("trace_id" in p for p in validate_record(
         _v(2, "request", trace_id=7, op="ic", status="ok", data={})))

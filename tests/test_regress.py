@@ -358,3 +358,67 @@ def test_derived_series_gate_and_declared_break(tmp_path):
                          candidate=_serve_rec(methodology="r10_new"))
     assert v["ok"]
     assert all(g["n_baseline"] == 0 for g in v["groups"])
+
+
+def _sharded_rec(value=100.0, skew=1.1, waste=0.02, available=True,
+                 methodology="r7_resident_sharded_v1"):
+    rec = {"metric": "cicc58_sharded_wall", "value": value,
+           "methodology": methodology, "n_shards": 8}
+    if skew is not None:
+        rec["mesh"] = {"available": available,
+                       "shard_skew_ratio": skew,
+                       "pad_waste_frac": waste}
+    return rec
+
+
+def test_derive_records_lifts_available_mesh_series():
+    recs = regress.derive_records(_sharded_rec())
+    assert [r["metric"] for r in recs] == [
+        "cicc58_sharded_wall.shard_skew_ratio",
+        "cicc58_sharded_wall.pad_waste_frac"]
+    assert recs[0]["value"] == 1.1 and recs[1]["value"] == 0.02
+    assert all(r["methodology"] == "r7_resident_sharded_v1"
+               for r in recs)
+
+
+def test_unavailable_mesh_never_seeds_a_baseline():
+    """ISSUE 9: occupancy/pad-only mesh blocks (available: false —
+    e.g. the single-device stream record's) must neither seed nor
+    gate the balance baselines; a record with no mesh block derives
+    nothing."""
+    assert regress.derive_records(_sharded_rec(available=False)) == []
+    assert regress.derive_records(
+        {"metric": "m", "value": 1.0, "mesh": None}) == []
+
+
+def test_mesh_series_gate_both_directions(tmp_path):
+    """The satellite's acceptance: a steady wall-clock headline with a
+    doubled shard skew (or padding waste) FLAGS on the derived group;
+    an in-band candidate stays quiet; a declared break opens fresh."""
+    for i, skew in enumerate((1.1, 1.12)):
+        with open(tmp_path / f"BENCH_r{i + 1:02d}.json", "w") as fh:
+            json.dump({"n": i + 1, "parsed": _sharded_rec(skew=skew)},
+                      fh)
+    entries = regress.load_bench_series(str(tmp_path))
+    metrics = {e["record"]["metric"] for e in entries}
+    assert {"cicc58_sharded_wall.shard_skew_ratio",
+            "cicc58_sharded_wall.pad_waste_frac"} <= metrics
+    # in-band: quiet
+    assert regress.evaluate(entries, candidate=_sharded_rec())["ok"]
+    # steady headline, straggling shard: the skew group flags
+    v = regress.evaluate(entries, candidate=_sharded_rec(skew=2.2))
+    assert not v["ok"]
+    assert any(f["metric"].endswith(".shard_skew_ratio")
+               for f in v["flagged"])
+    # steady headline + skew, doubled padding waste: the waste flags
+    v = regress.evaluate(entries, candidate=_sharded_rec(waste=0.04))
+    assert not v["ok"]
+    assert any(f["metric"].endswith(".pad_waste_frac")
+               for f in v["flagged"])
+    # an unavailable-mesh candidate cannot trip the balance gates
+    assert regress.evaluate(
+        entries,
+        candidate=_sharded_rec(skew=9.0, available=False))["ok"]
+    # a declared methodology break opens fresh series, never flagged
+    assert regress.evaluate(
+        entries, candidate=_sharded_rec(methodology="r10_mesh2d"))["ok"]

@@ -238,11 +238,20 @@ def test_resident_sharded_carry_requires_real_sharding(tpu_session):
     good = {"resident_sharded": {"ok": True, "results": [
         {"metric": "cicc58_5000tickers_1yr_wall_sharded", "value": 60.0,
          "mode": "resident", "n_shards": 8, "tickers": 5000,
-         "methodology": "r7_resident_sharded_v1"}]}}
+         "methodology": "r7_resident_sharded_v1",
+         "mesh": {"available": True, "shard_skew_ratio": 1.02}}]}}
     assert tpu_session.drop_conv_only_rolling(good) == good
+    # ISSUE 9: a sharded record without the mesh balance block cannot
+    # bank — the carried trajectory feeds the shard_skew_ratio series
+    no_mesh = {"resident_sharded": {"ok": True, "results": [
+        {"metric": "cicc58_5000tickers_1yr_wall_sharded", "value": 60.0,
+         "mode": "resident", "n_shards": 8, "tickers": 5000,
+         "methodology": "r7_resident_sharded_v1"}]}}
+    assert tpu_session.drop_conv_only_rolling(no_mesh) == {}
     fell_back = {"resident_sharded": {"ok": True, "results": [
         {"metric": "cicc58_5000tickers_1yr_wall_sharded", "value": 60.0,
-         "mode": "resident", "n_shards": 1, "tickers": 5000}]}}
+         "mode": "resident", "n_shards": 1, "tickers": 5000,
+         "mesh": {"available": True}}]}}
     assert tpu_session.drop_conv_only_rolling(fell_back) == {}
     no_stamp = {"resident_sharded": {"ok": True, "results": [
         {"metric": "cicc58_5000tickers_1yr_wall_sharded", "value": 60.0,
@@ -272,10 +281,20 @@ def test_resident_sharded_step_refuses_single_device(tpu_session,
     r = tpu_session.step_resident_sharded()
     assert r["ok"] is False and "n_shards" in r["error"]
 
-    def fake_gated_sharded(extra_env):
+    def fake_gated_no_mesh(extra_env):
         return {"ok": True, "rc": 0, "results": [
             {"metric": "cicc58_5000tickers_1yr_wall_sharded",
              "mode": "resident", "n_shards": 8, "tickers": 5000}]}
+    monkeypatch.setattr(tpu_session, "_run_bench_gated",
+                        fake_gated_no_mesh)
+    r = tpu_session.step_resident_sharded()
+    assert r["ok"] is False and "mesh" in r["error"]  # ISSUE 9
+
+    def fake_gated_sharded(extra_env):
+        return {"ok": True, "rc": 0, "results": [
+            {"metric": "cicc58_5000tickers_1yr_wall_sharded",
+             "mode": "resident", "n_shards": 8, "tickers": 5000,
+             "mesh": {"available": True, "shard_skew_ratio": 1.0}}]}
     monkeypatch.setattr(tpu_session, "_run_bench_gated",
                         fake_gated_sharded)
     assert tpu_session.step_resident_sharded()["ok"] is True
@@ -297,7 +316,7 @@ def test_stream_intraday_carry_requires_real_streaming(tpu_session):
     0, zero compiles during load, empty parity-mismatch list. A
     zero-update record, a cold (compiling) load, or an on-hardware
     parity failure must re-run."""
-    def entry(hbm=True, **stream):
+    def entry(hbm=True, mesh=True, **stream):
         base = {"updates": 2880, "compiles_during_load": 0,
                 "parity_mismatched": []}
         base.update(stream)
@@ -307,6 +326,8 @@ def test_stream_intraday_carry_requires_real_streaming(tpu_session):
                "stream": base}
         if hbm:
             rec["hbm"] = {"available": True, "peak_bytes": 1 << 30}
+        if mesh:
+            rec["mesh"] = {"available": False, "occupancy_frac": 1.0}
         return {"stream_intraday": {"ok": True, "results": [rec]}}
 
     good = entry()
@@ -315,6 +336,8 @@ def test_stream_intraday_carry_requires_real_streaming(tpu_session):
     # ISSUE 8: a record without the HBM watermark block cannot bank —
     # the carried trajectory feeds the hbm_peak_bytes regress series
     assert tpu_session.drop_conv_only_rolling(entry(hbm=False)) == {}
+    # ISSUE 9: same rule for the mesh balance block (cohort occupancy)
+    assert tpu_session.drop_conv_only_rolling(entry(mesh=False)) == {}
     assert tpu_session.drop_conv_only_rolling(
         entry(compiles_during_load=3)) == {}
     assert tpu_session.drop_conv_only_rolling(
@@ -342,6 +365,7 @@ def test_stream_intraday_step_refuses_unbankable_records(
             {"metric": "stream58_1024tickers_bars_per_s",
              "methodology": "r9_stream_intraday_v1",
              "hbm": {"available": True, "peak_bytes": 1 << 30},
+             "mesh": {"available": False, "occupancy_frac": 1.0},
              "stream": {"updates": 0, "compiles_during_load": 0,
                         "parity_mismatched": []}}]}
     monkeypatch.setattr(tpu_session, "_run_json_lines", fake_lines)
@@ -353,6 +377,7 @@ def test_stream_intraday_step_refuses_unbankable_records(
             {"metric": "stream58_1024tickers_bars_per_s",
              "methodology": "r9_stream_intraday_v1",
              "hbm": {"available": True, "peak_bytes": 1 << 30},
+             "mesh": {"available": False, "occupancy_frac": 1.0},
              "stream": {"updates": 99, "compiles_during_load": 0,
                         "parity_mismatched": []}}]}
     monkeypatch.setattr(tpu_session, "_run_json_lines", fake_good)

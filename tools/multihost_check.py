@@ -147,6 +147,15 @@ def main():
                                    atol=1e-5)
         eval_ok = True
 
+    # per-host telemetry bundle (ISSUE 9): every process writes its OWN
+    # schema-v3 bundle — identity stamps from jax.process_index() — and
+    # the parent test merges them through telemetry.aggregate into one
+    # pod bundle, the real 2-process exercise of the multihost
+    # aggregation path
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        get_telemetry)
+    get_telemetry().write(os.path.join(outdir, f"telemetry{pid}"))
+
     with open(os.path.join(outdir, f"ok{pid}"), "w") as fh:
         fh.write(f"devices=8 psum={'yes' if psum_ok else 'skipped'} "
                  f"eval={'yes' if eval_ok else 'skipped'}")
