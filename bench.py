@@ -65,6 +65,18 @@ MODE = os.environ.get("BENCH_MODE", "resident")
 # record's ``n_shards``/``methodology`` fields say which one ran.
 N_SHARDS = int(os.environ.get("BENCH_SHARDS", "0"))
 
+# r10: the device->host RESULT leg ships blocked-quantized int16 with
+# per-slice bitwise-f32 widening (data/result_wire.py) — the headline's
+# remaining byte lever after the ingest wire (~2.9 bytes/bar) and the
+# ones the uniform-dtype narrowing rejection left on the table
+# (docs/BENCHMARKS.md "Narrow result dtype"). BENCH_RESULT_WIRE=0
+# disables it (and the record's methodology drops back to the r6/r7
+# series, so a silent f32 fallback can never bank under the r10 name —
+# tpu_session's headline carry additionally REQUIRES the result_wire
+# block). The CPU fallback keeps the wire OFF: its value is
+# comparability with its own r6_stream_v3 indicator series.
+RESULT_WIRE = os.environ.get("BENCH_RESULT_WIRE", "1") != "0"
+
 _SUFFIX = os.environ.get("BENCH_METRIC_SUFFIX", "")
 
 
@@ -193,13 +205,19 @@ def _encode_kind_delta(before: dict) -> str:
     return "mixed" if (dw and dr) else None
 
 
-def make_batch(rng, n_days=None, n_tickers=N_TICKERS):
+def make_batch(rng, n_days=None, n_tickers=None):
     # f32 draws throughout (standard_normal/random with dtype=) — the
     # synth preamble runs on one host core inside a precious tunnel
     # up-window, and f64-draw-then-cast doubled its cost for bytes the
-    # bench immediately threw away; distributions are unchanged
+    # bench immediately threw away; distributions are unchanged.
+    # n_tickers resolves the module global at CALL time (not def time):
+    # tests monkeypatch bench.N_TICKERS, and the result-wire decode
+    # derives the payload geometry from it — a def-time default would
+    # silently desynchronize the two
     if n_days is None:
         n_days = DAYS_PER_BATCH
+    if n_tickers is None:
+        n_tickers = N_TICKERS
     shape = (n_days, n_tickers, 240)
     close = (10.0 * np.exp(np.cumsum(
         rng.standard_normal(shape, dtype=np.float32) * np.float32(1e-3),
@@ -338,7 +356,8 @@ def _aot_resident(label, key, lower_fn, phases):
     return _AOT_COMPILED.get(label, key, lower_fn, compile_cost=phases)
 
 
-def run_resident(batches, names, use_wire, group, keep_results=False):
+def run_resident(batches, names, use_wire, group, keep_results=False,
+                 result_spec=None):
     """The whole year in O(1) host round trips (VERDICT r4 #2):
 
       encode  — host: wire-encode + pack all batches (shared floor)
@@ -359,7 +378,15 @@ def run_resident(batches, names, use_wire, group, keep_results=False):
     ``compile_with_telemetry`` (memoised per module shape — see
     :func:`_aot_resident`), so ``phases['compile_s']`` is real compile
     wall on the first run and ~0 on warm reruns, and ``compute_s``
-    always means execute."""
+    always means execute.
+
+    ``result_spec`` (ISSUE 10) fuses the blocked-quantized RESULT wire
+    as the scan body's final stage: the fetch ships packed ``[N, L]``
+    uint8 payloads (~half the f32 bytes), host-decoded here
+    (``phases['decode_s']``; ``phases['result_wire']`` carries the
+    widen/overflow/byte verdict and ``keep_results`` returns DECODED
+    blocks). Overflowed spill budgets are reported, not raised — the
+    caller owns the widen-only floor (see main's warmup)."""
     from replication_of_minute_frequency_factor_tpu.config import (
         get_config)
     from replication_of_minute_frequency_factor_tpu.pipeline import (
@@ -383,10 +410,11 @@ def run_resident(batches, names, use_wire, group, keep_results=False):
         compiled = _aot_resident(
             "bench_resident_scan",
             ("resident", len(gbufs), gbufs[0].shape, spec, kind, names,
-             roll),
+             roll, result_spec),
             lambda: lower_packed_resident(gbufs, spec, kind,
                                           names=names,
-                                          rolling_impl=roll),
+                                          rolling_impl=roll,
+                                          result_spec=result_spec),
             phases)
         if compute_t0 is None:  # compile attributed apart from execute
             compute_t0 = time.perf_counter()
@@ -398,19 +426,69 @@ def run_resident(batches, names, use_wire, group, keep_results=False):
     t0 = time.perf_counter()
     results = [] if keep_results else None
     fetched_mb = 0.0
+    payload_rows = []  # result-wire mode: fetched [g, L] u8 stacks
     for o in outs:
         _count_sync("resident_fetch")
-        h = np.asarray(o)  # [group, F, D, T]
+        h = np.asarray(o)  # [group, F, D, T] f32, or [group, L] u8
         fetched_mb += h.nbytes
-        if keep_results:
+        if result_spec is not None:
+            payload_rows.extend(h)
+        elif keep_results:
             results.extend(h)
     phases["fetch_s"] = round(time.perf_counter() - t0, 3)
-    phases["fetch_MB"] = round(fetched_mb / 1e6, 1)
+    phases["fetch_MB"] = round(fetched_mb / 1e6, 3)
+    n_d, n_t = batches[0][0].shape[0], batches[0][0].shape[1]
+    phases["fetch_logical_MB"] = round(
+        len(batches) * len(names) * n_d * n_t * 4 / 1e6, 3)
+    if result_spec is not None:
+        _decode_result_phases(phases, payload_rows, names, n_d, n_t,
+                              n_t, result_spec, results)
     return phases, kind, results
 
 
+def _decode_result_phases(phases, payload_rows, names, n_d, t_pad,
+                          n_tickers, result_spec, results):
+    """Shared host half of the result wire for the resident loops:
+    decode every fetched payload row (strict=False — the caller owns
+    the widen-only floor), fold the verdicts into
+    ``phases['result_wire']``, time the numpy dequantize as its own
+    serial stage, and fill ``results`` with DECODED ``[F, D, :n_tickers]``
+    blocks when the caller kept them."""
+    from replication_of_minute_frequency_factor_tpu.data import (
+        result_wire as rw)
+    t0 = time.perf_counter()
+    widened = overflow = quantized = 0
+    for row in payload_rows:
+        dec, v = rw.decode_block(row, len(names), n_d, t_pad,
+                                 result_spec.spill_rows, strict=False)
+        widened += v["widened"]
+        overflow += v["overflow"]
+        quantized += v["quantized"]
+        if results is not None:
+            results.append(dec[..., :n_tickers])
+    phases["decode_s"] = round(time.perf_counter() - t0, 3)
+    payload_b = phases["fetch_MB"] * 1e6
+    logical_b = phases["fetch_logical_MB"] * 1e6
+    phases["result_wire"] = {
+        "enabled": True,
+        "spill_rows": result_spec.spill_rows,
+        "quantized_slices": quantized,
+        "widened_slices": widened,
+        "overflow_slices": overflow,
+        "payload_MB": phases["fetch_MB"],
+        "f32_logical_MB": phases["fetch_logical_MB"],
+        "ratio_vs_f32": round(logical_b / payload_b, 3)
+        if payload_b else None,
+    }
+    tel = get_telemetry()
+    tel.gauge("result.widened_slices", widened)
+    if overflow:
+        tel.counter("result.overflow_slices", overflow)
+
+
 def run_resident_sharded(batches, names, use_wire, group, mesh,
-                         keep_results=False, bucket=1):
+                         keep_results=False, bucket=1,
+                         result_spec=None):
     """The resident year, mesh-native AND ingest-overlapped:
 
       encode  — host: shared-floor wire-encode + per-shard pack
@@ -475,10 +553,11 @@ def run_resident_sharded(batches, names, use_wire, group, mesh,
         d = pend
         compiled = _aot_resident(
             "bench_resident_scan_sharded",
-            ("sharded", d.shape, spec, kind, names, roll, mesh),
-            lambda: lower_packed_resident_sharded(d, spec, kind, mesh,
-                                                  names=names,
-                                                  rolling_impl=roll),
+            ("sharded", d.shape, spec, kind, names, roll, mesh,
+             result_spec),
+            lambda: lower_packed_resident_sharded(
+                d, spec, kind, mesh, names=names, rolling_impl=roll,
+                result_spec=result_spec),
             phases)
         if compute_t0 is None:
             compute_t0 = time.perf_counter()
@@ -519,14 +598,29 @@ def run_resident_sharded(batches, names, use_wire, group, mesh,
     results = [] if keep_results else None
     fetched_mb = 0.0
     n_tickers = batches[0][0].shape[1]
+    n_days = batches[0][0].shape[0]
+    payload_rows = []
     for o in outs:
         _count_sync("resident_fetch")
-        h = np.asarray(o)  # [g, F, D, T_pad], one gather per shard
+        h = np.asarray(o)  # [g, F, D, T_pad] f32, or [g, L] u8 (wire)
         fetched_mb += h.nbytes
-        if keep_results:
+        if result_spec is not None:
+            payload_rows.extend(h)
+        elif keep_results:
             results.extend(h[..., :n_tickers])
     phases["fetch_s"] = round(time.perf_counter() - t0, 3)
-    phases["fetch_MB"] = round(fetched_mb / 1e6, 1)
+    # RAW fetched bytes (pad lanes included) AND the logical payload
+    # (ISSUE 10 satellite): the old single number silently reported
+    # padded-ticker bytes on sharded runs — h[..., :n_tickers] sliced
+    # AFTER counting — so any compression ratio computed from it was
+    # flattered by dead lanes. The ratio below is against the LOGICAL
+    # f32 payload.
+    phases["fetch_MB"] = round(fetched_mb / 1e6, 3)
+    phases["fetch_logical_MB"] = round(
+        len(batches) * len(names) * n_days * n_tickers * 4 / 1e6, 3)
+    if result_spec is not None:
+        _decode_result_phases(phases, payload_rows, names, n_days,
+                              t_pad, n_tickers, result_spec, results)
     return phases, kind, results
 
 
@@ -1526,6 +1620,54 @@ def meshplane_smoke():
             "ok": all(checks.values())}
 
 
+# --------------------------------------------------------------------------
+# result-wire smoke (ISSUE 10): encode -> fetch -> decode round trip
+# --------------------------------------------------------------------------
+
+
+def result_wire_smoke(days=2, tickers=48, names=None):
+    """run_tests.sh --quick smoke: the blocked-quantized result wire
+    end to end on a seeded batch — the full factor set computed raw,
+    then encoded ON DEVICE (one fused dispatch), fetched as ONE packed
+    payload, and host-dequantized. ``ok`` iff the all-factor parity
+    gate is green under the pinned contract (bitwise where widened,
+    pinned range-relative/rtol bounds where quantized — data/
+    result_wire.RESULT_BOUNDS, docs/PIN_BOUNDS.md), no slice overflowed
+    the spill budget, and the measured byte ratio clears 1.5x on this
+    tiny shape (meta overhead amortizes to ~1.9x+ at the headline
+    5000-ticker shape). One JSON line; nonzero exit on drift."""
+    import jax.numpy as jnp
+
+    from replication_of_minute_frequency_factor_tpu.data import (
+        result_wire as rw)
+    from replication_of_minute_frequency_factor_tpu.models.registry import (
+        compute_factors_jit, factor_names as _fnames)
+
+    rng = np.random.default_rng(21)
+    names = tuple(names or _fnames())
+    bars, mask = make_batch(rng, n_days=days, n_tickers=tickers)
+    out = compute_factors_jit(jax.device_put(bars),
+                              jax.device_put(mask), names=names)
+    raw = np.stack([np.asarray(out[n]) for n in names])
+    spec = rw.ResultWireSpec.for_names(names, days=days)
+    enc = jax.jit(rw.encode_block, static_argnums=1)
+    payload = np.asarray(enc(jnp.asarray(raw), spec))
+    dec, v = rw.decode_block(payload, len(names), days, tickers,
+                             spec.spill_rows, strict=False)
+    chk = rw.check_bounds(raw, dec, names, sidx=v["sidx"])
+    ratio = (v["f32_bytes"] / v["payload_bytes"]
+             if v["payload_bytes"] else 0.0)
+    return {
+        "smoke": "result_wire", "factors": len(names), "days": days,
+        "tickers": tickers, "spill_rows": spec.spill_rows,
+        "quantized": v["quantized"], "widened": v["widened"],
+        "overflow": v["overflow"], "byte_ratio": round(ratio, 3),
+        "max_rel_err": float(chk["max_rel_err"]),
+        "parity_bad": chk["bad_factors"],
+        "ok": (chk["ok"] and v["overflow"] == 0 and ratio >= 1.5),
+    }
+
+
 def main():
     _ensure_device_reachable()  # may exec into a CPU-fallback run
     if os.environ.get("BENCH_REQUIRE_TPU") \
@@ -1595,10 +1737,12 @@ def main():
 
     def launch(item):
         """Device half: ONE buffer over the wire -> fused on-device unpack
-        + decode + 58-factor graph -> ONE stacked output tensor."""
+        + decode + 58-factor graph -> ONE stacked output tensor (or,
+        through the result wire, ONE packed quantized payload)."""
         buf, spec, kind = item
         return compute_packed_prepared(buf, spec, kind, names=names,
-                                       replicate_quirks=True)
+                                       replicate_quirks=True,
+                                       result_spec=rspec)
 
     # warmup ships its own batches so the timed loop's bytes are cold in
     # any transfer-path cache; it runs BEFORE the timed batches are
@@ -1695,6 +1839,29 @@ def main():
 
     consolidate = os.environ.get("BENCH_CONSOLIDATE") == "1"
     mode = "stream" if is_cpu_fallback else MODE
+    # result wire (ISSUE 10): on by default for the TPU loops; off on
+    # the CPU fallback (own comparability series + the resident diag's
+    # raw equality check) and under BENCH_CONSOLIDATE=1 (the device
+    # concat A/B concatenates [F, D, T] blocks along days, which the
+    # packed payload shape does not admit)
+    from replication_of_minute_frequency_factor_tpu.data import (
+        result_wire as _rw)
+    rspec = None
+    if RESULT_WIRE and not is_cpu_fallback and not consolidate:
+        rspec = _rw.ResultWireSpec.for_names(names, days=days)
+    #: stream-loop result-wire totals (the resident loops carry theirs
+    #: in phases['result_wire'])
+    result_totals = {"widened": 0, "overflow": 0, "quantized": 0,
+                     "payload_bytes": 0}
+
+    def _decode_stream(h):
+        """Host dequantize of one stream-loop payload fetch."""
+        dec, v = _rw.decode_block(h, len(names), days, N_TICKERS,
+                                  rspec.spill_rows, strict=False)
+        for k in ("widened", "overflow", "quantized"):
+            result_totals[k] += v[k]
+        result_totals["payload_bytes"] += int(h.nbytes)
+        return dec
     # r7 mesh resolution: the resident scan shards the tickers axis
     # over every visible device (BENCH_SHARDS pins it; 1 device = the
     # single-device r6 loop). The headline pads tickers to the
@@ -1726,6 +1893,24 @@ def main():
         stream-mode fallback below (ADVICE r5: re-raising here lost the
         hardware window with nothing banked)."""
 
+    def _grow_result_floor(wp) -> bool:
+        """Widen-only spill floor (the result wire's analogue of
+        encode_year's spec-convergence loop): a warmup overflow grows
+        the static budget and the warm pass re-runs under a fresh
+        executable, so the TIMED loop can never hit a strict-decode
+        overflow on same-shaped data. Returns True when it grew."""
+        nonlocal rspec
+        info = (wp or {}).get("result_wire") or {}
+        if not info.get("overflow_slices"):
+            return False
+        need = info["widened_slices"] + info["overflow_slices"]
+        rspec = rspec.grow(need)
+        warm_info["result_floor_grown_to"] = rspec.spill_rows
+        print(f"# result wire spill budget overflowed in warmup; "
+              f"growing floor to {rspec.spill_rows} rows",
+              file=sys.stderr, flush=True)
+        return True
+
     def _warm_resident(group):
         """Compile + first-execute the resident scan graph on DISTINCT
         warm bytes (same caching rationale as the stream warmup), full
@@ -1738,7 +1923,10 @@ def main():
         while True:
             try:
                 t0 = time.perf_counter()
-                wp, _, _ = run_resident(wb, names, use_wire, group)
+                wp, _, _ = run_resident(wb, names, use_wire, group,
+                                        result_spec=rspec)
+                if rspec is not None and _grow_result_floor(wp):
+                    continue
                 warm_info["warm_total_s"] = round(
                     time.perf_counter() - t0, 1)
                 warm_info["warm_phases"] = wp
@@ -1797,7 +1985,10 @@ def main():
                 t0 = time.perf_counter()
                 wp, _, _ = run_resident_sharded(wb, names, use_wire, g,
                                                 mesh,
-                                                bucket=shard_bucket)
+                                                bucket=shard_bucket,
+                                                result_spec=rspec)
+                if rspec is not None and _grow_result_floor(wp):
+                    continue
                 warm_info["warm_total_s"] = round(
                     time.perf_counter() - t0, 1)
                 warm_info["warm_phases"] = wp
@@ -1929,6 +2120,7 @@ def main():
         p: reg.counter_value("bench.host_blocking_syncs", point=p)
         for p in _SYNC_POINTS}
     kind_before = _encode_kind_marks()
+    packed_bytes_before = reg.counter_total("wire.packed_bytes")
     phases = None
     # one-shot resident-path driver artifact on the CPU fallback
     # (VERDICT r5 weak #5: every fallback artifact exercised only the
@@ -1956,7 +2148,7 @@ def main():
             if mesh is not None:
                 phases, _kind, _ = run_resident_sharded(
                     batches, names, use_wire, group, mesh,
-                    bucket=shard_bucket)
+                    bucket=shard_bucket, result_spec=rspec)
                 # puts are per GROUP stack (none of them host-blocking;
                 # group >= 1 overlaps the previous group's execution)
                 round_trips = {"puts_async": -(-iters // group),
@@ -1964,7 +2156,8 @@ def main():
                                "fetches": -(-iters // group)}
             else:
                 phases, _kind, _ = run_resident(batches, names,
-                                                use_wire, group)
+                                                use_wire, group,
+                                                result_spec=rspec)
                 round_trips = {"puts_async": iters,
                                "executes": -(-iters // group),
                                "fetches": -(-iters // group)}
@@ -1979,6 +2172,8 @@ def main():
             # produce_wait_s)
             recon_components = {"produce_wait_s": 0.0, "dispatch_s": 0.0,
                                 "fetch_s": 0.0}
+            if rspec is not None:
+                recon_components["decode_s"] = 0.0
 
             def _timed(key, fn, *a):
                 t_ = time.perf_counter()
@@ -2013,14 +2208,20 @@ def main():
                         # materialize to host like the real driver's
                         # pipeline lag (pipeline.materialize): the
                         # [58,D,T] result crosses the link too, so it
-                        # belongs in the wall clock
+                        # belongs in the wall clock (through the result
+                        # wire it is the packed payload + a host
+                        # dequantize, timed as its own component)
                         _count_sync("stream_lagged_fetch")
                         h = _timed("fetch_s", np.asarray, outs[i - 2])
+                        if rspec is not None:
+                            h = _timed("decode_s", _decode_stream, h)
                         if stream_host_results is not None:
                             stream_host_results.append(h)
                 for o in outs[-2:]:
                     _count_sync("stream_drain_fetch")
                     h = _timed("fetch_s", np.asarray, o)
+                    if rspec is not None:
+                        h = _timed("decode_s", _decode_stream, h)
                     if stream_host_results is not None:
                         stream_host_results.append(h)
             wall = time.perf_counter() - t0
@@ -2047,6 +2248,44 @@ def main():
                                        "fetches"]
     encode_kind = _encode_kind_delta(kind_before)
     full_year = per_batch * (TRADING_DAYS_PER_YEAR / days)
+
+    # the bytes program (ISSUE 10): per-day bytes each way over the
+    # timed window, banked as first-class gauges + record blocks so the
+    # regress gate grows <metric>.wire_bytes_per_day /
+    # .result_bytes_per_day sub-series (both flag directions — byte
+    # GROWTH is a regression, a silent byte DROP usually means the
+    # payload lost content)
+    days_total = iters * days
+    wire_bytes = reg.counter_total("wire.packed_bytes") \
+        - packed_bytes_before
+    if mode == "resident":
+        result_bytes = (phases.get("fetch_MB") or 0.0) * 1e6
+        rw_block = dict(phases.get("result_wire")
+                        or {"enabled": False})
+    else:
+        if rspec is not None:
+            result_bytes = result_totals["payload_bytes"]
+            rw_block = {
+                "enabled": True,
+                "spill_rows": rspec.spill_rows,
+                "quantized_slices": result_totals["quantized"],
+                "widened_slices": result_totals["widened"],
+                "overflow_slices": result_totals["overflow"],
+                "payload_MB": round(result_bytes / 1e6, 3),
+                "f32_logical_MB": round(
+                    iters * len(names) * days * N_TICKERS * 4 / 1e6, 3),
+            }
+            rw_block["ratio_vs_f32"] = (round(
+                rw_block["f32_logical_MB"] / rw_block["payload_MB"], 3)
+                if rw_block["payload_MB"] else None)
+        else:
+            result_bytes = iters * len(names) * days * N_TICKERS * 4
+            rw_block = {"enabled": False}
+    wire_bytes_per_day = round(wire_bytes / days_total, 1)
+    result_bytes_per_day = round(result_bytes / days_total, 1)
+    tel_reg = get_telemetry()
+    tel_reg.gauge("wire.bytes_per_day", wire_bytes_per_day)
+    tel_reg.gauge("result.bytes_per_day", result_bytes_per_day)
 
     # wall-clock reconciliation (telemetry.attribution): the timed
     # loop's serial components vs its measured wall with the
@@ -2127,12 +2366,31 @@ def main():
         # (tickers-sharded buffers + overlapped group ingest change
         # both the module and the loop); a resident run whose mesh
         # resolved to one device stays on the r6 series, and the
-        # record's n_shards field is the discriminator.
+        # record's n_shards field is the discriminator. r10 DECLARES
+        # "r10_resident_v3" / "r10_resident_sharded_v2" /
+        # "r10_stream_v4" for the result-wire loops (the fetch leg
+        # ships blocked-quantized payloads + a host dequantize — both
+        # the module and the fetch bytes change); BENCH_RESULT_WIRE=0
+        # runs stay on their r6/r7 series, so a silent f32 fallback can
+        # never smear into the r10 baselines.
         "mode": mode,
-        "methodology": ("r7_resident_sharded_v1"
-                        if mode == "resident" and n_shards > 1
-                        else "r6_resident_v2" if mode == "resident"
-                        else "r6_stream_v3"),
+        "methodology": (
+            ("r10_resident_sharded_v2" if rspec is not None
+             else "r7_resident_sharded_v1")
+            if mode == "resident" and n_shards > 1
+            else ("r10_resident_v3" if rspec is not None
+                  else "r6_resident_v2") if mode == "resident"
+            else ("r10_stream_v4" if rspec is not None
+                  else "r6_stream_v3")),
+        # the result-wire verdict (ISSUE 10): enabled flag, spill
+        # budget, per-slice disposition counts, payload vs logical-f32
+        # bytes. tpu_session's headline carry REQUIRES this block with
+        # enabled=True, so a silent f32 fallback cannot bank.
+        "result_wire": rw_block,
+        # the bytes program: per-day bytes each way over the timed
+        # window (regress derives gateable sub-series from these)
+        "wire": {"bytes_per_day": wire_bytes_per_day},
+        "result": {"bytes_per_day": result_bytes_per_day},
         # how many mesh shards the tickers axis actually resolved to
         # (1 = single-device; tpu_session's resident_sharded step banks
         # only n_shards > 1 — a silent single-device fallback cannot

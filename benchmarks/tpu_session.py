@@ -136,7 +136,13 @@ def drop_conv_only_rolling(steps):
       a 500-ticker run printed a much faster number under the
       5000-ticker name which this carry would have banked forever
       (round-5 ADVICE medium). Pre-stamp records have no ``tickers``
-      key and are dropped — they re-run once under the new schema;
+      key and are dropped — they re-run once under the new schema.
+      Since ISSUE 10 the record must ALSO embed the ``result_wire``
+      block with ``enabled: true``: the headline's remaining byte
+      lever is the quantized result leg, and a run whose fetch
+      silently fell back to raw f32 (BENCH_RESULT_WIRE=0, or a spec
+      regression) measures the OLD transfer shape — it cannot bank as
+      the r10 headline;
     * 'stream' entries must be ``mode: stream`` records (the r1-r4
       series continuation under its own metric suffix);
     * 'resident_sharded' entries must be records of the r7 mesh-native
@@ -182,7 +188,10 @@ def drop_conv_only_rolling(steps):
                        and r.get("tickers") == 5000 for r in recs)
         if name == "headline":
             return any(r.get("mode") == "resident"
-                       and r.get("tickers") == 5000 for r in recs)
+                       and r.get("tickers") == 5000
+                       and isinstance(r.get("result_wire"), dict)
+                       and r["result_wire"].get("enabled") is True
+                       for r in recs)
         if name == "stream":
             return any(r.get("mode") == "stream" for r in recs)
         if name == "serve":

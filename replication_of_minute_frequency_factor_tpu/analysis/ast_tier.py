@@ -46,6 +46,14 @@ LOOP_SCOPE = ("ops", "models")
 #: its declared boundary module, not leak into instrumented hot paths.
 HOST_SYNC_SCOPE = ("ops", "models", "parallel", "serve", "stream",
                    "telemetry")
+#: module-granular GL-A3 extensions (ISSUE 10): ``data/`` as a layer is
+#: host-side by design (the ingest encoder and the parquet IO live
+#: there), but ``data/result_wire.py`` is device-hot — its encode fuses
+#: into every producing graph, and its host decode must operate on an
+#: ALREADY-FETCHED buffer, never trigger the fetch itself. Scoping the
+#: module keeps any ``np.asarray``/``.item()`` sync from creeping into
+#: it; the fetch stays the caller's declared boundary.
+HOST_SYNC_MODULES = frozenset({"data/result_wire.py"})
 #: layer where raw jnp reductions are banned in favour of ops.masked (GL-A5)
 MASKED_SCOPE = ("models",)
 
@@ -292,8 +300,9 @@ def _a3_add(scan: _ModuleScan, node: ast.AST, symbol: str,
 def _rule_a3(scan: _ModuleScan, node: ast.AST,
              stack: List[ast.AST]) -> None:
     """GL-A3: host-sync calls in device-hot modules."""
-    if not scan.in_scope(HOST_SYNC_SCOPE) or not isinstance(node,
-                                                            ast.Call):
+    in_scope = (scan.in_scope(HOST_SYNC_SCOPE)
+                or "/".join(scan.scope_parts) in HOST_SYNC_MODULES)
+    if not in_scope or not isinstance(node, ast.Call):
         return
     msg = ("host-device synchronization in a device-hot module blocks "
            "the dispatch pipeline; move it to a bench/telemetry/CLI "

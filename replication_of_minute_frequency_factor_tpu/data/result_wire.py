@@ -1,0 +1,410 @@
+"""On-device blocked-quantized RESULT wire (the device->host leg).
+
+:mod:`.wire` compressed the ingest direction to ~2.9 bytes/bar; the
+result direction still ships the raw f32 ``[F, D, T]`` exposure block
+(~9.3 MB per 8-day x 5000-ticker batch) over a tunnel that does
+3-15 MB/s up — and docs/BENCHMARKS.md "Narrow result dtype" measured
+and REJECTED uniform dtype narrowing (f16 overflows 22,355 lanes, bf16's
+step exceeds parity rtol). This module is the blocked alternative: a
+**per-(factor, day) affine int16 quantization** computed ON DEVICE as
+the final fused stage of the producing graph, with a **per-slice
+widening fallback to bitwise raw f32** chosen on device by a round-trip
+error check — the ingest wire's widen-don't-reject contract, symmetric
+on the output side.
+
+Why per-(factor, day) blocks are the right unit: one slice IS one
+cross-section — exactly what every downstream consumer (IC, rank-IC,
+qcut deciles, top-k) operates on. An affine map per cross-section
+preserves ordering up to quantization ties, and the guaranteed error is
+**range-relative**: ``|decode(q) - x| <= (hi - lo) / 131068`` (half the
+int16 step), which is the natural error measure for correlation- and
+rank-shaped consumers. Factors whose consumers need VALUE-relative
+accuracy carry tighter pinned bounds (``RESULT_BOUNDS``,
+docs/PIN_BOUNDS.md "Result-wire bounds") and their heavy-tailed slices
+widen instead.
+
+Payload layout for one ``[F, D, T]`` block (packed into ONE uint8
+buffer with :func:`..data.wire.pack_arrays`'s spec machinery, so the
+consolidated per-group fetch stays one RTT):
+
+  q       [F, D, T] int16  quantized lanes; NaN lanes ship the
+                           ``Q_NAN`` sentinel (-32768) and decode to
+                           NaN — NaN STATUS is preserved exactly
+  scale   [F, D]    f32    per-slice step ((hi - lo) / 65534; 1.0 for
+                           degenerate hi == lo slices, which decode
+                           bit-exactly to ``offset``)
+  offset  [F, D]    f32    per-slice lo
+  sidx    [F, D]    int16  -1 = quantized; >= 0 = row in ``spill``
+                           holding this slice's bitwise f32 lanes;
+                           -2 = widened but the spill budget was full
+                           (OVERFLOW — strict decode raises)
+  spill   [S, T]    f32    raw rows for widened slices, in flat
+                           (f, d) order of widening
+
+``S`` (the spill budget) is static per executable; the host threads a
+widen-only floor across runs exactly like the ingest wire's dtype
+floor: an overflow bumps the budget and the next executable has room
+(:class:`ResultWireSpec.grow`). Decode is a cheap host-side numpy
+dequantize (:func:`decode_block`) — this module's ONLY host-side numpy
+is there, and it deliberately avoids implicit device syncs (GL-A3
+scope: the module is device-hot; callers hand decode an already-fetched
+host buffer).
+
+The on-device round-trip check is load-bearing, not decorative: beyond
+heavy-tailed pinned factors it catches offset-dominated slices (values
+like 1e9 +/- 1e-3, where f32 cannot even REPRESENT the dequantized
+resolution — ``x' = q * scale + offset`` rounds at ulp(offset)), slices
+containing +/-inf, and non-finite scales; all of those widen to bitwise
+f32 rather than shipping silently-degraded lanes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: int16 NaN sentinel (decodes to NaN; never produced by quantization)
+Q_NAN = -32768
+#: quantized lanes land in [-Q_LIM, Q_LIM]
+Q_LIM = 32767
+#: number of representable quantization steps
+Q_STEPS = 2 * Q_LIM  # 65534
+
+#: sidx markers
+SIDX_QUANTIZED = -1
+SIDX_OVERFLOW = -2
+
+#: default pinned bound: range-relative absolute error. The int16
+#: quantization GUARANTEES (hi - lo) / 131068 ~= 7.63e-6 x range, so
+#: 1e-5 holds with ~1.3x margin over the worst case plus fp evaluation
+#: wobble; a slice that cannot meet it (offset-dominated, inf-bearing)
+#: widens.
+DEFAULT_ATOL_REL = 1e-5
+DEFAULT_RTOL = 0.0
+
+#: per-factor pinned bounds (docs/PIN_BOUNDS.md "Result-wire bounds"):
+#: ``(rtol, atol_rel, force_widen)``. The STRICT class pins factors
+#: whose magnitudes are CNY-volume/amount-scaled (the f16-overflow set
+#: of benchmarks/result_dtype_check.py) or value-relative by
+#: consumption: their bound is PURELY ``rtol * |x|`` (atol_rel = 0 —
+#: any range-relative slack would swallow exactly the tiny-lane errors
+#: the pin exists to catch), so a heavy-tailed slice (values spanning
+#: more than ~rtol * Q_STEPS decades, i.e. tiny lanes sharing a slice
+#: with huge ones) fails the on-device check and ships bitwise f32
+#: instead of range-relative noise.
+_STRICT = (2e-3, 0.0, False)
+RESULT_BOUNDS: Dict[str, Tuple[float, float, bool]] = {
+    "vol_volume1min": _STRICT,
+    "vol_upVol": _STRICT,
+    "vol_downVol": _STRICT,
+    "liq_amihud_1min": _STRICT,
+    "liq_openvol": _STRICT,
+    "liq_closevol": _STRICT,
+    "liq_closeprevol": _STRICT,
+    "shape_skewVol": _STRICT,
+    "shape_kurtVol": _STRICT,
+}
+
+
+def factor_bounds(name: str) -> Tuple[float, float, bool]:
+    """Pinned ``(rtol, atol_rel, force_widen)`` for one factor."""
+    return RESULT_BOUNDS.get(name, (DEFAULT_RTOL, DEFAULT_ATOL_REL,
+                                    False))
+
+
+class ResultWireOverflow(RuntimeError):
+    """More slices widened than the executable's static spill budget —
+    the payload is marked (``sidx == -2``) rather than silently lossy.
+    Callers grow the widen-only floor (:meth:`ResultWireSpec.grow`) and
+    re-encode under a bigger budget, mirroring the ingest wire's
+    re-encode-until-converged loop (bench.encode_year)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ResultWireSpec:
+    """Static (hashable) encode spec: one per compiled executable.
+
+    ``bounds[f]`` is factor f's pinned ``(rtol, atol_rel,
+    force_widen)``; ``spill_rows`` is the static widen budget S. The
+    spec travels as a static jit argument, so it is part of every AOT
+    executable key — growing the floor compiles a fresh executable, as
+    the contract requires."""
+    bounds: Tuple[Tuple[float, float, bool], ...]
+    spill_rows: int
+
+    @classmethod
+    def for_names(cls, names: Sequence[str],
+                  spill_rows: Optional[int] = None,
+                  days: int = 8) -> "ResultWireSpec":
+        names = tuple(names)
+        if spill_rows is None:
+            spill_rows = default_spill_rows(len(names), days)
+        return cls(bounds=tuple(factor_bounds(n) for n in names),
+                   spill_rows=int(spill_rows))
+
+    def grow(self, needed: int, headroom: float = 1.25
+             ) -> "ResultWireSpec":
+        """Widen-only floor bump: never shrinks."""
+        rows = max(self.spill_rows, int(np.ceil(needed * headroom)))
+        return dataclasses.replace(self, spill_rows=rows)
+
+
+def default_spill_rows(n_factors: int, days: int) -> int:
+    """Default static spill budget: ~2% of the block's slices (widening
+    is the exception by construction — the default bound is guaranteed
+    by the quantization itself), floored at 4 so tiny smokes always
+    have room. At the headline shape (58 x 8 x 5000) this is 10 rows =
+    0.2 MB against a 4.6 MB q plane."""
+    return max(4, int(np.ceil(0.02 * n_factors * max(1, days))))
+
+
+# --------------------------------------------------------------------------
+# payload spec (host): mirrors wire.pack_arrays' layout math
+# --------------------------------------------------------------------------
+
+
+def payload_arrays_shapes(n_factors: int, days: int, tickers: int,
+                          spill_rows: int):
+    """``(dtype, shape)`` of the payload arrays, in pack order."""
+    return (
+        (np.dtype(np.int16), (n_factors, days, tickers)),    # q
+        (np.dtype(np.float32), (n_factors, days)),           # scale
+        (np.dtype(np.float32), (n_factors, days)),           # offset
+        (np.dtype(np.int16), (n_factors, days)),             # sidx
+        (np.dtype(np.float32), (spill_rows, tickers)),       # spill
+    )
+
+
+def payload_spec(n_factors: int, days: int, tickers: int,
+                 spill_rows: int) -> tuple:
+    """The exact ``((dtype_str, shape, byte_offset), ...)`` spec
+    :func:`..data.wire.pack_arrays` would produce for the payload
+    arrays — asserted equal in tests, so the two layouts cannot
+    drift. 4-byte alignment pads between chunks, like pack_arrays."""
+    spec, off = [], 0
+    for dt, shape in payload_arrays_shapes(n_factors, days, tickers,
+                                           spill_rows):
+        spec.append((dt.str, shape, off))
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+        off += nbytes + ((-(off + nbytes)) % 4)
+    return tuple(spec)
+
+
+def payload_nbytes(n_factors: int, days: int, tickers: int,
+                   spill_rows: int) -> int:
+    """Total packed payload length in bytes (the device buffer's L)."""
+    last_dt, last_shape, last_off = payload_spec(
+        n_factors, days, tickers, spill_rows)[-1]
+    nbytes = (int(np.prod(last_shape, dtype=np.int64))
+              * np.dtype(last_dt).itemsize)
+    end = last_off + nbytes
+    return end + ((-end) % 4)
+
+
+# --------------------------------------------------------------------------
+# device encode (pure jax — fused into the producing graph)
+# --------------------------------------------------------------------------
+
+
+def _pack_device(arrays) -> jnp.ndarray:
+    """Device twin of ``wire.pack_arrays``: bitcast each array to bytes
+    and concatenate into one flat uint8 buffer with the SAME 4-byte
+    alignment, so the host unpacks with the shared spec machinery."""
+    chunks = []
+    off = 0
+    for a in arrays:
+        if a.dtype.itemsize == 1:
+            b = a.reshape(-1)
+        else:
+            b = jax.lax.bitcast_convert_type(
+                a.reshape(-1), jnp.uint8).reshape(-1)
+        nbytes = b.shape[0]
+        pad = (-(off + nbytes)) % 4
+        chunks.append(b)
+        if pad:
+            chunks.append(jnp.zeros((pad,), jnp.uint8))
+        off += nbytes + pad
+    return jnp.concatenate(chunks)
+
+
+def encode_block(x: jnp.ndarray, spec: ResultWireSpec) -> jnp.ndarray:
+    """Quantize one ``[F, D, T]`` exposure block on device into the
+    packed ``[L] uint8`` payload (see module docstring for the layout).
+
+    Per (factor, day) slice: masked min/max -> affine int16 with the
+    NaN sentinel -> round-trip error check against the factor's pinned
+    bound -> widen (ship bitwise f32 via the spill plane) on failure.
+    Pure jax, zero while/scan, zero callbacks, f32-only — traced by
+    graftlint under the reserved ``__result_encode__`` symbol."""
+    f, d, t = x.shape
+    if len(spec.bounds) != f:
+        raise ValueError(f"spec pins {len(spec.bounds)} factors; block "
+                         f"has {f}")
+    finite = jnp.isfinite(x)
+    has_finite = jnp.any(finite, axis=-1)                     # [F, D]
+    big = jnp.float32(np.finfo(np.float32).max)
+    lo = jnp.min(jnp.where(finite, x, big), axis=-1)
+    hi = jnp.max(jnp.where(finite, x, -big), axis=-1)
+    lo = jnp.where(has_finite, lo, 0.0)
+    hi = jnp.where(has_finite, hi, 0.0)
+    rng = hi - lo
+    degenerate = rng <= 0.0
+    scale = jnp.where(degenerate, 1.0, rng / jnp.float32(Q_STEPS))
+    offset = lo
+    qf = jnp.round((x - offset[..., None]) / scale[..., None])
+    q = jnp.clip(qf - jnp.float32(Q_LIM), -Q_LIM, Q_LIM)
+    q = jnp.where(finite, q, jnp.float32(Q_NAN)).astype(jnp.int16)
+    # round-trip check, exactly the host dequantize expression
+    xr = ((q.astype(jnp.float32) + jnp.float32(Q_LIM))
+          * scale[..., None] + offset[..., None])
+    err = jnp.abs(xr - x)
+    rtol = jnp.asarray([b[0] for b in spec.bounds],
+                       jnp.float32)[:, None, None]
+    atol_rel = jnp.asarray([b[1] for b in spec.bounds],
+                           jnp.float32)[:, None, None]
+    force = jnp.asarray([b[2] for b in spec.bounds],
+                        jnp.bool_)[:, None]
+    bound = atol_rel * rng[..., None] + rtol * jnp.abs(x)
+    lane_bad = finite & ~(err <= bound)
+    widen = (jnp.any(lane_bad, axis=-1)
+             | jnp.any(jnp.isinf(x), axis=-1)
+             | ~jnp.isfinite(scale)
+             | force)                                         # [F, D]
+    wflat = widen.reshape(-1)
+    row = jnp.cumsum(wflat.astype(jnp.int32)) - 1             # [F*D]
+    fits = wflat & (row < spec.spill_rows)
+    sidx = jnp.where(wflat,
+                     jnp.where(fits, row, SIDX_OVERFLOW),
+                     SIDX_QUANTIZED).reshape(f, d).astype(jnp.int16)
+    # scatter widened slices' raw f32 rows; out-of-budget rows drop
+    # (their sidx already says OVERFLOW)
+    target = jnp.where(fits, row, spec.spill_rows)            # [F*D]
+    spill = jnp.zeros((spec.spill_rows, t), jnp.float32)
+    spill = spill.at[target].set(x.reshape(-1, t), mode="drop")
+    return _pack_device((q, scale, offset, sidx, spill))
+
+
+def encode_stacked(x: jnp.ndarray, spec: ResultWireSpec) -> jnp.ndarray:
+    """``[N, F, D, T]`` -> ``[N, L]``: vmapped :func:`encode_block` for
+    the sharded resident path, where the encode must sit OUTSIDE the
+    ``shard_map`` (per-slice min/max is a cross-ticker — i.e.
+    cross-shard — reduction; GSPMD partitions it, and the global
+    parameters keep sharded payloads bit-comparable with the
+    single-device encode)."""
+    return jax.vmap(lambda b: encode_block(b, spec))(x)
+
+
+# --------------------------------------------------------------------------
+# host decode (numpy; input is an ALREADY-FETCHED host buffer)
+# --------------------------------------------------------------------------
+
+
+def _unpack_host(buf: np.ndarray, spec: tuple):
+    out = []
+    flat = buf.reshape(-1).view(np.uint8)
+    for dtype_str, shape, off in spec:
+        dt = np.dtype(dtype_str)
+        n = int(np.prod(shape, dtype=np.int64))
+        out.append(flat[off:off + n * dt.itemsize].view(dt)
+                   .reshape(shape))
+    return out
+
+
+def decode_block(buf: np.ndarray, n_factors: int, days: int,
+                 tickers: int, spill_rows: int, strict: bool = True,
+                 telemetry=None):
+    """Dequantize one fetched payload back to ``([F, D, T] f32,
+    verdict)``.
+
+    Widened slices come back BITWISE (the spill rows are the raw f32
+    lanes); quantized slices carry the pinned range-relative error; NaN
+    lanes are NaN. ``verdict`` reports ``{quantized, widened, overflow,
+    payload_bytes, f32_bytes, ratio}``; ``strict`` raises
+    :class:`ResultWireOverflow` when any slice overflowed the spill
+    budget (the caller's cue to grow the floor)."""
+    spec = payload_spec(n_factors, days, tickers, spill_rows)
+    q, scale, offset, sidx, spill = _unpack_host(buf, spec)
+    out = ((q.astype(np.float32) + np.float32(Q_LIM))
+           * scale[..., None] + offset[..., None])
+    out[q == Q_NAN] = np.nan
+    widened = sidx >= 0
+    if widened.any():
+        out[widened] = spill[sidx[widened].astype(np.int64)]
+    n_overflow = int((sidx == SIDX_OVERFLOW).sum())
+    payload_bytes = int(buf.nbytes)  # buf is an already-fetched host
+    # array — decode never touches the device (GL-A3: this module is
+    # device-hot scope; the fetch is the caller's declared boundary)
+    f32_bytes = n_factors * days * tickers * 4
+    verdict = {
+        "quantized": int((sidx == SIDX_QUANTIZED).sum()),
+        "widened": int(widened.sum()),
+        "overflow": n_overflow,
+        "payload_bytes": payload_bytes,
+        "f32_bytes": f32_bytes,
+        "ratio": round(f32_bytes / payload_bytes, 3)
+        if payload_bytes else None,
+        # the per-slice disposition plane, for parity gates
+        # (check_bounds); NOT JSON-able — record stampers drop it
+        "sidx": sidx,
+    }
+    tel = telemetry
+    if tel is None:
+        from ..telemetry import get_telemetry
+        tel = get_telemetry()
+    tel.counter("result.decode_blocks")
+    tel.counter("result.bytes", payload_bytes)
+    tel.counter("result.widened_slices", verdict["widened"])
+    if n_overflow:
+        tel.counter("result.overflow_slices", n_overflow)
+    if strict and n_overflow:
+        raise ResultWireOverflow(
+            f"{n_overflow} widened slice(s) did not fit the {spill_rows}"
+            f"-row spill budget; grow the widen-only floor "
+            f"(ResultWireSpec.grow) and re-encode")
+    return out, verdict
+
+
+def check_bounds(raw: np.ndarray, decoded: np.ndarray,
+                 names: Sequence[str], sidx: Optional[np.ndarray] = None
+                 ) -> dict:
+    """Parity gate helper: verify ``decoded`` against the raw f32 block
+    under the pinned per-factor contract — BITWISE where widened,
+    within ``atol_rel * range + rtol * |x|`` where quantized, NaN
+    status everywhere. Returns ``{ok, bad_factors, max_rel_err}``."""
+    bad, max_rel = [], 0.0
+    for i, n in enumerate(names):
+        a, b = raw[i], decoded[i]
+        if not np.array_equal(np.isnan(a), np.isnan(b)):
+            bad.append(n)
+            continue
+        finite = np.isfinite(a)
+        if not np.array_equal(finite, np.isfinite(b)):
+            bad.append(n)
+            continue
+        rtol, atol_rel, _ = factor_bounds(n)
+        for d in range(a.shape[0]):
+            af, bf = a[d], b[d]
+            fin = np.isfinite(af)
+            if sidx is not None and sidx[i, d] >= 0:
+                # widened slice: bitwise, nothing else to check
+                if not np.array_equal(af[fin], bf[fin]):
+                    bad.append(n)
+                continue
+            if not fin.any():
+                continue
+            lo, hi = af[fin].min(), af[fin].max()
+            bound = atol_rel * (hi - lo) + rtol * np.abs(af[fin])
+            err = np.abs(bf[fin] - af[fin])
+            # widened slices are bitwise, which trivially satisfies any
+            # bound; quantized slices must fit the pinned one
+            if not (err <= np.maximum(bound, 0.0)).all():
+                bad.append(n)
+            scale_ref = max(abs(lo), abs(hi), 1e-30)
+            max_rel = max(max_rel, float(err.max(initial=0.0))
+                          / scale_ref)
+    return {"ok": not bad, "bad_factors": sorted(set(bad)),
+            "max_rel_err": max_rel}

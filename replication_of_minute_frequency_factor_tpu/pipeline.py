@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from .config import Config, apply_compilation_cache, get_config
 from .data import io as dio
+from .data import result_wire
 from .data import wire
 from .data.minute import grid_day
 from .models.registry import compute_factors, compute_factors_jit, factor_names
@@ -68,12 +69,18 @@ def _compute_from_wire(base, dclose, dohl, volume, maskbits, vol_scale,
 
 
 def _compute_packed(buf, spec, kind, names, replicate_quirks,
-                    rolling_impl):
+                    rolling_impl, result_spec=None):
     """Single-buffer variant of the fused graph: ONE uint8 input (unpacked
     by static-offset bitcasts on device) and ONE stacked ``[F, ...]``
     output, so a batch costs one transfer each way over the tunnel instead
     of 6 in + ~58 out (see wire.pack_arrays). ``kind`` is 'wire' or 'raw'
-    (the raw-f32 fallback ships ``(bars, mask)`` through the same path)."""
+    (the raw-f32 fallback ships ``(bars, mask)`` through the same path).
+
+    ``result_spec`` (a static :class:`..data.result_wire.ResultWireSpec`)
+    fuses the RESULT wire as the graph's final stage: the output becomes
+    the packed quantized payload (``[L] uint8``) instead of the raw f32
+    stack — the device->host leg's analogue of the ingest wire (ISSUE
+    10); ``None`` keeps the raw-f32 result contract."""
     arrs = wire.unpack(buf, spec)
     if kind == "wire":
         bars, m = wire.decode(*arrs)
@@ -83,11 +90,14 @@ def _compute_packed(buf, spec, kind, names, replicate_quirks,
     out = compute_factors(bars, m, names=names,
                           replicate_quirks=replicate_quirks,
                           rolling_impl=rolling_impl)
-    return jnp.stack([out[n] for n in names])
+    stacked = jnp.stack([out[n] for n in names])
+    if result_spec is not None:
+        return result_wire.encode_block(stacked, result_spec)
+    return stacked
 
 
 _PACKED_STATIC = ("spec", "kind", "names", "replicate_quirks",
-                  "rolling_impl")
+                  "rolling_impl", "result_spec")
 _compute_packed_jit = functools.partial(
     jax.jit, static_argnames=_PACKED_STATIC)(_compute_packed)
 #: donated twin: the multi-MB packed day buffer is dead the moment the
@@ -114,32 +124,36 @@ def _donate_device_buffers(cfg: Optional["Config"] = None) -> bool:
 
 
 def compute_packed_prepared(buf, spec, kind, names, replicate_quirks=True,
-                            rolling_impl=None):
+                            rolling_impl=None, result_spec=None):
     """Device half of the packed path: one device_put of an already-packed
     buffer -> fused graph -> stacked [len(names), D, T] result (still on
     device). The streaming pipeline packs on its producer thread and
     calls this from the consumer, so the multi-MB host concatenate
     overlaps device compute. On accelerator backends the freshly-put
     device buffer is DONATED to the graph (see
-    ``_compute_packed_jit_donated``) — it has no other owner."""
+    ``_compute_packed_jit_donated``) — it has no other owner. With
+    ``result_spec`` the returned device array is the result wire's
+    packed ``[L] uint8`` payload (``result_wire.decode_block`` on the
+    host after the fetch)."""
     if rolling_impl is None:
         rolling_impl = get_config().rolling_impl
     fn = (_compute_packed_jit_donated if _donate_device_buffers()
           else _compute_packed_jit)
     return fn(jax.device_put(buf), spec, kind, names,
-              replicate_quirks, rolling_impl)
+              replicate_quirks, rolling_impl, result_spec)
 
 
 def compute_packed(arrays, kind, names, replicate_quirks=True,
-                   rolling_impl=None):
+                   rolling_impl=None, result_spec=None):
     """One-call packed path: pack + transfer + compute (see above)."""
     buf, spec = wire.pack_arrays(arrays)
     return compute_packed_prepared(buf, spec, kind, names,
-                                   replicate_quirks, rolling_impl)
+                                   replicate_quirks, rolling_impl,
+                                   result_spec)
 
 
 def _compute_packed_scan(bufs, spec, kind, names, replicate_quirks,
-                         rolling_impl):
+                         rolling_impl, result_spec=None):
     """Device-resident multi-batch variant: a whole year of packed
     buffers in ONE executable.
 
@@ -169,10 +183,17 @@ def _compute_packed_scan(bufs, spec, kind, names, replicate_quirks,
         out = compute_factors(bars, m, names=names,
                               replicate_quirks=replicate_quirks,
                               rolling_impl=rolling_impl)
-        return None, jnp.stack([out[n] for n in names])
+        y = jnp.stack([out[n] for n in names])
+        if result_spec is not None:
+            # result wire fused as the scan body's FINAL stage (ISSUE
+            # 10): each step emits its batch's packed quantized payload,
+            # so the year's accumulator is [N, L] uint8 instead of
+            # [N, F, D, T] f32 — the fetch ships ~half the bytes
+            y = result_wire.encode_block(y, result_spec)
+        return None, y
 
     _, ys = jax.lax.scan(body, None, stacked)
-    return ys  # [N, F, D, T]
+    return ys  # [N, F, D, T] f32, or [N, L] u8 through the result wire
 
 
 _compute_packed_scan_jit = functools.partial(
@@ -239,7 +260,8 @@ def _invalidate_donated(arrs) -> None:
 
 
 def compute_packed_resident(dbufs, spec, kind, names,
-                            replicate_quirks=True, rolling_impl=None):
+                            replicate_quirks=True, rolling_impl=None,
+                            result_spec=None):
     """Run N device-resident packed buffers through one fused scan
     executable; returns the stacked [N, F, D, T] result STILL ON DEVICE
     (callers fetch once). ``dbufs``: tuple of device uint8 buffers that
@@ -259,14 +281,15 @@ def compute_packed_resident(dbufs, spec, kind, names,
     fn = (_compute_packed_scan_jit_donated if donating
           else _compute_packed_scan_jit)
     out = fn(tuple(dbufs), spec, kind, names,
-             replicate_quirks, rolling_impl)
+             replicate_quirks, rolling_impl, result_spec)
     if donating:
         _invalidate_donated(dbufs)
     return out
 
 
 def lower_packed_resident(dbufs, spec, kind, names,
-                          replicate_quirks=True, rolling_impl=None):
+                          replicate_quirks=True, rolling_impl=None,
+                          result_spec=None):
     """AOT lowering of the resident scan executable (same twin
     selection as :func:`compute_packed_resident`). bench routes the
     first build through ``telemetry.attribution.compile_with_telemetry``
@@ -278,11 +301,12 @@ def lower_packed_resident(dbufs, spec, kind, names,
     fn = (_compute_packed_scan_jit_donated if _donate_device_buffers()
           else _compute_packed_scan_jit)
     return fn.lower(tuple(dbufs), spec, kind, names,
-                    replicate_quirks, rolling_impl)
+                    replicate_quirks, rolling_impl, result_spec)
 
 
 def _compute_packed_scan_sharded(stacked, spec, kind, names,
-                                 replicate_quirks, rolling_impl, mesh):
+                                 replicate_quirks, rolling_impl, mesh,
+                                 result_spec=None):
     """Mesh-native twin of :func:`_compute_packed_scan`: the resident
     year as ONE scan executable whose data parallelism spans the
     tickers axis of a ``(days=1, tickers=n)`` mesh.
@@ -324,7 +348,16 @@ def _compute_packed_scan_sharded(stacked, spec, kind, names,
     fn = shard_map(per_shard, mesh=mesh,
                    in_specs=(packed_year_spec(),),
                    out_specs=scan_output_spec())
-    return fn(stacked)
+    ys = fn(stacked)
+    if result_spec is not None:
+        # result-wire encode sits OUTSIDE the shard_map but INSIDE this
+        # one jitted module: the per-(factor, day) min/max is a
+        # cross-TICKER — i.e. cross-shard — reduction, so GSPMD owns
+        # the collectives, and the quantization parameters are the
+        # GLOBAL ones (bit-comparable with the single-device encode;
+        # min/max are exactly associative)
+        ys = result_wire.encode_stacked(ys, result_spec)
+    return ys
 
 
 _SHARDED_STATIC = _PACKED_STATIC + ("mesh",)
@@ -340,7 +373,8 @@ _compute_packed_scan_sharded_jit_donated = functools.partial(
 
 def compute_packed_resident_sharded(stacked, spec, kind, mesh, names,
                                     replicate_quirks=True,
-                                    rolling_impl=None):
+                                    rolling_impl=None,
+                                    result_spec=None):
     """Sharded resident scan over a mesh-placed ``[N, S, L]`` packed
     year (see :func:`_compute_packed_scan_sharded`); returns
     ``[N, F, D, T]`` STILL SHARDED on device — fetch once per scan
@@ -356,7 +390,7 @@ def compute_packed_resident_sharded(stacked, spec, kind, mesh, names,
     fn = (_compute_packed_scan_sharded_jit_donated if donating
           else _compute_packed_scan_sharded_jit)
     out = fn(stacked, spec, kind, names, replicate_quirks,
-             rolling_impl, mesh)
+             rolling_impl, mesh, result_spec)
     if donating:
         _invalidate_donated((stacked,))
     return out
@@ -364,7 +398,8 @@ def compute_packed_resident_sharded(stacked, spec, kind, mesh, names,
 
 def lower_packed_resident_sharded(stacked, spec, kind, mesh, names,
                                   replicate_quirks=True,
-                                  rolling_impl=None):
+                                  rolling_impl=None,
+                                  result_spec=None):
     """AOT lowering of the SHARDED resident scan (twin selection as
     :func:`compute_packed_resident_sharded`); call the compiled
     executable with ``compiled(stacked)``. See
@@ -376,7 +411,7 @@ def lower_packed_resident_sharded(stacked, spec, kind, mesh, names,
           if _donate_device_buffers()
           else _compute_packed_scan_sharded_jit)
     return fn.lower(stacked, spec, kind, names, replicate_quirks,
-                    rolling_impl, mesh)
+                    rolling_impl, mesh, result_spec)
 
 
 def compute_exposures_streamed(bars, mask, names=None, micro_batch=16,
@@ -511,10 +546,20 @@ class ExposureTable:
         return cls(cols)
 
     def save(self, path: str) -> None:
-        dio.write_parquet_atomic(self.to_arrow(), path)
+        """Atomic cache write. ``.mffz`` paths take the framed
+        compressed format (arrow IPC + zstd/lz4/zlib chain —
+        data/io.frame_bytes); everything else stays parquet, itself
+        codec-picked per the installed pyarrow (ISSUE 10's on-disk
+        half). Both are tempfile-then-rename crash-safe."""
+        if path.endswith(".mffz"):
+            dio.write_framed_table_atomic(self.to_arrow(), path)
+        else:
+            dio.write_parquet_atomic(self.to_arrow(), path)
 
     @classmethod
     def load(cls, path: str) -> "ExposureTable":
+        if path.endswith(".mffz"):
+            return cls.from_arrow(dio.read_framed_table(path))
         import pyarrow.parquet as pq
         return cls.from_arrow(pq.read_table(path))
 

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..data import result_wire
 from ..data import wire
 from ..eval_ops import _qcut_labels_jit, ic_series
 from ..models.registry import compute_factors
@@ -86,6 +87,16 @@ def _ic_fn(exposures, close, valid, row, horizon):
 
 _ic_jit = functools.partial(
     jax.jit, static_argnames=("row", "horizon"))(_ic_fn)
+
+#: result-wire encode of a block's stacked exposures (ISSUE 10): the
+#: answer leg's device half. Encodes from the cache's RAW f32 block
+#: every time — the cache never holds quantized data, so repeated
+#: answers can never re-quantize a decode (no double quantization by
+#: construction), and the encode is deterministic on the same block.
+_encode_exposures_jit = functools.partial(
+    jax.jit, static_argnames=("result_spec",))(
+        lambda exposures, result_spec:
+        result_wire.encode_block(exposures, result_spec))
 
 
 def _decile_fn(exposures, close, valid, row, horizon, group_num):
@@ -181,6 +192,27 @@ class ServeEngine:
             lambda: _ic_jit.lower(exposures, block["close"],
                                   block["valid"], row, horizon))
         return compiled(exposures, block["close"], block["valid"])
+
+    def result_spec(self, days: int) -> "result_wire.ResultWireSpec":
+        """The server's static result-wire spec for a ``days``-deep
+        block (pinned per-factor bounds + the default spill budget)."""
+        return result_wire.ResultWireSpec.for_names(self.names,
+                                                    days=days)
+
+    def encode_exposures(self, block: Dict[str, object]):
+        """Result-wire encode of the block's ``[F, D, T]`` exposures as
+        ONE warm device dispatch -> packed ``[L] uint8`` payload (still
+        on device; the request loop fetches + host-dequantizes it).
+        Always encodes from the cached RAW f32 exposures — see
+        ``_encode_exposures_jit`` for the no-double-quantization
+        argument."""
+        exposures = block["exposures"]
+        spec = self.result_spec(int(exposures.shape[1]))
+        key = ("result_encode", exposures.shape, spec)
+        compiled = self.executables.get(
+            "serve_result_encode", key,
+            lambda: _encode_exposures_jit.lower(exposures, spec))
+        return compiled(exposures), spec
 
     def decile(self, block: Dict[str, object], name: str, horizon: int,
                group_num: int):

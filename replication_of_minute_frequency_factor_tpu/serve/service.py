@@ -120,6 +120,15 @@ class ServeConfig:
     #: HBM watermark sampler thread period (0 disables the thread;
     #: dispatch-boundary sampling stays on either way)
     hbm_sample_period_s: float = 0.5
+    #: ship factors/intraday answers through the blocked-quantized
+    #: result wire (ISSUE 10): the block's exposures encode on device
+    #: (one warm dispatch from the cached RAW f32 block — never from a
+    #: decode, so the exposure cache can't double-quantize) and the
+    #: answer IS the host-side dequantize of the fetched payload.
+    #: Opt-in: quantized slices carry the pinned range-relative error
+    #: (data/result_wire.RESULT_BOUNDS), which answer consumers must
+    #: accept; widened slices stay bitwise.
+    result_wire: bool = False
 
 
 class FactorServer:
@@ -502,9 +511,26 @@ class FactorServer:
             block_s = 0.0
             try:
                 t0 = time.perf_counter()
-                exposures, ready = self.stream_engine.snapshot()
-                exp = np.asarray(exposures)   # the boundary sync
-                rdy = np.asarray(ready)
+                if self.scfg.result_wire:
+                    # one fused finalize+encode dispatch; the answer is
+                    # the host dequantize of the fetched payload
+                    from ..data import result_wire as _rw
+                    payload, ready = self.stream_engine.snapshot_wire()
+                    pay = np.asarray(payload)   # the boundary sync
+                    rdy = np.asarray(ready)
+                    eng = self.stream_engine
+                    exp, _v = _rw.decode_block(
+                        pay, len(eng.names), 1, eng.n_tickers,
+                        eng.result_spec.spill_rows,
+                        telemetry=self.telemetry)
+                    exp = exp[:, 0, :]
+                    self.telemetry.counter("serve.result_wire_answers")
+                    self.telemetry.counter("serve.result_wire_bytes",
+                                           _v["payload_bytes"])
+                else:
+                    exposures, ready = self.stream_engine.snapshot()
+                    exp = np.asarray(exposures)   # the boundary sync
+                    rdy = np.asarray(ready)
                 block_s = time.perf_counter() - t0
                 tel.observe("serve.stage_seconds", block_s,
                             stage="block")
@@ -658,9 +684,28 @@ class FactorServer:
     def _host_exposures(self, block, fetched: dict) -> np.ndarray:
         """The group's ONE host fetch of the stacked exposures (memoised
         across the group's factors-queries) — the declared GL-A3
-        boundary sync of the request loop."""
+        boundary sync of the request loop. With
+        ``ServeConfig.result_wire`` the fetch ships the blocked-
+        quantized payload instead of raw f32 (~half the bytes over the
+        tunnel) and the answer is its host dequantize — byte-identical
+        to decoding the same payload anywhere else, and re-encoded from
+        the RAW cached block on every dispatch group (never from a
+        decode: no double quantization through the exposure cache)."""
         if "exposures" not in fetched:
-            fetched["exposures"] = np.asarray(block["exposures"])
+            if self.scfg.result_wire:
+                from ..data import result_wire as _rw
+                payload_dev, spec = self.engine.encode_exposures(block)
+                payload = np.asarray(payload_dev)  # the boundary sync
+                f, d, t = block["exposures"].shape
+                dec, v = _rw.decode_block(
+                    payload, f, d, t, spec.spill_rows,
+                    telemetry=self.telemetry)
+                self.telemetry.counter("serve.result_wire_answers")
+                self.telemetry.counter("serve.result_wire_bytes",
+                                       v["payload_bytes"])
+                fetched["exposures"] = dec
+            else:
+                fetched["exposures"] = np.asarray(block["exposures"])
         return fetched["exposures"]
 
     def _answer(self, block, q: Query, fetched: dict) -> dict:

@@ -34,6 +34,7 @@ from typing import Dict, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from ..data import result_wire
 from ..serve.executables import ExecutableCache
 from . import carry as carry_mod
 
@@ -92,6 +93,21 @@ class StreamEngine:
         self._snapshot_jit = jax.jit(
             lambda c: carry_mod.finalize_with_readiness(
                 c, self.names, self.replicate_quirks, self.rolling_impl))
+        #: snapshot through the result wire (ISSUE 10): finalize +
+        #: on-device blocked-quantized encode of the [F, T] exposures
+        #: (as an [F, 1, T] block — one day) fused in ONE executable;
+        #: the readiness plane ships raw (bool, T bytes/factor)
+        self.result_spec = result_wire.ResultWireSpec.for_names(
+            self.names, days=1)
+
+        def _snap_wire(c):
+            exposures, ready = carry_mod.finalize_with_readiness(
+                c, self.names, self.replicate_quirks, self.rolling_impl)
+            payload = result_wire.encode_block(
+                exposures[:, None, :], self.result_spec)
+            return payload, ready
+
+        self._snapshot_wire_jit = jax.jit(_snap_wire)
         self.carry = None
         #: host-side minute cursor mirror (no device read needed for
         #: gauges or over-ingest guards)
@@ -245,3 +261,23 @@ class StreamEngine:
         self.telemetry.counter("stream.snapshots")
         self.telemetry.hbm.sample("stream.snapshot")
         return exposures, ready
+
+    def snapshot_wire(self):
+        """Partial-day view through the result wire (ISSUE 10): ONE
+        warm dispatch fusing finalize + the on-device blocked-quantized
+        encode; returns DEVICE ``(payload [L] u8, ready [F, T])``. The
+        caller fetches the payload and host-dequantizes via
+        ``data.result_wire.decode_block(payload, F, 1, T,
+        engine.result_spec.spill_rows)`` — the serve request loop does
+        exactly that under ``ServeConfig.result_wire``, so a stream
+        answer is by construction byte-identical to the host dequantize
+        of the same snapshot payload."""
+        exe = self._exe("stream_snapshot_wire", (self.result_spec,),
+                        self._snapshot_wire_jit, self.carry)
+        t0 = time.perf_counter()
+        payload, ready = exe(self.carry)
+        self.telemetry.observe("stream.snapshot_seconds",
+                               time.perf_counter() - t0)
+        self.telemetry.counter("stream.snapshots", kind="wire")
+        self.telemetry.hbm.sample("stream.snapshot")
+        return payload, ready

@@ -40,7 +40,21 @@ smeared):
   updates/bars/snapshots, carry bytes, compiles-during-load, the
   streamed-vs-full-day parity verdict — under ``stream``; per-bar
   ingest is a new workload, so its records start their own
-  baseline).
+  baseline), ``r10_resident_v3`` / ``r10_resident_sharded_v2`` /
+  ``r10_stream_v4`` (ISSUE 10: the device->host result leg ships
+  blocked-quantized int16 payloads with per-slice bitwise-f32
+  widening — data/result_wire.py — so the fetch bytes, the module,
+  and the loop's host decode stage all change; bench stamps the r10
+  names only when the record's ``result_wire.enabled`` is true, so
+  a silent f32 fallback stays on the r6/r7 series).
+
+Byte sub-series (ISSUE 10): every bench record that carries the
+``wire.bytes_per_day`` / ``result.bytes_per_day`` gauges contributes
+``<metric>.wire_bytes_per_day`` and ``<metric>.result_bytes_per_day``
+as their own gateable groups. Both deviation directions flag, like
+every derived series: byte GROWTH is a transfer regression, and a
+silent byte DROP usually means the payload lost content (e.g. an
+unnoticed factor-set shrink) — neither may pass quietly.
 
 Derived sub-series (ISSUE 8): each bench record additionally
 contributes ``<metric>.request_p99_ms`` (its end-to-end request-latency
@@ -257,6 +271,21 @@ def derive_records(record: dict) -> List[dict]:
                         "value": float(peak), "unit": "bytes",
                         "methodology": meth,
                         "derived_from": "hbm.peak_bytes"})
+    # byte-program sub-series (ISSUE 10): the per-day bytes each way.
+    # Either sign of deviation flags via the shared tolerance machinery
+    # (growth = transfer regression; silent shrink = lost payload)
+    for block_key, metric_suffix in (("wire", "wire_bytes_per_day"),
+                                     ("result", "result_bytes_per_day")):
+        block = record.get(block_key)
+        if isinstance(block, dict):
+            bpd = block.get("bytes_per_day")
+            if isinstance(bpd, (int, float)) \
+                    and not isinstance(bpd, bool) and bpd > 0:
+                out.append({"metric": f"{metric}.{metric_suffix}",
+                            "value": float(bpd), "unit": "bytes/day",
+                            "methodology": meth,
+                            "derived_from":
+                                f"{block_key}.bytes_per_day"})
     # mesh balance sub-series (ISSUE 9): gated on mesh.available — only
     # records with REAL shard watermarks (telemetry/meshplane.py) seed
     # or gate the balance baselines

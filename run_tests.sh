@@ -66,6 +66,14 @@ print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     # verdict line, nonzero on drift
     run python -c "import json, sys, bench; r = bench.stream_smoke(); \
 print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
+    # result-wire smoke (ISSUE 10): the blocked-quantized device->host
+    # exposure wire end to end on a seeded batch — all 58 factors
+    # computed raw, encoded on device, fetched as ONE packed payload,
+    # host-dequantized; parity verdict (bitwise where widened, pinned
+    # bounds where quantized) + the measured byte ratio in one JSON
+    # line, nonzero exit on drift or a sub-1.5x ratio
+    run python -c "import json, sys, bench; r = bench.result_wire_smoke(); \
+print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     # ops-plane smoke (ISSUE 8): a streaming FactorServer + HTTP under
     # mixed ingest+query load — X-Trace-Id round-trip with the request
     # lifecycle reconstructible from the bundle, Prometheus scrape

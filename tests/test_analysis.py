@@ -383,14 +383,20 @@ def test_resident_wrappers_trace_clean_and_scan_exempt_by_symbol():
     assert violations == []
     assert set(fps) == set(jaxpr_tier.RESIDENT_WRAPPERS)
     assert "__stream_update__" in fps
+    assert "__result_encode__" in fps      # ISSUE 10
     for name, fp in fps.items():
         assert fp["traced"] is True
-        assert fp["primitives"].get("scan") == 1, name
+        allowed = jaxpr_tier.WRAPPER_SCAN_ALLOWANCE.get(name, 1)
+        assert fp["primitives"].get("scan", 0) == allowed, name
         assert "while" not in fp["primitives"], name
+    # the result-wire encode gets NO scan exemption at all — its
+    # cumsum/scatter compaction must never trace to a serial loop
+    assert jaxpr_tier.WRAPPER_SCAN_ALLOWANCE["__result_encode__"] == 0
     # exemption is by symbol, NOT by baseline entry
     entries = Baseline.load(BASELINE_PATH).entries
     assert not any(e.get("kernel", "").startswith(("__resident",
-                                                   "__stream"))
+                                                   "__stream",
+                                                   "__result"))
                    for e in entries)
 
 
@@ -430,6 +436,8 @@ def test_report_carries_resident_wrapper_fingerprints():
     wrappers = rep["jaxpr"]["resident_wrappers"]
     assert set(wrappers) == {"__resident_scan__",
                              "__resident_scan_sharded__",
-                             "__stream_update__"}
-    for fp in wrappers.values():
-        assert fp["primitives"]["scan"] == 1
+                             "__stream_update__",
+                             "__result_encode__"}
+    for name, fp in wrappers.items():
+        want = 0 if name == "__result_encode__" else 1
+        assert fp["primitives"].get("scan", 0) == want, name

@@ -422,3 +422,87 @@ def test_mesh_series_gate_both_directions(tmp_path):
     # a declared methodology break opens fresh series, never flagged
     assert regress.evaluate(
         entries, candidate=_sharded_rec(methodology="r10_mesh2d"))["ok"]
+
+
+def _r10_rec(value=80.0, wire_bpd=600_000.0, result_bpd=610_000.0,
+             methodology="r10_resident_v3"):
+    return {"metric": "cicc58_5000tickers_1yr_wall", "value": value,
+            "methodology": methodology,
+            "result_wire": {"enabled": True, "ratio_vs_f32": 1.9},
+            "wire": {"bytes_per_day": wire_bpd},
+            "result": {"bytes_per_day": result_bpd}}
+
+
+def test_derive_records_lifts_byte_program():
+    recs = regress.derive_records(_r10_rec())
+    metrics = [r["metric"] for r in recs]
+    assert "cicc58_5000tickers_1yr_wall.wire_bytes_per_day" in metrics
+    assert "cicc58_5000tickers_1yr_wall.result_bytes_per_day" in metrics
+    by = {r["metric"]: r for r in recs}
+    assert by["cicc58_5000tickers_1yr_wall.wire_bytes_per_day"][
+        "value"] == 600_000.0
+    assert by["cicc58_5000tickers_1yr_wall.result_bytes_per_day"][
+        "methodology"] == "r10_resident_v3"
+    # absent/zero blocks derive nothing
+    assert not any("bytes_per_day" in r["metric"]
+                   for r in regress.derive_records(
+                       {"metric": "m", "value": 1.0,
+                        "wire": {"bytes_per_day": 0}}))
+
+
+def test_byte_series_flag_both_directions(tmp_path):
+    """ISSUE 10 satellite: per-day byte GROWTH is a transfer
+    regression, and a silent byte DROP (lost payload) flags too; a
+    declared r10_* break opens fresh series and is accepted by
+    --check semantics (evaluate with candidate)."""
+    for i, bpd in enumerate((610_000.0, 612_000.0)):
+        with open(tmp_path / f"BENCH_r{i + 1:02d}.json", "w") as fh:
+            json.dump({"n": i + 1, "parsed": _r10_rec(result_bpd=bpd)},
+                      fh)
+    entries = regress.load_bench_series(str(tmp_path))
+    assert regress.evaluate(entries, candidate=_r10_rec())["ok"]
+    # growth flags
+    v = regress.evaluate(entries,
+                         candidate=_r10_rec(result_bpd=1_200_000.0))
+    assert not v["ok"]
+    assert any(f["metric"].endswith(".result_bytes_per_day")
+               for f in v["flagged"])
+    # a silent DROP flags too (payload lost content)
+    v = regress.evaluate(entries,
+                         candidate=_r10_rec(result_bpd=300_000.0))
+    assert not v["ok"]
+    assert any(f["metric"].endswith(".result_bytes_per_day")
+               for f in v["flagged"])
+    # ingest-side series gates the same way
+    v = regress.evaluate(entries,
+                         candidate=_r10_rec(wire_bpd=1_500_000.0))
+    assert not v["ok"]
+    assert any(f["metric"].endswith(".wire_bytes_per_day")
+               for f in v["flagged"])
+
+
+def test_cli_check_r10_break_is_declared(tmp_path):
+    """A fresh r10_resident_v3 record gated against a banked r6/r7
+    trajectory is a DECLARED break: its own fresh series (headline and
+    byte sub-series alike), reported with empty baselines, exit 0."""
+    with open(tmp_path / "BENCH_r09.json", "w") as fh:
+        json.dump({"n": 9, "parsed": {
+            "metric": "cicc58_5000tickers_1yr_wall", "value": 146.2,
+            "methodology": "r6_resident_v2"}}, fh)
+    cand = tmp_path / "candidate.json"
+    with open(cand, "w") as fh:
+        json.dump(_r10_rec(value=80.0), fh)
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = regress.main([str(tmp_path), "--check", str(cand)])
+    assert rc == 0
+    verdict = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert verdict["ok"]
+    r10_groups = [g for g in verdict["groups"]
+                  if g["methodology"] == "r10_resident_v3"]
+    assert r10_groups and all(g["n_baseline"] == 0
+                              for g in r10_groups)
+    assert any("declared break" in g.get("note", "")
+               for g in r10_groups)

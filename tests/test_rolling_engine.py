@@ -185,7 +185,11 @@ def test_bench_resident_oom_falls_back_to_stream(monkeypatch, capsys):
              if ln.startswith("{")]
     rec = json.loads(lines[-1])
     assert rec["mode"] == "stream"
-    assert rec["methodology"] == "r6_stream_v3"
+    # the result wire stays on through the OOM ladder (it shrinks the
+    # fetch footprint too), so the fallback lands on the r10 stream
+    # series with the wire block stamped
+    assert rec["methodology"] == "r10_stream_v4"
+    assert rec["result_wire"]["enabled"] is True
     assert rec["n_shards"] == 1
     assert rec["days_per_batch"] == 8
     # both ladder rungs recorded: sharded scan OOM'd first (the test

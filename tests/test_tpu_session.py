@@ -115,7 +115,8 @@ def test_legacy_rolling_entries_never_carry(tpu_session):
              "value": 141.7}]},
         "headline": {"ok": True, "results": [
             {"metric": "x", "days_per_batch": 32, "mode": "resident",
-             "tickers": 5000}]},
+             "tickers": 5000,
+             "result_wire": {"enabled": True}}]},
     }
     got = tpu_session.drop_conv_only_rolling(steps)
     assert set(got) == {"headline"}
@@ -147,8 +148,22 @@ def test_pre_reshape_headline_dropped(tpu_session):
     assert tpu_session.drop_conv_only_rolling(r4) == {}
     new = {"headline": {"ok": True, "results": [
         {"metric": "cicc58_5000tickers_1yr_wall", "value": 58.0,
-         "days_per_batch": 32, "mode": "resident", "tickers": 5000}]}}
+         "days_per_batch": 32, "mode": "resident", "tickers": 5000,
+         "result_wire": {"enabled": True, "ratio_vs_f32": 1.9}}]}}
     assert tpu_session.drop_conv_only_rolling(new) == new
+    # ISSUE 10: a resident record WITHOUT the result_wire block (or
+    # with the wire disabled — a silent f32 fallback) measures the old
+    # transfer shape and must re-run; it can never bank as the r10
+    # headline
+    no_wire = {"headline": {"ok": True, "results": [
+        {"metric": "cicc58_5000tickers_1yr_wall", "value": 58.0,
+         "days_per_batch": 32, "mode": "resident", "tickers": 5000}]}}
+    assert tpu_session.drop_conv_only_rolling(no_wire) == {}
+    wire_off = {"headline": {"ok": True, "results": [
+        {"metric": "cicc58_5000tickers_1yr_wall", "value": 58.0,
+         "days_per_batch": 32, "mode": "resident", "tickers": 5000,
+         "result_wire": {"enabled": False}}]}}
+    assert tpu_session.drop_conv_only_rolling(wire_off) == {}
     # a resident record WITHOUT the tickers stamp predates the r6
     # schema (N_TICKERS was already overridable, so it could be a
     # mislabeled small run) — never carried (ADVICE r5 medium)
