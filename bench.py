@@ -1099,6 +1099,380 @@ def serve_main():
 
 
 # --------------------------------------------------------------------------
+# fleet mode (ISSUE 11): N replicas behind the coalescing-affinity router
+# --------------------------------------------------------------------------
+
+#: fleet-mode knobs (python bench.py fleet). Client levels are the pod's
+#: SIMULATED CONCURRENT CLIENTS; replica counts must each fit the
+#: visible device count (disjoint submeshes) — counts that don't fit
+#: are skipped and stamped, and the tpu_session carry refuses records
+#: with fewer than 2 live replicas.
+FLEET_CLIENTS = os.environ.get("BENCH_FLEET_CLIENTS", "64,512,2048")
+FLEET_REPLICAS = os.environ.get("BENCH_FLEET_REPLICAS", "1,2,4")
+FLEET_REQUESTS = int(os.environ.get("BENCH_FLEET_REQUESTS", "1024"))
+FLEET_TICKERS = int(os.environ.get("BENCH_FLEET_TICKERS", "1024"))
+FLEET_DAYS = int(os.environ.get("BENCH_FLEET_DAYS", "32"))
+FLEET_WINDOW_DAYS = int(os.environ.get("BENCH_FLEET_WINDOW_DAYS", "8"))
+
+
+def fleet_bench(replica_counts=None, levels=None, total_requests=None,
+                tickers=None, days=None, window_days=None, names=None):
+    """Load-generate against a :class:`fleet.FactorFleet` per replica
+    count and return the ``r11_fleet_v1`` record: per-replica-count
+    p50/p99/QPS at every client level, the coalesce + affinity
+    counters, the pod HBM block, and the pod-folded counter check
+    (pod totals == Σ per-replica — the PR 9 merge contract,
+    RE-VERIFIED per count, never assumed).
+
+    Per replica count, four phases (each a ``stages`` column):
+
+      coalesce — the paused-queue probe through the ROUTER: 8
+                 same-range queries must land on ONE replica (affinity)
+                 and drain as ONE coalesced dispatch there;
+      warm     — every combo the load uses, once, through the router —
+                 affinity pins each range's compile to its owner, so
+                 all compiles land here and the load phase is warm on
+                 every replica;
+      load     — per client level: N threads issuing the combo cycle;
+      bundle   — (top count only) per-replica telemetry bundles written
+                 with the replica identity stamps, folded by the
+                 telemetry.aggregate CLI path, and the pod bundle
+                 re-validated — the fleet's bundles ARE multihost
+                 bundles.
+    """
+    import tempfile
+    import threading as _th
+
+    from replication_of_minute_frequency_factor_tpu.fleet import (
+        FactorFleet, FleetConfig)
+    from replication_of_minute_frequency_factor_tpu.models.registry import (
+        factor_names as _fnames)
+    from replication_of_minute_frequency_factor_tpu.serve import (
+        Query, ServeConfig, SyntheticSource)
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        Telemetry)
+    from replication_of_minute_frequency_factor_tpu.telemetry.aggregate \
+        import aggregate_dirs
+    from replication_of_minute_frequency_factor_tpu.telemetry.validate \
+        import validate_dir
+
+    levels = tuple(levels if levels is not None else
+                   (int(s) for s in FLEET_CLIENTS.split(",")
+                    if s.strip()))
+    counts = tuple(replica_counts if replica_counts is not None else
+                   (int(s) for s in FLEET_REPLICAS.split(",")
+                    if s.strip()))
+    total_requests = total_requests or FLEET_REQUESTS
+    tickers = tickers or FLEET_TICKERS
+    days = days or FLEET_DAYS
+    window_days = window_days or FLEET_WINDOW_DAYS
+    if names is None:
+        factors_env = os.environ.get("BENCH_FACTORS")
+        names = (tuple(s.strip() for s in factors_env.split(",")
+                       if s.strip()) if factors_env else _fnames())
+    names = tuple(names)
+
+    n_devices = len(jax.devices())
+    runnable = [c for c in counts if c <= n_devices]
+    skipped = [c for c in counts if c > n_devices]
+    if skipped:
+        print(f"# fleet: skipping replica counts {skipped} — only "
+              f"{n_devices} device(s) visible (disjoint submeshes "
+              "required)", file=sys.stderr, flush=True)
+    if not runnable:
+        raise RuntimeError(
+            f"no requested replica count fits {n_devices} device(s)")
+
+    source = SyntheticSource(n_days=days, n_tickers=tickers, seed=7)
+    ranges = [(s, s + window_days)
+              for s in range(0, days - window_days + 1, window_days)]
+    combos = []
+    for i, r in enumerate(ranges):
+        combos.append(Query("factors", *r,
+                            names=(names[i % len(names)],)))
+        combos.append(Query("ic", *r, factor=names[(i + 1) % len(names)]))
+        combos.append(Query("decile", *r,
+                            factor=names[(i + 2) % len(names)],
+                            group_num=5))
+
+    stages = {}
+    per_count = {}
+    pod_block = None
+    hbm_block = None
+
+    for c in runnable:
+        tel_pod = Telemetry()
+        fleet = FactorFleet(source, c, names=names,
+                            serve_cfg=ServeConfig(),
+                            fleet_cfg=FleetConfig(),
+                            start=False, telemetry=tel_pod)
+        # --- coalesce probe: same-range queries through the router
+        # land on ONE replica and drain as one dispatch there
+        t0 = time.perf_counter()
+        probe = [fleet.submit(Query("factors", *ranges[0],
+                                    names=(names[0],)))
+                 for _ in range(8)]
+        fleet.start()
+        for f in probe:
+            f.result(600)
+        stages[f"r{c}_coalesce_s"] = round(time.perf_counter() - t0, 3)
+        # --- warm every combo (affinity pins each range's compiles)
+        t0 = time.perf_counter()
+        for q in combos:
+            fleet.submit(q).result(600)
+        stages[f"r{c}_warm_s"] = round(time.perf_counter() - t0, 3)
+
+        def pod_total(name):
+            return (tel_pod.registry.counter_total(name)
+                    + sum(r.telemetry.registry.counter_total(name)
+                          for r in fleet.replicas))
+
+        compiles_before = pod_total("xla.compiles")
+        level_stats = {}
+        for level in levels:
+            lat_lock = _th.Lock()
+            latencies = []
+            n_threads = max(1, level)
+            per_thread = max(1, total_requests // n_threads)
+
+            def run_client(tid):
+                mine = []
+                for j in range(per_thread):
+                    q = combos[(tid + j) % len(combos)]
+                    t_req = time.perf_counter()
+                    fleet.submit(q).result(600)
+                    mine.append(time.perf_counter() - t_req)
+                with lat_lock:
+                    latencies.extend(mine)
+
+            t0 = time.perf_counter()
+            threads = [_th.Thread(target=run_client, args=(i,))
+                       for i in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            lat = np.sort(np.asarray(latencies))
+            level_stats[str(level)] = {
+                "requests": len(lat),
+                "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+                "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+                "qps": round(len(lat) / wall, 1),
+            }
+            stages[f"r{c}_load_{level}_s"] = round(wall, 3)
+            tel_pod.hbm.sample(f"fleet.load_r{c}_{level}", force=True)
+
+        # --- the pod fold, RE-VERIFIED: every merged counter equals
+        # the control-plane + per-replica sum (the PR 9 contract)
+        merged = fleet.pod_registry()
+        snap = merged.snapshot()
+        regs = ([tel_pod.registry]
+                + [r.telemetry.registry for r in fleet.replicas])
+        checked = mismatched = 0
+        for key, total in snap["counters"].items():
+            per = sum(reg.snapshot()["counters"].get(key, 0.0)
+                      for reg in regs)
+            checked += 1
+            if abs(per - total) > 1e-9 * max(1.0, abs(total)):
+                mismatched += 1
+        preg = tel_pod.registry
+        health = fleet.health()
+        per_count[str(c)] = {
+            "levels": level_stats,
+            "live": health["pod"]["live"],
+            "demoted": health["pod"]["demoted"],
+            "per_replica_dispatches": {
+                r.label: int(r.telemetry.registry.counter_total(
+                    "serve.dispatches")) for r in fleet.replicas},
+            "counters": {
+                "compiles_during_load": int(pod_total("xla.compiles")
+                                            - compiles_before),
+                "compiles_total": int(pod_total("xla.compiles")),
+                "coalesced_dispatches": int(
+                    pod_total("serve.coalesced_dispatches")),
+                "coalesced_requests": int(
+                    pod_total("serve.coalesced_requests")),
+                "cache_hits": int(sum(
+                    r.telemetry.registry.counter_value(
+                        "serve.cache", outcome="hit")
+                    for r in fleet.replicas)),
+                "routed": int(preg.counter_total("fleet.routed")),
+                "affinity_hits": int(preg.counter_value(
+                    "fleet.affinity", outcome="hit")),
+                "affinity_misses": int(preg.counter_value(
+                    "fleet.affinity", outcome="miss")),
+                "reroutes": int(preg.counter_total("fleet.reroutes")),
+                "load_shed": int(pod_total("serve.load_shed")
+                                 + preg.counter_total("fleet.load_shed")),
+                "failures": int(pod_total("serve.failures")),
+            },
+            "counter_totals": {"checked": checked,
+                               "mismatched": mismatched},
+        }
+        if c == runnable[-1]:
+            hbm_block = tel_pod.hbm.summary()
+            # --- the bundle leg: the fleet's per-replica bundles fold
+            # through the SAME aggregate path as multihost bundles and
+            # the emitted pod bundle must re-validate
+            t0 = time.perf_counter()
+            try:
+                with tempfile.TemporaryDirectory() as td:
+                    dirs = []
+                    for r in fleet.replicas:
+                        d = os.path.join(td, r.label)
+                        r.write_bundle(d)
+                        dirs.append(d)
+                    pod_dir = os.path.join(td, "pod")
+                    agg = aggregate_dirs(dirs, pod_dir)
+                    val = validate_dir(pod_dir)
+                    bundle = {"ok": bool(agg["ok"] and val["ok"]),
+                              "hosts": agg["hosts"],
+                              "merged_counters": agg["merged_counters"],
+                              "counter_totals": agg["counter_totals"],
+                              "validate_ok": val["ok"]}
+            except Exception as e:  # noqa: BLE001 — record the failure
+                bundle = {"ok": False,
+                          "error": f"{type(e).__name__}: {e}"}
+            stages[f"r{c}_bundle_s"] = round(time.perf_counter() - t0, 3)
+            pod_block = {
+                "counter_totals": per_count[str(c)]["counter_totals"],
+                "affinity_hits":
+                    per_count[str(c)]["counters"]["affinity_hits"],
+                "routed": per_count[str(c)]["counters"]["routed"],
+                "bundle": bundle,
+            }
+        fleet.close()
+
+    top = str(runnable[-1])
+    top_level = str(levels[-1])
+    return {
+        # metric name derives from the ACTUAL factor/ticker counts,
+        # like every other mode (a restricted smoke can never print
+        # under the full-set name)
+        "metric": f"fleet{len(names)}_{tickers}tickers_qps" + _SUFFIX,
+        "value": per_count[top]["levels"][top_level]["qps"],
+        "unit": "req/s",
+        "mode": "fleet",
+        "tickers": tickers,
+        "days": days,
+        "window_days": window_days,
+        "factors": len(names),
+        "clients": list(levels),
+        "replica_counts": runnable,
+        "replica_counts_skipped": skipped,
+        #: how many replicas actually served at the top count — the
+        #: tpu_session carry refuses < 2 (a 1-replica record measures
+        #: serve, not the fleet)
+        "live_replicas": per_count[top]["live"],
+        # DECLARED series (telemetry/regress.py): a new workload AND a
+        # new topology — fleet records start their own baseline
+        "methodology": "r11_fleet_v1",
+        "p50_ms": per_count[top]["levels"][top_level]["p50_ms"],
+        "p99_ms": per_count[top]["levels"][top_level]["p99_ms"],
+        "replicas": per_count,
+        "pod": pod_block,
+        "hbm": hbm_block,
+        "stages": stages,
+    }
+
+
+def fleet_smoke():
+    """run_tests.sh --quick smoke (8 virtual CPU devices): a tiny
+    2-replica fleet_bench. ``ok`` iff the pod served warm and as one —
+    zero compiles during load, affinity hit-rate > 0, >= 1 coalesced
+    dispatch, pod counter totals exactly the per-replica sums, the
+    aggregated pod bundle schema-valid, both replicas live, nothing
+    shed or failed."""
+    record = fleet_bench(replica_counts=(2,), levels=(2, 8),
+                         total_requests=48, tickers=32, days=8,
+                         window_days=4,
+                         names=("vol_return1min", "mmt_am",
+                                "liq_openvol"))
+    two = record["replicas"]["2"]
+    pod = record["pod"]
+    cnt = two["counters"]
+    return {
+        "smoke": "fleet",
+        "replicas": 2,
+        "live_replicas": record["live_replicas"],
+        "compiles_during_load": cnt["compiles_during_load"],
+        "affinity_hits": cnt["affinity_hits"],
+        "coalesced_dispatches": cnt["coalesced_dispatches"],
+        "counter_mismatched": two["counter_totals"]["mismatched"],
+        "bundle_ok": pod["bundle"].get("ok", False),
+        "failures": cnt["failures"] + cnt["load_shed"],
+        "p50_ms": record["p50_ms"], "p99_ms": record["p99_ms"],
+        "qps": record["value"], "methodology": record["methodology"],
+        "ok": (record["live_replicas"] == 2
+               and cnt["compiles_during_load"] == 0
+               and cnt["affinity_hits"] > 0
+               and cnt["coalesced_dispatches"] >= 1
+               and two["counter_totals"]["mismatched"] == 0
+               and pod["bundle"].get("ok") is True
+               and cnt["failures"] == 0 and cnt["load_shed"] == 0),
+    }
+
+
+def fleet_main():
+    """``python bench.py fleet`` — the fleet-mode entry point. Tunnel
+    handling mirrors serve_main (argv preserved, metric suffix flips on
+    the CPU fallback); additionally, a CPU resolution with fewer
+    devices than the largest requested replica count self-heals onto an
+    8-device virtual mesh (the same trick as __graft_entry__/conftest —
+    disjoint submeshes need devices to partition)."""
+    if "PALLAS_AXON_POOL_IPS" in os.environ and not _tunnel_alive():
+        if os.environ.get("BENCH_REQUIRE_TPU"):
+            print("# BENCH_REQUIRE_TPU set and tunnel unreachable; "
+                  "aborting instead of CPU fallback", file=sys.stderr,
+                  flush=True)
+            return 17
+        env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}
+        env["JAX_PLATFORMS"] = "cpu"
+        env["BENCH_METRIC_SUFFIX"] = "_cpu_fallback_tunnel_down"
+        if "--xla_force_host_platform_device_count" \
+                not in env.get("XLA_FLAGS", ""):
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                                + " --xla_force_host_platform_device_"
+                                  "count=8").strip()
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__), "fleet"],
+                  env)
+    if os.environ.get("BENCH_REQUIRE_TPU") \
+            and jax.devices()[0].platform == "cpu":
+        print("# BENCH_REQUIRE_TPU set but jax resolved to CPU; aborting",
+              file=sys.stderr, flush=True)
+        return 17
+    counts = [int(s) for s in FLEET_REPLICAS.split(",") if s.strip()]
+    if (jax.devices()[0].platform == "cpu"
+            and len(jax.devices()) < max(counts)
+            and "--xla_force_host_platform_device_count"
+            not in os.environ.get("XLA_FLAGS", "")):
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_"
+                              "count=8").strip()
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__), "fleet"],
+                  env)
+    _wait_host_quiet()
+    from replication_of_minute_frequency_factor_tpu.config import (
+        apply_compilation_cache, get_config)
+    apply_compilation_cache(get_config())
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        Telemetry, set_telemetry, get_telemetry)
+    set_telemetry(Telemetry())
+    record = fleet_bench()
+    print(json.dumps(record))
+    tdir = os.environ.get("BENCH_TELEMETRY_DIR")
+    if tdir:
+        get_telemetry().write(tdir,
+                              manifest_extra={"run_kind": "bench_fleet"})
+    return 0
+
+
+# --------------------------------------------------------------------------
 # stream mode (ISSUE 7): online intraday ingest against the streaming engine
 # --------------------------------------------------------------------------
 
@@ -2466,4 +2840,6 @@ if __name__ == "__main__":
         sys.exit(serve_main())
     if len(sys.argv) > 1 and sys.argv[1] == "stream":
         sys.exit(stream_main())
+    if len(sys.argv) > 1 and sys.argv[1] == "fleet":
+        sys.exit(fleet_main())
     main()

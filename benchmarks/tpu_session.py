@@ -165,7 +165,15 @@ def drop_conv_only_rolling(steps):
       sharded scan, cohort occupancy for the stream): the banked
       trajectory feeds the ``<metric>.shard_skew_ratio`` /
       ``.pad_waste_frac`` regress series, so a record with no
-      shard-balance telemetry cannot bank.
+      shard-balance telemetry cannot bank;
+    * 'fleet' entries must be records of the r11 replica fleet that
+      actually MULTIPLIED the service (ISSUE 11): the declared
+      ``r11_fleet_v1`` methodology with ``live_replicas >= 2`` (a
+      single-device window runs one replica at most — that measures
+      serve, not the fleet; it fails loudly and re-runs on the next
+      multi-device window, the resident_sharded rule's mirror), the
+      pod ``hbm`` watermark block, and the pod-folded counter block
+      (:func:`_fleet_record_banks`).
     """
     def keep(name, v):
         recs = [r for r in v.get("results") or [] if isinstance(r, dict)]
@@ -206,6 +214,12 @@ def drop_conv_only_rolling(steps):
             # parity list means the streamed fold diverged on hardware
             # — none of those may bank
             return any(_stream_record_banks(r) for r in recs)
+        if name == "fleet":
+            # ISSUE 11: fewer than 2 live replicas means the pod never
+            # multiplied (one replica IS the serve step), and a record
+            # without the pod hbm/counter blocks has no degrade-policy
+            # or fold evidence — neither may bank
+            return any(_fleet_record_banks(r) for r in recs)
         return True
 
     return {k: v for k, v in steps.items() if keep(k, v)}
@@ -415,6 +429,55 @@ def _stream_record_banks(rec) -> bool:
             and isinstance(rec.get("mesh"), dict))
 
 
+def step_fleet():
+    """The r11 replica fleet (ISSUE 11) on the chip: ``bench.py
+    fleet`` load-generates against N FactorServer replicas over
+    disjoint device submeshes behind the coalescing-affinity router at
+    64/512 simulated clients and banks per-replica-count p50/p99/QPS
+    under the declared ``r11_fleet_v1`` methodology (2048-client
+    sweeps stay for dedicated windows via BENCH_FLEET_CLIENTS). The
+    carry rule (:func:`_fleet_record_banks`) rejects records with
+    fewer than 2 live replicas (a single-chip window cannot validate
+    the fleet — it fails loudly and re-runs, like resident_sharded) or
+    a missing pod ``hbm`` block."""
+    r = _run_json_lines(
+        [sys.executable, "bench.py", "fleet"], timeout=1800,
+        env=dict(os.environ, BENCH_REQUIRE_TPU="1",
+                 BENCH_FLEET_CLIENTS="64,512"))
+    if r.get("ok"):
+        recs = [rec for rec in r.get("results") or []
+                if isinstance(rec, dict)]
+        if any("_cpu_fallback" in str(rec.get("metric", ""))
+               for rec in recs):
+            r["ok"] = False
+            r["error"] = "fleet bench printed a CPU-fallback metric"
+        elif not any(_fleet_record_banks(rec) for rec in recs):
+            r["ok"] = False
+            r["error"] = ("no r11_fleet_v1 record with >= 2 live "
+                          "replicas, a pod hbm block and the pod "
+                          "counter fold — cannot bank")
+    return r
+
+
+def _fleet_record_banks(rec) -> bool:
+    """A fleet record banks only when the pod actually multiplied the
+    service and carried its evidence: declared methodology,
+    ``live_replicas >= 2`` (one replica measures serve, not the
+    fleet), the pod HBM watermark block (the degrade policy's input —
+    same rationale as :func:`_serve_record_banks`), and the pod
+    counter-fold block (the PR 9 exact-merge contract, re-verified in
+    the record, with zero mismatches)."""
+    hbm = rec.get("hbm")
+    pod = rec.get("pod")
+    return (rec.get("methodology") == "r11_fleet_v1"
+            and isinstance(rec.get("live_replicas"), int)
+            and rec["live_replicas"] >= 2
+            and isinstance(hbm, dict) and "available" in hbm
+            and isinstance(pod, dict)
+            and isinstance(pod.get("counter_totals"), dict)
+            and pod["counter_totals"].get("mismatched") == 0)
+
+
 def step_ladder():
     return _run_json_lines(
         [sys.executable, "benchmarks/ladder.py", "--configs", "1,2,4,5"],
@@ -523,8 +586,12 @@ def main():
     # stream_intraday rides directly behind serve: the r9 online
     # intraday engine's hardware bars/sec + on-chip streamed parity is
     # this round's must-bank evidence (ISSUE 7)
+    # fleet rides directly behind stream_intraday: the r11 replica
+    # fleet's hardware p50/p99/QPS per replica count is this round's
+    # must-bank evidence (ISSUE 11) — and it only banks when at least
+    # two replicas actually served (a single-chip window cannot)
     ap.add_argument("--steps", default="headline,resident_sharded,"
-                    "pallas,link,stream,serve,stream_intraday,"
+                    "pallas,link,stream,serve,stream_intraday,fleet,"
                     "lad1,lad2,lad4,lad5,spot,sweep,pipeline")
     ap.add_argument("--one-step", default=None,
                     help="internal: run one step's body in-process and "
@@ -595,6 +662,7 @@ def main():
              "resident_sharded": step_resident_sharded,
              "serve": step_serve,
              "stream_intraday": step_stream_intraday,
+             "fleet": step_fleet,
              "lad1": _step_ladder_one("1"), "lad2": _step_ladder_one("2"),
              "lad4": _step_ladder_one("4"), "lad5": _step_ladder_one("5")}
     want = [s.strip() for s in args.steps.split(",") if s.strip()]

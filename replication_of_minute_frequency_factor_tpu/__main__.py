@@ -139,6 +139,12 @@ def _add_serve(sub: "argparse._SubParsersAction") -> None:
     p.add_argument("--stream-batches", default="1",
                    help="comma-separated ingest micro-batch minute "
                         "counts warmed at startup (default: 1)")
+    p.add_argument("--fleet", type=int, default=0, metavar="N",
+                   help="run N FactorServer replicas over DISJOINT "
+                        "device submeshes behind the coalescing-"
+                        "affinity router, served as one pod "
+                        "(docs/fleet.md); 0 = a single server. Needs "
+                        "at least N visible devices.")
     p.add_argument("--demo", type=int, default=None, metavar="N",
                    help="answer N in-process queries (factors/IC/decile "
                         "cycle), print a JSON summary, exit — no HTTP")
@@ -184,6 +190,10 @@ def cmd_serve(args: argparse.Namespace) -> int:
     stream_batches = tuple(int(s) for s in
                            str(args.stream_batches).split(",")
                            if s.strip())
+    if args.fleet > 0:
+        return _cmd_serve_fleet(args, source, names, scfg,
+                                stream_batches or (1,), tel,
+                                _write_bundle)
     with FactorServer(source, names=names, serve_cfg=scfg,
                       telemetry=tel, stream=args.stream,
                       stream_batches=stream_batches or (1,)) as server:
@@ -231,6 +241,75 @@ def cmd_serve(args: argparse.Namespace) -> int:
         finally:
             httpd.shutdown()
             _write_bundle()
+    return 0
+
+
+def _cmd_serve_fleet(args, source, names, scfg, stream_batches, tel,
+                     write_bundle) -> int:
+    """``serve --fleet N`` (ISSUE 11): one pod front door over N
+    replicas on disjoint device submeshes. ``--demo N`` answers N
+    queries through the ROUTER and prints the pod summary (per-replica
+    dispatch spread included); otherwise the fleet HTTP front door
+    serves until interrupted."""
+    import os
+
+    from .fleet import FactorFleet, serve_fleet_http
+    from .serve import Query
+
+    with FactorFleet(source, args.fleet, names=names, serve_cfg=scfg,
+                     stream=args.stream, stream_batches=stream_batches,
+                     telemetry=tel) as fleet:
+        if args.demo is not None:
+            w = max(2, min(8, source.n_days))
+            n_ranges = max(1, source.n_days // w)
+            for i in range(args.demo):
+                start = (i % n_ranges) * w
+                kind = ("factors", "ic", "decile")[i % 3]
+                if kind == "factors":
+                    q = Query("factors", start, start + w,
+                              names=(names[i % len(names)],))
+                elif kind == "ic":
+                    q = Query("ic", start, start + w,
+                              factor=names[i % len(names)])
+                else:
+                    q = Query("decile", start, start + w,
+                              factor=names[i % len(names)])
+                fleet.submit(q).result(120)
+            reg = fleet.pod_registry()
+            health = fleet.health()
+            write_bundle()
+            print(json.dumps({
+                "demo_requests": args.demo,
+                "fleet": args.fleet,
+                "live_replicas": health["pod"]["live"],
+                "factors": len(names),
+                "days": source.n_days,
+                "tickers": source.n_tickers,
+                "dispatches": int(reg.counter_total("serve.dispatches")),
+                "routed": int(reg.counter_total("fleet.routed")),
+                "cache_hits": int(reg.counter_value("serve.cache",
+                                                    outcome="hit")),
+                "compiles": int(reg.counter_total("xla.compiles")),
+                "per_replica_dispatches": {
+                    r.label: int(r.telemetry.registry.counter_total(
+                        "serve.dispatches")) for r in fleet.replicas},
+            }))
+            return 0
+        httpd, _thread = serve_fleet_http(fleet, host=args.host,
+                                          port=args.port)
+        print(json.dumps({
+            "serving": True, "fleet": args.fleet,
+            "host": args.host, "port": httpd.server_address[1],
+            "factors": len(names), "days": source.n_days,
+            "replicas": [r.label for r in fleet.replicas],
+            "pid": os.getpid()}), flush=True)
+        try:
+            _thread.join()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            httpd.shutdown()
+            write_bundle()
     return 0
 
 

@@ -44,8 +44,12 @@ LOOP_SCOPE = ("ops", "models")
 #: reads device memory from host code, and those reads
 #: (``.memory_stats()`` / ``jax.live_arrays``) must stay confined to
 #: its declared boundary module, not leak into instrumented hot paths.
+#: ``fleet`` joined with ISSUE 11: the router sits in front of N
+#: replicas' dispatch queues — a sync on the routing path would stall
+#: the whole pod, so the layer keeps the full rule with two declared
+#: boundary modules (below).
 HOST_SYNC_SCOPE = ("ops", "models", "parallel", "serve", "stream",
-                   "telemetry")
+                   "telemetry", "fleet")
 #: module-granular GL-A3 extensions (ISSUE 10): ``data/`` as a layer is
 #: host-side by design (the ingest encoder and the parquet IO live
 #: there), but ``data/result_wire.py`` is device-hot — its encode fuses
@@ -73,12 +77,20 @@ MASKED_SCOPE = ("models",)
 #: shard-balance sampler (ISSUE 9), whose declared sync is the
 #: per-shard ``.block_until_ready()`` readiness probe its watcher
 #: threads run (telemetry/meshplane.py — watermark blocking stays
-#: centralized there, never in an instrumented hot path).
+#: centralized there, never in an instrumented hot path). ISSUE 11
+#: adds the fleet layer's two boundaries: the router's single
+#: ``np.asarray`` normalizes an ingest body ONCE before the N-replica
+#: fan-out (fleet/router.py), and the replica lifecycle's single
+#: ``.block_until_ready()`` is the device-liveness probe on the
+#: submesh lead (fleet/replica.py) — routing/policy/http modules keep
+#: the full rule.
 GLA3_BOUNDARY_SYNCS = {
     "serve/service.py": frozenset({"np.asarray"}),
     "telemetry/opsplane.py": frozenset({".memory_stats()",
                                         "jax.live_arrays"}),
     "telemetry/meshplane.py": frozenset({".block_until_ready()"}),
+    "fleet/router.py": frozenset({"np.asarray"}),
+    "fleet/replica.py": frozenset({".block_until_ready()"}),
 }
 
 #: (acquire, release) method-name pairs for GL-A4

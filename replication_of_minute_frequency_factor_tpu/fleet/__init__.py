@@ -1,0 +1,43 @@
+"""fleet/ — N FactorServer replicas as ONE pod (ISSUE 11).
+
+``serve/`` made the pipeline a resident process; this package
+multiplies it. Every ingredient already existed — the AOT executable
+cache, the device-resident exposure cache, the coalescing micro-batch
+queue + breaker (PR 6), streaming ingest (PR 7), the flight recorder /
+HBM watermarks / Prometheus scrape (PR 8), and the schema-v3 multihost
+bundle aggregation (PR 9) — the fleet composes them:
+
+* :mod:`.replica` — :func:`partition_devices` (disjoint per-replica
+  device submeshes) + :class:`Replica`: one FactorServer pinned to its
+  submesh with its own Telemetry, identity-stamped bundles
+  (``process_index``/``host``), and the device-liveness probe;
+* :mod:`.router` — :class:`FleetRouter`: bounded pod admission +
+  **coalescing-aware affinity** (rendezvous hash on the query's
+  ``(start, end)`` range, so same-range queries still collapse to one
+  dispatch on one replica), ingest fan-out with per-replica failure
+  isolation, trace-ID propagation through the hop;
+  :class:`FactorFleet` composes replicas + policy + router;
+* :mod:`.policy` — :class:`ShedPolicy`: demote/probe/restore driven by
+  the existing breaker + HBM headroom signals; pod-level shed (503 +
+  ``Retry-After``) only when every candidate is out;
+* :mod:`.http` — the one front door (``/v1/query``, ``/v1/ingest``,
+  ``/healthz`` per-replica + rollup, ``/v1/metrics`` as the
+  registry-merge pod fold), HTTP-compatible with a single server.
+
+Run it: ``python -m replication_of_minute_frequency_factor_tpu serve
+--fleet N`` (docs/fleet.md); load-bench it: ``python bench.py fleet``
+(the declared ``r11_fleet_v1`` methodology).
+"""
+
+from __future__ import annotations
+
+from .http import pod_registry, serve_fleet_http
+from .policy import ShedPolicy
+from .replica import Replica, build_replicas, partition_devices
+from .router import FactorFleet, FleetConfig, FleetRouter, FleetShedError
+
+__all__ = [
+    "FactorFleet", "FleetConfig", "FleetRouter", "FleetShedError",
+    "Replica", "ShedPolicy", "build_replicas", "partition_devices",
+    "pod_registry", "serve_fleet_http",
+]

@@ -1,46 +1,30 @@
-"""Stdlib-only HTTP/JSON binding for :class:`.service.FactorServer`.
+"""The pod front door: one HTTP surface multiplexing N replicas.
 
-Protocol-agnostic by construction: the handler only translates JSON to
-:class:`..serve.service.Query` objects and futures back to JSON — every
-serving semantic (batching, coalescing, caching, shedding) lives in the
-server. ``ThreadingHTTPServer`` gives one thread per connection, which
-is exactly what the micro-batching queue wants: concurrent HTTP clients
-land in one collection window and coalesce.
+Same stdlib-only shape as :mod:`..serve.http` (one thread per
+connection feeding the replicas' micro-batch windows), same endpoints —
+a client cannot tell a pod from a single server except by reading the
+payloads:
 
-Endpoints:
+* ``POST /v1/query`` — routed by the coalescing-affinity key
+  (:meth:`..fleet.router.FleetRouter.submit`); 503 + ``Retry-After``
+  when the POD sheds (every candidate out) exactly like a single
+  server's breaker shed.
+* ``POST /v1/ingest`` — the fan-out: 200 with the per-replica leg map
+  as long as ANY leg applied (failure isolation is the point — the
+  response SAYS which legs failed/skipped), 503 only when none did.
+* ``GET /healthz`` — per-replica payloads (the shared ISSUE 11 shape)
+  + the pod rollup (live/demoted, policy states, stream cursor skew).
+* ``GET /v1/metrics`` — the POD registry: the control plane + every
+  replica registry folded through ``telemetry.aggregate``'s
+  registry-merge (:func:`pod_registry` — counters exact, the PR 9
+  contract; never an ad-hoc merger). JSON by default, Prometheus text
+  on content negotiation, same as the single server.
+* ``POST /v1/debug/dump`` — fans the on-demand flight capture out to
+  every replica; returns ``{label: path}``.
 
-* ``POST /v1/query`` — body ``{"kind": "factors"|"ic"|"decile"|
-  "intraday", "start": int, "end": int, "names"?: [..], "factor"?:
-  str, "horizon"?: int, "group_num"?: int}`` -> the answer dict
-  (``intraday`` ignores the range and reads the live streaming carry;
-  needs a ``stream=True`` server).
-  400 on a malformed query, 503 when the server sheds (breaker open /
-  queue full) — the HTTP face of backpressure, 500 on a failed dispatch.
-  Every 503 carries a ``Retry-After`` header (ISSUE 11) derived from
-  the breaker cooldown: the remaining cooldown on a breaker shed, the
-  full cooldown as the backoff hint on a full-queue shed.
-* ``POST /v1/ingest`` — body ``{"bars": [[[o,h,l,c,v]×T]×B],
-  "present": [[bool×T]×B]}`` advances the streaming carry by ``B``
-  minutes; -> ``{"minute", "bars"}``. Same error mapping as query
-  (the JSON body bound is wider: a full universe-minute is big).
-* ``POST /v1/debug/dump`` — on-demand flight-recorder capture
-  (ISSUE 8): dumps the request ring + last-dispatch metadata +
-  registry counter deltas; -> ``{"path", "requests"}`` (409 when no
-  dump directory is configured anywhere).
-* ``GET /healthz`` — liveness: breaker state, uptime, queue depth,
-  flight-recorder counts, HBM-stats availability (+ the stream
-  carry's minute cursor when streaming is on).
-* ``GET /v1/metrics`` — the telemetry registry: JSON snapshot by
-  default; the standard Prometheus text format (v0.0.4) when the
-  request asks for it (``Accept: text/plain`` / ``application/
-  openmetrics-text``, or ``?format=prometheus``) — scrapeable by
-  stock tooling (ISSUE 8).
-
-Request tracing (ISSUE 8): ``POST /v1/query`` and ``POST /v1/ingest``
-accept an ``X-Trace-Id`` header (``[A-Za-z0-9._-]{1,64}``; anything
-else is replaced at admission) and every response — success or error —
-echoes the request's effective trace ID back in the same header, so a
-client can join its own logs to the server's span/request records.
+Trace IDs: ``X-Trace-Id`` in/out as in :mod:`..serve.http`; the pod
+assigns one ID at admission and the same ID crosses the router→replica
+hop, so the two telemetry streams join on it.
 """
 
 from __future__ import annotations
@@ -51,31 +35,27 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
+from ..serve.http import (MAX_BODY_BYTES, MAX_INGEST_BODY_BYTES,
+                          retry_after_seconds)
+from ..serve.service import LoadShedError, Query
 from ..telemetry.opsplane import canonical_trace_id, to_prometheus
-from .service import FactorServer, LoadShedError, Query
-
-#: request-body bound (a factors query is a few hundred bytes)
-MAX_BODY_BYTES = 1 << 20
-
-#: ingest-body bound: B minutes × T tickers × 5 fields as JSON text
-#: (~16 bytes/number puts a 64-minute × 5000-ticker micro-batch well
-#: inside 64 MiB)
-MAX_INGEST_BODY_BYTES = 64 << 20
+from .router import FactorFleet
 
 
-def retry_after_seconds(retry_after_s: Optional[float]) -> int:
-    """``Retry-After`` header value from a shed's backoff hint: whole
-    seconds, rounded UP, floor 1 (a zero/None hint must still tell the
-    client to back off for a beat, not hammer). Shared by this binding
-    and the fleet front door (ISSUE 11) so the two renderings cannot
-    drift."""
-    import math
-    if retry_after_s is None or retry_after_s <= 0:
-        return 1
-    return max(1, math.ceil(retry_after_s))
+def pod_registry(fleet: FactorFleet):
+    """The pod metrics registry: the fleet control plane + every
+    replica registry through :func:`..telemetry.aggregate
+    .merge_registries` — the SAME fold the multihost bundle aggregator
+    runs, so pod counter totals equal the per-replica sums by
+    construction (re-verified, not assumed, in ``bench.fleet_smoke``
+    and tests/test_fleet.py)."""
+    from ..telemetry.aggregate import merge_registries
+    return merge_registries(
+        [fleet.telemetry.registry]
+        + [r.telemetry.registry for r in fleet.replicas])
 
 
-def _make_handler(server: FactorServer, timeout: Optional[float]):
+def _make_handler(fleet: FactorFleet, timeout: Optional[float]):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -105,16 +85,12 @@ def _make_handler(server: FactorServer, timeout: Optional[float]):
             self.wfile.write(body)
 
         def _trace_id(self) -> str:
-            """The request's effective trace ID: the propagated
-            ``X-Trace-Id`` when well-formed, else freshly generated —
-            the SAME canonicalization the server applies at admission,
-            so the echoed header and the recorded ID always agree."""
             return canonical_trace_id(self.headers.get("X-Trace-Id"))
 
         def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
             parsed = urllib.parse.urlparse(self.path)
             if parsed.path == "/healthz":
-                self._reply(200, self._health_payload())
+                self._reply(200, fleet.health())
                 return
             if parsed.path == "/v1/metrics":
                 accept = self.headers.get("Accept", "")
@@ -123,23 +99,15 @@ def _make_handler(server: FactorServer, timeout: Optional[float]):
                              or "openmetrics" in accept
                              or query.get("format", [""])[0]
                              == "prometheus")
+                reg = pod_registry(fleet)
                 if want_text:
-                    body = to_prometheus(
-                        server.telemetry.registry).encode()
                     self._reply_bytes(
-                        200, body,
+                        200, to_prometheus(reg).encode(),
                         "text/plain; version=0.0.4; charset=utf-8")
                 else:
-                    self._reply(200,
-                                server.telemetry.registry.snapshot())
+                    self._reply(200, reg.snapshot())
                 return
             self._reply(404, {"error": f"no route {self.path}"})
-
-        def _health_payload(self) -> dict:
-            # ISSUE 11: the payload (replica identity block included)
-            # is built by the server so the standalone endpoint and the
-            # fleet rollup report the same shape from the same code
-            return server.health()
 
         def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
             if self.path == "/v1/ingest":
@@ -172,7 +140,7 @@ def _make_handler(server: FactorServer, timeout: Optional[float]):
                             tid)
                 return
             try:
-                fut = server.submit(q, trace_id=tid)
+                fut = fleet.submit(q, trace_id=tid)
             except LoadShedError as e:
                 self._reply(503, {"error": str(e), "shed": True}, tid,
                             retry_after_s=e.retry_after_s)
@@ -187,9 +155,6 @@ def _make_handler(server: FactorServer, timeout: Optional[float]):
                             tid)
 
         def _post_ingest(self):
-            # no numpy here: the JSON lists go to the server verbatim
-            # and service.py (the declared GL-A3 boundary module) owns
-            # the array conversion + shape validation
             tid = self._trace_id()
             try:
                 length = int(self.headers.get("Content-Length", "0"))
@@ -204,7 +169,8 @@ def _make_handler(server: FactorServer, timeout: Optional[float]):
                             tid)
                 return
             try:
-                fut = server.ingest(bars, present, trace_id=tid)
+                res = fleet.ingest(bars, present, trace_id=tid,
+                                   timeout=timeout)
             except LoadShedError as e:
                 self._reply(503, {"error": str(e), "shed": True}, tid,
                             retry_after_s=e.retry_after_s)
@@ -212,39 +178,35 @@ def _make_handler(server: FactorServer, timeout: Optional[float]):
             except ValueError as e:
                 self._reply(400, {"error": str(e)}, tid)
                 return
-            try:
-                self._reply(200, fut.result(timeout), tid)
-            except Exception as e:  # noqa: BLE001 — dispatch failure
-                self._reply(500, {"error": f"{type(e).__name__}: {e}"},
-                            tid)
+            self._reply(200, res, tid)
 
         def _post_dump(self):
-            try:
-                path = server.debug_dump()
-            except Exception as e:  # noqa: BLE001 — dump is best-effort
-                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
-                return
-            if path is None:
+            paths = {}
+            for r in fleet.replicas:
+                try:
+                    paths[r.label] = r.server.debug_dump()
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    paths[r.label] = f"error: {type(e).__name__}: {e}"
+            if all(p is None for p in paths.values()):
                 self._reply(409, {"error": "no flight dump directory "
-                                           "configured "
+                                           "configured on any replica "
                                            "(ServeConfig.flight_dir)"})
                 return
-            self._reply(200, {"path": path,
-                              "requests": len(server.flight)})
+            self._reply(200, {"paths": paths})
 
     return Handler
 
 
-def serve_http(server: FactorServer, host: str = "127.0.0.1",
-               port: int = 0, timeout: Optional[float] = 60.0,
-               ) -> Tuple[ThreadingHTTPServer, threading.Thread]:
-    """Bind ``server`` on ``host:port`` (0 = ephemeral) and serve from a
-    daemon thread. Returns ``(httpd, thread)``; the bound port is
-    ``httpd.server_address[1]``; stop with ``httpd.shutdown()``."""
+def serve_fleet_http(fleet: FactorFleet, host: str = "127.0.0.1",
+                     port: int = 0, timeout: Optional[float] = 60.0,
+                     ) -> Tuple[ThreadingHTTPServer, threading.Thread]:
+    """Bind the pod on ``host:port`` (0 = ephemeral) and serve from a
+    daemon thread — the fleet twin of :func:`..serve.http.serve_http`;
+    stop with ``httpd.shutdown()``."""
     httpd = ThreadingHTTPServer((host, port),
-                                _make_handler(server, timeout))
+                                _make_handler(fleet, timeout))
     httpd.daemon_threads = True
     thread = threading.Thread(target=httpd.serve_forever, daemon=True,
-                              name="factor-serve-http")
+                              name="factor-fleet-http")
     thread.start()
     return httpd, thread

@@ -1,0 +1,157 @@
+"""Replica lifecycle: N :class:`..serve.service.FactorServer` s over
+disjoint device submeshes.
+
+A *replica* is one resident FactorServer pinned to its own slice of
+``jax.devices()`` (:func:`partition_devices` — disjoint by
+construction, validated on the 8-virtual-CPU-device harness the sharded
+tests run on) with its OWN :class:`..telemetry.Telemetry`. The replica
+index/label ride the schema-v3 multihost identity stamps
+(``process_index``/``host``, ISSUE 9) on every bundle the replica
+writes, so ``telemetry.aggregate`` folds a fleet's bundles exactly like
+a multihost pod's — the fleet IS a pod, in-process.
+
+Health is the existing ``healthz`` surface: :meth:`Replica.health`
+returns :meth:`..serve.service.FactorServer.health` verbatim (the
+ISSUE 11 shape with the ``replica`` identity block), plus
+:meth:`Replica.probe_device` — a device-liveness probe that blocks on a
+tiny put to the replica's lead device.
+
+graftlint note (docs/static-analysis.md): this module is a declared
+GL-A3 *boundary module* of the ``fleet/`` layer — its one allowed host
+sync is the ``.block_until_ready()`` of the liveness probe. Everything
+else in the layer stays sync-free; the answer materialization stays
+``serve/service.py``'s declared sync.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..serve.service import FactorServer, ServeConfig
+from ..telemetry import Telemetry
+
+
+def partition_devices(n_replicas: int, devices: Optional[Sequence] = None
+                      ) -> List[tuple]:
+    """``n_replicas`` DISJOINT contiguous device groups out of
+    ``devices`` (default ``jax.devices()``): ``len(devices) //
+    n_replicas`` devices each, remainder devices left unassigned (a
+    9-device host at N=4 runs 4×2 and idles one — the partition is
+    uniform so no replica is a structural straggler). Raises when the
+    host has fewer devices than replicas: a fleet with shared devices
+    would serialize on the hardware while reporting parallelism."""
+    if n_replicas < 1:
+        raise ValueError(f"n_replicas must be >= 1 (got {n_replicas})")
+    if devices is None:
+        import jax
+        devices = jax.devices()
+    devices = list(devices)
+    if n_replicas > len(devices):
+        raise ValueError(
+            f"cannot partition {len(devices)} device(s) into "
+            f"{n_replicas} disjoint replica submeshes")
+    per = len(devices) // n_replicas
+    return [tuple(devices[i * per:(i + 1) * per])
+            for i in range(n_replicas)]
+
+
+class Replica:
+    """One fleet member: a FactorServer over its submesh, its own
+    telemetry, and the identity the pod planes address it by."""
+
+    def __init__(self, index: int, devices: Sequence, source,
+                 names: Optional[Sequence[str]] = None,
+                 serve_cfg: Optional[ServeConfig] = None,
+                 replicate_quirks: bool = True,
+                 rolling_impl: Optional[str] = None,
+                 stream: bool = False,
+                 stream_batches: Sequence[int] = (1,),
+                 start: bool = True,
+                 label: Optional[str] = None):
+        self.index = int(index)
+        self.label = label or f"r{self.index}"
+        self.devices: Tuple = tuple(devices)
+        if not self.devices:
+            raise ValueError(f"replica {self.label} got an empty "
+                             "device set")
+        #: per-replica telemetry: counters/spans/requests of this
+        #: replica only — the pod view is the registry-merge fold over
+        #: these (fleet/http.py), never a shared mutable registry
+        self.telemetry = Telemetry()
+        self.stream = bool(stream)
+        self.server = FactorServer(
+            source, names=names, serve_cfg=serve_cfg,
+            replicate_quirks=replicate_quirks,
+            rolling_impl=rolling_impl, telemetry=self.telemetry,
+            start=start, stream=stream, stream_batches=stream_batches,
+            replica_label=self.label, devices=self.devices)
+
+    # --- health ---------------------------------------------------------
+    def health(self) -> dict:
+        """The replica's ``healthz`` payload — exactly the standalone
+        server's shape (ISSUE 11 satellite), so the pod rollup is a
+        dict of these."""
+        return self.server.health()
+
+    def probe_device(self) -> bool:
+        """Device liveness: put one scalar on the submesh lead and
+        block until it lands. The ``.block_until_ready()`` is this
+        module's one declared GL-A3 boundary sync — a wedged device
+        surfaces here (False), not as a hung request inside the worker
+        loop."""
+        try:
+            import jax
+            jax.device_put(np.float32(1.0),
+                           self.devices[0]).block_until_ready()
+            return True
+        except Exception:  # noqa: BLE001 — the probe's job is the bool
+            self.telemetry.counter("fleet.device_probe_failures",
+                                   replica=self.label)
+            return False
+
+    def hbm_bytes(self) -> Tuple[float, bool]:
+        """``(bytes_in_use summed over this replica's devices,
+        available)`` from the replica telemetry's last HBM watermark
+        sample — the headroom signal the shed policy demotes on. Plain
+        dict reads; never a device sync."""
+        summary = self.telemetry.hbm.summary()
+        keys = {f"{d.platform}:{d.id}" for d in self.devices}
+        total = sum(v.get("bytes_in_use", 0)
+                    for k, v in (summary.get("devices") or {}).items()
+                    if k in keys)
+        return float(total), bool(summary.get("available"))
+
+    # --- bundles (the pod aggregation leg) ------------------------------
+    def write_bundle(self, out_dir: str, cfg=None) -> dict:
+        """Write this replica's telemetry bundle stamped with its
+        identity (``process_index=index``, ``host=label`` — the
+        schema-v3 stamps), so ``telemetry.aggregate`` folds fleet
+        bundles exactly like multihost ones. Returns the artifact
+        paths."""
+        return self.telemetry.write(out_dir, cfg=cfg,
+                                    process_index=self.index,
+                                    host=self.label)
+
+    # --- lifecycle ------------------------------------------------------
+    def start(self) -> "Replica":
+        self.server.start()
+        return self
+
+    def close(self, timeout: float = 10.0) -> None:
+        self.server.close(timeout=timeout)
+
+    def __repr__(self) -> str:  # debug/demo friendliness
+        return (f"Replica({self.label}, devices="
+                f"{[str(d) for d in self.devices]})")
+
+
+def build_replicas(source, n_replicas: int,
+                   devices: Optional[Sequence] = None,
+                   **replica_kwargs) -> List[Replica]:
+    """``n_replicas`` Replicas over :func:`partition_devices`' disjoint
+    submeshes, indices/labels assigned in device order."""
+    groups = partition_devices(n_replicas, devices)
+    return [Replica(i, g, source, **replica_kwargs)
+            for i, g in enumerate(groups)]

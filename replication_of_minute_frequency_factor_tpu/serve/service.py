@@ -33,6 +33,7 @@ Everything device-side stays in :mod:`.engine`.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import queue
 import threading
@@ -56,7 +57,18 @@ class LoadShedError(RuntimeError):
     """The server refused the request up front: breaker open after
     sustained dispatch failure, or the bounded queue is full. Callers
     retry later (or against another replica) — the error IS the
-    backpressure signal."""
+    backpressure signal.
+
+    ``retry_after_s`` (ISSUE 11) is the server's backoff hint: the
+    remaining breaker cooldown on a breaker shed, the full cooldown on
+    a full-queue shed (the queue has no clock; the breaker cooldown is
+    the service's one declared backoff constant). The HTTP binding
+    renders it as a ``Retry-After`` header on every 503."""
+
+    def __init__(self, message: str,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
 
 
 @dataclasses.dataclass(frozen=True)
@@ -145,7 +157,9 @@ class FactorServer:
                  rolling_impl: Optional[str] = None,
                  telemetry=None, start: bool = True,
                  stream: bool = False,
-                 stream_batches: Sequence[int] = (1,)):
+                 stream_batches: Sequence[int] = (1,),
+                 replica_label: Optional[str] = None,
+                 devices: Optional[Sequence] = None):
         from ..models.registry import factor_names
         from ..telemetry import get_telemetry
         self.source = source
@@ -154,28 +168,40 @@ class FactorServer:
         self.scfg = serve_cfg or ServeConfig()
         self.telemetry = telemetry if telemetry is not None \
             else get_telemetry()
+        #: replica identity (ISSUE 11): the fleet spawns N servers over
+        #: disjoint device submeshes; ``replica_label`` names this one
+        #: in health payloads / flight dumps and ``devices`` pins every
+        #: device dispatch (construction warmup AND the worker loop run
+        #: under ``jax.default_device(devices[0])``, so blocks, carries
+        #: and executables live on this replica's submesh only). A
+        #: standalone server keeps both unset and reports the process's
+        #: full device view.
+        self.replica_label = replica_label or "standalone"
+        self.devices: Optional[tuple] = (tuple(devices) if devices
+                                         else None)
         self.executables = ExecutableCache(telemetry=self.telemetry)
-        self.engine = ServeEngine(self.names,
-                                  replicate_quirks=replicate_quirks,
-                                  rolling_impl=rolling_impl,
-                                  telemetry=self.telemetry,
-                                  executables=self.executables)
-        self.cache = DeviceExposureCache(self.scfg.cache_bytes,
-                                         telemetry=self.telemetry)
-        #: ISSUE 7: the live intraday engine over the source's ticker
-        #: universe, sharing THE executable cache (one compile-count
-        #: ground truth). Warmed at construction for the declared
-        #: ingest micro-batch shapes, so steady-state ingest/intraday
-        #: traffic compiles nothing.
-        self.stream_engine = None
-        if stream:
-            from ..stream.engine import StreamEngine
-            self.stream_engine = StreamEngine(
-                source.n_tickers, names=self.names,
-                replicate_quirks=replicate_quirks,
-                rolling_impl=rolling_impl, telemetry=self.telemetry,
-                executables=self.executables)
-            self.stream_engine.warmup(micro_batches=stream_batches)
+        with self._device_ctx():
+            self.engine = ServeEngine(self.names,
+                                      replicate_quirks=replicate_quirks,
+                                      rolling_impl=rolling_impl,
+                                      telemetry=self.telemetry,
+                                      executables=self.executables)
+            self.cache = DeviceExposureCache(self.scfg.cache_bytes,
+                                             telemetry=self.telemetry)
+            #: ISSUE 7: the live intraday engine over the source's
+            #: ticker universe, sharing THE executable cache (one
+            #: compile-count ground truth). Warmed at construction for
+            #: the declared ingest micro-batch shapes, so steady-state
+            #: ingest/intraday traffic compiles nothing.
+            self.stream_engine = None
+            if stream:
+                from ..stream.engine import StreamEngine
+                self.stream_engine = StreamEngine(
+                    source.n_tickers, names=self.names,
+                    replicate_quirks=replicate_quirks,
+                    rolling_impl=rolling_impl, telemetry=self.telemetry,
+                    executables=self.executables)
+                self.stream_engine.warmup(micro_batches=stream_batches)
         self._q: "queue.Queue" = queue.Queue(maxsize=self.scfg.queue_limit)
         self._state_lock = threading.Lock()
         self._consecutive = 0
@@ -193,6 +219,16 @@ class FactorServer:
             self.telemetry.hbm.start(self.scfg.hbm_sample_period_s)
         if start:
             self.start()
+
+    def _device_ctx(self):
+        """Pin device placement to this replica's submesh lead: every
+        un-annotated ``device_put``/jit dispatch inside lands on
+        ``devices[0]`` (thread-scoped, so N replicas in one process
+        stay disjoint). A no-op for a standalone server."""
+        if not self.devices:
+            return contextlib.nullcontext()
+        import jax
+        return jax.default_device(self.devices[0])
 
     # --- lifecycle ------------------------------------------------------
     def start(self) -> "FactorServer":
@@ -317,7 +353,8 @@ class FactorServer:
                     raise LoadShedError(
                         "breaker open after "
                         f"{self._consecutive} consecutive dispatch "
-                        "failures; retry after the cooldown")
+                        "failures; retry after the cooldown",
+                        retry_after_s=self._open_until - now)
                 # half-open: this request is the probe; keep the gate up
                 # for everyone else until it succeeds
                 self._open_until = now + self.scfg.breaker_cooldown_s
@@ -330,7 +367,8 @@ class FactorServer:
             tel.counter("serve.load_shed", reason="queue_full")
             self.flight.note_shed("queue_full")
             raise LoadShedError(
-                f"request queue full ({self.scfg.queue_limit})") from None
+                f"request queue full ({self.scfg.queue_limit})",
+                retry_after_s=self.scfg.breaker_cooldown_s) from None
         tel.counter("serve.requests", kind=kind)
         self._note_depth()
         return pending.future
@@ -364,6 +402,51 @@ class FactorServer:
             self._consecutive = 0
             self._open_until = None
         self.telemetry.gauge("serve.breaker_consecutive_failures", 0)
+
+    def breaker_state(self) -> str:
+        """``closed`` / ``open`` / ``half_open`` — the breaker as a
+        label (health payloads, the fleet routing policy). ``open``
+        means submits shed right now; ``half_open`` means the cooldown
+        lapsed and the next submit is the probe."""
+        with self._state_lock:
+            if self._open_until is None:
+                return "closed"
+            return ("open" if time.monotonic() < self._open_until
+                    else "half_open")
+
+    # --- health (ISSUE 11: one shape for standalone AND fleet) ----------
+    def health(self) -> dict:
+        """The ``/healthz`` payload: liveness + breaker + queue depth +
+        flight/HBM markers, PLUS the ``replica`` identity block (label,
+        device set, breaker state) — the standalone server and every
+        fleet replica report the same shape, so the pod rollup is a
+        dict of these with nothing translated."""
+        with self._state_lock:
+            open_until = self._open_until
+            consecutive = self._consecutive
+        hbm = self.telemetry.hbm.sample("healthz")
+        if self.devices is not None:
+            device_names = [str(d) for d in self.devices]
+        else:
+            import jax
+            device_names = [str(d) for d in jax.devices()]
+        payload = {
+            "ok": True, "factors": len(self.names),
+            "days": self.source.n_days,
+            "breaker_open": open_until is not None,
+            "breaker_consecutive_failures": consecutive,
+            "uptime_s": round(time.monotonic() - self._t_start, 3),
+            "queue_depth": self._q.qsize(),
+            "flight": {"requests": len(self.flight),
+                       "dumps": self.flight.dump_count},
+            "hbm_available": bool(hbm.get("available")),
+            "replica": {"label": self.replica_label,
+                        "devices": device_names,
+                        "breaker": self.breaker_state()},
+        }
+        if self.stream_engine is not None:
+            payload["stream_minute"] = self.stream_engine.minutes
+        return payload
 
     # --- request-lifecycle recording (ISSUE 8) --------------------------
     def _complete(self, p: _Pending, op: str, status: str,
@@ -410,7 +493,11 @@ class FactorServer:
     # --- worker ---------------------------------------------------------
     def _worker(self) -> None:
         try:
-            self._worker_loop()
+            # device pinning is thread-scoped config: re-enter the
+            # replica's default-device context on the worker thread
+            # (dispatches happen here, not on the submitting threads)
+            with self._device_ctx():
+                self._worker_loop()
         except BaseException:
             # an exception ESCAPING the loop (per-request failures are
             # contained above) would kill the worker silently — capture

@@ -24,6 +24,16 @@ exactly as in serve):
 Device-hot module (GL-A3): inputs arrive as HOST numpy and are
 ``jax.device_put`` explicitly; nothing here blocks or materializes —
 the serve request loop / bench own the host boundary.
+
+Fan-out contract (ISSUE 11): a replica fleet broadcasts every ingest
+micro-batch to ALL stream-enabled replicas, so N engines advance the
+SAME ordered bar feed in lockstep — :meth:`StreamEngine.cursor` is the
+per-engine progress stamp the router's pod health compares (cursor
+skew across live replicas means a replica missed legs while demoted).
+A recovered replica whose carry fell behind re-syncs through the
+existing :meth:`save`/:meth:`restore` pair from a healthy replica's
+snapshot (or replays the missed bars); the fleet surfaces the skew, it
+does not silently paper over it.
 """
 
 from __future__ import annotations
@@ -118,6 +128,14 @@ class StreamEngine:
     def _graph_key(self):
         return (self.n_tickers, self.names, self.replicate_quirks,
                 self.rolling_impl)
+
+    def cursor(self) -> dict:
+        """The fan-out contract's progress stamp (ISSUE 11): where this
+        engine's carry stands — ``{"minute", "tickers"}``, host-side
+        mirrors only (never a device read). Replicas fed the same
+        broadcast ingest stream report equal cursors; the fleet health
+        rollup surfaces any skew."""
+        return {"minute": self.minutes, "tickers": self.n_tickers}
 
     def reset(self) -> "StreamEngine":
         """Fresh empty-day carry (one explicit host->device put)."""

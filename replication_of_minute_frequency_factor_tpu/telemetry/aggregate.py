@@ -140,6 +140,19 @@ def _identity(bundle: dict, position: int) -> Tuple[int, str]:
     return int(idx), str(host)
 
 
+def merge_registries(regs) -> MetricsRegistry:
+    """THE registry-merge fold: N registries -> one pod registry via
+    the ISSUE 8 deep-copy :meth:`..registry.MetricsRegistry.merge`
+    (counters/histogram counts+sums exact per-source sums, gauges
+    last-write-wins, percentiles approximate). Shared by this CLI's
+    bundle aggregation and the in-process fleet pod view
+    (``fleet/http.py``, ISSUE 11) so the two folds cannot drift."""
+    merged = MetricsRegistry()
+    for reg in regs:
+        merged.merge(reg)
+    return merged
+
+
 def registry_of(bundle: dict) -> MetricsRegistry:
     """Reconstitute one host's registry from its persisted metric
     records."""
@@ -200,9 +213,7 @@ def aggregate_dirs(dirs: List[str], out_dir: str) -> dict:
             f"duplicate process_index among inputs: {idents}")
 
     regs = [registry_of(b) for b in bundles]
-    merged = MetricsRegistry()
-    for reg in regs:
-        merged.merge(reg)  # the ISSUE 8 deep-copy merge
+    merged = merge_registries(regs)  # the ISSUE 8 deep-copy merge
 
     per_host = {}
     for (idx, host), b, reg in zip(idents, bundles, regs):

@@ -46,7 +46,17 @@ smeared):
   widening — data/result_wire.py — so the fetch bytes, the module,
   and the loop's host decode stage all change; bench stamps the r10
   names only when the record's ``result_wire.enabled`` is true, so
-  a silent f32 fallback stays on the r6/r7 series).
+  a silent f32 fallback stays on the r6/r7 series),
+  ``r11_fleet_v1`` (ISSUE 11: the replica fleet, ``bench.py
+  fleet`` — N FactorServer replicas over disjoint device submeshes
+  behind the coalescing-affinity router; the ``value`` is pod QPS at
+  the record's highest client level × highest replica count, with
+  per-replica-count p50/p99/QPS under ``replicas``, the pod-folded
+  counters (routed/affinity/coalesced, exact per-replica sums —
+  the PR 9 merge contract) under ``pod``, and ``live_replicas``
+  stamping how many replicas actually served; a new workload and a
+  new topology, so its records start their own baseline — a
+  single-replica record can never smear onto the serve series).
 
 Byte sub-series (ISSUE 10): every bench record that carries the
 ``wire.bytes_per_day`` / ``result.bytes_per_day`` gauges contributes

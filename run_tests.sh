@@ -94,6 +94,16 @@ print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     run env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python -c "import json, sys, bench; r = bench.meshplane_smoke(); \
 print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
+    # fleet smoke (ISSUE 11): 2 FactorServer replicas over disjoint
+    # 4-device submeshes of the 8-device virtual mesh behind the
+    # coalescing-affinity router — zero compiles during load (warm on
+    # every replica), affinity hit-rate > 0, >=1 coalesced dispatch,
+    # pod counter totals exactly the per-replica sums, and the
+    # per-replica telemetry bundles aggregated into one schema-valid
+    # pod bundle; one JSON verdict line, nonzero on any missing piece
+    run env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -c "import json, sys, bench; r = bench.fleet_smoke(); \
+print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     # graftlint (ISSUE 4): AST rules over the whole package + jaxpr
     # contracts over all 58 registered kernels AND the resident scan
     # wrappers (abstract trace on CPU), gated on the committed baseline

@@ -53,8 +53,13 @@ def rng():
 #: device-resident and moves only by explicit put (reset/restore/
 #: ingest) and explicit get (save/the tests' device_get) — the whole
 #: carry contract is exercised under the guard.
+#: test_fleet joins (ISSUE 11): the pod router forwards host data only
+#: — replicas' device work stays on their worker threads, the one
+#: declared fan-out normalization is host-on-host, and the replica
+#: liveness probe moves data only by explicit put.
 TRANSFER_GUARDED_MODULES = {"test_kernel_purity", "test_serve",
-                            "test_stream", "test_opsplane"}
+                            "test_stream", "test_opsplane",
+                            "test_fleet"}
 
 
 @pytest.fixture(autouse=True)

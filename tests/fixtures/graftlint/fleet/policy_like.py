@@ -1,0 +1,11 @@
+"""GL-A3 fleet-scope fixture (ISSUE 11): a non-boundary module under
+fleet/ gets the full rule — np.asarray AND .block_until_ready() flag
+here even though the boundary modules next door are each allowed one
+of them."""
+import numpy as np
+
+
+def demote_signal(gauge_array):
+    host = np.asarray(gauge_array)      # flags: not a boundary module
+    gauge_array.block_until_ready()     # flags: not a boundary module
+    return host.sum()

@@ -1,0 +1,12 @@
+"""GL-A3 boundary-policy fixture (ISSUE 11): this path matches the
+policy key ``fleet/replica.py``, whose allowed set is exactly
+``{".block_until_ready()"}`` — the device-liveness probe's blocking
+put must NOT flag, every other sync symbol still must."""
+import jax
+import numpy as np
+
+
+def probe(device):
+    x = jax.device_put(1.0, device)
+    x.block_until_ready()               # allowed by the boundary policy
+    return np.asarray(x)                # NOT allowed: still flags

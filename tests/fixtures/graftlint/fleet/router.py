@@ -1,0 +1,14 @@
+"""GL-A3 boundary-policy fixture (ISSUE 11): this path matches the
+policy key ``fleet/router.py``, whose allowed set is exactly
+``{"np.asarray"}`` — the one ingest-normalization materialization
+before the fan-out must NOT flag, every other sync symbol still must
+(a boundary module is not a blanket exclusion)."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def fan_out(bars, replicas):
+    body = np.asarray(bars)             # allowed by the boundary policy
+    total = jnp.sum(body)
+    total.block_until_ready()           # NOT allowed: still flags
+    return [total.item() for _ in replicas]  # NOT allowed: still flags

@@ -41,7 +41,7 @@ def _codes_by_file(violations):
 @pytest.fixture(scope="module")
 def fixture_violations():
     violations, n_files = run_ast_tier(FIXTURES, display_base=REPO)
-    assert n_files == 11
+    assert n_files == 14
     return violations
 
 
@@ -114,22 +114,57 @@ def test_a3_boundary_policy_is_not_a_blanket_exclusion(
 
 
 def test_a3_policy_matches_the_real_request_loop():
-    """The committed policy has exactly three entries — the serving
+    """The committed policy has exactly five entries — the serving
     request loop with its one declared sync, the ops-plane sampler
-    with its device-memory reads (ISSUE 8), and the mesh-plane
-    shard-watermark prober with its per-shard blocking (ISSUE 9) — and
-    scanning the real package stays clean under it (the policy is
-    load-bearing: docs list it)."""
+    with its device-memory reads (ISSUE 8), the mesh-plane
+    shard-watermark prober with its per-shard blocking (ISSUE 9), and
+    the fleet layer's two boundaries (ISSUE 11: the router's one
+    ingest normalization, the replica lifecycle's one device-liveness
+    block) — and scanning the real package stays clean under it (the
+    policy is load-bearing: docs list it)."""
     from replication_of_minute_frequency_factor_tpu.analysis import (
         ast_tier)
     assert ast_tier.GLA3_BOUNDARY_SYNCS == {
         "serve/service.py": frozenset({"np.asarray"}),
         "telemetry/opsplane.py": frozenset({".memory_stats()",
                                             "jax.live_arrays"}),
-        "telemetry/meshplane.py": frozenset({".block_until_ready()"})}
+        "telemetry/meshplane.py": frozenset({".block_until_ready()"}),
+        "fleet/router.py": frozenset({"np.asarray"}),
+        "fleet/replica.py": frozenset({".block_until_ready()"})}
     violations, _ = ast_tier.run_ast_tier()
     assert not [v for v in violations if "/serve/" in v.path]
     assert not [v for v in violations if "/telemetry/" in v.path]
+    assert not [v for v in violations if "/fleet/" in v.path]
+
+
+def test_a3_fleet_router_boundary_allows_asarray_only(
+        fixture_violations):
+    """ISSUE 11: the fleet router boundary fixture uses its one
+    allowed symbol (np.asarray, the pre-fan-out ingest normalization)
+    plus two banned ones — only the banned ones flag."""
+    hits = _codes_by_file(fixture_violations)["router.py"]
+    assert {s for _, _, s in hits} == {".block_until_ready()",
+                                      ".item()"}
+    assert all(c == "GL-A3" for c, _, _ in hits)
+
+
+def test_a3_fleet_replica_boundary_allows_blocking_only(
+        fixture_violations):
+    """The fleet replica boundary fixture uses its one allowed sync
+    (.block_until_ready(), the device-liveness probe) plus a banned
+    np.asarray — only the banned symbol flags."""
+    hits = _codes_by_file(fixture_violations)["replica.py"]
+    assert [(c, s) for c, _, s in hits] == [("GL-A3", "np.asarray")]
+
+
+def test_a3_fleet_scope_is_not_a_blanket_exclusion(
+        fixture_violations):
+    """A fleet/ module that is NOT a declared boundary gets the full
+    rule: both its np.asarray and its .block_until_ready() flag."""
+    hits = _codes_by_file(fixture_violations)["policy_like.py"]
+    assert {s for _, _, s in hits} == {"np.asarray",
+                                      ".block_until_ready()"}
+    assert all(c == "GL-A3" for c, _, _ in hits)
 
 
 def test_a3_meshplane_boundary_allows_blocking_only(
@@ -336,7 +371,7 @@ def test_cli_flags_fixtures_then_baseline_clears_them(tmp_path):
             "--report", report)
     out = _run_cli(*args)
     assert out.returncode == 1
-    assert json.loads(out.stdout.strip().splitlines()[-1])["new"] == 20
+    assert json.loads(out.stdout.strip().splitlines()[-1])["new"] == 25
     # refuse to baseline without a why
     out = _run_cli(*args, "--update-baseline")
     assert out.returncode == 2
@@ -349,7 +384,7 @@ def test_cli_flags_fixtures_then_baseline_clears_them(tmp_path):
     out = _run_cli(*args)
     assert out.returncode == 0
     assert json.loads(
-        out.stdout.strip().splitlines()[-1])["baselined"] == 20
+        out.stdout.strip().splitlines()[-1])["baselined"] == 25
 
 
 def test_manifest_carries_the_analysis_block(tmp_path):

@@ -1,0 +1,456 @@
+"""The replica fleet (ISSUE 11): disjoint submeshes, coalescing-aware
+affinity routing, shed/degrade ladder, ingest fan-out isolation, the
+pod metrics fold, and trace propagation through the router hop.
+
+Runs under ``jax.transfer_guard("disallow")``
+(conftest.TRANSFER_GUARDED_MODULES): the router hands HOST data both
+ways, replicas' device work stays on their worker threads, and the
+liveness probe moves data only by explicit put.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from replication_of_minute_frequency_factor_tpu.fleet import (
+    FactorFleet, FleetConfig, FleetShedError, partition_devices,
+    serve_fleet_http)
+from replication_of_minute_frequency_factor_tpu.serve import (
+    Query, ServeConfig, SyntheticSource)
+from replication_of_minute_frequency_factor_tpu.serve.engine import (
+    ServeEngine)
+
+NAMES = ("vol_return1min", "mmt_am")
+
+N_DEVICES = 8
+
+
+def _fleet(n=2, n_days=8, n_tickers=24, names=NAMES, start=True,
+           stream=False, fleet_cfg=None, **scfg):
+    src = SyntheticSource(n_days=n_days, n_tickers=n_tickers, seed=3)
+    return FactorFleet(src, n, names=names,
+                       serve_cfg=ServeConfig(**scfg),
+                       fleet_cfg=fleet_cfg, stream=stream,
+                       start=start)
+
+
+def _day_minutes(src, lo, hi):
+    bars, mask = src.slab(0, 1)
+    return (np.ascontiguousarray(np.swapaxes(bars[0][:, lo:hi], 0, 1)),
+            np.ascontiguousarray(mask[0][:, lo:hi].T))
+
+
+def _boom(*a, **k):
+    raise RuntimeError("injected replica failure")
+
+
+# --------------------------------------------------------------------------
+# submesh partition
+# --------------------------------------------------------------------------
+
+
+def test_partition_devices_disjoint_and_uniform():
+    """The partition gate: disjoint uniform submeshes on the 8-device
+    virtual mesh, remainder devices unassigned, over-subscription
+    refused."""
+    assert len(jax.devices()) == N_DEVICES
+    for n in (1, 2, 4, 8):
+        groups = partition_devices(n)
+        assert len(groups) == n
+        assert all(len(g) == N_DEVICES // n for g in groups)
+        seen = [d for g in groups for d in g]
+        assert len(seen) == len(set(seen))  # disjoint
+    # non-dividing count: uniform groups, remainder idles
+    groups = partition_devices(3)
+    assert [len(g) for g in groups] == [2, 2, 2]
+    with pytest.raises(ValueError, match="disjoint"):
+        partition_devices(N_DEVICES + 1)
+    with pytest.raises(ValueError, match=">= 1"):
+        partition_devices(0)
+
+
+# --------------------------------------------------------------------------
+# affinity + coalescing (the routing contract)
+# --------------------------------------------------------------------------
+
+
+def test_same_range_queries_coalesce_on_one_replica():
+    """THE affinity gate: K same-range queries through the router land
+    on ONE replica and drain as ONE coalesced dispatch there — the
+    other replica dispatches nothing; the block lives on the owner's
+    own submesh."""
+    fleet = _fleet(start=False)
+    try:
+        futs = [fleet.submit(Query("factors", 2, 6, names=("mmt_am",)))
+                for _ in range(6)]
+        fleet.start()
+        results = [f.result(120) for f in futs]
+        for r in results[1:]:
+            np.testing.assert_array_equal(
+                r["exposures"]["mmt_am"],
+                results[0]["exposures"]["mmt_am"])
+        disp = {r.label: r.telemetry.registry.counter_total(
+            "serve.dispatches") for r in fleet.replicas}
+        coal = {r.label: r.telemetry.registry.counter_value(
+            "serve.coalesced_dispatches") for r in fleet.replicas}
+        owners = [l_ for l_, d in disp.items() if d > 0]
+        assert len(owners) == 1, disp
+        owner_label = owners[0]
+        assert disp[owner_label] == 1
+        assert coal[owner_label] == 1
+        assert fleet.replicas[
+            0 if owner_label == "r0" else 1].telemetry.registry \
+            .counter_value("serve.coalesced_requests") == 6
+        # rendezvous agrees with what happened
+        order = fleet.router.route_order((2, 6))
+        assert order[0].label == owner_label
+        # pod affinity counters saw repeat hits on the key
+        preg = fleet.telemetry.registry
+        assert preg.counter_value("fleet.affinity", outcome="hit") == 5
+        assert preg.counter_value("fleet.routed",
+                                  replica=owner_label) == 6
+        # the block was built on the owner's own submesh lead
+        owner = next(r for r in fleet.replicas
+                     if r.label == owner_label)
+        block = owner.server.cache.get((2, 6))
+        assert {str(d) for d in block["exposures"].devices()} \
+            == {str(owner.devices[0])}
+    finally:
+        fleet.close()
+
+
+def test_distinct_ranges_spread_and_reuse_their_owner():
+    """Different keys may land on different replicas (rendezvous), and
+    a repeated key always returns to its owner — the compile/cache
+    locality the affinity exists for: the repeat answers warm (cache
+    hit on the owner, zero new compiles anywhere)."""
+    fleet = _fleet(n_days=8)
+    try:
+        keys = [(0, 2), (2, 4), (4, 6), (6, 8)]
+        for k in keys:
+            fleet.submit(Query("factors", *k)).result(120)
+        compiles = sum(r.telemetry.registry.counter_total("xla.compiles")
+                       for r in fleet.replicas)
+        for k in keys:
+            fleet.submit(Query("factors", *k)).result(120)
+        assert sum(r.telemetry.registry.counter_total("xla.compiles")
+                   for r in fleet.replicas) == compiles
+        hits = sum(r.telemetry.registry.counter_value(
+            "serve.cache", outcome="hit") for r in fleet.replicas)
+        assert hits == len(keys)
+    finally:
+        fleet.close()
+
+
+# --------------------------------------------------------------------------
+# shed/degrade ladder (the acceptance criterion, end to end)
+# --------------------------------------------------------------------------
+
+
+def test_breaker_demotion_pod_keeps_serving_then_recovers(tmp_path):
+    """A replica whose breaker is forced open is demoted from routing
+    (flight dump naming it), the pod keeps answering the SAME range
+    through the remaining replica, and the half-open ladder restores
+    the healed replica — asserted end to end."""
+    fleet = _fleet(start=True, breaker_threshold=1,
+                   breaker_cooldown_s=0.4,
+                   flight_dir=str(tmp_path),
+                   fleet_cfg=FleetConfig(demote_cooldown_s=0.2))
+    try:
+        key = (0, 4)
+        owner = fleet.router.route_order(key)[0]
+        other = next(r for r in fleet.replicas if r is not owner)
+        owner.server.engine.build_block = _boom
+        with pytest.raises(RuntimeError, match="injected"):
+            fleet.submit(Query("factors", *key)).result(120)
+        assert owner.server.breaker_state() == "open"
+        # the pod still answers the same range — routed to the other
+        r = fleet.submit(Query("factors", *key)).result(120)
+        assert "exposures" in r
+        health = fleet.health()
+        assert health["ok"] is True
+        assert health["pod"]["live"] == 1
+        assert health["pod"]["demoted"] == [owner.label]
+        assert health["pod"]["reasons"][owner.label] == "breaker"
+        assert health["replicas"][owner.label]["replica"]["breaker"] \
+            in ("open", "half_open")
+        # the demotion dumped the owner's flight recorder, named
+        dumps = [f for f in os.listdir(tmp_path)
+                 if "fleet_demote" in f]
+        assert dumps, os.listdir(tmp_path)
+        content = open(tmp_path / dumps[0]).read()
+        assert owner.label in content and "breaker" in content
+        assert fleet.telemetry.registry.counter_value(
+            "fleet.demotions", replica=owner.label,
+            reason="breaker") == 1
+        # heal + wait out both cooldowns: the next same-range query is
+        # the probe (rendezvous prefers the owner again) and restores
+        owner.server.engine = ServeEngine(
+            owner.server.names, telemetry=owner.telemetry,
+            executables=owner.server.executables)
+        time.sleep(0.5)
+        r2 = fleet.submit(Query("factors", *key)).result(120)
+        assert "exposures" in r2
+        health = fleet.health()
+        assert health["pod"]["live"] == 2
+        assert health["pod"]["demoted"] == []
+        assert fleet.telemetry.registry.counter_value(
+            "fleet.restores", replica=owner.label) == 1
+    finally:
+        fleet.close()
+
+
+def test_pod_sheds_503_with_retry_after_only_when_all_out():
+    """Pod-level shed is the LAST resort: with every replica demoted
+    the router raises FleetShedError (Retry-After derived from the
+    demotion cooldown) and the front door answers 503 + Retry-After —
+    while a single demotion never sheds the pod."""
+    fleet = _fleet(start=True, breaker_threshold=1,
+                   breaker_cooldown_s=30.0,
+                   fleet_cfg=FleetConfig(demote_cooldown_s=30.0))
+    httpd = None
+    try:
+        key = (0, 4)
+        for r in fleet.replicas:
+            r.server.engine.build_block = _boom
+        # trip both replicas (the second submit reroutes to the
+        # surviving candidate and trips it too)
+        for _ in range(2):
+            with pytest.raises(RuntimeError, match="injected"):
+                fleet.submit(Query("factors", *key)).result(120)
+        with pytest.raises(FleetShedError) as e:
+            fleet.submit(Query("factors", *key))
+        assert e.value.retry_after_s and e.value.retry_after_s > 0
+        assert fleet.health()["ok"] is False
+        httpd, _t = serve_fleet_http(fleet)
+        port = httpd.server_address[1]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/query",
+            data=json.dumps({"kind": "factors", "start": 0,
+                             "end": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as he:
+            urllib.request.urlopen(req, timeout=60)
+        assert he.value.code == 503
+        assert json.loads(he.value.read())["shed"] is True
+        assert int(he.value.headers["Retry-After"]) >= 1
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        fleet.close()
+
+
+# --------------------------------------------------------------------------
+# ingest fan-out (failure isolation)
+# --------------------------------------------------------------------------
+
+
+def test_ingest_fanout_isolates_failed_leg_and_excludes_it():
+    """One replica's ingest failure must not poison the others: the
+    failed leg is surfaced alone, the healthy carry advances, the
+    broken replica is excluded from the next fan-out (demoted), and
+    intraday queries keep serving from the healthy replica."""
+    fleet = _fleet(stream=True, breaker_threshold=1,
+                   breaker_cooldown_s=30.0,
+                   fleet_cfg=FleetConfig(demote_cooldown_s=30.0))
+    try:
+        broken, healthy = fleet.replicas
+        broken.server.stream_engine.ingest_minutes = _boom
+        bars, present = _day_minutes(fleet.source, 0, 2)
+        res = fleet.ingest(bars, present)
+        assert res["minute"] == 2
+        assert res["failed"] == [broken.label]
+        assert res["replicas"][healthy.label]["ok"] is True
+        assert "injected" in res["replicas"][broken.label]["error"]
+        assert healthy.server.stream_engine.minutes == 2
+        assert broken.server.stream_engine.minutes == 0
+        # second fan-out: the tripped replica is EXCLUDED, not retried
+        bars2, present2 = _day_minutes(fleet.source, 2, 4)
+        res2 = fleet.ingest(bars2, present2)
+        assert res2["minute"] == 4
+        assert res2["replicas"][broken.label].get("skipped") is True
+        # the pod health view surfaces the drained replica + the skew
+        health = fleet.health()
+        assert health["pod"]["demoted"] == [broken.label]
+        assert health["pod"]["stream_minute"] == 4
+        assert health["pod"]["stream_minute_skew"] == 4
+        assert broken.server.stream_engine.cursor()["minute"] == 0
+        # intraday keeps serving from the healthy carry
+        snap = fleet.submit(Query("intraday")).result(120)
+        assert snap["minute"] == 4
+    finally:
+        fleet.close()
+
+
+def test_ingest_fanout_sheds_only_when_every_leg_fails():
+    fleet = _fleet(stream=True, breaker_threshold=1,
+                   breaker_cooldown_s=30.0,
+                   fleet_cfg=FleetConfig(demote_cooldown_s=30.0))
+    try:
+        for r in fleet.replicas:
+            r.server.stream_engine.ingest_minutes = _boom
+        bars, present = _day_minutes(fleet.source, 0, 1)
+        with pytest.raises(FleetShedError, match="every stream"):
+            fleet.ingest(bars, present)
+    finally:
+        fleet.close()
+
+
+# --------------------------------------------------------------------------
+# pod metrics fold + trace propagation
+# --------------------------------------------------------------------------
+
+
+def test_pod_counter_totals_equal_per_replica_sums():
+    """The PR 9 exact-merge contract, re-verified in process: every
+    pod counter equals the control-plane + per-replica sum."""
+    fleet = _fleet()
+    try:
+        for k in ((0, 2), (2, 4), (0, 2)):
+            fleet.submit(Query("factors", *k)).result(120)
+        merged = fleet.pod_registry()
+        snap = merged.snapshot()
+        regs = ([fleet.telemetry.registry]
+                + [r.telemetry.registry for r in fleet.replicas])
+        assert snap["counters"], "pod fold lost every counter"
+        for key, total in snap["counters"].items():
+            per = sum(reg.snapshot()["counters"].get(key, 0.0)
+                      for reg in regs)
+            assert abs(per - total) <= 1e-9 * max(1.0, abs(total)), key
+        assert merged.counter_total("fleet.routed") == 3
+        assert merged.counter_total("serve.dispatches") == 2
+    finally:
+        fleet.close()
+
+
+def test_trace_id_round_trips_router_to_replica():
+    """One request is reconstructable across the hop: the caller's
+    trace ID comes back in the answer, the router's route record
+    names the replica under the SAME ID, and the replica's request
+    record carries it too."""
+    fleet = _fleet()
+    try:
+        tid = "fleet-trace-0001"
+        r = fleet.submit(Query("factors", 0, 2),
+                         trace_id=tid).result(120)
+        assert r["trace_id"] == tid
+        routes = [t for t in fleet.telemetry._requests
+                  if t["trace_id"] == tid]
+        assert len(routes) == 1 and routes[0]["op"] == "route"
+        owner_label = routes[0]["data"]["replica"]
+        owner = next(rep for rep in fleet.replicas
+                     if rep.label == owner_label)
+        replica_side = [t for t in owner.telemetry._requests
+                        if t["trace_id"] == tid]
+        assert len(replica_side) == 1
+        assert replica_side[0]["op"] == "factors"
+    finally:
+        fleet.close()
+
+
+# --------------------------------------------------------------------------
+# front door + smoke + CLI
+# --------------------------------------------------------------------------
+
+
+def test_fleet_http_front_door_round_trip():
+    """One HTTP surface: routed query (trace echoed), per-replica +
+    pod healthz (the shared replica shape), the pod-folded metrics in
+    JSON and Prometheus text, ingest fan-out with the leg map."""
+    fleet = _fleet(stream=True)
+    httpd = None
+    try:
+        httpd, _t = serve_fleet_http(fleet)
+        port = httpd.server_address[1]
+
+        def post(doc, path="/v1/query", tid=None):
+            headers = {"Content-Type": "application/json"}
+            if tid:
+                headers["X-Trace-Id"] = tid
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=json.dumps(doc).encode(), headers=headers)
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return (resp.status, dict(resp.headers),
+                        json.loads(resp.read()))
+
+        status, headers, r = post({"kind": "factors", "start": 0,
+                                   "end": 2, "names": ["mmt_am"]},
+                                  tid="pod-req-1")
+        assert status == 200 and headers["X-Trace-Id"] == "pod-req-1"
+        assert r["trace_id"] == "pod-req-1"
+        assert list(r["exposures"]) == ["mmt_am"]
+        # healthz: per-replica payloads in the shared shape + rollup
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=30) as resp:
+            h = json.loads(resp.read())
+        assert h["ok"] and h["pod"]["live"] == 2
+        assert set(h["replicas"]) == {"r0", "r1"}
+        for label, rep in h["replicas"].items():
+            assert rep["replica"]["label"] == label
+            assert len(rep["replica"]["devices"]) == N_DEVICES // 2
+        # metrics: pod fold, JSON + Prometheus
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/metrics",
+                timeout=30) as resp:
+            snap = json.loads(resp.read())
+        assert "fleet.routed{replica=r0}" in snap["counters"] \
+            or "fleet.routed{replica=r1}" in snap["counters"]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/metrics",
+            headers={"Accept": "text/plain"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            text = resp.read().decode()
+        assert "fleet_routed_total" in text
+        assert "serve_dispatches_total" in text
+        # ingest fan-out over HTTP: the leg map rides the response
+        bars, present = _day_minutes(fleet.source, 0, 1)
+        status, _hdr, res = post({"bars": bars.tolist(),
+                                  "present": present.tolist()},
+                                 path="/v1/ingest")
+        assert status == 200 and res["minute"] == 1
+        assert res["failed"] == []
+        assert all(leg["ok"] for leg in res["replicas"].values())
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        fleet.close()
+
+
+def test_fleet_bench_smoke_record():
+    """bench.fleet_smoke: the CPU acceptance evidence — 2 live
+    replicas, zero compiles during load, affinity hits, >=1 coalesced
+    dispatch, the exact pod counter fold, and a schema-valid
+    aggregated pod bundle."""
+    import bench
+    r = bench.fleet_smoke()
+    assert r["ok"], r
+    assert r["methodology"] == "r11_fleet_v1"
+    assert r["live_replicas"] == 2
+    assert r["compiles_during_load"] == 0
+    assert r["affinity_hits"] > 0
+    assert r["coalesced_dispatches"] >= 1
+    assert r["counter_mismatched"] == 0
+    assert r["bundle_ok"] is True
+    assert r["p50_ms"] > 0 and r["p99_ms"] >= r["p50_ms"]
+
+
+def test_cli_fleet_demo(capsys):
+    from replication_of_minute_frequency_factor_tpu.__main__ import main
+    rc = main(["serve", "--fleet", "2", "--demo", "6",
+               "--synthetic-days", "6", "--synthetic-tickers", "16",
+               "--factors", "vol_return1min,mmt_am"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["demo_requests"] == 6 and out["fleet"] == 2
+    assert out["live_replicas"] == 2
+    assert out["routed"] == 6
+    assert sum(out["per_replica_dispatches"].values()) \
+        == out["dispatches"]

@@ -275,6 +275,37 @@ def test_cli_check_r9_stream_break_is_declared(tmp_path):
     assert "declared break" in g.get("note", "")
 
 
+def test_cli_check_r11_fleet_break_is_declared(tmp_path):
+    """ISSUE 11: the replica fleet's first ``bench.py fleet`` record
+    (pod QPS under ``r11_fleet_v1``) gates against the REAL banked
+    trajectory as a declared break — its own fresh series, reported
+    with an empty baseline, never flagged, exit 0. The pod blocks ride
+    the record for the session carry rule (live_replicas >= 2, zero
+    fold mismatches)."""
+    cand = tmp_path / "candidate.json"
+    with open(cand, "w") as fh:
+        json.dump({"metric": "fleet58_1024tickers_qps", "value": 910.0,
+                   "unit": "req/s", "methodology": "r11_fleet_v1",
+                   "p50_ms": 38.0, "p99_ms": 140.0,
+                   "live_replicas": 2,
+                   "replicas": {"1": {"levels": {"64": {"qps": 520.0}}},
+                                "2": {"levels": {"64": {"qps": 910.0}}}},
+                   "pod": {"counter_totals": {"checked": 40,
+                                              "mismatched": 0},
+                           "affinity_hits": 200}}, fh)
+    rc, verdict = _cli(REPO, "--check", str(cand))
+    assert rc == 0 and verdict["ok"]
+    (g,) = [g for g in verdict["groups"]
+            if g["metric"] == "fleet58_1024tickers_qps"]
+    assert g["n_baseline"] == 0 and g["flagged"] is False
+    assert "declared break" in g.get("note", "")
+    # the derived request-p99 sub-series rides the same check as its
+    # own declared break under the fleet methodology
+    (d,) = [g for g in verdict["groups"]
+            if g["metric"] == "fleet58_1024tickers_qps.request_p99_ms"]
+    assert d["flagged"] is False
+
+
 def test_cli_check_r7_sharded_break_is_declared(tmp_path):
     """ISSUE 5: a fresh record under the r7 mesh-native resident
     methodology gates against the REAL banked trajectory as a declared

@@ -381,6 +381,96 @@ def test_http_error_codes():
         srv.close()
 
 
+def test_shed_503_carries_retry_after_header():
+    """ISSUE 11 satellite: both shed shapes answer 503 WITH a
+    ``Retry-After`` backoff hint derived from the breaker cooldown —
+    the remaining cooldown on a breaker shed, the full cooldown on a
+    full-queue shed."""
+    # breaker-open shed: remaining cooldown (<= 30 s, >= 1 s rounded)
+    srv, _ = _server(breaker_threshold=1, breaker_cooldown_s=30.0)
+    httpd = None
+    try:
+        httpd, _t = serve_http(srv)
+        port = httpd.server_address[1]
+        srv.engine.build_block = _boom
+        with pytest.raises(urllib.error.HTTPError):
+            _post(port, {"kind": "factors", "start": 0, "end": 2})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(port, {"kind": "factors", "start": 0, "end": 2})
+        assert e.value.code == 503
+        retry = int(e.value.headers["Retry-After"])
+        assert 1 <= retry <= 30
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        srv.close()
+    # full-queue shed: the cooldown as the backoff hint
+    srv2, _ = _server(start=False, queue_limit=1,
+                      breaker_cooldown_s=7.0)
+    httpd2 = None
+    try:
+        httpd2, _t = serve_http(srv2)
+        port = httpd2.server_address[1]
+        srv2.submit(Query("factors", 0, 2))  # fills the queue
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(port, {"kind": "factors", "start": 0, "end": 2})
+        assert e.value.code == 503
+        assert int(e.value.headers["Retry-After"]) == 7
+        srv2.start()  # drain on close
+    finally:
+        if httpd2 is not None:
+            httpd2.shutdown()
+        srv2.close()
+
+
+def test_load_shed_error_carries_retry_after_attr():
+    """The in-process face of the same hint: LoadShedError.retry_after_s
+    is set on both shed shapes (the fleet router reads it to pick the
+    pod Retry-After)."""
+    srv, _ = _server(start=False, queue_limit=1, breaker_cooldown_s=5.0)
+    try:
+        srv.submit(Query("factors", 0, 2))
+        with pytest.raises(LoadShedError) as e:
+            srv.submit(Query("factors", 0, 2))
+        assert e.value.retry_after_s == 5.0
+        srv.start()
+    finally:
+        srv.close()
+
+
+def test_health_carries_replica_identity_block():
+    """ISSUE 11 satellite: healthz (served from FactorServer.health so
+    the standalone server and the fleet rollup share one shape) gains
+    the ``replica`` identity block — label, device set, breaker
+    state."""
+    srv, _ = _server(breaker_threshold=1, breaker_cooldown_s=30.0)
+    try:
+        h = srv.health()
+        rep = h["replica"]
+        assert rep["label"] == "standalone"  # no identity passed
+        assert rep["breaker"] == "closed"
+        assert rep["devices"] == [str(d) for d in jax.devices()]
+        # breaker state tracks the ladder
+        srv.engine.build_block = _boom
+        with pytest.raises(RuntimeError, match="injected"):
+            srv.submit(Query("factors", 0, 2)).result(60)
+        assert srv.health()["replica"]["breaker"] == "open"
+        # the HTTP payload is the same dict
+        httpd, _t = serve_http(srv)
+        try:
+            port = httpd.server_address[1]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz",
+                    timeout=30) as resp:
+                via_http = json.loads(resp.read())
+            assert via_http["replica"]["label"] == "standalone"
+            assert via_http["replica"]["breaker"] == "open"
+        finally:
+            httpd.shutdown()
+    finally:
+        srv.close()
+
+
 # --------------------------------------------------------------------------
 # smoke + load path (the r8_serve_v1 record)
 # --------------------------------------------------------------------------

@@ -405,3 +405,85 @@ def test_stream_intraday_in_default_steps(tpu_session):
     src = open(os.path.join(REPO, "benchmarks", "tpu_session.py")).read()
     assert "serve,stream_intraday," in src
     assert "stream_intraday" in src.split("steps = {")[1]
+
+
+def test_fleet_carry_requires_multiplied_pod(tpu_session):
+    """ISSUE 11: a 'fleet' entry only carries when it is an r11 record
+    that actually multiplied the service — >= 2 live replicas, the pod
+    hbm block, and the zero-mismatch pod counter fold. A one-replica
+    record (single-chip window), a watermark-less record, or a fold
+    mismatch must re-run."""
+    def entry(hbm=True, pod=True, mismatched=0, **top):
+        rec = {"metric": "fleet58_1024tickers_qps", "value": 900.0,
+               "methodology": "r11_fleet_v1", "live_replicas": 2}
+        rec.update(top)
+        if hbm:
+            rec["hbm"] = {"available": True, "peak_bytes": 1 << 30}
+        if pod:
+            rec["pod"] = {"counter_totals": {"checked": 40,
+                                             "mismatched": mismatched},
+                          "affinity_hits": 120}
+        return {"fleet": {"ok": True, "results": [rec]}}
+
+    good = entry()
+    assert tpu_session.drop_conv_only_rolling(good) == good
+    assert tpu_session.drop_conv_only_rolling(
+        entry(live_replicas=1)) == {}
+    assert tpu_session.drop_conv_only_rolling(entry(hbm=False)) == {}
+    assert tpu_session.drop_conv_only_rolling(entry(pod=False)) == {}
+    assert tpu_session.drop_conv_only_rolling(entry(mismatched=3)) == {}
+    wrong_series = entry()
+    wrong_series["fleet"]["results"][0]["methodology"] = "r8_serve_v1"
+    assert tpu_session.drop_conv_only_rolling(wrong_series) == {}
+    # the serve step's own carry rule is untouched by the fleet rule
+    serve = {"serve": {"ok": True, "results": [
+        {"methodology": "r8_serve_v1",
+         "hbm": {"available": True}, "serve": {"cache_hits": 5}}]}}
+    assert tpu_session.drop_conv_only_rolling(serve) == serve
+
+
+def test_fleet_step_refuses_single_replica(tpu_session, monkeypatch):
+    """The step flips ok=False when the record never multiplied (one
+    live replica — the single-attached-chip case) so the next
+    multi-device window re-runs it; a bankable record passes."""
+    def fake_solo(cmd, timeout, env=None):
+        assert cmd[1:] == ["bench.py", "fleet"]
+        assert env["BENCH_REQUIRE_TPU"] == "1"
+        return {"ok": True, "rc": 0, "results": [
+            {"metric": "fleet58_1024tickers_qps",
+             "methodology": "r11_fleet_v1", "live_replicas": 1,
+             "hbm": {"available": True},
+             "pod": {"counter_totals": {"checked": 10,
+                                        "mismatched": 0}}}]}
+    monkeypatch.setattr(tpu_session, "_run_json_lines", fake_solo)
+    r = tpu_session.step_fleet()
+    assert r["ok"] is False and "cannot bank" in r["error"]
+
+    def fake_good(cmd, timeout, env=None):
+        return {"ok": True, "rc": 0, "results": [
+            {"metric": "fleet58_1024tickers_qps",
+             "methodology": "r11_fleet_v1", "live_replicas": 2,
+             "hbm": {"available": True},
+             "pod": {"counter_totals": {"checked": 10,
+                                        "mismatched": 0}}}]}
+    monkeypatch.setattr(tpu_session, "_run_json_lines", fake_good)
+    assert tpu_session.step_fleet()["ok"] is True
+
+    def fake_cpu(cmd, timeout, env=None):
+        return {"ok": True, "rc": 0, "results": [
+            {"metric": "fleet58_1024tickers_qps_cpu_fallback_tunnel_down",
+             "methodology": "r11_fleet_v1", "live_replicas": 2,
+             "hbm": {"available": False},
+             "pod": {"counter_totals": {"checked": 10,
+                                        "mismatched": 0}}}]}
+    monkeypatch.setattr(tpu_session, "_run_json_lines", fake_cpu)
+    r = tpu_session.step_fleet()
+    assert r["ok"] is False and "CPU-fallback" in r["error"]
+
+
+def test_fleet_in_default_steps(tpu_session):
+    """The r11 fleet's hardware validation rides the default list,
+    directly behind stream_intraday."""
+    src = open(os.path.join(REPO, "benchmarks", "tpu_session.py")).read()
+    assert "stream_intraday,fleet," in src
+    assert '"fleet": step_fleet' in src
