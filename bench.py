@@ -169,6 +169,19 @@ def _count_sync(point: str) -> None:
     get_telemetry().counter("bench.host_blocking_syncs", point=point)
 
 
+def _observe_factor_stats(names, stats_rows, boundary) -> None:
+    """Feed the fused per-batch ``[F, 9]`` data-quality sketches
+    (ISSUE 12) into the run's factor-health plane; the record's
+    ``factor_health`` block reads the plane's summary back. Never
+    raises — a quality observation must not cost a hardware window."""
+    try:
+        plane = get_telemetry().factorplane
+        for row in stats_rows:
+            plane.observe_block(names, row, boundary=boundary)
+    except Exception:  # noqa: BLE001 — diagnostics only
+        pass
+
+
 def _encode_kind_marks() -> dict:
     """Snapshot of the encode-kind counters (see encode_year/encode_pack);
     diff two snapshots with :func:`_encode_kind_delta`."""
@@ -386,7 +399,15 @@ def run_resident(batches, names, use_wire, group, keep_results=False,
     (``phases['decode_s']``; ``phases['result_wire']`` carries the
     widen/overflow/byte verdict and ``keep_results`` returns DECODED
     blocks). Overflowed spill budgets are reported, not raised — the
-    caller owns the widen-only floor (see main's warmup)."""
+    caller owns the widen-only floor (see main's warmup).
+
+    The per-factor data-quality sketch (ISSUE 12) is ALWAYS fused as a
+    scan side-output: each group's tiny ``[g, F, 9]`` stats array
+    materializes right after the group's main fetch (the bytes are
+    already landed — the measured host-blocking sync count is
+    unchanged, no new ``_count_sync`` point) and feeds
+    ``telemetry.factorplane``; the record's ``factor_health`` block
+    reads the plane back."""
     from replication_of_minute_frequency_factor_tpu.config import (
         get_config)
     from replication_of_minute_frequency_factor_tpu.pipeline import (
@@ -410,11 +431,12 @@ def run_resident(batches, names, use_wire, group, keep_results=False,
         compiled = _aot_resident(
             "bench_resident_scan",
             ("resident", len(gbufs), gbufs[0].shape, spec, kind, names,
-             roll, result_spec),
+             roll, result_spec, "stats"),
             lambda: lower_packed_resident(gbufs, spec, kind,
                                           names=names,
                                           rolling_impl=roll,
-                                          result_spec=result_spec),
+                                          result_spec=result_spec,
+                                          factor_stats=True),
             phases)
         if compute_t0 is None:  # compile attributed apart from execute
             compute_t0 = time.perf_counter()
@@ -427,9 +449,14 @@ def run_resident(batches, names, use_wire, group, keep_results=False,
     results = [] if keep_results else None
     fetched_mb = 0.0
     payload_rows = []  # result-wire mode: fetched [g, L] u8 stacks
+    stats_rows = []    # fused [g, F, 9] data-quality sketches
     for o in outs:
+        ys, st = o
         _count_sync("resident_fetch")
-        h = np.asarray(o)  # [group, F, D, T] f32, or [group, L] u8
+        h = np.asarray(ys)  # [group, F, D, T] f32, or [group, L] u8
+        # the stats side-output rode the same executable; its bytes are
+        # ready the moment the main fetch lands (no new sync point)
+        stats_rows.extend(np.asarray(st))
         fetched_mb += h.nbytes
         if result_spec is not None:
             payload_rows.extend(h)
@@ -440,6 +467,7 @@ def run_resident(batches, names, use_wire, group, keep_results=False,
     n_d, n_t = batches[0][0].shape[0], batches[0][0].shape[1]
     phases["fetch_logical_MB"] = round(
         len(batches) * len(names) * n_d * n_t * 4 / 1e6, 3)
+    _observe_factor_stats(names, stats_rows, "resident.fetch")
     if result_spec is not None:
         _decode_result_phases(phases, payload_rows, names, n_d, n_t,
                               n_t, result_spec, results)
@@ -458,12 +486,16 @@ def _decode_result_phases(phases, payload_rows, names, n_d, t_pad,
         result_wire as rw)
     t0 = time.perf_counter()
     widened = overflow = quantized = 0
+    by_factor: dict = {}
     for row in payload_rows:
         dec, v = rw.decode_block(row, len(names), n_d, t_pad,
-                                 result_spec.spill_rows, strict=False)
+                                 result_spec.spill_rows, strict=False,
+                                 names=names)
         widened += v["widened"]
         overflow += v["overflow"]
         quantized += v["quantized"]
+        for n, c in (v.get("widened_by_factor") or {}).items():
+            by_factor[n] = by_factor.get(n, 0) + c
         if results is not None:
             results.append(dec[..., :n_tickers])
     phases["decode_s"] = round(time.perf_counter() - t0, 3)
@@ -475,6 +507,11 @@ def _decode_result_phases(phases, payload_rows, names, n_d, t_pad,
         "quantized_slices": quantized,
         "widened_slices": widened,
         "overflow_slices": overflow,
+        # per-factor widen attribution (ISSUE 12): WHICH factors'
+        # slices fail the round-trip check — the ROADMAP's open
+        # question about the 9 strict-pinned volume factors reads
+        # straight off the banked record
+        "widened_by_factor": by_factor,
         "payload_MB": phases["fetch_MB"],
         "f32_logical_MB": phases["fetch_logical_MB"],
         "ratio_vs_f32": round(logical_b / payload_b, 3)
@@ -484,6 +521,15 @@ def _decode_result_phases(phases, payload_rows, names, n_d, t_pad,
     tel.gauge("result.widened_slices", widened)
     if overflow:
         tel.counter("result.overflow_slices", overflow)
+    # fold the widen disposition into the factor-health plane: the
+    # record's factor_health.widen_rate (and regress's
+    # <metric>.widen_rate sub-series) read it back
+    try:
+        tel.factorplane.observe_widen(
+            names, by_factor,
+            slices_per_factor=n_d * max(1, len(payload_rows)))
+    except Exception:  # noqa: BLE001 — diagnostics only
+        pass
 
 
 def run_resident_sharded(batches, names, use_wire, group, mesh,
@@ -549,15 +595,19 @@ def run_resident_sharded(batches, names, use_wire, group, mesh,
     hidden = 0.0
     compute_t0 = None
     t0 = time.perf_counter()
+    # fused factor stats over the LOGICAL tickers only (ISSUE 12): the
+    # lcm pad lanes are masked filler and must not read as missing data
+    n_tickers_logical = batches[0][0].shape[1]
     for gi in range(len(groups)):
         d = pend
         compiled = _aot_resident(
             "bench_resident_scan_sharded",
             ("sharded", d.shape, spec, kind, names, roll, mesh,
-             result_spec),
+             result_spec, "stats", n_tickers_logical),
             lambda: lower_packed_resident_sharded(
                 d, spec, kind, mesh, names=names, rolling_impl=roll,
-                result_spec=result_spec),
+                result_spec=result_spec,
+                factor_stats=n_tickers_logical),
             phases)
         if compute_t0 is None:
             compute_t0 = time.perf_counter()
@@ -567,8 +617,10 @@ def run_resident_sharded(batches, names, use_wire, group, mesh,
         # watcher blocks on each shard of this group's output in the
         # background and records its completion time since dispatch —
         # the hot loop never blocks, so the measured sync counts and
-        # the double-buffered overlap are untouched
-        tel.meshplane.watch_async(outs[-1], boundary="resident.group",
+        # the double-buffered overlap are untouched (the main result,
+        # not the replicated stats side-output, carries the shards)
+        tel.meshplane.watch_async(outs[-1][0],
+                                  boundary="resident.group",
                                   t0=t_dispatch)
         # HBM watermark per scan group (ISSUE 8): the first measured
         # signal the OOM ladder's group-halving gets, sampled while
@@ -600,15 +652,21 @@ def run_resident_sharded(batches, names, use_wire, group, mesh,
     n_tickers = batches[0][0].shape[1]
     n_days = batches[0][0].shape[0]
     payload_rows = []
+    stats_rows = []
     for o in outs:
+        ys, st = o
         _count_sync("resident_fetch")
-        h = np.asarray(o)  # [g, F, D, T_pad] f32, or [g, L] u8 (wire)
+        h = np.asarray(ys)  # [g, F, D, T_pad] f32, or [g, L] u8 (wire)
+        # the [g, F, 9] stats side-output rode the same module; its
+        # bytes land with the consolidated fetch (no new sync point)
+        stats_rows.extend(np.asarray(st))
         fetched_mb += h.nbytes
         if result_spec is not None:
             payload_rows.extend(h)
         elif keep_results:
             results.extend(h[..., :n_tickers])
     phases["fetch_s"] = round(time.perf_counter() - t0, 3)
+    _observe_factor_stats(names, stats_rows, "resident.fetch")
     # RAW fetched bytes (pad lanes included) AND the logical payload
     # (ISSUE 10 satellite): the old single number silently reported
     # padded-ticker bytes on sharded runs — h[..., :n_tickers] sliced
@@ -1028,6 +1086,9 @@ def serve_bench(levels=None, total_requests=None, tickers=None,
         # dispatch boundary rides the same summary shape as the
         # sharded records
         "mesh": tel.meshplane.summary(),
+        # factor-health block (ISSUE 12): every block BUILD fed the
+        # plane its fused [F, 9] sketch; IC queries fed realized-IC
+        "factor_health": tel.factorplane.summary(),
         "stages": stages,
     }
 
@@ -1199,6 +1260,7 @@ def fleet_bench(replica_counts=None, levels=None, total_requests=None,
     per_count = {}
     pod_block = None
     hbm_block = None
+    fh_block = None
 
     for c in runnable:
         tel_pod = Telemetry()
@@ -1342,6 +1404,10 @@ def fleet_bench(replica_counts=None, levels=None, total_requests=None,
                 "routed": per_count[str(c)]["counters"]["routed"],
                 "bundle": bundle,
             }
+            # pod factor-health rollup (ISSUE 12): worst-coverage
+            # factor per replica + the stream cursor skew beside it —
+            # read from the same healthz rollup the front door serves
+            fh_block = health["pod"].get("factor_health")
         fleet.close()
 
     top = str(runnable[-1])
@@ -1373,6 +1439,10 @@ def fleet_bench(replica_counts=None, levels=None, total_requests=None,
         "replicas": per_count,
         "pod": pod_block,
         "hbm": hbm_block,
+        # per-replica factor health at the top count (ISSUE 12): the
+        # pod healthz rollup's data-quality view, banked so a replica
+        # whose factors degraded is visible in the trajectory
+        "factor_health": fh_block,
         "stages": stages,
     }
 
@@ -1608,10 +1678,17 @@ def stream_bench(cohorts=None, tickers=None, updates=None, names=None,
         }
         stages[f"load_{k}_s"] = round(wall, 3)
     # one warm snapshot after load: the intraday query the live feed
-    # interleaves (also proves snapshot stayed compiled)
+    # interleaves (also proves snapshot stayed compiled). The
+    # stats-fused variant (ISSUE 12) is warmed alongside the plain one,
+    # so this is still a zero-compile dispatch — and its [F, 9] sketch
+    # + readiness plane feed the factor-health block below
     t0 = time.perf_counter()
-    engine.snapshot()
+    _exp, _ready, _stats = engine.snapshot_stats()
     stages["snapshot_s"] = round(time.perf_counter() - t0, 3)
+    tel.factorplane.observe_stream(
+        names, np.asarray(_stats),
+        ready_frac=np.asarray(_ready).mean(axis=1),
+        minute=engine.minutes, boundary="stream.snapshot")
     tel.hbm.sample("stream.load_end", force=True)
 
     top = str(cohorts[-1])
@@ -1652,6 +1729,10 @@ def stream_bench(cohorts=None, tickers=None, updates=None, names=None,
         # K-row scatter) with available=False until the carry itself
         # shards; tpu_session's stream carry rule requires the block
         "mesh": tel.meshplane.summary(),
+        # factor-health block (ISSUE 12): the end-of-load fused
+        # snapshot's per-factor stats + readiness lag; tpu_session's
+        # stream_intraday carry rule requires an available block
+        "factor_health": tel.factorplane.summary(),
         "stages": stages,
     }
 
@@ -2039,6 +2120,93 @@ def result_wire_smoke(days=2, tickers=48, names=None):
         "max_rel_err": float(chk["max_rel_err"]),
         "parity_bad": chk["bad_factors"],
         "ok": (chk["ok"] and v["overflow"] == 0 and ratio >= 1.5),
+    }
+
+
+# --------------------------------------------------------------------------
+# factor-health smoke (ISSUE 12): fused stats parity + drift round trip
+# --------------------------------------------------------------------------
+
+
+def factorplane_smoke(days=2, tickers=48, names=None):
+    """run_tests.sh --quick smoke: the factor-health plane end to end
+    on a seeded day batch. ``ok`` iff:
+
+      * the FUSED on-device stats of the full factor set (computed as a
+        side-output of the packed dispatch — the exact production
+        fusion point) match a host-side numpy recompute over the same
+        fetched exposures: lane/NaN/±inf counts and min/max EXACTLY,
+        mean/std within f32 reduction-order tolerance;
+      * the fused dispatch's exposures are BITWISE the plain
+        dispatch's (the side-output reads, never rewrites);
+      * an injected coverage collapse trips a ``factor_drift_burst``
+        flight dump that ``telemetry.validate`` accepts and that names
+        the collapsed factor, while the stable seeded pass produced
+        ZERO dumps.
+
+    One JSON verdict line; nonzero exit on drift."""
+    import tempfile
+
+    from replication_of_minute_frequency_factor_tpu import pipeline
+    from replication_of_minute_frequency_factor_tpu.models.registry import (
+        factor_names as _fnames)
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        Telemetry)
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        factorplane as fp)
+    from replication_of_minute_frequency_factor_tpu.telemetry.validate \
+        import validate_dump
+
+    rng = np.random.default_rng(23)
+    names = tuple(names or _fnames())
+    bars, mask = make_batch(rng, n_days=days, n_tickers=tickers)
+    arrays = (bars, mask.view(np.uint8))
+    out, st = pipeline.compute_packed(arrays, "raw", names,
+                                      factor_stats=True)
+    base = pipeline.compute_packed(arrays, "raw", names)
+    exp = np.asarray(out)
+    dev_stats = np.asarray(st)
+    bitwise = np.array_equal(exp, np.asarray(base), equal_nan=True)
+    host_stats = fp.factor_stats_host(exp)
+    counts_ok = np.array_equal(dev_stats[:, :5], host_stats[:, :5])
+    minmax_ok = np.array_equal(dev_stats[:, 7:], host_stats[:, 7:],
+                               equal_nan=True)
+    moments_ok = bool(np.allclose(dev_stats[:, 5:7], host_stats[:, 5:7],
+                                  rtol=1e-4, atol=1e-6, equal_nan=True))
+    # drift round trip: bank the seeded baseline, re-observe it (zero
+    # dumps on stable data), then collapse one factor's coverage and
+    # check the burst dump names it and schema-validates
+    victim = names[0]
+    with tempfile.TemporaryDirectory() as td:
+        plane = fp.FactorPlane(telemetry=Telemetry(), dump_dir=td,
+                               burst=2)
+        plane.observe_block(names, dev_stats)       # banks baselines
+        stable = [plane.observe_block(names, dev_stats)["bursts"]
+                  for _ in range(3)]
+        collapsed = dev_stats.copy()
+        collapsed[0, 1] = 0.0                        # finite -> 0
+        collapsed[0, 2] = collapsed[0, 0]            # all NaN
+        collapsed[0, 5:] = np.nan
+        dumps = []
+        for _ in range(3):
+            s = plane.observe_block(names, collapsed)
+            dumps.extend(s.get("burst_dumps") or [])
+        dump_ok = named_ok = False
+        if dumps:
+            rep = validate_dump(dumps[0])
+            dump_ok = bool(rep.get("ok"))
+            with open(dumps[0]) as fh:
+                named_ok = victim in fh.read()
+    return {
+        "smoke": "factorplane", "factors": len(names), "days": days,
+        "tickers": tickers, "exposures_bitwise": bitwise,
+        "counts_exact": bool(counts_ok),
+        "minmax_exact": bool(minmax_ok), "moments_ok": moments_ok,
+        "stable_bursts": sum(stable), "drift_dumps": len(dumps),
+        "dump_valid": dump_ok, "dump_names_factor": named_ok,
+        "ok": (bitwise and bool(counts_ok) and bool(minmax_ok)
+               and moments_ok and sum(stable) == 0 and dump_ok
+               and named_ok),
     }
 
 
@@ -2778,6 +2946,14 @@ def main():
         # <metric>.shard_skew_ratio / .pad_waste_frac series from it
         "mesh": (get_telemetry().meshplane.summary()
                  if mode == "resident" and n_shards > 1 else None),
+        # per-factor data-quality block (ISSUE 12): worst coverage,
+        # widen rate, drift bursts — fused stats ride the resident
+        # fetch, so resident-mode records always carry a live block
+        # (available=False on the stream-mode CPU fallback, which runs
+        # without the fused side-output); tpu_session's headline carry
+        # REQUIRES an available block, and regress derives the
+        # <metric>.widen_rate / .coverage_frac sub-series from it
+        "factor_health": get_telemetry().factorplane.summary(),
         # per-op-class device time from the loop's profiler capture
         # (null when no profile dir was configured/captured)
         "device_time": device_time,

@@ -142,7 +142,12 @@ def drop_conv_only_rolling(steps):
       lever is the quantized result leg, and a run whose fetch
       silently fell back to raw f32 (BENCH_RESULT_WIRE=0, or a spec
       regression) measures the OLD transfer shape — it cannot bank as
-      the r10 headline;
+      the r10 headline. Since ISSUE 12 the record must ALSO carry an
+      AVAILABLE ``factor_health`` block (the fused per-factor stats
+      side-output sampled): the first hardware window is what banks
+      the ROADMAP's real-data widen-rate answer for the 9
+      strict-pinned volume factors, so a record without the
+      data-quality plane cannot bank;
     * 'stream' entries must be ``mode: stream`` records (the r1-r4
       series continuation under its own metric suffix);
     * 'resident_sharded' entries must be records of the r7 mesh-native
@@ -199,6 +204,8 @@ def drop_conv_only_rolling(steps):
                        and r.get("tickers") == 5000
                        and isinstance(r.get("result_wire"), dict)
                        and r["result_wire"].get("enabled") is True
+                       and isinstance(r.get("factor_health"), dict)
+                       and r["factor_health"].get("available") is True
                        for r in recs)
         if name == "stream":
             return any(r.get("mode") == "stream" for r in recs)
@@ -417,16 +424,22 @@ def _stream_record_banks(rec) -> bool:
     ISSUE 8, the embedded HBM watermark block (same rationale as
     :func:`_serve_record_banks`), and, since ISSUE 9, the ``mesh``
     balance block (cohort-occupancy telemetry: a record with no
-    shard-balance telemetry cannot bank)."""
+    shard-balance telemetry cannot bank), and, since ISSUE 12, an
+    AVAILABLE ``factor_health`` block (the fused stats + readiness-lag
+    sample of the end-of-load snapshot — the stream's data-quality
+    evidence feeds the ``<metric>.coverage_frac`` regress series)."""
     stream = rec.get("stream") or {}
     hbm = rec.get("hbm")
+    fh = rec.get("factor_health")
     return (rec.get("methodology") == "r9_stream_intraday_v1"
             and isinstance(stream.get("updates"), int)
             and stream["updates"] > 0
             and stream.get("compiles_during_load") == 0
             and stream.get("parity_mismatched") == []
             and isinstance(hbm, dict) and "available" in hbm
-            and isinstance(rec.get("mesh"), dict))
+            and isinstance(rec.get("mesh"), dict)
+            and isinstance(fh, dict)
+            and fh.get("available") is True)
 
 
 def step_fleet():

@@ -83,12 +83,18 @@ MASKED_SCOPE = ("models",)
 #: fan-out (fleet/router.py), and the replica lifecycle's single
 #: ``.block_until_ready()`` is the device-liveness probe on the
 #: submesh lead (fleet/replica.py) — routing/policy/http modules keep
-#: the full rule.
+#: the full rule. ISSUE 12 adds the factor-health plane: its one
+#: declared sync is the ``np.asarray`` that materializes the tiny
+#: fused ``[F, 9]`` stats side-output (telemetry/factorplane.py) —
+#: the stats ride a fetch that already happened, and the
+#: materialization stays centralized there, never in an instrumented
+#: hot path.
 GLA3_BOUNDARY_SYNCS = {
     "serve/service.py": frozenset({"np.asarray"}),
     "telemetry/opsplane.py": frozenset({".memory_stats()",
                                         "jax.live_arrays"}),
     "telemetry/meshplane.py": frozenset({".block_until_ready()"}),
+    "telemetry/factorplane.py": frozenset({"np.asarray"}),
     "fleet/router.py": frozenset({"np.asarray"}),
     "fleet/replica.py": frozenset({".block_until_ready()"}),
 }

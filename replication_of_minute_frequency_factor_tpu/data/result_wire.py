@@ -316,7 +316,7 @@ def _unpack_host(buf: np.ndarray, spec: tuple):
 
 def decode_block(buf: np.ndarray, n_factors: int, days: int,
                  tickers: int, spill_rows: int, strict: bool = True,
-                 telemetry=None):
+                 telemetry=None, names: Optional[Sequence[str]] = None):
     """Dequantize one fetched payload back to ``([F, D, T] f32,
     verdict)``.
 
@@ -325,7 +325,16 @@ def decode_block(buf: np.ndarray, n_factors: int, days: int,
     lanes are NaN. ``verdict`` reports ``{quantized, widened, overflow,
     payload_bytes, f32_bytes, ratio}``; ``strict`` raises
     :class:`ResultWireOverflow` when any slice overflowed the spill
-    budget (the caller's cue to grow the floor)."""
+    budget (the caller's cue to grow the floor).
+
+    ``names`` (ISSUE 12) attributes the widen disposition PER FACTOR:
+    the verdict gains ``widened_by_factor`` (nonzero counts only) and
+    each factor's count lands in the ``result.widen_count{factor=}``
+    counter — the instrument behind the ROADMAP's open question (how
+    often do the strict-pinned volume factors widen on real data); the
+    spill-plane occupancy gauge ``result.spill_occupancy_frac``
+    (widened / budget) says how close the static budget is to its next
+    growth."""
     spec = payload_spec(n_factors, days, tickers, spill_rows)
     q, scale, offset, sidx, spill = _unpack_host(buf, spec)
     out = ((q.astype(np.float32) + np.float32(Q_LIM))
@@ -358,6 +367,25 @@ def decode_block(buf: np.ndarray, n_factors: int, days: int,
     tel.counter("result.decode_blocks")
     tel.counter("result.bytes", payload_bytes)
     tel.counter("result.widened_slices", verdict["widened"])
+    if names is not None:
+        if len(names) != n_factors:
+            raise ValueError(f"names has {len(names)} entries; payload "
+                             f"holds {n_factors} factors")
+        # widened OR overflowed slices both failed the round-trip
+        # check — the per-factor widen counters count the data truth,
+        # not what fit the spill budget
+        per_factor = ((sidx != SIDX_QUANTIZED).sum(axis=1)
+                      .astype(np.int64))
+        by_factor = {}
+        for n, c in zip(names, per_factor):
+            if c:
+                tel.counter("result.widen_count", int(c),
+                            factor=str(n))
+                by_factor[str(n)] = int(c)
+        verdict["widened_by_factor"] = by_factor
+        if spill_rows > 0:
+            tel.gauge("result.spill_occupancy_frac",
+                      round(verdict["widened"] / spill_rows, 6))
     if n_overflow:
         tel.counter("result.overflow_slices", n_overflow)
     if strict and n_overflow:

@@ -13,7 +13,11 @@ payloads:
   as long as ANY leg applied (failure isolation is the point — the
   response SAYS which legs failed/skipped), 503 only when none did.
 * ``GET /healthz`` — per-replica payloads (the shared ISSUE 11 shape)
-  + the pod rollup (live/demoted, policy states, stream cursor skew).
+  + the pod rollup (live/demoted, policy states, stream cursor skew,
+  and the ISSUE 12 ``factor_health`` block: each replica's
+  worst-coverage factor / widen rate / drift bursts read verbatim
+  from its own healthz payload, with the stream cursor skew beside
+  them).
 * ``GET /v1/metrics`` — the POD registry: the control plane + every
   replica registry folded through ``telemetry.aggregate``'s
   registry-merge (:func:`pod_registry` — counters exact, the PR 9

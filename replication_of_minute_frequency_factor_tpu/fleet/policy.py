@@ -67,6 +67,24 @@ class ShedPolicy:
         return budget > 0 and in_use > budget
 
     # --- transitions ----------------------------------------------------
+    def _factor_health_audit(self, replica) -> dict:
+        """The replica's factor-health snapshot at demote time (ISSUE
+        12): MEASURED data quality joins the demote-signal audit trail
+        — the event and the flight dump record what the factors looked
+        like when the machine-level signal fired — but it is NOT a
+        demote signal itself: only the breaker and measured HBM
+        demote. Never raises (an audit read must not block a state
+        flip)."""
+        try:
+            block = replica.telemetry.factorplane.summary()
+            return {"available": bool(block.get("available")),
+                    "worst_coverage": block.get("worst_coverage"),
+                    "widen_rate": block.get("widen_rate"),
+                    "drift_bursts": (block.get("drift")
+                                     or {}).get("bursts")}
+        except Exception:  # noqa: BLE001 — audit only
+            return {"available": False}
+
     def _demote(self, replica, reason: str) -> None:
         """candidate/probing -> demoted (caller holds the lock for the
         state flip; the dump runs outside it)."""
@@ -76,7 +94,9 @@ class ShedPolicy:
         self.telemetry.counter("fleet.demotions",
                                replica=replica.label, reason=reason)
         self.telemetry.event("fleet.demote", replica=replica.label,
-                             reason=reason)
+                             reason=reason,
+                             factor_health=self._factor_health_audit(
+                                 replica))
 
     def refresh(self) -> None:
         """One pass over the signals: demote tripped/over-budget
@@ -105,10 +125,14 @@ class ShedPolicy:
         for r, reason in dumps:
             # the anomaly evidence (ISSUE 11 acceptance): the demoted
             # replica's own flight recorder dumps its recent requests
-            # with the demotion naming it — forced, outside the lock
-            r.server.flight.dump("fleet_demote", force=True,
-                                 extra={"replica": r.label,
-                                        "reason": reason})
+            # with the demotion naming it — forced, outside the lock.
+            # The factor-health snapshot rides as audit context (ISSUE
+            # 12) — measured data quality at demote time, never a
+            # demote signal
+            r.server.flight.dump(
+                "fleet_demote", force=True,
+                extra={"replica": r.label, "reason": reason,
+                       "factor_health": self._factor_health_audit(r)})
 
     def note_result(self, label: str, ok: bool) -> None:
         """A routed request's outcome: a probing replica is restored on

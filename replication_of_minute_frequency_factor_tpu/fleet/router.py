@@ -363,6 +363,25 @@ class FactorFleet:
             payload["pod"]["stream_minute"] = max(minutes)
             payload["pod"]["stream_minute_skew"] = (max(minutes)
                                                     - min(minutes))
+        # pod factor-health rollup (ISSUE 12): the worst-coverage
+        # factor PER REPLICA (read verbatim from the shared healthz
+        # shape — nothing translated) with the stream cursor skew
+        # beside it: a replica whose data quality collapsed and a
+        # replica whose carry fell behind are the same triage page
+        fh = {}
+        for label, h in reps.items():
+            block = h.get("factor_health") or {}
+            fh[label] = {
+                "available": bool(block.get("available")),
+                "worst_coverage": block.get("worst_coverage"),
+                "widen_rate": block.get("widen_rate"),
+                "drift_bursts": (block.get("drift") or {}).get("bursts"),
+            }
+        payload["pod"]["factor_health"] = {
+            "replicas": fh,
+            "stream_minute_skew": payload["pod"].get(
+                "stream_minute_skew"),
+        }
         return payload
 
     def pod_registry(self):

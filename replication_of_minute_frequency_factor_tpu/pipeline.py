@@ -31,6 +31,7 @@ from .data import result_wire
 from .data import wire
 from .data.minute import grid_day
 from .models.registry import compute_factors, compute_factors_jit, factor_names
+from .telemetry.factorplane import factor_stats_block as _factor_stats_block
 
 
 def _compute_from_wire_fn(base, dclose, dohl, volume, maskbits, vol_scale,
@@ -69,7 +70,7 @@ def _compute_from_wire(base, dclose, dohl, volume, maskbits, vol_scale,
 
 
 def _compute_packed(buf, spec, kind, names, replicate_quirks,
-                    rolling_impl, result_spec=None):
+                    rolling_impl, result_spec=None, factor_stats=False):
     """Single-buffer variant of the fused graph: ONE uint8 input (unpacked
     by static-offset bitcasts on device) and ONE stacked ``[F, ...]``
     output, so a batch costs one transfer each way over the tunnel instead
@@ -80,7 +81,14 @@ def _compute_packed(buf, spec, kind, names, replicate_quirks,
     fuses the RESULT wire as the graph's final stage: the output becomes
     the packed quantized payload (``[L] uint8``) instead of the raw f32
     stack — the device->host leg's analogue of the ingest wire (ISSUE
-    10); ``None`` keeps the raw-f32 result contract."""
+    10); ``None`` keeps the raw-f32 result contract.
+
+    ``factor_stats`` (ISSUE 12) fuses the per-factor data-quality
+    sketch (:func:`..telemetry.factorplane.factor_stats_block`) as a
+    SIDE-output: the return becomes ``(result, stats [F, 9])`` — the
+    tiny stats ride the result's fetch, adding zero device->host round
+    trips; the result itself is bitwise unchanged (the stats read the
+    stacked block, they never rewrite it)."""
     arrs = wire.unpack(buf, spec)
     if kind == "wire":
         bars, m = wire.decode(*arrs)
@@ -91,13 +99,19 @@ def _compute_packed(buf, spec, kind, names, replicate_quirks,
                           replicate_quirks=replicate_quirks,
                           rolling_impl=rolling_impl)
     stacked = jnp.stack([out[n] for n in names])
+    stats = (_factor_stats_block(
+        stacked if factor_stats is True
+        else stacked[..., :int(factor_stats)])
+        if factor_stats else None)
     if result_spec is not None:
-        return result_wire.encode_block(stacked, result_spec)
+        stacked = result_wire.encode_block(stacked, result_spec)
+    if factor_stats:
+        return stacked, stats
     return stacked
 
 
 _PACKED_STATIC = ("spec", "kind", "names", "replicate_quirks",
-                  "rolling_impl", "result_spec")
+                  "rolling_impl", "result_spec", "factor_stats")
 _compute_packed_jit = functools.partial(
     jax.jit, static_argnames=_PACKED_STATIC)(_compute_packed)
 #: donated twin: the multi-MB packed day buffer is dead the moment the
@@ -124,7 +138,8 @@ def _donate_device_buffers(cfg: Optional["Config"] = None) -> bool:
 
 
 def compute_packed_prepared(buf, spec, kind, names, replicate_quirks=True,
-                            rolling_impl=None, result_spec=None):
+                            rolling_impl=None, result_spec=None,
+                            factor_stats=False):
     """Device half of the packed path: one device_put of an already-packed
     buffer -> fused graph -> stacked [len(names), D, T] result (still on
     device). The streaming pipeline packs on its producer thread and
@@ -140,20 +155,23 @@ def compute_packed_prepared(buf, spec, kind, names, replicate_quirks=True,
     fn = (_compute_packed_jit_donated if _donate_device_buffers()
           else _compute_packed_jit)
     return fn(jax.device_put(buf), spec, kind, names,
-              replicate_quirks, rolling_impl, result_spec)
+              replicate_quirks, rolling_impl, result_spec,
+              factor_stats)
 
 
 def compute_packed(arrays, kind, names, replicate_quirks=True,
-                   rolling_impl=None, result_spec=None):
+                   rolling_impl=None, result_spec=None,
+                   factor_stats=False):
     """One-call packed path: pack + transfer + compute (see above)."""
     buf, spec = wire.pack_arrays(arrays)
     return compute_packed_prepared(buf, spec, kind, names,
                                    replicate_quirks, rolling_impl,
-                                   result_spec)
+                                   result_spec, factor_stats)
 
 
 def _compute_packed_scan(bufs, spec, kind, names, replicate_quirks,
-                         rolling_impl, result_spec=None):
+                         rolling_impl, result_spec=None,
+                         factor_stats=False):
     """Device-resident multi-batch variant: a whole year of packed
     buffers in ONE executable.
 
@@ -184,16 +202,29 @@ def _compute_packed_scan(bufs, spec, kind, names, replicate_quirks,
                               replicate_quirks=replicate_quirks,
                               rolling_impl=rolling_impl)
         y = jnp.stack([out[n] for n in names])
+        # per-factor data-quality sketch as a fused side-output
+        # (ISSUE 12): computed from the raw stacked block BEFORE any
+        # result-wire encode, accumulated [N, F, 9] alongside the main
+        # accumulator so it rides the year's consolidated fetch; with
+        # factor_stats off the traced jaxpr is byte-identical to the
+        # pre-ISSUE-12 module (no phantom zero accumulator). An int
+        # value restricts the sketch to the leading tickers (the
+        # logical universe — pad lanes must not read as missing data).
+        st = (_factor_stats_block(
+            y if factor_stats is True else y[..., :int(factor_stats)])
+            if factor_stats else None)
         if result_spec is not None:
             # result wire fused as the scan body's FINAL stage (ISSUE
             # 10): each step emits its batch's packed quantized payload,
             # so the year's accumulator is [N, L] uint8 instead of
             # [N, F, D, T] f32 — the fetch ships ~half the bytes
             y = result_wire.encode_block(y, result_spec)
-        return None, y
+        return None, ((y, st) if factor_stats else y)
 
     _, ys = jax.lax.scan(body, None, stacked)
-    return ys  # [N, F, D, T] f32, or [N, L] u8 through the result wire
+    # [N, F, D, T] f32 or [N, L] u8 through the result wire; with
+    # factor_stats the (result, [N, F, 9] stats) tuple
+    return ys
 
 
 _compute_packed_scan_jit = functools.partial(
@@ -261,7 +292,7 @@ def _invalidate_donated(arrs) -> None:
 
 def compute_packed_resident(dbufs, spec, kind, names,
                             replicate_quirks=True, rolling_impl=None,
-                            result_spec=None):
+                            result_spec=None, factor_stats=False):
     """Run N device-resident packed buffers through one fused scan
     executable; returns the stacked [N, F, D, T] result STILL ON DEVICE
     (callers fetch once). ``dbufs``: tuple of device uint8 buffers that
@@ -281,7 +312,7 @@ def compute_packed_resident(dbufs, spec, kind, names,
     fn = (_compute_packed_scan_jit_donated if donating
           else _compute_packed_scan_jit)
     out = fn(tuple(dbufs), spec, kind, names,
-             replicate_quirks, rolling_impl, result_spec)
+             replicate_quirks, rolling_impl, result_spec, factor_stats)
     if donating:
         _invalidate_donated(dbufs)
     return out
@@ -289,7 +320,7 @@ def compute_packed_resident(dbufs, spec, kind, names,
 
 def lower_packed_resident(dbufs, spec, kind, names,
                           replicate_quirks=True, rolling_impl=None,
-                          result_spec=None):
+                          result_spec=None, factor_stats=False):
     """AOT lowering of the resident scan executable (same twin
     selection as :func:`compute_packed_resident`). bench routes the
     first build through ``telemetry.attribution.compile_with_telemetry``
@@ -301,12 +332,13 @@ def lower_packed_resident(dbufs, spec, kind, names,
     fn = (_compute_packed_scan_jit_donated if _donate_device_buffers()
           else _compute_packed_scan_jit)
     return fn.lower(tuple(dbufs), spec, kind, names,
-                    replicate_quirks, rolling_impl, result_spec)
+                    replicate_quirks, rolling_impl, result_spec,
+                    factor_stats)
 
 
 def _compute_packed_scan_sharded(stacked, spec, kind, names,
                                  replicate_quirks, rolling_impl, mesh,
-                                 result_spec=None):
+                                 result_spec=None, factor_stats=False):
     """Mesh-native twin of :func:`_compute_packed_scan`: the resident
     year as ONE scan executable whose data parallelism spans the
     tickers axis of a ``(days=1, tickers=n)`` mesh.
@@ -349,6 +381,20 @@ def _compute_packed_scan_sharded(stacked, spec, kind, names,
                    in_specs=(packed_year_spec(),),
                    out_specs=scan_output_spec())
     ys = fn(stacked)
+    stats = None
+    if factor_stats:
+        # the data-quality sketch sits OUTSIDE the shard_map for the
+        # same reason as the result-wire encode below: its reductions
+        # span the tickers axis — i.e. cross-shard — so GSPMD owns the
+        # collectives and the statistics are the GLOBAL ones. Counts
+        # and min/max are exactly associative (bit-comparable with the
+        # single-device module); the f32 moment sums carry an
+        # ulp-level pin (reduction order is GSPMD's). An int value
+        # restricts the sketch to the leading LOGICAL tickers so the
+        # lcm pad lanes never read as missing data.
+        block = (ys if factor_stats is True
+                 else ys[..., :int(factor_stats)])
+        stats = jax.vmap(_factor_stats_block)(block)
     if result_spec is not None:
         # result-wire encode sits OUTSIDE the shard_map but INSIDE this
         # one jitted module: the per-(factor, day) min/max is a
@@ -357,6 +403,8 @@ def _compute_packed_scan_sharded(stacked, spec, kind, names,
         # GLOBAL ones (bit-comparable with the single-device encode;
         # min/max are exactly associative)
         ys = result_wire.encode_stacked(ys, result_spec)
+    if factor_stats:
+        return ys, stats
     return ys
 
 
@@ -374,7 +422,8 @@ _compute_packed_scan_sharded_jit_donated = functools.partial(
 def compute_packed_resident_sharded(stacked, spec, kind, mesh, names,
                                     replicate_quirks=True,
                                     rolling_impl=None,
-                                    result_spec=None):
+                                    result_spec=None,
+                                    factor_stats=False):
     """Sharded resident scan over a mesh-placed ``[N, S, L]`` packed
     year (see :func:`_compute_packed_scan_sharded`); returns
     ``[N, F, D, T]`` STILL SHARDED on device — fetch once per scan
@@ -390,7 +439,7 @@ def compute_packed_resident_sharded(stacked, spec, kind, mesh, names,
     fn = (_compute_packed_scan_sharded_jit_donated if donating
           else _compute_packed_scan_sharded_jit)
     out = fn(stacked, spec, kind, names, replicate_quirks,
-             rolling_impl, mesh, result_spec)
+             rolling_impl, mesh, result_spec, factor_stats)
     if donating:
         _invalidate_donated((stacked,))
     return out
@@ -399,7 +448,8 @@ def compute_packed_resident_sharded(stacked, spec, kind, mesh, names,
 def lower_packed_resident_sharded(stacked, spec, kind, mesh, names,
                                   replicate_quirks=True,
                                   rolling_impl=None,
-                                  result_spec=None):
+                                  result_spec=None,
+                                  factor_stats=False):
     """AOT lowering of the SHARDED resident scan (twin selection as
     :func:`compute_packed_resident_sharded`); call the compiled
     executable with ``compiled(stacked)``. See
@@ -411,7 +461,7 @@ def lower_packed_resident_sharded(stacked, spec, kind, mesh, names,
           if _donate_device_buffers()
           else _compute_packed_scan_sharded_jit)
     return fn.lower(stacked, spec, kind, names, replicate_quirks,
-                    rolling_impl, mesh, result_spec)
+                    rolling_impl, mesh, result_spec, factor_stats)
 
 
 def compute_exposures_streamed(bars, mask, names=None, micro_batch=16,

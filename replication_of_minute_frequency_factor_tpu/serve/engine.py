@@ -32,6 +32,7 @@ from ..data import result_wire
 from ..data import wire
 from ..eval_ops import _qcut_labels_jit, ic_series
 from ..models.registry import compute_factors
+from ..telemetry.factorplane import factor_stats_block
 from .executables import ExecutableCache
 
 
@@ -39,7 +40,11 @@ def _block_fn(buf, spec, kind, names, replicate_quirks, rolling_impl):
     """The fused block graph: one packed uint8 buffer in, the whole
     query-answering state out. ``close`` is each (day, ticker)'s last
     valid bar's close (NaN when the day has no valid bar) — the basis
-    for the forward returns IC/decile queries correlate against."""
+    for the forward returns IC/decile queries correlate against.
+    ``stats`` (ISSUE 12) is the per-factor data-quality sketch fused
+    as a side-output of the SAME module — the request loop feeds it to
+    the factor-health plane at the block-build boundary, zero extra
+    dispatches."""
     arrs = wire.unpack(buf, spec)
     if kind == "wire":
         bars, m = wire.decode(*arrs)
@@ -56,7 +61,7 @@ def _block_fn(buf, spec, kind, names, replicate_quirks, rolling_impl):
     close = jnp.take_along_axis(
         bars[..., 3], jnp.maximum(last, 0)[..., None], axis=-1)[..., 0]
     close = jnp.where(valid, close, jnp.nan)
-    return exposures, close, valid
+    return exposures, close, valid, factor_stats_block(exposures)
 
 
 _BLOCK_STATIC = ("spec", "kind", "names", "replicate_quirks",
@@ -170,8 +175,9 @@ class ServeEngine:
             lambda: _block_jit.lower(dbuf, spec, kind, self.names,
                                      self.replicate_quirks,
                                      self.rolling_impl))
-        exposures, close, valid = compiled(dbuf)
-        block = {"exposures": exposures, "close": close, "valid": valid}
+        exposures, close, valid, stats = compiled(dbuf)
+        block = {"exposures": exposures, "close": close, "valid": valid,
+                 "stats": stats}
         # device bytes this block pins (shape metadata, not a sync):
         # the HBM signal the exposure-cache LRU budget is set against
         self._tel().gauge("serve.block_bytes", sum(

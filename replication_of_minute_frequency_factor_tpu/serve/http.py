@@ -29,7 +29,10 @@ Endpoints:
   dump directory is configured anywhere).
 * ``GET /healthz`` — liveness: breaker state, uptime, queue depth,
   flight-recorder counts, HBM-stats availability (+ the stream
-  carry's minute cursor when streaming is on).
+  carry's minute cursor when streaming is on), and the
+  ``factor_health`` data-quality block (ISSUE 12: worst-coverage
+  factor, result-wire widen rate, drift bursts) — the same shape the
+  fleet front door rolls up per replica.
 * ``GET /v1/metrics`` — the telemetry registry: JSON snapshot by
   default; the standard Prometheus text format (v0.0.4) when the
   request asks for it (``Accept: text/plain`` / ``application/

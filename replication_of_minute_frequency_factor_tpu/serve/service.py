@@ -213,6 +213,12 @@ class FactorServer:
         self.flight = FlightRecorder(telemetry=self.telemetry,
                                      ring=self.scfg.flight_ring,
                                      dump_dir=self.scfg.flight_dir)
+        #: factor-health plane (ISSUE 12): drift bursts dump through
+        #: THIS server's flight recorder into the same flight_dir, so
+        #: a factor_drift_burst capture sits next to the breaker-trip
+        #: ones and carries the recent request ring
+        self.telemetry.factorplane.configure(
+            dump_dir=self.scfg.flight_dir, flight=self.flight)
         self._t_start = time.monotonic()
         self._dispatch_seq = 0  # worker-thread-only; no lock needed
         if self.scfg.hbm_sample_period_s > 0:
@@ -443,6 +449,12 @@ class FactorServer:
             "replica": {"label": self.replica_label,
                         "devices": device_names,
                         "breaker": self.breaker_state()},
+            # factor-health block (ISSUE 12): the data-quality view —
+            # worst-coverage factor, widen rate, drift bursts — shared
+            # VERBATIM by the standalone endpoint and every fleet
+            # replica (the pod rollup reads these, nothing translated),
+            # like the replica identity block above
+            "factor_health": self.telemetry.factorplane.summary(),
         }
         if self.stream_engine is not None:
             payload["stream_minute"] = self.stream_engine.minutes
@@ -599,26 +611,38 @@ class FactorServer:
             try:
                 t0 = time.perf_counter()
                 if self.scfg.result_wire:
-                    # one fused finalize+encode dispatch; the answer is
-                    # the host dequantize of the fetched payload
+                    # one fused finalize+encode(+stats) dispatch; the
+                    # answer is the host dequantize of the fetched
+                    # payload, and the per-factor quality sketch rode
+                    # the same fetch (ISSUE 12)
                     from ..data import result_wire as _rw
-                    payload, ready = self.stream_engine.snapshot_wire()
+                    eng = self.stream_engine
+                    payload, ready, st = eng.snapshot_wire_stats()
                     pay = np.asarray(payload)   # the boundary sync
                     rdy = np.asarray(ready)
-                    eng = self.stream_engine
                     exp, _v = _rw.decode_block(
                         pay, len(eng.names), 1, eng.n_tickers,
                         eng.result_spec.spill_rows,
-                        telemetry=self.telemetry)
+                        telemetry=self.telemetry,
+                        names=self.names)
                     exp = exp[:, 0, :]
                     self.telemetry.counter("serve.result_wire_answers")
                     self.telemetry.counter("serve.result_wire_bytes",
                                            _v["payload_bytes"])
                 else:
-                    exposures, ready = self.stream_engine.snapshot()
+                    exposures, ready, st = \
+                        self.stream_engine.snapshot_stats()
                     exp = np.asarray(exposures)   # the boundary sync
                     rdy = np.asarray(ready)
                 block_s = time.perf_counter() - t0
+                # factor-health sample (ISSUE 12): fused stats +
+                # per-factor readiness fraction + the carry's minute —
+                # the stream's data-level lag signal
+                tel.factorplane.observe_stream(
+                    self.names, st,
+                    ready_frac=rdy.mean(axis=1),
+                    minute=self.stream_engine.minutes,
+                    boundary="serve.intraday")
                 tel.observe("serve.stage_seconds", block_s,
                             stage="block")
             except Exception as e:  # noqa: BLE001 — fail the group, shed
@@ -721,6 +745,15 @@ class FactorServer:
             if len(group) > 1:
                 tel.counter("serve.coalesced_dispatches")
                 tel.counter("serve.coalesced_requests", len(group))
+            if not cached and block.get("stats") is not None:
+                # factor-health sample (ISSUE 12): the fused [F, 9]
+                # sketch rode the block's own module — one sample per
+                # block BUILD (cache hits re-serve already-observed
+                # data). Materializing it here fronts the same block
+                # wait the first answer's fetch pays; no extra wall
+                tel.factorplane.observe_block(self.names,
+                                              block["stats"],
+                                              boundary="serve.block")
             fetched: dict = {}
             ok = True
             for p in group:
@@ -813,6 +846,11 @@ class FactorServer:
                 "ic": ic.tolist(), "rank_ic": rank_ic.tolist(),
                 "mean_ic": _finite_mean(ic),
                 "mean_rank_ic": _finite_mean(rank_ic)})
+            # realized-IC health (ISSUE 12): the existing AOT IC graph
+            # already produced the number whenever horizon data was
+            # available — the plane only rolls it per (factor, horizon)
+            self.telemetry.factorplane.note_ic(
+                q.factor, out["mean_ic"], horizon=q.horizon)
             return out
         _labels, counts, mean_ret = self.engine.decile(
             block, q.factor, q.horizon, q.group_num)

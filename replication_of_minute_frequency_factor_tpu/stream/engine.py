@@ -46,6 +46,7 @@ import numpy as np
 
 from ..data import result_wire
 from ..serve.executables import ExecutableCache
+from ..telemetry.factorplane import factor_stats_block
 from . import carry as carry_mod
 
 
@@ -118,6 +119,29 @@ class StreamEngine:
             return payload, ready
 
         self._snapshot_wire_jit = jax.jit(_snap_wire)
+
+        #: factor-health snapshots (ISSUE 12): the SAME finalize graph
+        #: with the per-factor data-quality sketch fused as a third
+        #: output — the tiny [F, 9] stats ride the snapshot fetch, so
+        #: the data-quality plane costs zero extra dispatches. The
+        #: exposures/readiness outputs are bitwise the plain
+        #: snapshot's (the stats read, never rewrite).
+        def _snap_stats(c):
+            exposures, ready = carry_mod.finalize_with_readiness(
+                c, self.names, self.replicate_quirks, self.rolling_impl)
+            return exposures, ready, factor_stats_block(exposures)
+
+        self._snapshot_stats_jit = jax.jit(_snap_stats)
+
+        def _snap_wire_stats(c):
+            exposures, ready = carry_mod.finalize_with_readiness(
+                c, self.names, self.replicate_quirks, self.rolling_impl)
+            stats = factor_stats_block(exposures)
+            payload = result_wire.encode_block(
+                exposures[:, None, :], self.result_spec)
+            return payload, ready, stats
+
+        self._snapshot_wire_stats_jit = jax.jit(_snap_wire_stats)
         self.carry = None
         #: host-side minute cursor mirror (no device read needed for
         #: gauges or over-ingest guards)
@@ -192,6 +216,11 @@ class StreamEngine:
         if snapshot:
             self._exe("stream_snapshot", (), self._snapshot_jit,
                       self.carry)
+            # the factor-health snapshot (ISSUE 12) warms alongside so
+            # the serve layer's intraday path stays compile-free under
+            # load with the data-quality plane on
+            self._exe("stream_snapshot_stats", (),
+                      self._snapshot_stats_jit, self.carry)
 
     # --- ingest ---------------------------------------------------------
     def ingest_minutes(self, bars: np.ndarray,
@@ -299,3 +328,38 @@ class StreamEngine:
         self.telemetry.counter("stream.snapshots", kind="wire")
         self.telemetry.hbm.sample("stream.snapshot")
         return payload, ready
+
+    def snapshot_stats(self):
+        """:meth:`snapshot` with the per-factor data-quality sketch
+        fused as a third output (ISSUE 12): DEVICE ``(exposures [F, T],
+        ready [F, T], stats [F, 9])`` in ONE warm dispatch — the stats
+        ride the snapshot's fetch, zero extra round trips. Exposures
+        and readiness are bitwise the plain snapshot's; the boundary
+        module materializes and feeds
+        ``telemetry.factorplane.observe_stream``."""
+        exe = self._exe("stream_snapshot_stats", (),
+                        self._snapshot_stats_jit, self.carry)
+        t0 = time.perf_counter()
+        exposures, ready, stats = exe(self.carry)
+        self.telemetry.observe("stream.snapshot_seconds",
+                               time.perf_counter() - t0)
+        self.telemetry.counter("stream.snapshots")
+        self.telemetry.hbm.sample("stream.snapshot")
+        return exposures, ready, stats
+
+    def snapshot_wire_stats(self):
+        """:meth:`snapshot_wire` with the fused data-quality sketch
+        (ISSUE 12): DEVICE ``(payload [L] u8, ready [F, T],
+        stats [F, 9])`` in one warm dispatch. The stats are computed
+        from the raw exposures BEFORE the result-wire encode, so the
+        quality numbers are the pre-quantization truth."""
+        exe = self._exe("stream_snapshot_wire_stats",
+                        (self.result_spec,),
+                        self._snapshot_wire_stats_jit, self.carry)
+        t0 = time.perf_counter()
+        payload, ready, stats = exe(self.carry)
+        self.telemetry.observe("stream.snapshot_seconds",
+                               time.perf_counter() - t0)
+        self.telemetry.counter("stream.snapshots", kind="wire")
+        self.telemetry.hbm.sample("stream.snapshot")
+        return payload, ready, stats

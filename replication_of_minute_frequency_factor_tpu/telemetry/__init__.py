@@ -4,7 +4,7 @@ One injectable :class:`Telemetry` object bundles the four pieces every
 layer emits into:
 
 * a :class:`.registry.MetricsRegistry` — counters, gauges, bounded
-  histograms (p50/p95/max) keyed by name+labels;
+  histograms (p50/p95/p99/max) keyed by name+labels;
 * a :class:`.spans.SpanTracer` — nesting span context managers with
   ``Timer`` semantics, ``jax.profiler`` annotation, Chrome/Perfetto
   ``trace_events`` export;
@@ -31,6 +31,7 @@ from typing import Dict, List, Optional
 
 from ..utils.tracing import Timer
 from .attribution import TraceCapture, reconcile
+from .factorplane import FactorPlane
 from .meshplane import MeshPlane
 from .opsplane import (FlightRecorder, HbmSampler, canonical_trace_id,
                        gen_trace_id, to_prometheus)
@@ -39,8 +40,8 @@ from .sink import SCHEMA_VERSION, EventSink, validate_jsonl, validate_record
 from .spans import SpanTracer
 
 __all__ = [
-    "SCHEMA_VERSION", "EventSink", "FlightRecorder", "HbmSampler",
-    "Histogram", "MeshPlane", "MetricsRegistry", "SpanTracer",
+    "SCHEMA_VERSION", "EventSink", "FactorPlane", "FlightRecorder",
+    "HbmSampler", "Histogram", "MeshPlane", "MetricsRegistry", "SpanTracer",
     "StageTimer", "Telemetry", "TraceCapture", "canonical_trace_id",
     "gen_trace_id", "get_telemetry", "reconcile", "render_key",
     "set_telemetry", "to_prometheus", "validate_jsonl",
@@ -98,6 +99,7 @@ class Telemetry:
         self._requests_dropped = 0
         self._hbm: Optional[HbmSampler] = None
         self._meshplane: Optional[MeshPlane] = None
+        self._factorplane: Optional[FactorPlane] = None
         self._lock = threading.Lock()
 
     @property
@@ -123,6 +125,20 @@ class Telemetry:
                 if self._meshplane is None:
                     self._meshplane = MeshPlane(telemetry=self)
         return self._meshplane
+
+    @property
+    def factorplane(self) -> FactorPlane:
+        """The per-factor data-quality sampler bound to this telemetry
+        (created on first use; ISSUE 12). Boundary modules feed it the
+        fused ``[F, 9]`` stats side-outputs —
+        ``tel.factorplane.observe_block(names, stats, boundary)`` —
+        never-raising and fetch-free by contract (the stats already
+        rode the caller's consolidated fetch)."""
+        if self._factorplane is None:
+            with self._lock:
+                if self._factorplane is None:
+                    self._factorplane = FactorPlane(telemetry=self)
+        return self._factorplane
 
     # --- emit -----------------------------------------------------------
     def counter(self, name: str, value: float = 1.0, **labels) -> None:

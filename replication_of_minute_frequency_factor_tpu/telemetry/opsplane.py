@@ -26,7 +26,7 @@ bench loops) report through:
 
 * :func:`to_prometheus` — the standard Prometheus text exposition of a
   :class:`..telemetry.registry.MetricsRegistry` (counters, gauges,
-  histogram-as-summary quantiles, with labels), rendered from ONE
+  histogram-as-summary p50/p95/p99 quantiles, with labels), rendered from ONE
   atomic ``records()`` read so a concurrent scrape can never observe a
   torn snapshot. ``GET /v1/metrics`` content-negotiates it.
 """
@@ -450,8 +450,10 @@ def to_prometheus(registry: MetricsRegistry) -> str:
     """The registry as Prometheus text-format v0.0.4.
 
     Counters render with the conventional ``_total`` suffix, gauges
-    as-is, histograms as summaries (``quantile="0.5"/"0.95"`` from the
-    bounded reservoir plus exact ``_sum``/``_count``). Metric and label
+    as-is, histograms as summaries (``quantile="0.5"/"0.95"/"0.99"``
+    from the bounded reservoir plus exact ``_sum``/``_count`` — the
+    p99 tail joined with ISSUE 12, since the regress gate already
+    rides ``request_p99_ms``). Metric and label
     names are sanitized to the Prometheus charset; everything is
     rendered from one atomic ``registry.records()`` read, so a scrape
     concurrent with writers is internally consistent."""
@@ -477,7 +479,8 @@ def to_prometheus(registry: MetricsRegistry) -> str:
                          f"{_prom_value(rec['value'])}")
         else:  # histogram -> summary
             _type(base, "summary")
-            for q, field in (("0.5", "p50"), ("0.95", "p95")):
+            for q, field in (("0.5", "p50"), ("0.95", "p95"),
+                             ("0.99", "p99")):
                 v = rec.get(field)
                 if v is not None:
                     lines.append(

@@ -91,27 +91,32 @@ class Histogram:
                 "sum": round(self.total, 9),
                 "min": self.min, "max": self.max,
                 "p50": self.percentile(0.50),
-                "p95": self.percentile(0.95)}
+                "p95": self.percentile(0.95),
+                # the tail quantile the ops plane exports and regress
+                # gates (ISSUE 12 satellite: request_p99_ms was gated
+                # from bench records while the scrape stopped at p95)
+                "p99": self.percentile(0.99)}
 
     @classmethod
     def from_stats(cls, count, total, vmin=None, vmax=None,
-                   p50=None, p95=None, bound: int = HIST_BOUND
+                   p50=None, p95=None, p99=None, bound: int = HIST_BOUND
                    ) -> "Histogram":
         """Reconstitute a histogram from its persisted JSONL stats
         (ISSUE 9: ``telemetry.aggregate`` rebuilding per-host
         registries from their written bundles). ``count``/``sum``/
         ``min``/``max`` are exact — merging reconstituted histograms
         keeps pod counts and sums equal to the per-host sums by
-        construction; the reservoir is re-seeded from the four known
-        order statistics, so merged percentiles are APPROXIMATE (the
-        full sample stream is not persisted) and are documented as
-        such in the pod bundle."""
+        construction; the reservoir is re-seeded from the known order
+        statistics, so merged percentiles are APPROXIMATE (the full
+        sample stream is not persisted) and are documented as such in
+        the pod bundle."""
         h = cls(bound)
         h.count = int(count)
         h.total = float(total)
         h.min = None if vmin is None else float(vmin)
         h.max = None if vmax is None else float(vmax)
-        h._samples = sorted(float(v) for v in (vmin, p50, p95, vmax)
+        h._samples = sorted(float(v)
+                            for v in (vmin, p50, p95, p99, vmax)
                             if v is not None)
         return h
 
@@ -244,7 +249,8 @@ class MetricsRegistry:
         if kind == "histogram":
             h = Histogram.from_stats(rec["count"], rec["sum"],
                                      rec.get("min"), rec.get("max"),
-                                     rec.get("p50"), rec.get("p95"))
+                                     rec.get("p50"), rec.get("p95"),
+                                     rec.get("p99"))
             k = _key(name, labels)
             with self._lock:
                 mine = self._hists.get(k)

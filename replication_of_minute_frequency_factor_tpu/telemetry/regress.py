@@ -82,6 +82,17 @@ regression the wall-clock headline hides until it IS the wall) and
 ``<metric>.pad_waste_frac`` (the lcm ticker-padding waste — a universe
 or shard-count change that silently doubles dead lanes flags here).
 
+Factor-health sub-series (ISSUE 12, same availability contract): a
+record whose ``factor_health.available`` is true (the fused per-factor
+stats side-output actually sampled) contributes
+``<metric>.coverage_frac`` (the worst per-factor coverage — missing
+DATA, which no machine-level gauge sees) and, when result-wire slices
+were observed, ``<metric>.widen_rate`` (the fraction of per-(factor,
+day) slices that failed their pinned round-trip bound and shipped
+bitwise f32 — the ROADMAP's log-transform decision input). Declared-
+break semantics ride the parent's methodology like every derived
+series.
+
 Baseline = median of every record in the group EXCEPT the latest; the
 latest is the record under test. ``--check FILE`` instead gates a fresh
 candidate record against the baseline of the FULL banked group (the
@@ -296,6 +307,33 @@ def derive_records(record: dict) -> List[dict]:
                             "methodology": meth,
                             "derived_from":
                                 f"{block_key}.bytes_per_day"})
+    # factor-health sub-series (ISSUE 12): gated on
+    # factor_health.available — only records whose dispatches actually
+    # carried the fused stats side-output seed or gate these.
+    # widen_rate additionally requires observed result-wire slices
+    # (None when the wire was off — a wire-less record must not gate a
+    # widen baseline at 0). Both directions flag: a widen-rate JUMP
+    # means slices stopped fitting their pinned bounds (the
+    # log-transform question), a silent DROP to ~0 usually means the
+    # per-factor attribution went dark; a coverage DROP is missing
+    # data, a jump means the mask/universe changed shape.
+    fh = record.get("factor_health")
+    if isinstance(fh, dict) and fh.get("available"):
+        wr = fh.get("widen_rate")
+        if isinstance(wr, (int, float)) and not isinstance(wr, bool) \
+                and (fh.get("widen") or {}).get("slices"):
+            out.append({"metric": f"{metric}.widen_rate",
+                        "value": float(wr), "unit": "frac",
+                        "methodology": meth,
+                        "derived_from": "factor_health.widen_rate"})
+        cov = fh.get("coverage_frac")
+        if isinstance(cov, (int, float)) and not isinstance(cov, bool) \
+                and cov > 0:
+            out.append({"metric": f"{metric}.coverage_frac",
+                        "value": float(cov), "unit": "frac",
+                        "methodology": meth,
+                        "derived_from":
+                            "factor_health.coverage_frac"})
     # mesh balance sub-series (ISSUE 9): gated on mesh.available — only
     # records with REAL shard watermarks (telemetry/meshplane.py) seed
     # or gate the balance baselines

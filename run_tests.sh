@@ -74,6 +74,16 @@ print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     # line, nonzero exit on drift or a sub-1.5x ratio
     run python -c "import json, sys, bench; r = bench.result_wire_smoke(); \
 print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
+    # factor-health smoke (ISSUE 12): the per-factor data-quality
+    # plane end to end on a seeded day — the fused on-device stats of
+    # all 58 factors must match a host-side numpy recompute (counts +
+    # min/max exact, moments within f32 reduction tolerance) with the
+    # exposures bitwise unchanged, a stable pass must dump nothing,
+    # and an injected coverage collapse must produce a validated
+    # flight dump naming the factor; one JSON verdict line, nonzero
+    # on drift
+    run python -c "import json, sys, bench; r = bench.factorplane_smoke(); \
+print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     # ops-plane smoke (ISSUE 8): a streaming FactorServer + HTTP under
     # mixed ingest+query load — X-Trace-Id round-trip with the request
     # lifecycle reconstructible from the bundle, Prometheus scrape

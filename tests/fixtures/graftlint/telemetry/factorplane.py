@@ -1,0 +1,12 @@
+"""GL-A3 boundary-policy fixture (ISSUE 12): this path matches the
+policy key ``telemetry/factorplane.py`` (ast_tier.GLA3_BOUNDARY_SYNCS),
+whose allowed set is exactly ``{"np.asarray"}`` — the tiny fused-stats
+materialization must NOT flag here, every other sync symbol still must
+(a boundary module is not a blanket exclusion)."""
+import numpy as np
+
+
+def observe(stats_dev):
+    stats = np.asarray(stats_dev)       # allowed by the boundary policy
+    stats_dev.block_until_ready()       # NOT allowed: still flags
+    return stats

@@ -41,7 +41,7 @@ def _codes_by_file(violations):
 @pytest.fixture(scope="module")
 def fixture_violations():
     violations, n_files = run_ast_tier(FIXTURES, display_base=REPO)
-    assert n_files == 14
+    assert n_files == 15
     return violations
 
 
@@ -114,11 +114,12 @@ def test_a3_boundary_policy_is_not_a_blanket_exclusion(
 
 
 def test_a3_policy_matches_the_real_request_loop():
-    """The committed policy has exactly five entries — the serving
+    """The committed policy has exactly six entries — the serving
     request loop with its one declared sync, the ops-plane sampler
     with its device-memory reads (ISSUE 8), the mesh-plane
-    shard-watermark prober with its per-shard blocking (ISSUE 9), and
-    the fleet layer's two boundaries (ISSUE 11: the router's one
+    shard-watermark prober with its per-shard blocking (ISSUE 9), the
+    factor-health plane's one fused-stats materialization (ISSUE 12),
+    and the fleet layer's two boundaries (ISSUE 11: the router's one
     ingest normalization, the replica lifecycle's one device-liveness
     block) — and scanning the real package stays clean under it (the
     policy is load-bearing: docs list it)."""
@@ -129,6 +130,7 @@ def test_a3_policy_matches_the_real_request_loop():
         "telemetry/opsplane.py": frozenset({".memory_stats()",
                                             "jax.live_arrays"}),
         "telemetry/meshplane.py": frozenset({".block_until_ready()"}),
+        "telemetry/factorplane.py": frozenset({"np.asarray"}),
         "fleet/router.py": frozenset({"np.asarray"}),
         "fleet/replica.py": frozenset({".block_until_ready()"})}
     violations, _ = ast_tier.run_ast_tier()
@@ -175,6 +177,17 @@ def test_a3_meshplane_boundary_allows_blocking_only(
     telemetry module (sampler_like's scope test covers the layer)."""
     hits = _codes_by_file(fixture_violations)["meshplane.py"]
     assert [(c, s) for c, _, s in hits] == [("GL-A3", "np.asarray")]
+
+
+def test_a3_factorplane_boundary_allows_asarray_only(
+        fixture_violations):
+    """ISSUE 12: the factor-health plane's boundary fixture uses its
+    one allowed sync (np.asarray — the tiny fused-stats
+    materialization) plus a banned .block_until_ready() — only the
+    banned symbol flags."""
+    hits = _codes_by_file(fixture_violations)["factorplane.py"]
+    assert [(c, s) for c, _, s in hits] == [("GL-A3",
+                                             ".block_until_ready()")]
 
 
 def test_a3_memreads_flag_outside_the_opsplane_boundary(
@@ -371,7 +384,7 @@ def test_cli_flags_fixtures_then_baseline_clears_them(tmp_path):
             "--report", report)
     out = _run_cli(*args)
     assert out.returncode == 1
-    assert json.loads(out.stdout.strip().splitlines()[-1])["new"] == 25
+    assert json.loads(out.stdout.strip().splitlines()[-1])["new"] == 26
     # refuse to baseline without a why
     out = _run_cli(*args, "--update-baseline")
     assert out.returncode == 2
@@ -384,7 +397,7 @@ def test_cli_flags_fixtures_then_baseline_clears_them(tmp_path):
     out = _run_cli(*args)
     assert out.returncode == 0
     assert json.loads(
-        out.stdout.strip().splitlines()[-1])["baselined"] == 25
+        out.stdout.strip().splitlines()[-1])["baselined"] == 26
 
 
 def test_manifest_carries_the_analysis_block(tmp_path):

@@ -1,0 +1,512 @@
+"""Factor-health plane (ISSUE 12) — docs/observability.md "factor.*".
+
+Coverage map:
+
+* the fused on-device ``[F, 9]`` stats sketch matches the host numpy
+  recompute for ALL 58 factors (counts/min/max exact, moments within
+  f32 reduction tolerance) and NEVER perturbs the exposures (bitwise);
+* sharded and single-device modules produce the same stats payload
+  (exactly-associative columns bitwise, moment sums ulp-pinned — the
+  result-wire encode's associativity contract, applied to stats);
+* drift detection fires in BOTH directions: an injected coverage
+  collapse produces a schema-valid flight dump naming the factor,
+  while a stable seeded run produces zero dumps;
+* baseline updates require a justification (graftlint's contract);
+* the result wire's per-factor widen counters and spill occupancy;
+* the serve/stream integration: healthz carries the block, intraday
+  snapshots feed readiness lag, IC answers feed realized-IC health;
+* drift dumps carry the schema-v3 identity stamps and fold through
+  ``telemetry.aggregate`` with identity intact.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from replication_of_minute_frequency_factor_tpu import pipeline
+from replication_of_minute_frequency_factor_tpu.data import wire
+from replication_of_minute_frequency_factor_tpu.models.registry import (
+    factor_names)
+from replication_of_minute_frequency_factor_tpu.telemetry import (
+    Telemetry)
+from replication_of_minute_frequency_factor_tpu.telemetry import (
+    factorplane as fp)
+from replication_of_minute_frequency_factor_tpu.telemetry.validate import (
+    validate_dump)
+
+NAMES = ("vol_return1min", "mmt_am", "liq_openvol")
+
+
+def _batch(rng, days=2, tickers=16):
+    shape = (days, tickers, 240)
+    close = 10.0 * np.exp(np.cumsum(
+        rng.standard_normal(shape).astype(np.float32) * 1e-3, axis=-1))
+    open_ = close * (1 + rng.standard_normal(shape).astype(np.float32)
+                     * 1e-4)
+    bars = np.stack([open_, np.maximum(open_, close) * 1.0002,
+                     np.minimum(open_, close) * 0.9998, close,
+                     (rng.integers(0, 1000, shape) * 100.0)
+                     .astype(np.float32)], axis=-1).astype(np.float32)
+    mask = rng.random(shape) > 0.05
+    return bars, mask
+
+
+def _stats(names, coverage=1.0, mean=0.0, std=1.0, lanes=1000.0):
+    """A synthetic [F, 9] sketch row set for plane-level tests."""
+    out = np.zeros((len(names), fp.N_STATS), np.float32)
+    out[:, 0] = lanes
+    out[:, 1] = coverage * lanes
+    out[:, 2] = lanes - coverage * lanes
+    out[:, 5] = mean
+    out[:, 6] = std
+    out[:, 7] = mean - 2 * std
+    out[:, 8] = mean + 2 * std
+    return out
+
+
+# --------------------------------------------------------------------------
+# fused stats: parity + bitwise non-perturbation
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fused_stats_match_host_recompute_all_58(rng):
+    """The acceptance parity gate over the full factor set (the quick
+    tier runs the same check as bench.factorplane_smoke)."""
+    names = factor_names()
+    bars, mask = _batch(rng, days=2, tickers=16)
+    arrays = (bars, mask.view(np.uint8))
+    out, st = pipeline.compute_packed(arrays, "raw", names,
+                                      factor_stats=True)
+    exp = np.asarray(out)
+    dev = np.asarray(st)
+    host = fp.factor_stats_host(exp)
+    assert np.array_equal(dev[:, :5], host[:, :5])
+    assert np.array_equal(dev[:, 7:], host[:, 7:], equal_nan=True)
+    assert np.allclose(dev[:, 5:7], host[:, 5:7], rtol=1e-4,
+                       atol=1e-6, equal_nan=True)
+
+
+def test_fused_stats_do_not_perturb_exposures(rng):
+    bars, mask = _batch(rng)
+    arrays = (bars, mask.view(np.uint8))
+    with_stats, st = pipeline.compute_packed(arrays, "raw", NAMES,
+                                             factor_stats=True)
+    plain = pipeline.compute_packed(arrays, "raw", NAMES)
+    assert np.array_equal(np.asarray(with_stats), np.asarray(plain),
+                          equal_nan=True)
+    dev = np.asarray(st)
+    host = fp.factor_stats_host(np.asarray(plain))
+    assert np.array_equal(dev[:, :5], host[:, :5])
+    assert np.array_equal(dev[:, 7:], host[:, 7:], equal_nan=True)
+
+
+def test_stats_layout_counts_nan_and_inf(rng):
+    x = rng.standard_normal((2, 4, 7)).astype(np.float32)
+    x[0, 0, :3] = np.nan
+    x[0, 1, 0] = np.inf
+    x[1, 2, 1] = -np.inf
+    dev = np.asarray(fp.factor_stats_block(jax.device_put(x)))
+    host = fp.factor_stats_host(x)
+    assert np.array_equal(dev[:, :5], host[:, :5])
+    i = fp.STAT_FIELDS.index
+    assert dev[0, i("nan")] == 3 and dev[0, i("posinf")] == 1
+    assert dev[1, i("neginf")] == 1
+    assert dev[0, i("lanes")] == 28 and dev[0, i("finite")] == 24
+
+
+def test_all_nan_factor_reports_nan_moments():
+    x = np.full((1, 3, 5), np.nan, np.float32)
+    dev = np.asarray(fp.factor_stats_block(jax.device_put(x)))
+    assert dev[0, 1] == 0  # finite
+    assert np.isnan(dev[0, 5:]).all()
+
+
+def test_resident_scan_carries_stats_side_output(rng):
+    """The resident scan's (result, stats) tuple: stats per batch match
+    the per-batch recompute; the main accumulator is bitwise the
+    stats-off run's."""
+    batches = [_batch(rng) for _ in range(2)]
+    packs = [wire.pack_arrays((b, m.view(np.uint8)))
+             for b, m in batches]
+    spec = packs[0][1]
+    dbufs = tuple(jax.device_put(p[0]) for p in packs)
+    ys, stats = pipeline.compute_packed_resident(
+        dbufs, spec, "raw", NAMES, factor_stats=True)
+    dbufs2 = tuple(jax.device_put(p[0]) for p in packs)
+    plain = pipeline.compute_packed_resident(dbufs2, spec, "raw", NAMES)
+    ys, stats, plain = (np.asarray(ys), np.asarray(stats),
+                        np.asarray(plain))
+    assert np.array_equal(ys, plain, equal_nan=True)
+    assert stats.shape == (2, len(NAMES), fp.N_STATS)
+    for i in range(2):
+        host = fp.factor_stats_host(ys[i])
+        assert np.array_equal(stats[i, :, :5], host[:, :5])
+        assert np.array_equal(stats[i, :, 7:], host[:, 7:],
+                              equal_nan=True)
+
+
+def test_sharded_stats_match_single_device(rng):
+    """The associativity contract: counts/min/max bitwise between the
+    sharded and single-device modules (GSPMD owns the cross-shard
+    reductions; min/max/counts are exactly associative), moment sums
+    within a tight ulp band."""
+    from replication_of_minute_frequency_factor_tpu.parallel import (
+        resident_mesh)
+    from replication_of_minute_frequency_factor_tpu.parallel.mesh import (
+        put_packed_year)
+    mesh = resident_mesh()
+    n_shards = mesh.devices.size
+    assert n_shards > 1  # the 8-device virtual harness
+    tickers = 4 * n_shards
+    batches = [_batch(rng, tickers=tickers) for _ in range(2)]
+    # single-device stats
+    packs = [wire.pack_arrays((b, m.view(np.uint8)))
+             for b, m in batches]
+    dbufs = tuple(jax.device_put(p[0]) for p in packs)
+    _, single = pipeline.compute_packed_resident(
+        dbufs, packs[0][1], "raw", NAMES, factor_stats=True)
+    single = np.asarray(single)
+    # sharded stats over the same bytes
+    spacks = [wire.pack_sharded((b, m.view(np.uint8)), n_shards)
+              for b, m in batches]
+    stacked = put_packed_year(
+        np.stack([p[0] for p in spacks]), mesh)
+    _, sharded = pipeline.compute_packed_resident_sharded(
+        stacked, spacks[0][1], "raw", mesh, NAMES,
+        factor_stats=tickers)
+    sharded = np.asarray(sharded)
+    assert np.array_equal(single[:, :, :5], sharded[:, :, :5])
+    assert np.array_equal(single[:, :, 7:], sharded[:, :, 7:],
+                          equal_nan=True)
+    fin_s, fin_h = single[:, :, 5:7], sharded[:, :, 5:7]
+    scale = np.maximum(np.abs(fin_s), 1e-6)
+    assert (np.abs(fin_s - fin_h)
+            <= 32 * np.finfo(np.float32).eps * scale).all()
+
+
+# --------------------------------------------------------------------------
+# the host plane: gauges, drift, baselines
+# --------------------------------------------------------------------------
+
+
+def test_observe_block_publishes_gauges():
+    tel = Telemetry()
+    plane = tel.factorplane
+    s = plane.observe_block(NAMES, _stats(NAMES, coverage=0.9),
+                            boundary="test")
+    assert s["factors"] == len(NAMES)
+    g = tel.registry.snapshot()["gauges"]
+    for n in NAMES:
+        assert abs(g[f"factor.coverage_frac{{factor={n}}}"] - 0.9) < 1e-6
+    summary = plane.summary()
+    assert summary["available"] is True
+    assert abs(summary["coverage_frac"] - 0.9) < 1e-6
+    assert summary["worst_coverage"]["factor"] in NAMES
+
+
+def test_garbage_stats_never_raise():
+    tel = Telemetry()
+    plane = tel.factorplane
+    assert plane.observe_block(NAMES, np.zeros((2, 2))) == {}
+    assert plane.observe_block(NAMES, "nope") == {}
+    assert tel.registry.counter_total("factor.sample_failures") == 2
+    assert plane.summary()["available"] is False
+
+
+def test_coverage_collapse_trips_named_validated_dump(tmp_path):
+    tel = Telemetry()
+    plane = fp.FactorPlane(telemetry=tel, dump_dir=str(tmp_path),
+                           burst=3)
+    base = _stats(NAMES, coverage=0.95)
+    plane.observe_block(NAMES, base)          # banks baselines
+    collapsed = base.copy()
+    victim = NAMES[1]
+    collapsed[1, 1] = 0.1 * collapsed[1, 0]   # coverage 0.95 -> 0.1
+    collapsed[1, 2] = collapsed[1, 0] - collapsed[1, 1]
+    dumps = []
+    for _ in range(3):
+        s = plane.observe_block(NAMES, collapsed)
+        assert victim in s["drifting"]
+        dumps.extend(s["burst_dumps"])
+    assert len(dumps) == 1  # burst logic: N consecutive, then reset
+    rep = validate_dump(dumps[0])
+    assert rep["ok"], rep
+    doc = [json.loads(line) for line in open(dumps[0])]
+    header = [r for r in doc if r.get("kind") == "dump"][0]
+    extra = header["data"]["extra"]
+    assert extra["factor"] == victim
+    assert any("coverage_frac" in r for r in extra["reasons"])
+    # schema-v3 identity stamps ride the dump (aggregate folds it)
+    assert "process_index" in header and "host" in header
+    assert tel.registry.counter_value("factor.drift_bursts",
+                                      factor=victim) == 1
+
+
+def test_moment_drift_and_std_collapse_trip(tmp_path):
+    plane = fp.FactorPlane(telemetry=Telemetry(),
+                           dump_dir=str(tmp_path), burst=2)
+    plane.observe_block(NAMES, _stats(NAMES, mean=1.0, std=0.1))
+    shifted = _stats(NAMES, mean=50.0, std=0.1)      # z = 490
+    s1 = plane.observe_block(NAMES, shifted)
+    s2 = plane.observe_block(NAMES, shifted)
+    assert s1["drifting"] and s2["bursts"] == len(NAMES)
+    # std collapse alone (mean unchanged) also counts
+    plane2 = fp.FactorPlane(telemetry=Telemetry(), burst=2)
+    plane2.observe_block(NAMES, _stats(NAMES, mean=1.0, std=1.0))
+    s = plane2.observe_block(NAMES, _stats(NAMES, mean=1.0,
+                                           std=1e-4))
+    assert s["drifting"] == list(NAMES)
+
+
+def test_stable_run_produces_zero_dumps(tmp_path, rng):
+    plane = fp.FactorPlane(telemetry=Telemetry(),
+                           dump_dir=str(tmp_path), burst=2)
+    base = _stats(NAMES, coverage=0.95, mean=1.0, std=0.5)
+    for _ in range(10):
+        jitter = base.copy()
+        jitter[:, 5] += rng.standard_normal(len(NAMES)) * 0.01
+        s = plane.observe_block(NAMES, jitter)
+        assert s["bursts"] == 0 and not s["drifting"]
+    assert plane.summary()["drift"]["bursts"] == 0
+    assert not os.listdir(tmp_path)
+
+
+def test_baseline_update_requires_justification():
+    plane = fp.FactorPlane(telemetry=Telemetry())
+    plane.observe_block(NAMES, _stats(NAMES, mean=1.0))
+    plane.observe_block(NAMES, _stats(NAMES, mean=2.0))
+    with pytest.raises(ValueError, match="justification"):
+        plane.update_baseline()
+    with pytest.raises(ValueError, match="justification"):
+        plane.update_baseline(justification="   ")
+    moved = plane.update_baseline(
+        justification="universe re-seeded for the test")
+    assert moved == len(NAMES)
+    assert plane.bank_baseline()[NAMES[0]]["mean"] == 2.0
+
+
+def test_consecutive_drift_resets_on_recovery(tmp_path):
+    """A transient blip shorter than the burst never dumps — the
+    mesh-plane skew-burst semantics, per factor."""
+    plane = fp.FactorPlane(telemetry=Telemetry(),
+                           dump_dir=str(tmp_path), burst=3)
+    good = _stats(NAMES, coverage=0.95)
+    bad = _stats(NAMES, coverage=0.1)
+    plane.observe_block(NAMES, good)
+    for _ in range(2):
+        plane.observe_block(NAMES, bad)
+    plane.observe_block(NAMES, good)   # recovery resets the counters
+    s = plane.observe_block(NAMES, bad)
+    assert s["bursts"] == 0 and not os.listdir(tmp_path)
+
+
+# --------------------------------------------------------------------------
+# widen / stream / IC health
+# --------------------------------------------------------------------------
+
+
+def test_widen_rates_accumulate_per_factor():
+    tel = Telemetry()
+    plane = tel.factorplane
+    plane.observe_widen(NAMES, {"mmt_am": 2}, slices_per_factor=8)
+    plane.observe_widen(NAMES, [0, 2, 1], slices_per_factor=8)
+    g = tel.registry.snapshot()["gauges"]
+    assert abs(g["factor.widen_rate{factor=mmt_am}"] - 0.25) < 1e-6
+    assert abs(g["factor.widen_rate{factor=liq_openvol}"]
+               - 1 / 16) < 1e-6
+    summary = plane.summary()
+    assert summary["widen"]["slices"] == len(NAMES) * 16
+    assert summary["widen"]["worst"]["factor"] == "mmt_am"
+    assert abs(summary["widen_rate"] - 5 / 48) < 1e-6
+
+
+def test_decode_block_per_factor_widen_counters(rng):
+    from replication_of_minute_frequency_factor_tpu.data import (
+        result_wire as rw)
+    import jax.numpy as jnp
+    tel = Telemetry()
+    days, tickers = 2, 24
+    # vol_upVol is one of the STRICT-pinned factors (rtol-only bound):
+    # a heavy-tailed slice — tiny lanes sharing a slice with huge
+    # ones — fails the on-device round-trip check and widens (exactly
+    # the ROADMAP question these counters instrument)
+    names = ("vol_return1min", "vol_upVol", "liq_openvol")
+    raw = rng.standard_normal((len(names), days, tickers)) \
+        .astype(np.float32)
+    raw[1] = 10.0 ** rng.uniform(-5, 6, (days, tickers)) \
+        .astype(np.float32)
+    spec = rw.ResultWireSpec.for_names(names, days=days)
+    payload = np.asarray(jax.jit(rw.encode_block, static_argnums=1)(
+        jnp.asarray(raw), spec))
+    dec, v = rw.decode_block(payload, len(names), days, tickers,
+                             spec.spill_rows, telemetry=tel,
+                             names=names)
+    assert v["widened_by_factor"].get("vol_upVol") == days
+    assert "vol_return1min" not in v["widened_by_factor"]
+    assert tel.registry.counter_value("result.widen_count",
+                                      factor="vol_upVol") == days
+    occ = tel.registry.gauge_value("result.spill_occupancy_frac")
+    assert occ is not None and occ > 0
+    with pytest.raises(ValueError, match="names"):
+        rw.decode_block(payload, len(names), days, tickers,
+                        spec.spill_rows, telemetry=tel,
+                        names=names[:-1])
+
+
+def test_observe_stream_readiness_lag():
+    tel = Telemetry()
+    plane = tel.factorplane
+    s = plane.observe_stream(NAMES, _stats(NAMES),
+                             ready_frac=[1.0, 0.5, 0.25], minute=120)
+    assert s["stream"]["least_ready"]["factor"] == "liq_openvol"
+    g = tel.registry.snapshot()["gauges"]
+    assert abs(g["stream.readiness_lag"]
+               - (1 - (1.0 + 0.5 + 0.25) / 3)) < 1e-6
+    assert plane.summary()["stream"]["minute"] == 120
+
+
+def test_note_ic_rolls_per_factor_horizon():
+    tel = Telemetry()
+    plane = tel.factorplane
+    for v in (0.1, 0.3):
+        plane.note_ic("mmt_am", v, horizon=1)
+    plane.note_ic("mmt_am", None, horizon=1)      # ignored
+    plane.note_ic("mmt_am", float("nan"), horizon=1)
+    g = tel.registry.snapshot()["gauges"]
+    assert abs(g["factor.realized_ic_rolling{factor=mmt_am,horizon=1}"]
+               - 0.2) < 1e-6
+    ic = plane.summary()["ic"]
+    assert ic["mmt_am@1"]["n"] == 2
+
+
+# --------------------------------------------------------------------------
+# integration: stream engine, serve, fleet health
+# --------------------------------------------------------------------------
+
+
+def test_stream_snapshot_stats_bitwise_and_warm(rng):
+    from replication_of_minute_frequency_factor_tpu.stream.engine import (
+        StreamEngine)
+    tel = Telemetry()
+    eng = StreamEngine(8, names=NAMES, telemetry=tel)
+    eng.warmup(micro_batches=(4,))
+    bars, mask = _batch(rng, days=1, tickers=8)
+    eng.ingest_minutes(
+        np.ascontiguousarray(np.swapaxes(bars[0][:, :8], 0, 1)),
+        np.ascontiguousarray(mask[0][:, :8].T))
+    before = tel.registry.counter_total("xla.compiles")
+    exp, ready, st = jax.device_get(eng.snapshot_stats())
+    assert tel.registry.counter_total("xla.compiles") == before
+    exp0, ready0 = jax.device_get(eng.snapshot())
+    assert np.array_equal(exp, exp0, equal_nan=True)
+    assert np.array_equal(ready, ready0)
+    host = fp.factor_stats_host(exp)
+    assert np.array_equal(st[:, :5], host[:, :5])
+    # wire twin: stats identical (computed pre-encode)
+    _pay, ready_w, st_w = jax.device_get(eng.snapshot_wire_stats())
+    assert np.array_equal(ready_w, ready0)
+    assert np.array_equal(st_w[:, :5], st[:, :5])
+
+
+def test_serve_health_and_intraday_feed_the_plane(rng):
+    from replication_of_minute_frequency_factor_tpu.serve import (
+        FactorServer, Query, ServeConfig, SyntheticSource)
+    tel = Telemetry()
+    src = SyntheticSource(n_days=6, n_tickers=8, seed=3)
+    srv = FactorServer(src, names=NAMES, telemetry=tel,
+                       serve_cfg=ServeConfig(), stream=True,
+                       stream_batches=(4,))
+    try:
+        c = srv.client()
+        c.factors(0, 2)
+        c.ic("mmt_am", 0, 4, horizon=1)
+        c.intraday()
+        h = srv.health()
+        fh = h["factor_health"]
+        assert fh["available"] is True
+        assert fh["worst_coverage"]["factor"] in NAMES
+        # one sample per block BUILD — the factors query built (0, 2)
+        # and the IC query built (0, 4) — plus the intraday snapshot
+        assert tel.registry.counter_value(
+            "factor.samples", boundary="serve.block") == 2
+        assert tel.registry.counter_value(
+            "factor.samples", boundary="serve.intraday") == 1
+        # IC answer fed realized-IC health through the AOT IC graph
+        assert fh["ic"] and "mmt_am@1" in fh["ic"]
+        # readiness lag published for the (empty) carry
+        assert tel.registry.gauge_value("stream.readiness_lag") \
+            is not None
+        # a cache hit re-serves observed data without a new sample
+        c.factors(0, 2)
+        assert tel.registry.counter_value(
+            "factor.samples", boundary="serve.block") == 2
+    finally:
+        srv.close()
+
+
+def test_fleet_pod_health_rolls_up_factor_health():
+    from replication_of_minute_frequency_factor_tpu.fleet import (
+        FactorFleet)
+    from replication_of_minute_frequency_factor_tpu.serve import (
+        Query, SyntheticSource)
+    src = SyntheticSource(n_days=6, n_tickers=8, seed=3)
+    fleet = FactorFleet(src, 2, names=NAMES)
+    try:
+        fleet.submit(Query("factors", 0, 2)).result(60)
+        h = fleet.health()
+        pod_fh = h["pod"]["factor_health"]
+        assert set(pod_fh["replicas"]) == {"r0", "r1"}
+        served = [r for r in pod_fh["replicas"].values()
+                  if r["available"]]
+        assert served and served[0]["worst_coverage"]["factor"] in NAMES
+        # the rollup reads the replica healthz payloads verbatim
+        for label, rep in h["replicas"].items():
+            assert rep["factor_health"]["available"] == \
+                pod_fh["replicas"][label]["available"]
+    finally:
+        fleet.close()
+
+
+def test_prometheus_exports_p99_quantile():
+    from replication_of_minute_frequency_factor_tpu.telemetry.opsplane \
+        import to_prometheus
+    tel = Telemetry()
+    for i in range(200):
+        tel.observe("serve.request_seconds", i / 1000.0)
+    text = to_prometheus(tel.registry)
+    assert 'quantile="0.99"' in text
+    stats = tel.registry.histogram_stats("serve.request_seconds")
+    assert stats["p99"] is not None and stats["p99"] >= stats["p95"]
+
+
+def test_aggregate_folds_factor_gauges_with_identity(tmp_path):
+    """Two per-host bundles carrying factor.* gauges fold into one
+    schema-valid pod bundle (the fleet/multihost contract)."""
+    from replication_of_minute_frequency_factor_tpu.telemetry.aggregate \
+        import aggregate_dirs
+    from replication_of_minute_frequency_factor_tpu.telemetry.validate \
+        import validate_dir
+    dirs = []
+    for i in range(2):
+        tel = Telemetry()
+        tel.factorplane.observe_block(NAMES,
+                                      _stats(NAMES, coverage=0.9))
+        tel.counter("factor.samples", boundary="test")
+        d = str(tmp_path / f"host{i}")
+        tel.write(d, process_index=i, host=f"host{i}")
+        dirs.append(d)
+    pod = str(tmp_path / "pod")
+    agg = aggregate_dirs(dirs, pod)
+    assert agg["ok"], agg
+    val = validate_dir(pod)
+    assert val["ok"], val
+    merged = [json.loads(line)
+              for line in open(os.path.join(pod, "metrics.jsonl"))]
+    counters = [r for r in merged if r.get("kind") == "counter"
+                and r.get("name") == "factor.samples"]
+    assert counters and counters[0]["value"] == 2.0

@@ -455,6 +455,66 @@ def test_mesh_series_gate_both_directions(tmp_path):
         entries, candidate=_sharded_rec(methodology="r10_mesh2d"))["ok"]
 
 
+def _fh_rec(value=80.0, widen=0.01, cov=0.97, available=True,
+            slices=928, methodology="r10_resident_v3"):
+    return {"metric": "cicc58_5000tickers_1yr_wall", "value": value,
+            "methodology": methodology,
+            "factor_health": {"available": available,
+                              "widen_rate": widen,
+                              "coverage_frac": cov,
+                              "widen": {"slices": slices,
+                                        "widened": int(widen * slices)}}}
+
+
+def test_derive_records_lifts_available_factor_health():
+    recs = regress.derive_records(_fh_rec())
+    assert [r["metric"] for r in recs] == [
+        "cicc58_5000tickers_1yr_wall.widen_rate",
+        "cicc58_5000tickers_1yr_wall.coverage_frac"]
+    assert recs[0]["value"] == 0.01 and recs[1]["value"] == 0.97
+    assert all(r["methodology"] == "r10_resident_v3" for r in recs)
+
+
+def test_unavailable_or_wireless_factor_health_never_seeds():
+    """ISSUE 12: an unavailable block derives nothing; an available
+    block without observed result-wire slices (wire off) derives only
+    the coverage series — a wire-less record must not gate a widen
+    baseline at 0."""
+    assert regress.derive_records(_fh_rec(available=False)) == []
+    recs = regress.derive_records(_fh_rec(widen=0.0, slices=0))
+    assert [r["metric"] for r in recs] == [
+        "cicc58_5000tickers_1yr_wall.coverage_frac"]
+
+
+def test_factor_health_series_gate_both_directions(tmp_path):
+    """The tentpole's regress acceptance: a steady wall-clock headline
+    whose widen rate storms (the log-transform signal) or whose
+    coverage collapses (missing data) FLAGS on the derived group; an
+    in-band candidate stays quiet; a declared break opens fresh."""
+    for i, widen in enumerate((0.010, 0.0102)):
+        with open(tmp_path / f"BENCH_r{i + 1:02d}.json", "w") as fh:
+            json.dump({"n": i + 1, "parsed": _fh_rec(widen=widen)}, fh)
+    entries = regress.load_bench_series(str(tmp_path))
+    metrics = {e["record"]["metric"] for e in entries}
+    assert {"cicc58_5000tickers_1yr_wall.widen_rate",
+            "cicc58_5000tickers_1yr_wall.coverage_frac"} <= metrics
+    assert regress.evaluate(entries, candidate=_fh_rec())["ok"]
+    v = regress.evaluate(entries, candidate=_fh_rec(widen=0.08))
+    assert not v["ok"]
+    assert any(f["metric"].endswith(".widen_rate")
+               for f in v["flagged"])
+    v = regress.evaluate(entries, candidate=_fh_rec(cov=0.5))
+    assert not v["ok"]
+    assert any(f["metric"].endswith(".coverage_frac")
+               for f in v["flagged"])
+    # a quality-dark candidate cannot trip the data gates
+    assert regress.evaluate(
+        entries, candidate=_fh_rec(widen=0.5, cov=0.1,
+                                   available=False))["ok"]
+    assert regress.evaluate(
+        entries, candidate=_fh_rec(methodology="r13_newloop"))["ok"]
+
+
 def _r10_rec(value=80.0, wire_bpd=600_000.0, result_bpd=610_000.0,
              methodology="r10_resident_v3"):
     return {"metric": "cicc58_5000tickers_1yr_wall", "value": value,

@@ -116,7 +116,8 @@ def test_legacy_rolling_entries_never_carry(tpu_session):
         "headline": {"ok": True, "results": [
             {"metric": "x", "days_per_batch": 32, "mode": "resident",
              "tickers": 5000,
-             "result_wire": {"enabled": True}}]},
+             "result_wire": {"enabled": True},
+             "factor_health": {"available": True}}]},
     }
     got = tpu_session.drop_conv_only_rolling(steps)
     assert set(got) == {"headline"}
@@ -149,7 +150,9 @@ def test_pre_reshape_headline_dropped(tpu_session):
     new = {"headline": {"ok": True, "results": [
         {"metric": "cicc58_5000tickers_1yr_wall", "value": 58.0,
          "days_per_batch": 32, "mode": "resident", "tickers": 5000,
-         "result_wire": {"enabled": True, "ratio_vs_f32": 1.9}}]}}
+         "result_wire": {"enabled": True, "ratio_vs_f32": 1.9},
+         "factor_health": {"available": True,
+                           "widen_rate": 0.001}}]}}
     assert tpu_session.drop_conv_only_rolling(new) == new
     # ISSUE 10: a resident record WITHOUT the result_wire block (or
     # with the wire disabled — a silent f32 fallback) measures the old
@@ -157,13 +160,31 @@ def test_pre_reshape_headline_dropped(tpu_session):
     # headline
     no_wire = {"headline": {"ok": True, "results": [
         {"metric": "cicc58_5000tickers_1yr_wall", "value": 58.0,
-         "days_per_batch": 32, "mode": "resident", "tickers": 5000}]}}
+         "days_per_batch": 32, "mode": "resident", "tickers": 5000,
+         "factor_health": {"available": True}}]}}
     assert tpu_session.drop_conv_only_rolling(no_wire) == {}
     wire_off = {"headline": {"ok": True, "results": [
         {"metric": "cicc58_5000tickers_1yr_wall", "value": 58.0,
          "days_per_batch": 32, "mode": "resident", "tickers": 5000,
-         "result_wire": {"enabled": False}}]}}
+         "result_wire": {"enabled": False},
+         "factor_health": {"available": True}}]}}
     assert tpu_session.drop_conv_only_rolling(wire_off) == {}
+    # ISSUE 12: a resident record WITHOUT an available factor_health
+    # block (the fused stats side-output never sampled) cannot bank —
+    # the first hardware window is what answers the ROADMAP's
+    # real-data widen-rate question, so a quality-blind record would
+    # defer it forever
+    no_health = {"headline": {"ok": True, "results": [
+        {"metric": "cicc58_5000tickers_1yr_wall", "value": 58.0,
+         "days_per_batch": 32, "mode": "resident", "tickers": 5000,
+         "result_wire": {"enabled": True, "ratio_vs_f32": 1.9}}]}}
+    assert tpu_session.drop_conv_only_rolling(no_health) == {}
+    health_dark = {"headline": {"ok": True, "results": [
+        {"metric": "cicc58_5000tickers_1yr_wall", "value": 58.0,
+         "days_per_batch": 32, "mode": "resident", "tickers": 5000,
+         "result_wire": {"enabled": True, "ratio_vs_f32": 1.9},
+         "factor_health": {"available": False}}]}}
+    assert tpu_session.drop_conv_only_rolling(health_dark) == {}
     # a resident record WITHOUT the tickers stamp predates the r6
     # schema (N_TICKERS was already overridable, so it could be a
     # mislabeled small run) — never carried (ADVICE r5 medium)
@@ -331,7 +352,7 @@ def test_stream_intraday_carry_requires_real_streaming(tpu_session):
     0, zero compiles during load, empty parity-mismatch list. A
     zero-update record, a cold (compiling) load, or an on-hardware
     parity failure must re-run."""
-    def entry(hbm=True, mesh=True, **stream):
+    def entry(hbm=True, mesh=True, fh=True, **stream):
         base = {"updates": 2880, "compiles_during_load": 0,
                 "parity_mismatched": []}
         base.update(stream)
@@ -343,6 +364,9 @@ def test_stream_intraday_carry_requires_real_streaming(tpu_session):
             rec["hbm"] = {"available": True, "peak_bytes": 1 << 30}
         if mesh:
             rec["mesh"] = {"available": False, "occupancy_frac": 1.0}
+        if fh:
+            rec["factor_health"] = {"available": True,
+                                    "coverage_frac": 0.97}
         return {"stream_intraday": {"ok": True, "results": [rec]}}
 
     good = entry()
@@ -353,6 +377,9 @@ def test_stream_intraday_carry_requires_real_streaming(tpu_session):
     assert tpu_session.drop_conv_only_rolling(entry(hbm=False)) == {}
     # ISSUE 9: same rule for the mesh balance block (cohort occupancy)
     assert tpu_session.drop_conv_only_rolling(entry(mesh=False)) == {}
+    # ISSUE 12: same rule for the factor-health block (the fused
+    # stats + readiness-lag sample feeds the coverage_frac series)
+    assert tpu_session.drop_conv_only_rolling(entry(fh=False)) == {}
     assert tpu_session.drop_conv_only_rolling(
         entry(compiles_during_load=3)) == {}
     assert tpu_session.drop_conv_only_rolling(
@@ -393,6 +420,8 @@ def test_stream_intraday_step_refuses_unbankable_records(
              "methodology": "r9_stream_intraday_v1",
              "hbm": {"available": True, "peak_bytes": 1 << 30},
              "mesh": {"available": False, "occupancy_frac": 1.0},
+             "factor_health": {"available": True,
+                               "coverage_frac": 0.97},
              "stream": {"updates": 99, "compiles_during_load": 0,
                         "parity_mismatched": []}}]}
     monkeypatch.setattr(tpu_session, "_run_json_lines", fake_good)
