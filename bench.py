@@ -31,6 +31,14 @@ from replication_of_minute_frequency_factor_tpu.telemetry import (
 
 N_TICKERS = int(os.environ.get("BENCH_TICKERS", "5000"))
 TRADING_DAYS_PER_YEAR = 244
+# r12: the synthetic workload scales to the "decades x global
+# universe" shape a production factor service actually runs —
+# BENCH_YEARS=10 BENCH_TICKERS=20000 is the 2-D mesh item's target
+# shape (ROADMAP). YEARS multiplies the default timed iteration count
+# (BENCH_ITERS still pins it explicitly) and the headline metric name
+# carries it, so a multi-year number can never be read as the 1-year
+# series.
+YEARS = max(1, int(os.environ.get("BENCH_YEARS", "1")))
 # The r3 capture decomposed the 146 s headline as ~0.7 s/batch of
 # bandwidth+compute against a 4.8 s/batch wall — the gap is per-round-
 # trip cost, so the loop now ships FEWER, BIGGER batches: 8 x 32 days
@@ -41,7 +49,7 @@ TRADING_DAYS_PER_YEAR = 244
 # live); the warmup catches RESOURCE_EXHAUSTED and retries at the
 # proven 8-day shape instead of losing the window (see main).
 DAYS_PER_BATCH = int(os.environ.get("BENCH_DAYS_PER_BATCH", "32"))
-ITERS = int(os.environ.get("BENCH_ITERS", "8"))
+ITERS = int(os.environ.get("BENCH_ITERS", str(8 * YEARS)))
 WARMUP = 1
 
 # r5 loop shapes (VERDICT r4 #2): the r4 sweep measured ~12 s of FIXED
@@ -64,6 +72,14 @@ MODE = os.environ.get("BENCH_MODE", "resident")
 # n_shards == 1 falls back to the single-device resident scan and the
 # record's ``n_shards``/``methodology`` fields say which one ran.
 N_SHARDS = int(os.environ.get("BENCH_SHARDS", "0"))
+
+# r12: the 2-D (days, tickers) pipelined resident scan (ISSUE 13).
+# BENCH_MESH_DAYS=d (>1) splits each batch's day axis over d day-shards
+# alongside the ticker split — the mesh resolves to (d, total//d) with
+# total = BENCH_SHARDS or every local device; when total//d < 2 the run
+# falls back to the 1-D sharded loop (a 2-D record REQUIRES d > 1 AND
+# t > 1 — tpu_session's resident_2d carry rule enforces it).
+MESH_DAYS = int(os.environ.get("BENCH_MESH_DAYS", "0"))
 
 # r10: the device->host RESULT leg ships blocked-quantized int16 with
 # per-slice bitwise-f32 widening (data/result_wire.py) — the headline's
@@ -155,6 +171,7 @@ class _NullTimer:
 #: every _count_sync call-site label, for the per-point measured
 #: breakdown in the headline record's ``round_trips``
 _SYNC_POINTS = ("resident_ingest", "resident_compute", "resident_fetch",
+                "resident_carry_fetch",
                 "stream_lagged_fetch", "stream_drain_fetch",
                 "stream_consolidated_fetch")
 
@@ -323,6 +340,46 @@ def encode_year_sharded(batches, use_wire, n_shards, max_passes=4,
     return [p[0] for p in packs], packs[0][1], "raw", t_pad
 
 
+def encode_year_2d(batches, use_wire, d_shards, t_shards, max_passes=4,
+                   bucket=1):
+    """2-D twin of :func:`encode_year_sharded` (ISSUE 13): the tickers
+    axis pads with masked lanes to lcm(bucket, t_shards) AND the days
+    axis pads with fully-masked filler days to a multiple of
+    ``d_shards`` (day-group padding — its waste is the ``axis=days``
+    entry of ``mesh.pad_waste_frac``); then the same shared widen-only
+    floor + spec-convergence loop, with each batch packed as a
+    ``[Sd, St, L]`` per-tile stack (wire.pack_sharded_2d). Returns
+    ``(stacks, spec, kind, t_pad, d_pad)``."""
+    mult = int(bucket * t_shards // np.gcd(bucket, t_shards))
+    t = batches[0][0].shape[1]
+    t_pad = -(-t // mult) * mult
+    d = batches[0][0].shape[0]
+    d_pad = -(-d // d_shards) * d_shards
+    if t_pad != t or d_pad != d:
+        pad_b = [(0, d_pad - d), (0, t_pad - t), (0, 0), (0, 0)]
+        pad_m = [(0, d_pad - d), (0, t_pad - t), (0, 0)]
+        batches = [(np.pad(b, pad_b), np.pad(m, pad_m))
+                   for b, m in batches]
+    tel = get_telemetry()
+    if use_wire:
+        floor: dict = {}
+        encs = [wire.encode(b, m, floor=floor) for b, m in batches]
+        for _ in range(max_passes):
+            if not all(e is not None for e in encs):
+                break  # unrepresentable under wire: raw fallback
+            packs = [wire.pack_sharded_2d(e.arrays, d_shards, t_shards)
+                     for e in encs]
+            if len({p[1] for p in packs}) == 1:
+                tel.counter("bench.encode_kind", kind="wire")
+                return ([p[0] for p in packs], packs[0][1], "wire",
+                        t_pad, d_pad)
+            encs = [wire.encode(b, m, floor=floor) for b, m in batches]
+    packs = [wire.pack_sharded_2d((b, m.view(np.uint8)), d_shards,
+                                  t_shards) for b, m in batches]
+    tel.counter("bench.encode_kind", kind="raw")
+    return [p[0] for p in packs], packs[0][1], "raw", t_pad, d_pad
+
+
 #: AOT-compiled resident executables, keyed on everything that shapes
 #: the module — lowering re-traces the whole 58-kernel graph (seconds
 #: of host work), so a memo hit must skip the .lower() call itself,
@@ -475,18 +532,22 @@ def run_resident(batches, names, use_wire, group, keep_results=False,
 
 
 def _decode_result_phases(phases, payload_rows, names, n_d, t_pad,
-                          n_tickers, result_spec, results):
+                          n_tickers, result_spec, results,
+                          n_days=None):
     """Shared host half of the result wire for the resident loops:
     decode every fetched payload row (strict=False — the caller owns
     the widen-only floor), fold the verdicts into
     ``phases['result_wire']``, time the numpy dequantize as its own
     serial stage, and fill ``results`` with DECODED ``[F, D, :n_tickers]``
-    blocks when the caller kept them."""
+    blocks when the caller kept them. ``n_days`` (2-D loop) slices the
+    day-group padding back off kept results — ``n_d`` stays the PADDED
+    extent the payload geometry encodes."""
     from replication_of_minute_frequency_factor_tpu.data import (
         result_wire as rw)
     t0 = time.perf_counter()
     widened = overflow = quantized = 0
     by_factor: dict = {}
+    keep_days = n_days if n_days is not None else n_d
     for row in payload_rows:
         dec, v = rw.decode_block(row, len(names), n_d, t_pad,
                                  result_spec.spill_rows, strict=False,
@@ -497,7 +558,7 @@ def _decode_result_phases(phases, payload_rows, names, n_d, t_pad,
         for n, c in (v.get("widened_by_factor") or {}).items():
             by_factor[n] = by_factor.get(n, 0) + c
         if results is not None:
-            results.append(dec[..., :n_tickers])
+            results.append(dec[:, :keep_days, :n_tickers])
     phases["decode_s"] = round(time.perf_counter() - t0, 3)
     payload_b = phases["fetch_MB"] * 1e6
     logical_b = phases["fetch_logical_MB"] * 1e6
@@ -682,6 +743,151 @@ def run_resident_sharded(batches, names, use_wire, group, mesh,
     return phases, kind, results
 
 
+def run_resident_2d(batches, names, use_wire, group, mesh,
+                    keep_results=False, bucket=1, result_spec=None,
+                    keep_carry=False):
+    """The resident year on the full 2-D ``(days, tickers)`` mesh,
+    pipelined across the day axis (ISSUE 13):
+
+      encode  — host: shared-floor wire-encode + per-tile pack
+                (encode_year_2d; tickers padded to the shard multiple,
+                days padded to the day-shard multiple — both wastes in
+                ``mesh.pad_waste_frac{axis=}``)
+      ingest  — group 0's ``[g, Sd, St, L]`` stack device_puts over
+                BOTH mesh axes; every later group's put dispatches
+                while the previous group's scan executes (the same
+                double-buffer as the 1-D loop, now per day-shard too:
+                day-shard i computes its span while day-shard i+1's
+                bytes ingest). No ingest ever blocks the host.
+      compute — one 2-D scan executable per group
+                (pipeline.compute_packed_resident_2d's module): per
+                tile unpack + decode + 58 kernels, the doc_pdf* rank
+                the only cross-ticker collective and the cross-day
+                carry handoff (ppermute on the days axis) the only
+                cross-day one. The carry THREADS between groups as a
+                device array — zero extra host-blocking syncs per
+                group vs the 1-D sharded loop (the resident_2d smoke's
+                gate).
+      fetch   — one consolidated per-group ``np.asarray``; the
+                year-end carry is fetched ONCE (and only under
+                ``keep_carry``), counted at its own sync point.
+
+    Returns ``(phases, kind, results, carry)`` — ``carry`` is the
+    host-fetched ``{last_close, n_bars, has}`` year-end intraday
+    prefix state when ``keep_carry``, else None (it still threaded
+    between groups on device)."""
+    from replication_of_minute_frequency_factor_tpu.config import (
+        get_config)
+    from replication_of_minute_frequency_factor_tpu.parallel.mesh import (
+        DAYS_AXIS, TICKERS_AXIS, put_packed_year_2d, put_span_carry)
+    from replication_of_minute_frequency_factor_tpu.pipeline import (
+        lower_packed_resident_2d)
+    from replication_of_minute_frequency_factor_tpu.stream.carry import (
+        init_span_state)
+    tel = get_telemetry()
+    d_shards = mesh.shape[DAYS_AXIS]
+    t_shards = mesh.shape[TICKERS_AXIS]
+    phases = {}
+    t0 = time.perf_counter()
+    stacks, spec, kind, t_pad, d_pad = encode_year_2d(
+        batches, use_wire, d_shards, t_shards, bucket=bucket)
+    phases["encode_s"] = round(time.perf_counter() - t0, 3)
+    n_tickers = batches[0][0].shape[1]
+    n_days = batches[0][0].shape[0]
+    # padding waste per AXIS (ISSUE 13 satellite): the lcm ticker pad
+    # AND the day-group pad to d — dead lanes/days every shard still
+    # computes; both land in mesh.pad_waste_frac{axis=} and the
+    # record's mesh block
+    tel.meshplane.record_pad_waste(n_tickers, t_pad, axis="tickers")
+    tel.meshplane.record_pad_waste(n_days, d_pad, axis="days")
+    groups = [np.stack(stacks[g0:g0 + group])  # [g, Sd, St, L]
+              for g0 in range(0, len(stacks), group)]
+    phases["ingest_MB"] = round(sum(g.nbytes for g in groups) / 1e6, 1)
+    roll = get_config().rolling_impl
+    t0 = time.perf_counter()
+    pend = put_packed_year_2d(groups[0], mesh)
+    carry = put_span_carry(init_span_state(t_pad), mesh)
+    phases["ingest_s"] = round(time.perf_counter() - t0, 3)
+    outs = []
+    hidden = 0.0
+    compute_t0 = None
+    t0 = time.perf_counter()
+    for gi in range(len(groups)):
+        d = pend
+        cin = carry
+        compiled = _aot_resident(
+            "bench_resident_scan_2d",
+            ("2d", d.shape, spec, kind, names, roll, mesh, result_spec,
+             "stats", n_days, n_tickers),
+            lambda: lower_packed_resident_2d(
+                d, cin, spec, kind, mesh, names=names,
+                rolling_impl=roll, result_spec=result_spec,
+                factor_stats=(n_days, n_tickers)),
+            phases)
+        if compute_t0 is None:
+            compute_t0 = time.perf_counter()
+        t_dispatch = time.perf_counter()
+        out = compiled(d, cin)
+        outs.append(out)
+        carry = out[-1]  # threads on device into the next group
+        tel.meshplane.note_collective("carry_handoff")
+        # per-axis shard watermarks (ISSUE 13): the daemon watcher maps
+        # each device back to its (day-shard, ticker-shard) coordinate
+        # — day-axis skew is the number that says whether the day
+        # pipeline balances, apart from the ticker split
+        tel.meshplane.watch_async_mesh(out[0], mesh,
+                                       boundary="resident.group2d",
+                                       t0=t_dispatch)
+        tel.hbm.sample("resident.group")
+        if gi + 1 < len(groups):
+            t1 = time.perf_counter()
+            pend = put_packed_year_2d(groups[gi + 1], mesh)
+            hidden += time.perf_counter() - t1
+    _count_sync("resident_compute")
+    jax.block_until_ready([o[0] for o in outs])
+    phases["compute_s"] = round(
+        time.perf_counter() - (compute_t0 or t0), 3)
+    tel.meshplane.drain()
+    phases["ingest_hidden_s"] = round(hidden, 6)
+    tel.gauge("resident.ingest_hidden_s", round(hidden, 6),
+              n_shards=str(d_shards * t_shards))
+    t0 = time.perf_counter()
+    results = [] if keep_results else None
+    fetched_mb = 0.0
+    payload_rows = []
+    stats_rows = []
+    for o in outs:
+        ys, st = o[0], o[1]
+        _count_sync("resident_fetch")
+        h = np.asarray(ys)  # [g, F, D_pad, T_pad] f32, or [g, L] u8
+        stats_rows.extend(np.asarray(st))
+        fetched_mb += h.nbytes
+        if result_spec is not None:
+            payload_rows.extend(h)
+        elif keep_results:
+            results.extend(h[..., :n_days, :n_tickers])
+    phases["fetch_s"] = round(time.perf_counter() - t0, 3)
+    _observe_factor_stats(names, stats_rows, "resident.fetch")
+    # RAW fetched bytes include BOTH paddings; the logical payload
+    # strips them (the PR 10 ticker fix, extended to the day axis)
+    phases["fetch_MB"] = round(fetched_mb / 1e6, 3)
+    phases["fetch_logical_MB"] = round(
+        len(batches) * len(names) * n_days * n_tickers * 4 / 1e6, 3)
+    if result_spec is not None:
+        _decode_result_phases(phases, payload_rows, names, d_pad,
+                              t_pad, n_tickers, result_spec, results,
+                              n_days=n_days)
+    host_carry = None
+    if keep_carry:
+        # once per YEAR, never per group — and only when asked: the
+        # timed loops leave the carry on device so the sync budget
+        # stays <= the 1-D loop's 1 + n_groups
+        _count_sync("resident_carry_fetch")
+        host_carry = {k: np.asarray(v)[:n_tickers]
+                      for k, v in carry.items()}
+    return phases, kind, results, host_carry
+
+
 def resident_diag(batches, names, use_wire, stream_results):
     """One-shot resident-path driver artifact (VERDICT r5 weak #5):
     every CPU-fallback artifact to date exercised only the stream loop,
@@ -792,6 +998,120 @@ def sharded_smoke(n_batches=2, days=2, tickers=32, names=None,
             "ingest_hidden_s": phases.get("ingest_hidden_s"),
             "mismatched": sorted(set(bad)), "max_abs_diff": max_diff,
             "ok": not bad and overlap_ok and n_shards > 1}
+
+
+def resident_2d_smoke(n_batches=2, days=2, tickers=32, names=None,
+                      group=None, mesh_shape=(2, 4)):
+    """run_tests.sh --quick smoke (8 virtual CPU devices): the 2-D
+    ``(days, tickers)`` pipelined resident scan end to end (ISSUE 13).
+    One JSON verdict; ``ok`` iff
+
+    * ALL 58 factors equal the single-device resident scan (bitwise
+      outside the documented ``_ULP_FACTORS`` pair) on the ``(2, 4)``
+      mesh;
+    * the 2-D loop's measured host-blocking syncs are <= the 1-D
+      sharded loop's on the same year/groups (zero extra syncs per
+      group — the carry threads on device);
+    * the carry-handoff collective count is nonzero;
+    * the handed-off year-end carry bit-equals the single-device
+      ``stream/carry`` prefix-state fold over the same decoded days.
+    """
+    import jax.numpy as jnp
+
+    from replication_of_minute_frequency_factor_tpu.parallel import (
+        resident_mesh)
+    from replication_of_minute_frequency_factor_tpu.stream import (
+        carry as scarry)
+    rng = np.random.default_rng(13)
+    names = tuple(names or factor_names())
+    batches = [make_batch(rng, n_days=days, n_tickers=tickers)
+               for _ in range(n_batches)]
+    use_wire = wire.encode(*batches[0]) is not None
+    group = group or max(1, -(-n_batches // 2))
+    d_sh, t_sh = mesh_shape
+    mesh2d = resident_mesh(shape=mesh_shape)
+    reg = get_telemetry().registry
+    # the reference runs at the SAME scan-group structure: XLA fuses a
+    # handful of kernels (the documented _ULP_FACTORS class) ulp-
+    # differently between an N=1 and an N=2 scan module on ANY
+    # backend, sharded or not — matching N isolates exactly the
+    # sharding question this smoke gates
+    _, _, single = run_resident(batches, names, use_wire,
+                                group=group, keep_results=True)
+    # 1-D sharded sync budget on the same year/groups (the comparison
+    # baseline for "zero extra host-blocking syncs per group")
+    mesh1d = resident_mesh(d_sh * t_sh)
+    s0 = reg.counter_total("bench.host_blocking_syncs")
+    run_resident_sharded(batches, names, use_wire, group, mesh1d)
+    syncs_1d = int(reg.counter_total("bench.host_blocking_syncs") - s0)
+    handoff0 = reg.counter_value("mesh.collective_dispatches",
+                                 label="carry_handoff")
+    s0 = reg.counter_total("bench.host_blocking_syncs")
+    phases, kind, sharded, _ = run_resident_2d(
+        batches, names, use_wire, group, mesh2d, keep_results=True)
+    syncs_2d = int(reg.counter_total("bench.host_blocking_syncs") - s0)
+    handoffs = int(reg.counter_value("mesh.collective_dispatches",
+                                     label="carry_handoff") - handoff0)
+    # the carry fetch rides a SEPARATE run so it cannot blur the sync
+    # comparison above
+    _, _, _, carry = run_resident_2d(batches, names, use_wire,
+                                     len(batches), mesh2d,
+                                     keep_carry=True)
+    bad, max_diff = [], 0.0
+    for s, r in zip(single, sharded):
+        for j, n in enumerate(names):
+            a, b = np.asarray(s[j]), np.asarray(r[j])
+            if np.array_equal(a, b, equal_nan=True):
+                continue
+            f = np.isfinite(a) & np.isfinite(b)
+            d = float(np.abs(a[f] - b[f]).max(initial=0.0))
+            max_diff = max(max_diff, d)
+            scale = float(np.abs(a[f]).max(initial=1.0)) or 1.0
+            if n in _ULP_FACTORS and np.array_equal(
+                    np.isfinite(a), np.isfinite(b)) \
+                    and d <= 16 * np.finfo(np.float32).eps * scale:
+                continue
+            bad.append(n)
+    # single-device reference fold of the cross-day carry: the SAME
+    # decoded days through stream/carry's span_prefix_state — pure
+    # selections + integer counts, so equality must be bitwise
+    bufs, sspec, _ = encode_year(batches, use_wire)
+    if use_wire:
+        dec = jax.jit(lambda b: wire.decode(*wire.unpack(b, sspec)))
+    else:
+        def _dec_raw(b):
+            bars, m = wire.unpack(b, sspec)
+            return bars, m.astype(bool)
+        dec = jax.jit(_dec_raw)
+    state = jax.device_put({**scarry.init_span_state(tickers),
+                            "day": np.full(tickers, -1, np.int32)})
+    fold = jax.jit(lambda s, b, n: scarry.combine_span_state(
+        s, scarry.span_prefix_state(*dec(b), day_base=n * days)))
+    for n_, b in enumerate(bufs):
+        state = fold(state, jax.device_put(b), jnp.int32(n_))
+    ref = jax.device_get(state)
+    carry_ok = bool(
+        carry is not None
+        and np.array_equal(ref["n_bars"], carry["n_bars"])
+        and np.array_equal(ref["has"], carry["has"])
+        and np.array_equal(np.isnan(ref["last_close"]),
+                           np.isnan(carry["last_close"]))
+        and np.array_equal(ref["last_close"][ref["has"]],
+                           carry["last_close"][carry["has"]]))
+    groups = -(-n_batches // group)
+    overlap_ok = groups < 2 or phases.get("ingest_hidden_s", 0) > 0
+    mesh_block = get_telemetry().meshplane.summary()
+    return {"smoke": "resident_2d", "mesh_shape": [d_sh, t_sh],
+            "batches": n_batches, "factors": len(names),
+            "encode_kind": kind, "scan_groups": groups,
+            "syncs_1d": syncs_1d, "syncs_2d": syncs_2d,
+            "carry_handoffs": handoffs, "carry_ok": carry_ok,
+            "ingest_hidden_s": phases.get("ingest_hidden_s"),
+            "pad_waste_by_axis": mesh_block.get(
+                "pad_waste_frac_by_axis"),
+            "mismatched": sorted(set(bad)), "max_abs_diff": max_diff,
+            "ok": (not bad and overlap_ok and carry_ok
+                   and handoffs > 0 and syncs_2d <= syncs_1d)}
 
 
 def probe_latency(rng, n=3):
@@ -2411,23 +2731,50 @@ def main():
     # BENCH_TICKERS smokes pad to the shard multiple only.
     n_shards = 1
     mesh = None
+    mesh2d = None
+    mesh_shape = None
     shard_bucket = 1
     if mode == "resident" and not is_cpu_fallback:
         avail = len(jax.devices())
         n_shards = max(1, min(N_SHARDS or avail, avail))
-        if n_shards > 1:
+        from replication_of_minute_frequency_factor_tpu.pipeline import (
+            TICKER_BUCKET)
+        # r12: BENCH_MESH_DAYS=d lifts the scan to the full (d, t)
+        # mesh — the day axis pipelines groups of scan steps, the
+        # ticker axis stays the wide data-parallel one. Falls back to
+        # the 1-D tickers mesh when t would collapse to 1 (a 2-D
+        # record REQUIRES d > 1 AND t > 1).
+        if MESH_DAYS > 1 and n_shards // MESH_DAYS > 1:
             from replication_of_minute_frequency_factor_tpu.parallel import (
                 resident_mesh)
-            from replication_of_minute_frequency_factor_tpu.pipeline import (
-                TICKER_BUCKET)
-            mesh = resident_mesh(n_shards)
+            d_sh = MESH_DAYS
+            t_sh = n_shards // d_sh
+            n_shards = d_sh * t_sh
+            mesh2d = resident_mesh(shape=(d_sh, t_sh))
+            mesh_shape = (d_sh, t_sh)
             if N_TICKERS >= TICKER_BUCKET:
                 shard_bucket = TICKER_BUCKET
+        elif n_shards > 1:
+            from replication_of_minute_frequency_factor_tpu.parallel import (
+                resident_mesh)
+            mesh = resident_mesh(n_shards)
+            mesh_shape = (1, n_shards)
+            if N_TICKERS >= TICKER_BUCKET:
+                shard_bucket = TICKER_BUCKET
+    if mesh2d is not None and rspec is not None:
+        # the 2-D payload geometry carries the day-group padding: the
+        # result spec must describe the PADDED day extent or the host
+        # decode misreads the slice table (d divides DAYS_PER_BATCH in
+        # the default shapes, so this is usually a no-op)
+        d_pad_days = -(-days // mesh_shape[0]) * mesh_shape[0]
+        if d_pad_days != days:
+            rspec = _rw.ResultWireSpec.for_names(names, days=d_pad_days)
     # sharded default: two scan groups, so group 1's ingest genuinely
     # double-buffers behind group 0's execution (ingest_hidden_s > 0);
     # single-device default stays one group (the r6 3-sync shape)
     group = int(os.environ.get("BENCH_RESIDENT_GROUP", "0")) or (
-        -(-iters // 2) if mesh is not None else iters)
+        -(-iters // 2) if (mesh is not None or mesh2d is not None)
+        else iters)
     warm_info: dict = {}
 
     class _ResidentOOM(RuntimeError):
@@ -2552,6 +2899,66 @@ def main():
                       f"memory; retrying with group={g}",
                       file=sys.stderr, flush=True)
 
+    def _warm_resident_2d(group):
+        """2-D twin of ``_warm_resident_sharded``: compile +
+        first-execute the (d, t) pipelined scan on distinct warm
+        bytes, carry threading + overlapped ingest + fetch included.
+        OOM halves the scan group; an OOM at group == 1 raises
+        ``_ResidentOOM`` and the caller steps down the ladder to the
+        1-D sharded scan (then single-device, then stream)."""
+        wb = [make_batch(rng, n_days=days) for _ in range(iters)]
+        g = group
+        while True:
+            try:
+                t0 = time.perf_counter()
+                wp, _, _, _ = run_resident_2d(wb, names, use_wire, g,
+                                              mesh2d,
+                                              bucket=shard_bucket,
+                                              result_spec=rspec)
+                if rspec is not None and _grow_result_floor(wp):
+                    continue
+                warm_info["warm_total_s"] = round(
+                    time.perf_counter() - t0, 1)
+                warm_info["warm_phases"] = wp
+                return g
+            except Exception as e:  # noqa: BLE001 — filtered to OOM
+                oom = any(s in str(e) for s in
+                          ("RESOURCE_EXHAUSTED", "Out of memory",
+                           "out of memory"))
+                if not oom:
+                    raise
+                if g <= 1:
+                    raise _ResidentOOM(str(e)[:300]) from e
+                g = max(1, g // 2)
+                _flight_note("oom_ladder_demotion",
+                             rung="resident_2d",
+                             action="halve_group", group=g,
+                             error=str(e)[:200])
+                print(f"# 2-D resident scan exhausted device memory; "
+                      f"retrying with group={g}",
+                      file=sys.stderr, flush=True)
+
+    if mode == "resident" and mesh2d is not None:
+        try:
+            group = _warm_resident_2d(group)
+        except _ResidentOOM as e:
+            # first rung of the r12 ladder: 2-D -> 1-D tickers-sharded
+            # (the record's mesh_shape/methodology fields flip with
+            # the fallback, so a 1-D number can never read as 2-D)
+            print("# 2-D resident scan OOM at group=1; falling back "
+                  "to the 1-D tickers-sharded resident scan",
+                  file=sys.stderr, flush=True)
+            _flight_note("oom_ladder_demotion", rung="resident_2d",
+                         action="fallback_1d_sharded",
+                         error=str(e)[:200])
+            warm_info["mesh2d_oom_fallback"] = str(e)[:200]
+            from replication_of_minute_frequency_factor_tpu.parallel import (
+                resident_mesh)
+            mesh2d = None
+            mesh = resident_mesh(n_shards)
+            mesh_shape = (1, n_shards)
+            group = int(os.environ.get("BENCH_RESIDENT_GROUP",
+                                       "0")) or -(-iters // 2)
     if mode == "resident" and mesh is not None:
         try:
             group = _warm_resident_sharded(group)
@@ -2568,6 +2975,7 @@ def main():
                          error=str(e)[:200])
             warm_info["sharded_oom_fallback"] = str(e)[:200]
             mesh = None
+            mesh_shape = None
             n_shards = 1
             group = int(os.environ.get("BENCH_RESIDENT_GROUP",
                                        "0")) or iters
@@ -2687,7 +3095,17 @@ def main():
     with loop_trace:
         if mode == "resident":
             t0 = time.perf_counter()
-            if mesh is not None:
+            if mesh2d is not None:
+                phases, _kind, _, _ = run_resident_2d(
+                    batches, names, use_wire, group, mesh2d,
+                    bucket=shard_bucket, result_spec=rspec)
+                # same loop shape as the 1-D sharded loop: per-group
+                # stacked puts (none host-blocking), the carry threads
+                # on device and is never fetched here
+                round_trips = {"puts_async": -(-iters // group),
+                               "executes": -(-iters // group),
+                               "fetches": -(-iters // group)}
+            elif mesh is not None:
                 phases, _kind, _ = run_resident_sharded(
                     batches, names, use_wire, group, mesh,
                     bucket=shard_bucket, result_spec=rspec)
@@ -2789,7 +3207,7 @@ def main():
     round_trips["predicted_fields"] = ["puts_async", "executes",
                                        "fetches"]
     encode_kind = _encode_kind_delta(kind_before)
-    full_year = per_batch * (TRADING_DAYS_PER_YEAR / days)
+    full_year = per_batch * (TRADING_DAYS_PER_YEAR * YEARS / days)
 
     # the bytes program (ISSUE 10): per-day bytes each way over the
     # timed window, banked as first-class gauges + record blocks so the
@@ -2876,11 +3294,14 @@ def main():
         # under the hardcoded 5000-ticker name, and the session carry
         # would bank it as the headline series); tpu_session's carry
         # additionally rejects non-5000-ticker headline records
-        "metric": f"cicc{len(names)}_{N_TICKERS}tickers_1yr_wall"
+        "metric": f"cicc{len(names)}_{N_TICKERS}tickers_{YEARS}yr_wall"
                   + _SUFFIX,
         "value": round(full_year, 3),
         "unit": "s",
         "tickers": N_TICKERS,
+        # BENCH_YEARS workload multiplier (r12: the decades-x-global-
+        # universe shape); 1 keeps the historical "1yr" metric name
+        "years": YEARS,
         # 'wire' / 'raw' / 'mixed', measured from the registry counter
         # the timed loop's encoders incremented — a raw fallback ships
         # ~4x the bytes and must be visible in the record it distorted
@@ -2916,14 +3337,27 @@ def main():
         # runs stay on their r6/r7 series, so a silent f32 fallback can
         # never smear into the r10 baselines.
         "mode": mode,
+        # r12 DECLARES "r12_resident_2d_v1" for the 2-D (days,
+        # tickers) pipelined scan (day-axis split + cross-day carry
+        # handoff change both the module and the loop); a run whose
+        # mesh fell back to 1-D stays on the r7/r10 sharded series,
+        # and mesh_shape is the discriminator.
         "methodology": (
-            ("r10_resident_sharded_v2" if rspec is not None
-             else "r7_resident_sharded_v1")
+            "r12_resident_2d_v1"
+            if mode == "resident" and mesh2d is not None
+            else ("r10_resident_sharded_v2" if rspec is not None
+                  else "r7_resident_sharded_v1")
             if mode == "resident" and n_shards > 1
             else ("r10_resident_v3" if rspec is not None
                   else "r6_resident_v2") if mode == "resident"
             else ("r10_stream_v4" if rspec is not None
                   else "r6_stream_v3")),
+        # the resolved resident mesh layout ([d, t]; [1, n] for the
+        # 1-D tickers mesh; null when single-device/stream) — the
+        # resident_2d carry rule refuses records without d > 1 AND
+        # t > 1, so a silent 1-D fallback can never bank as 2-D
+        "mesh_shape": (list(mesh_shape)
+                       if mode == "resident" and mesh_shape else None),
         # the result-wire verdict (ISSUE 10): enabled flag, spill
         # budget, per-slice disposition counts, payload vs logical-f32
         # bytes. tpu_session's headline carry REQUIRES this block with

@@ -171,6 +171,13 @@ def drop_conv_only_rolling(steps):
       trajectory feeds the ``<metric>.shard_skew_ratio`` /
       ``.pad_waste_frac`` regress series, so a record with no
       shard-balance telemetry cannot bank;
+    * 'resident_2d' entries (ISSUE 13) must be records of the r12 2-D
+      pipelined scan that GENUINELY ran 2-D: the declared
+      ``r12_resident_2d_v1`` methodology with ``mesh_shape`` d > 1
+      AND t > 1, per-axis mesh watermarks covering both axes, the
+      ``result_wire`` block and an available ``factor_health`` block
+      (:func:`_resident_2d_record_banks`) — a 1-D fallback re-runs on
+      the next multi-device window;
     * 'fleet' entries must be records of the r11 replica fleet that
       actually MULTIPLIED the service (ISSUE 11): the declared
       ``r11_fleet_v1`` methodology with ``live_replicas >= 2`` (a
@@ -227,6 +234,12 @@ def drop_conv_only_rolling(steps):
             # without the pod hbm/counter blocks has no degrade-policy
             # or fold evidence — neither may bank
             return any(_fleet_record_banks(r) for r in recs)
+        if name == "resident_2d":
+            # ISSUE 13: a record whose mesh fell back to 1-D (or whose
+            # balance/wire/data-quality evidence is missing) is not
+            # 2-D validation — it fails loudly and re-runs on the next
+            # multi-device window
+            return any(_resident_2d_record_banks(r) for r in recs)
         return True
 
     return {k: v for k, v in steps.items() if keep(k, v)}
@@ -346,6 +359,65 @@ def step_resident_sharded():
         r["error"] = ("sharded resident record has no mesh "
                       "shard-balance block — cannot bank")
     return r
+
+
+def step_resident_2d():
+    """The r12 2-D ``(days, tickers)`` pipelined resident scan
+    (ISSUE 13), SAME hardware window as the headline and the 1-D
+    sharded step: bench in resident mode with ``BENCH_MESH_DAYS=2``
+    under the ``_2d`` metric suffix. Banks ONLY through
+    :func:`_resident_2d_record_banks` — the mesh must have resolved to
+    a genuinely 2-D ``(d > 1, t > 1)`` shape (on fewer than 4 devices
+    bench falls back to the 1-D loop and this step fails loudly,
+    exactly like resident_sharded on one chip), with the per-axis
+    ``mesh`` watermark block, the ``result_wire`` block and an
+    available ``factor_health`` block riding the record. CPU parity is
+    already gated in tier-1 on the 8-virtual-device ``(2, 4)`` mesh
+    (tests/test_sharded_resident.py + bench.resident_2d_smoke); this
+    is the hardware half. One clean multi-device window therefore
+    banks r12 alongside the carried r7-r11 backlog in one capture."""
+    r = _run_bench_gated({"BENCH_MODE": "resident",
+                          "BENCH_MESH_DAYS": "2",
+                          "BENCH_METRIC_SUFFIX": "_2d",
+                          "BENCH_STAGES": "0", "BENCH_LINK": "0"})
+    if r.get("ok") and not any(
+            _resident_2d_record_banks(rec)
+            for rec in r.get("results") or []
+            if isinstance(rec, dict)):
+        r["ok"] = False
+        r["error"] = ("no r12_resident_2d_v1 record with a 2-D "
+                      "mesh_shape (d > 1 AND t > 1), per-axis mesh "
+                      "watermarks, the result_wire block and an "
+                      "available factor_health block — cannot bank")
+    return r
+
+
+def _resident_2d_record_banks(rec) -> bool:
+    """A resident_2d record banks only when the scan genuinely ran
+    2-D and carried its evidence: the declared ``r12_resident_2d_v1``
+    methodology, a ``mesh_shape`` with d > 1 AND t > 1 (a 1-D
+    fallback measures the r7/r10 loop, not the day pipeline), a
+    ``mesh`` block whose per-axis watermarks cover BOTH axes (PR 9's
+    instrument is what says whether the day pipeline balances), the
+    ``result_wire`` block with ``enabled`` true, and an available
+    ``factor_health`` block — the same silent-fallback-cannot-bank
+    rule as every other step."""
+    ms = rec.get("mesh_shape")
+    mesh = rec.get("mesh")
+    rw = rec.get("result_wire")
+    fh = rec.get("factor_health")
+    axes = (mesh or {}).get("axes") or {}
+    return (rec.get("methodology") == "r12_resident_2d_v1"
+            and isinstance(ms, (list, tuple)) and len(ms) == 2
+            and all(isinstance(x, int) for x in ms)
+            and ms[0] > 1 and ms[1] > 1
+            and isinstance(mesh, dict) and mesh.get("available") is True
+            and isinstance(axes.get("days"), dict)
+            and isinstance(axes.get("tickers"), dict)
+            and (axes["days"].get("shard_time_s") or {})
+            and (axes["tickers"].get("shard_time_s") or {})
+            and isinstance(rw, dict) and rw.get("enabled") is True
+            and isinstance(fh, dict) and fh.get("available") is True)
 
 
 def step_serve():
@@ -603,8 +675,13 @@ def main():
     # fleet's hardware p50/p99/QPS per replica count is this round's
     # must-bank evidence (ISSUE 11) — and it only banks when at least
     # two replicas actually served (a single-chip window cannot)
+    # resident_2d rides directly behind resident_sharded: the r12 2-D
+    # pipelined scan's hardware validation is this round's must-bank
+    # evidence (ISSUE 13), and it only banks when the mesh genuinely
+    # resolved to d > 1 AND t > 1 (>= 4 devices)
     ap.add_argument("--steps", default="headline,resident_sharded,"
-                    "pallas,link,stream,serve,stream_intraday,fleet,"
+                    "resident_2d,pallas,link,stream,"
+                    "serve,stream_intraday,fleet,"
                     "lad1,lad2,lad4,lad5,spot,sweep,pipeline")
     ap.add_argument("--one-step", default=None,
                     help="internal: run one step's body in-process and "
@@ -673,6 +750,7 @@ def main():
              "link": step_link, "pipeline": step_pipeline,
              "stream": step_stream, "pallas": step_pallas,
              "resident_sharded": step_resident_sharded,
+             "resident_2d": step_resident_2d,
              "serve": step_serve,
              "stream_intraday": step_stream_intraday,
              "fleet": step_fleet,

@@ -173,7 +173,14 @@ def run_jaxpr_tier(names: Optional[Sequence[str]] = None, days: int = 2,
 #: all — zero while, zero scan, zero f64, zero callbacks, the full
 #: kernel contract (its cumsum/scatter compaction must never trace to
 #: a serial loop).
+#: ``__resident_scan_2d__`` (ISSUE 13) is the 2-D (days, tickers)
+#: pipelined scan: ONE driving scan like its 1-D siblings, zero
+#: while/f64/callbacks — and its fingerprint must carry ``ppermute``
+#: (the cross-day carry handoff is counted in the collective class;
+#: the leg is emitted even on the one-device trace mesh precisely so
+#: the reserved symbol's committed fingerprint pins it).
 RESIDENT_WRAPPERS = ("__resident_scan__", "__resident_scan_sharded__",
+                     "__resident_scan_2d__",
                      "__stream_update__", "__result_encode__")
 
 #: allowed driving-scan count per wrapper symbol (default 1)
@@ -228,6 +235,22 @@ def resident_wrapper_jaxprs(n_batches: int = 2, days: int = 2,
     out["__resident_scan_sharded__"] = jax.make_jaxpr(
         lambda s: pipeline._compute_packed_scan_sharded(
             s, spec, "raw", names, True, rolling_impl, mesh))(stacked)
+    # the 2-D pipelined scan (ISSUE 13) at the canonical per-tile
+    # shape on the same one-device mesh: the per-tile module is what
+    # every (day-shard, ticker-shard) runs, and the carry-handoff leg
+    # emits its ppermute even at day-axis extent 1 so the fingerprint
+    # carries the collective class
+    stacked_2d = jax.ShapeDtypeStruct((n_batches, 1, 1, buf.shape[0]),
+                                      np.uint8)
+    carry_sds_2d = {
+        "last_close": jax.ShapeDtypeStruct((tickers,), np.float32),
+        "n_bars": jax.ShapeDtypeStruct((tickers,), np.int32),
+        "has": jax.ShapeDtypeStruct((tickers,), np.bool_),
+    }
+    out["__resident_scan_2d__"] = jax.make_jaxpr(
+        lambda s, c: pipeline._compute_packed_scan_2d(
+            s, c, spec, "raw", names, True, rolling_impl,
+            mesh))(stacked_2d, carry_sds_2d)
     carry_sds = jax.tree_util.tree_map(
         lambda x: jax.ShapeDtypeStruct(np.shape(x),
                                        np.asarray(x).dtype),

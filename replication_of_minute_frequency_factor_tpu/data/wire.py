@@ -360,6 +360,67 @@ def pack_sharded(arrays, n_shards: int) -> tuple:
     return np.stack([buf for buf, _ in packs]), packs[0][1]
 
 
+def shard_arrays_2d(arrays, d_shards: int, t_shards: int):
+    """Split a batch's arrays into a ``d_shards x t_shards`` grid of
+    contiguous (day-span, ticker-block) tiles (ISSUE 13).
+
+    Same contract as :func:`shard_arrays`, extended to the days axis:
+    every array of rank >= 2 carries days on axis 0 and tickers on
+    axis 1 and splits on BOTH; scalars (``vol_scale``) replicate into
+    every tile. The split happens AFTER the full-batch encode, so
+    per-tile narrowing decisions cannot diverge — tile (i, j)'s bytes
+    are literally a 2-D slice of the single-device encoding, which is
+    what keeps the 2-D resident scan's per-shard decode bitwise.
+
+    Both extents must divide (callers pad tickers with masked lanes
+    and days with fully-masked filler days first — see
+    ``bench.encode_year_2d``). Returns ``grid[i][j]`` tuples.
+    """
+    arrays = [np.asarray(a) for a in arrays]
+    for a in arrays:
+        if a.ndim >= 2 and (a.shape[0] % d_shards
+                            or a.shape[1] % t_shards):
+            raise ValueError(
+                f"batch extents {a.shape[:2]} do not divide into a "
+                f"({d_shards}, {t_shards}) shard grid — pad the batch "
+                "first")
+    grid = []
+    for i in range(d_shards):
+        row = []
+        for j in range(t_shards):
+            parts = []
+            for a in arrays:
+                if a.ndim >= 2:
+                    dd = a.shape[0] // d_shards
+                    tt = a.shape[1] // t_shards
+                    parts.append(a[i * dd:(i + 1) * dd,
+                                   j * tt:(j + 1) * tt])
+                else:
+                    parts.append(a)
+            row.append(tuple(parts))
+        grid.append(row)
+    return grid
+
+
+def pack_sharded_2d(arrays, d_shards: int, t_shards: int) -> tuple:
+    """Pack a batch as a ``[Sd, St, L]`` stack of per-tile single
+    buffers plus the (shared) per-tile spec — the 2-D twin of
+    :func:`pack_sharded`. A ``NamedSharding`` over the leading two
+    axes (``parallel.mesh.packed_year_2d_spec``) lands tile (i, j)'s
+    bytes on the device owning day-shard i x tickers-shard j, and the
+    on-device :func:`unpack` needs no cross-shard addressing. The spec
+    is identical across tiles by construction (equal extents, shared
+    dtypes) and travels as ONE static jit argument."""
+    grid = [[pack_arrays(cell) for cell in row]
+            for row in shard_arrays_2d(arrays, d_shards, t_shards)]
+    specs = {spec for row in grid for _, spec in row}
+    if len(specs) != 1:  # cannot happen: equal extents + shared dtypes
+        raise AssertionError(f"per-tile specs diverged: {specs}")
+    return (np.stack([np.stack([buf for buf, _ in row])
+                      for row in grid]),
+            grid[0][0][1])
+
+
 def put(wire: WireBatch, shardings=None):
     """device_put the packed representation (decode happens device-side)."""
     if shardings is None:

@@ -18,14 +18,20 @@ from .mesh import (
     day_batch_spec,
     make_mesh,
     mask_spec,
+    packed_year_2d_spec,
     packed_year_spec,
     put_packed_year,
+    put_packed_year_2d,
+    put_span_carry,
     resident_mesh,
+    scan_output_2d_spec,
     scan_output_spec,
     shard_day_batch,
+    span_carry_spec,
 )
 from .collectives import (
     sharded_compute_factors,
+    xs_carry_handoff_local,
     xs_global_rank_local,
     xs_masked_mean,
     xs_masked_std,
@@ -41,10 +47,16 @@ __all__ = [
     "day_batch_spec",
     "mask_spec",
     "packed_year_spec",
+    "packed_year_2d_spec",
     "put_packed_year",
+    "put_packed_year_2d",
+    "put_span_carry",
     "resident_mesh",
     "scan_output_spec",
+    "scan_output_2d_spec",
+    "span_carry_spec",
     "shard_day_batch",
+    "xs_carry_handoff_local",
     "xs_global_rank_local",
     "sharded_compute_factors",
     "xs_masked_mean",

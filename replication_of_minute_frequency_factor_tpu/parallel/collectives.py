@@ -35,7 +35,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.registry import compute_factors
 from ..ops import rank_average
 from ..telemetry import get_telemetry
-from .mesh import TICKERS_AXIS, day_batch_spec, mask_spec
+from .mesh import DAYS_AXIS, TICKERS_AXIS, day_batch_spec, mask_spec
 
 
 # --------------------------------------------------------------------------
@@ -187,6 +187,44 @@ def xs_qcut_local(x, mask, group_num: int, axis_name=TICKERS_AXIS):
     idx = jax.lax.axis_index(axis_name)
     return jax.lax.dynamic_slice_in_dim(
         lab, idx * x.shape[-1], x.shape[-1], axis=-1)
+
+
+def xs_carry_handoff_local(state, combine, axis_name=DAYS_AXIS,
+                           axis_size: int = 1):
+    """Cross-day carry handoff between day-shards (ISSUE 13): combine
+    each shard's end-of-span state into the global prefix state,
+    replicated across the ``d`` axis, through explicit
+    ``lax.ppermute`` legs — the 2-D resident scan's ONE days-axis
+    collective (``xs_global_rank_local`` stays the only cross-TICKER
+    one).
+
+    ``combine(a, b)`` must be associative, commutative and IDEMPOTENT
+    (``stream.carry.combine_span_state`` is, by max-over-distinct-day
+    construction): the handoff runs ``ceil(log2(d))`` doubling rounds
+    of ring-shifted ppermutes, which revisit shards when ``d`` is not
+    a power of two. On a 1-extent day axis the leg degenerates to one
+    identity permute — emitted anyway, so the reserved
+    ``__resident_scan_2d__`` wrapper's jaxpr fingerprint always
+    carries the collective class (analysis/jaxpr_tier.py traces on a
+    one-device mesh).
+
+    Host-side dispatch counting lives with the caller
+    (``mesh.collective_dispatches{label=carry_handoff}`` via
+    ``pipeline.compute_packed_resident_2d``), exactly like the
+    ``_xs_wrap`` collectives.
+    """
+    shifts, s = [], 1
+    while s < axis_size:
+        shifts.append(s)
+        s *= 2
+    if not shifts:
+        shifts = [0]  # identity leg: keep the primitive in the jaxpr
+    for shift in shifts:
+        perm = [(i, (i + shift) % axis_size) for i in range(axis_size)]
+        recv = jax.tree_util.tree_map(
+            lambda x: jax.lax.ppermute(x, axis_name, perm), state)
+        state = combine(state, recv)
+    return state
 
 
 # --------------------------------------------------------------------------

@@ -48,18 +48,30 @@ def make_mesh(
 def resident_mesh(
     n_shards: Optional[int] = None,
     devices: Optional[Sequence] = None,
+    shape: Optional[Tuple[int, int]] = None,
 ) -> Mesh:
-    """A ``(1, n)`` tickers-only mesh for the resident-scan callers.
+    """The resident-scan callers' mesh: ``(1, n)`` tickers-only by
+    default, or a full 2-D ``(d, t)`` via ``shape`` (ISSUE 13).
 
     The streaming pipeline's mesh guard rejects any days dimension
     (batch day counts vary there); the resident scan's batch list is
-    fixed up front, but it shards the TICKERS axis only too — the scan
-    axis is batches, the wide data-parallel axis is tickers, and the
-    per-shard bodies need zero collectives outside the ``doc_pdf*``
-    rank gather. ``n_shards=None`` uses every local device.
+    fixed up front, so it may shard BOTH axes — the scan axis is
+    batches, the wide data-parallel axes are each batch's days and
+    tickers, and the per-shard bodies need zero collectives outside
+    the ``doc_pdf*`` rank gather (tickers axis) and the cross-day
+    carry handoff leg (days axis; ``collectives.
+    xs_carry_handoff_local``). ``n_shards=None`` with no ``shape``
+    uses every local device on the ``(1, n)`` layout.
     """
     if devices is None:
         devices = jax.devices()
+    if shape is not None:
+        d, t = int(shape[0]), int(shape[1])
+        if d < 1 or t < 1 or d * t > len(devices):
+            raise ValueError(
+                f"resident mesh shape {shape} needs {d * t} devices; "
+                f"{len(devices)} visible")
+        return make_mesh((d, t), devices[:d * t])
     if n_shards is None:
         n_shards = len(devices)
     return make_mesh((1, n_shards), devices[:n_shards])
@@ -87,6 +99,49 @@ def put_packed_year(stacked, mesh: Mesh):
     double-buffered group ingest) and never need to block: the
     consuming executable's data dependency orders the transfer."""
     return jax.device_put(stacked, NamedSharding(mesh, packed_year_spec()))
+
+
+def packed_year_2d_spec() -> P:
+    """PartitionSpec for a stacked 2-D packed year ``[N, Sd, St, L]``
+    (batches x day-shards x ticker-shards x per-shard packed bytes):
+    the day-shard axis maps onto the mesh days axis, the ticker-shard
+    axis onto tickers; batches and bytes stay whole. Host-side twin of
+    :func:`..data.wire.pack_sharded_2d`."""
+    return P(None, DAYS_AXIS, TICKERS_AXIS, None)
+
+
+def scan_output_2d_spec() -> P:
+    """PartitionSpec of the 2-D resident scan's ``[N, F, D, T]``
+    output: each batch's day rows shard over the days axis, tickers
+    over tickers — device (i, j) holds its own contiguous
+    ``[N, F, D/d, T/t]`` block until the consolidated fetch."""
+    return P(None, None, DAYS_AXIS, TICKERS_AXIS)
+
+
+def span_carry_spec() -> P:
+    """PartitionSpec of a cross-day carry leaf ``[T]``
+    (:func:`..stream.carry.init_span_state`): sharded over tickers,
+    replicated over the days axis — the post-handoff placement every
+    day-shard agrees on."""
+    return P(TICKERS_AXIS)
+
+
+def put_packed_year_2d(stacked, mesh: Mesh):
+    """device_put a host ``[N, Sd, St, L]`` stacked packed year onto a
+    2-D ``(days, tickers)`` mesh — shard (i, j)'s bytes land on the
+    device owning day-shard i x tickers-shard j. Same async-dispatch
+    contract as :func:`put_packed_year` (callers overlap, never
+    block)."""
+    return jax.device_put(stacked, NamedSharding(mesh,
+                                                 packed_year_2d_spec()))
+
+
+def put_span_carry(carry, mesh: Mesh):
+    """device_put a host cross-day carry (``{last_close, n_bars, has}``
+    ``[T]`` leaves — ``stream.carry.init_span_state``) onto the mesh:
+    sharded over tickers, replicated over days."""
+    s = NamedSharding(mesh, span_carry_spec())
+    return {k: jax.device_put(v, s) for k, v in carry.items()}
 
 
 def day_batch_spec(batched: bool = True) -> P:

@@ -464,6 +464,207 @@ def lower_packed_resident_sharded(stacked, spec, kind, mesh, names,
                     rolling_impl, mesh, result_spec, factor_stats)
 
 
+def _compute_packed_scan_2d(stacked, carry_in, spec, kind, names,
+                            replicate_quirks, rolling_impl, mesh,
+                            result_spec=None, factor_stats=False):
+    """2-D mesh-native resident scan (ISSUE 13): the year as ONE scan
+    executable whose data parallelism spans BOTH axes of a
+    ``(days=d, tickers=t)`` mesh.
+
+    ``stacked`` is ``[N, Sd, St, L]`` uint8 — N scan steps x a
+    ``d x t`` grid of per-tile packed buffers
+    (:func:`..data.wire.pack_sharded_2d`), placed with
+    ``parallel.mesh.packed_year_2d_spec()`` so tile (i, j)'s bytes
+    live on the device owning day-shard i x tickers-shard j. Inside
+    ``shard_map`` each device scans its OWN ``[N, 1, 1, L]`` block:
+    per-tile unpack + decode + the fused factor graph over its
+    ``[D/d, T/t]`` slab. Collective budget per the contract:
+
+    * tickers axis — only the ``doc_pdf*`` global rank gathers (via
+      ``xs_axis_name``; each day-shard row ranks its OWN days'
+      frames, so day sharding adds nothing cross-ticker);
+    * days axis — only the cross-day carry handoff
+      (``parallel.collectives.xs_carry_handoff_local``): each shard's
+      end-of-span intraday prefix state (the ``stream/carry.py``
+      inject pair — ``last_close``/``n_bars`` of the latest day with
+      bars, folded inside the driving scan with the global day index
+      as ordering key) hands off between day-shards through explicit
+      ``lax.ppermute`` legs, leaving the global carry replicated over
+      ``d``.
+
+    ``carry_in`` ({``last_close``, ``n_bars``, ``has``} ``[T]``
+    leaves, tickers-sharded/days-replicated — ``stream.carry.
+    init_span_state`` + ``parallel.mesh.put_span_carry``) seeds the
+    fold; day indices are call-relative, so a caller pipelining scan
+    GROUPS threads the returned carry straight into the next group's
+    call (newer call wins wherever it saw a bar) with zero host
+    syncs. Returns ``(ys, carry)`` — or ``(ys, stats, carry)`` with
+    ``factor_stats`` (a ``(days, tickers)`` tuple restricts the
+    sketch to the logical extents so neither axis's pad filler reads
+    as missing data). Outputs stay sharded until the caller's one
+    consolidated fetch; the carry is O(T) and stays on device between
+    groups — the O(1) host-blocking-syncs-per-year property is
+    unchanged from the 1-D loop."""
+    from jax.sharding import PartitionSpec as P
+
+    from .parallel.collectives import shard_map, xs_carry_handoff_local
+    from .parallel.mesh import (DAYS_AXIS, TICKERS_AXIS,
+                                packed_year_2d_spec, scan_output_2d_spec,
+                                span_carry_spec)
+    from .stream.carry import combine_span_state, span_prefix_state
+
+    d_shards = mesh.shape[DAYS_AXIS]
+    carry_keys = ("last_close", "n_bars", "has")
+
+    def per_shard(bufs, cin):  # local [N, 1, 1, L], {k: [T_local]}
+        i = jax.lax.axis_index(DAYS_AXIS)
+        # the incoming carry is strictly OLDER than anything this call
+        # sees: day -1 loses to every real day, wins where no bar lands
+        state0 = {**cin, "day": jnp.full(cin["n_bars"].shape, -1,
+                                         jnp.int32)}
+
+        def body(c, xs):
+            buf, n = xs
+            arrs = wire.unpack(buf[0, 0], spec)
+            if kind == "wire":
+                bars, m = wire.decode(*arrs)
+            else:
+                bars, m = arrs
+                m = m.astype(bool)
+            out = compute_factors(bars, m, names=names,
+                                  replicate_quirks=replicate_quirks,
+                                  rolling_impl=rolling_impl,
+                                  xs_axis_name=TICKERS_AXIS)
+            y = jnp.stack([out[k] for k in names])
+            d_local = bars.shape[0]
+            # global day order is batch-major, day-shard-minor: step n
+            # covers global days [n*d*D_loc, (n+1)*d*D_loc), this
+            # shard's slab starting at + i*D_loc
+            st = span_prefix_state(
+                bars, m,
+                day_base=n * (d_shards * d_local) + i * d_local)
+            return combine_span_state(c, st), y
+
+        carry, ys = jax.lax.scan(
+            body, state0,
+            (bufs, jnp.arange(bufs.shape[0], dtype=jnp.int32)))
+        carry = xs_carry_handoff_local(carry, combine_span_state,
+                                       axis_name=DAYS_AXIS,
+                                       axis_size=d_shards)
+        # post-handoff every day-shard holds the identical global
+        # state; emit one [1, T_local] row per shard (out_spec stacks
+        # them [d, T]) and let the enclosing module slice row 0 — the
+        # replication is by construction, not by shard_map's checker
+        return ys, {k: carry[k][None] for k in carry_keys}
+
+    fn = shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(packed_year_2d_spec(),
+                  {k: span_carry_spec() for k in carry_keys}),
+        out_specs=(scan_output_2d_spec(),
+                   {k: P(DAYS_AXIS, TICKERS_AXIS) for k in carry_keys}))
+    ys, carry_rows = fn(stacked, carry_in)
+    # slice row 0 (all rows identical post-handoff) and PIN the carry
+    # back onto the canonical tickers-sharded/days-replicated
+    # placement: the caller threads it verbatim into the next group's
+    # compiled call, whose input spec is exactly this NamedSharding —
+    # without the constraint GSPMD parks the slice on day-row 0's
+    # devices and the AOT sharding check rejects the handoff
+    from jax.sharding import NamedSharding
+    carry_sharding = NamedSharding(mesh, span_carry_spec())
+    carry = {k: jax.lax.with_sharding_constraint(v[0], carry_sharding)
+             for k, v in carry_rows.items()}
+    stats = None
+    if factor_stats:
+        # outside the shard_map, like the 1-D sharded scan: GSPMD owns
+        # the cross-shard reductions so the statistics are the GLOBAL
+        # ones. A (days, tickers) tuple restricts the sketch to the
+        # logical extents — neither the lcm ticker pad nor the
+        # day-group pad to d may read as missing data.
+        block = ys
+        if factor_stats is not True:
+            fd, ft = factor_stats
+            block = ys[..., :int(fd), :int(ft)]
+        stats = jax.vmap(_factor_stats_block)(block)
+    if result_spec is not None:
+        # result-wire encode outside the shard_map but inside this one
+        # module (the 1-D rationale): per-(factor, day) min/max spans
+        # the ticker shards, so GSPMD owns those collectives and the
+        # quantization parameters are the global ones
+        ys = result_wire.encode_stacked(ys, result_spec)
+    if factor_stats:
+        return ys, stats, carry
+    return ys, carry
+
+
+_SCAN_2D_STATIC = ("spec", "kind", "names", "replicate_quirks",
+                   "rolling_impl", "mesh", "result_spec", "factor_stats")
+_compute_packed_scan_2d_jit = functools.partial(
+    jax.jit, static_argnames=_SCAN_2D_STATIC)(_compute_packed_scan_2d)
+#: donated twin — the HBM rationale of the 1-D scans, per tile: each
+#: device's [N, 1, 1, L] slice of the year dies at its scan step's
+#: unpack (the carry is O(T) and never donated: the caller threads it
+#: into the next group's call)
+_compute_packed_scan_2d_jit_donated = functools.partial(
+    jax.jit, static_argnames=_SCAN_2D_STATIC,
+    donate_argnums=(0,))(_compute_packed_scan_2d)
+
+
+def compute_packed_resident_2d(stacked, spec, kind, mesh, names,
+                               replicate_quirks=True, rolling_impl=None,
+                               result_spec=None, factor_stats=False,
+                               carry_in=None, n_tickers=None):
+    """Run a mesh-placed ``[N, Sd, St, L]`` packed year through the
+    2-D pipelined scan (see :func:`_compute_packed_scan_2d`); returns
+    ``(ys, carry)`` (or ``(ys, stats, carry)``) STILL SHARDED on
+    device — fetch the exposures once per scan group, thread ``carry``
+    into the next group's call, and fetch it (if at all) once per
+    YEAR. ``carry_in=None`` seeds a fresh empty carry (``n_tickers``
+    = the padded ticker extent; required then). Donation contract
+    matches :func:`compute_packed_resident_sharded` for ``stacked``.
+    Every call counts one ``carry_handoff`` dispatch in
+    ``mesh.collective_dispatches`` — the smoke's nonzero-handoff
+    gate."""
+    from .parallel.mesh import put_span_carry
+    from .stream.carry import init_span_state
+
+    if rolling_impl is None:
+        rolling_impl = get_config().rolling_impl
+    _guard_donated_args((stacked,), "compute_packed_resident_2d")
+    if carry_in is None:
+        if n_tickers is None:
+            raise ValueError("carry_in=None needs n_tickers (the "
+                             "padded ticker extent) to seed the carry")
+        carry_in = put_span_carry(init_span_state(int(n_tickers)), mesh)
+    get_telemetry().meshplane.note_collective("carry_handoff")
+    donating = _donate_device_buffers()
+    fn = (_compute_packed_scan_2d_jit_donated if donating
+          else _compute_packed_scan_2d_jit)
+    out = fn(stacked, carry_in, spec, kind, names, replicate_quirks,
+             rolling_impl, mesh, result_spec, factor_stats)
+    if donating:
+        _invalidate_donated((stacked,))
+    return out
+
+
+def lower_packed_resident_2d(stacked, carry_in, spec, kind, mesh, names,
+                             replicate_quirks=True, rolling_impl=None,
+                             result_spec=None, factor_stats=False):
+    """AOT lowering of the 2-D pipelined scan (twin selection as
+    :func:`compute_packed_resident_2d`); call the compiled executable
+    with ``compiled(stacked, carry_in)``. See
+    :func:`lower_packed_resident` for why bench compiles through
+    this."""
+    if rolling_impl is None:
+        rolling_impl = get_config().rolling_impl
+    fn = (_compute_packed_scan_2d_jit_donated
+          if _donate_device_buffers()
+          else _compute_packed_scan_2d_jit)
+    return fn.lower(stacked, carry_in, spec, kind, names,
+                    replicate_quirks, rolling_impl, mesh, result_spec,
+                    factor_stats)
+
+
 def compute_exposures_streamed(bars, mask, names=None, micro_batch=16,
                                replicate_quirks=True, rolling_impl=None,
                                engine=None):

@@ -141,6 +141,16 @@ class ServeConfig:
     #: (data/result_wire.RESULT_BOUNDS), which answer consumers must
     #: accept; widened slices stay bitwise.
     result_wire: bool = False
+    #: place the streaming carry over a tickers mesh spanning this
+    #: server's devices (ISSUE 13): cohort ingest and snapshot stop
+    #: being single-device-bound — every carry leaf gets a
+    #: ``NamedSharding`` over the replica submesh's ticker axis, with
+    #: snapshot/finalize bitwise the unsharded engine's (the
+    #: tests/test_stream.py re-placement pin). Applied only when more
+    #: than one device is visible AND the universe divides over them;
+    #: otherwise the engine stays single-device, silently — the
+    #: ``stream.carry_sharded`` gauge says which one runs.
+    stream_sharded: bool = False
 
 
 class FactorServer:
@@ -195,12 +205,26 @@ class FactorServer:
             #: ingest/intraday traffic compiles nothing.
             self.stream_engine = None
             if stream:
+                import jax as _jax
+
                 from ..stream.engine import StreamEngine
+                stream_mesh = None
+                if self.scfg.stream_sharded:
+                    from ..parallel.mesh import resident_mesh
+                    devs = (list(self.devices) if self.devices
+                            else list(_jax.devices()))
+                    if (len(devs) > 1
+                            and source.n_tickers % len(devs) == 0):
+                        stream_mesh = resident_mesh(len(devs), devs)
+                self.telemetry.gauge(
+                    "stream.carry_sharded",
+                    0 if stream_mesh is None
+                    else stream_mesh.devices.size)
                 self.stream_engine = StreamEngine(
                     source.n_tickers, names=self.names,
                     replicate_quirks=replicate_quirks,
                     rolling_impl=rolling_impl, telemetry=self.telemetry,
-                    executables=self.executables)
+                    executables=self.executables, mesh=stream_mesh)
                 self.stream_engine.warmup(micro_batches=stream_batches)
         self._q: "queue.Queue" = queue.Queue(maxsize=self.scfg.queue_limit)
         self._state_lock = threading.Lock()

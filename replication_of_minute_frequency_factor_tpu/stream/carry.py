@@ -154,6 +154,84 @@ def finalize_with_readiness(carry, names: Tuple[str, ...],
 
 
 # --------------------------------------------------------------------------
+# cross-day span prefix state (the 2-D resident scan's carry — ISSUE 13)
+# --------------------------------------------------------------------------
+#
+# The 2-D (days, tickers) resident scan threads a tiny per-lane carry
+# across its day-spans: the SAME two reorder-exact accumulators this
+# module's ``finalize`` injects into the batch graph (``inc/bars`` →
+# ``n_bars`` and ``inc/last_close``), taken from the most recent day
+# that held any bar. Keeping the definition HERE — next to the inject
+# pair — is what makes "the intraday prefix state shared with
+# stream/carry.py" literal: a resident year's end carry is exactly the
+# state a streaming engine's accumulators would hold at that day's
+# close, so a resident catch-up can hand a live stream a warm seed.
+# Both fields are pure selections / integer counts, so every fold and
+# handoff below is bitwise under any sharding or combine order.
+
+
+def init_span_state(n_tickers: int) -> Dict[str, object]:
+    """Empty cross-day carry as HOST numpy (callers device_put it with
+    a tickers NamedSharding — ``parallel.mesh.put_span_carry``):
+    ``last_close`` NaN / ``n_bars`` 0 / ``has`` False per lane."""
+    import numpy as np
+
+    return {"last_close": np.full((n_tickers,), np.nan, np.float32),
+            "n_bars": np.zeros((n_tickers,), np.int32),
+            "has": np.zeros((n_tickers,), bool)}
+
+
+def span_prefix_state(bars, mask, day_base=0):
+    """Intraday prefix state of a day-span ``bars [D, T, 240, 5]`` /
+    ``mask [D, T, 240]``: per ticker lane, the finalize-inject pair of
+    the LAST day in the span that held any bar — ``last_close`` (that
+    day's last present close, the fold of ``inc/last_close``) and
+    ``n_bars`` (that day's bar count, ``inc/bars``) — plus ``has``
+    (any bar anywhere in the span) and ``day`` (the global day index
+    that produced the state, ``day_base + local``, ``-1`` when none;
+    the handoff combine's ordering key). Pure selections and integer
+    counts only — bitwise under any span split."""
+    from ..data.minute import F_CLOSE
+
+    n_bars = jnp.sum(mask, axis=-1, dtype=jnp.int32)         # [D, T]
+    slot = jnp.where(mask, jnp.arange(N_SLOTS, dtype=jnp.int32),
+                     jnp.int32(-1))
+    last_slot = jnp.max(slot, axis=-1)                       # [D, T]
+    close = bars[..., F_CLOSE]
+    lc = jnp.take_along_axis(
+        close, jnp.maximum(last_slot, 0)[..., None], axis=-1)[..., 0]
+    day_has = n_bars > 0                                     # [D, T]
+    didx = jnp.arange(bars.shape[0], dtype=jnp.int32)[:, None]
+    last_day = jnp.max(jnp.where(day_has, didx, jnp.int32(-1)),
+                       axis=0)                               # [T]
+    sel = jnp.maximum(last_day, 0)[None, :]
+    has = last_day >= 0
+    pick = lambda a: jnp.take_along_axis(a, sel, axis=0)[0]  # noqa: E731
+    return {
+        "last_close": jnp.where(has, pick(lc), jnp.float32(jnp.nan)),
+        "n_bars": jnp.where(has, pick(n_bars), jnp.int32(0)),
+        "has": has,
+        "day": jnp.where(has, jnp.int32(day_base) + last_day,
+                         jnp.int32(-1)),
+    }
+
+
+def combine_span_state(a, b):
+    """Associative, commutative, IDEMPOTENT combine of two span states
+    sharing one lane axis: the state from the strictly later day wins
+    per lane (day keys are globally distinct by construction, so ties
+    only occur at the empty ``day == -1`` state, whose payload is the
+    shared init value). Idempotence is load-bearing: the ppermute
+    doubling handoff (``parallel.collectives.xs_carry_handoff_local``)
+    revisits shards on non-power-of-two day axes."""
+    newer = b["has"] & (~a["has"] | (b["day"] > a["day"]))
+    out = {k: jnp.where(newer, b[k], a[k])
+           for k in ("last_close", "n_bars", "day")}
+    out["has"] = a["has"] | b["has"]
+    return out
+
+
+# --------------------------------------------------------------------------
 # serialization (mid-day restart: serialize -> restore -> identical tail)
 # --------------------------------------------------------------------------
 

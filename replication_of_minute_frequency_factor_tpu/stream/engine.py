@@ -65,9 +65,20 @@ def scan_update(carry, bars_seq, present_seq):
 def _sds(tree):
     """ShapeDtypeStruct skeleton of a pytree of (device or host)
     arrays — lets every executable build from shapes alone, so warmup
-    moves zero data."""
-    return jax.tree_util.tree_map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
+    moves zero data. Device arrays keep their sharding on the struct
+    (ISSUE 13: a mesh-placed carry's executables compile FOR the
+    ``NamedSharding`` placement, so a sharded engine's warm dispatch
+    is the sharded module, not an unsharded one plus resharding)."""
+    def one(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return x  # pre-built struct (warmup): sharding already set
+        sharding = getattr(x, "sharding", None)
+        if sharding is not None and hasattr(x, "addressable_shards"):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                        sharding=sharding)
+        return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+    return jax.tree_util.tree_map(one, tree)
 
 
 class StreamEngine:
@@ -83,12 +94,53 @@ class StreamEngine:
                  replicate_quirks: bool = True,
                  rolling_impl: Optional[str] = None,
                  telemetry=None,
-                 executables: Optional[ExecutableCache] = None):
+                 executables: Optional[ExecutableCache] = None,
+                 mesh=None):
         from ..config import get_config
         from ..models.registry import factor_names
         from ..telemetry import get_telemetry
 
         self.n_tickers = int(n_tickers)
+        #: ISSUE 13: a tickers mesh (e.g. ``parallel.resident_mesh``
+        #: over a replica's submesh) places the carry — day buffer,
+        #: mask and every per-lane accumulator — with a
+        #: ``NamedSharding`` over the ticker axis, so cohort ingest
+        #: and snapshot dispatch as sharded modules across the
+        #: submesh instead of being single-device-bound. Finalize is
+        #: bitwise under the placement (per-ticker kernels are data
+        #: parallel; the one cross-ticker rank is sort-based, exact),
+        #: which tests/test_stream.py pins: a carry saved unsharded
+        #: and restored onto a different ticker sharding must
+        #: finalize identically.
+        self.mesh = mesh
+        self._shardings = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.mesh import TICKERS_AXIS
+
+            t_shards = mesh.shape[TICKERS_AXIS]
+            if self.n_tickers % t_shards:
+                raise ValueError(
+                    f"n_tickers {self.n_tickers} does not divide over "
+                    f"{t_shards} ticker shards — pad the universe "
+                    "first (TICKER_BUCKET callers already do)")
+            ax = TICKERS_AXIS
+
+            def _leaf_sharding(x):
+                shape = getattr(x, "shape", ())
+                if len(shape) >= 1 and shape[0] == self.n_tickers:
+                    return NamedSharding(mesh, P(ax))
+                return NamedSharding(mesh, P())
+
+            self._shardings = {
+                "leaf": _leaf_sharding,
+                # ingest micro-batch [B, T, 5] / [B, T]: tickers on
+                # axis 1; cohort rows/idx replicate (the scatter's
+                # target is the sharded buffer, not the payload)
+                "minutes": NamedSharding(mesh, P(None, ax)),
+                "repl": NamedSharding(mesh, P()),
+            }
         self.names: Tuple[str, ...] = (tuple(names) if names is not None
                                        else factor_names())
         self.replicate_quirks = replicate_quirks
@@ -161,9 +213,27 @@ class StreamEngine:
         rollup surfaces any skew."""
         return {"minute": self.minutes, "tickers": self.n_tickers}
 
+    def _put_carry(self, host_tree):
+        """One explicit host->device put of a whole carry pytree —
+        with a mesh, every leaf lands under its ``NamedSharding``
+        (per-lane leaves over tickers, scalars replicated) so the
+        whole streaming state is submesh-resident."""
+        if self._shardings is None:
+            return jax.device_put(host_tree)
+        leaf = self._shardings["leaf"]
+        shardings = jax.tree_util.tree_map(leaf, host_tree)
+        return jax.device_put(host_tree, shardings)
+
+    def _put_in(self, x, kind: str):
+        """Place one ingest input (``minutes`` = tickers on axis 1;
+        ``repl`` = replicated cohort payloads)."""
+        if self._shardings is None:
+            return jax.device_put(x)
+        return jax.device_put(x, self._shardings[kind])
+
     def reset(self) -> "StreamEngine":
         """Fresh empty-day carry (one explicit host->device put)."""
-        self.carry = jax.device_put(carry_mod.init_carry(self.n_tickers))
+        self.carry = self._put_carry(carry_mod.init_carry(self.n_tickers))
         self.minutes = 0
         self._note_carry()
         return self
@@ -185,7 +255,11 @@ class StreamEngine:
             raise ValueError(
                 f"snapshot holds {host['mask'].shape[0]} tickers; engine "
                 f"is sized for {self.n_tickers}")
-        self.carry = jax.device_put(host)
+        # re-placement is part of the contract (ISSUE 13): a snapshot
+        # saved under ANY ticker sharding restores onto THIS engine's
+        # placement — the carry is pure state, and the sharded finalize
+        # is bitwise the unsharded one (pinned in tests/test_stream.py)
+        self.carry = self._put_carry(host)
         self.minutes = int(snapshot["t"])
         self._note_carry()
         return self
@@ -202,14 +276,23 @@ class StreamEngine:
         after this, steady-state ingest/snapshot compiles nothing
         (``xla.compiles`` delta == 0, the r9 acceptance gate)."""
         T = self.n_tickers
+
+        def sds(shape, dtype, kind):
+            # shardings ride the structs (see _sds) so a mesh engine's
+            # warmup compiles the SHARDED modules — zero data moved
+            if self._shardings is None:
+                return jax.ShapeDtypeStruct(shape, dtype)
+            return jax.ShapeDtypeStruct(shape, dtype,
+                                        sharding=self._shardings[kind])
+
         for b in micro_batches:
-            bars = np.zeros((int(b), T, 5), np.float32)
-            present = np.zeros((int(b), T), bool)
+            bars = sds((int(b), T, 5), np.float32, "minutes")
+            present = sds((int(b), T), bool, "minutes")
             self._exe("stream_update_scan", (int(b),), self._scan_jit,
                       self.carry, bars, present)
         for k in cohorts:
-            rows = np.zeros((int(k), 5), np.float32)
-            idx = np.zeros((int(k),), np.int32)
+            rows = sds((int(k), 5), np.float32, "repl")
+            idx = sds((int(k),), np.int32, "repl")
             self._exe("stream_update_cohort", (int(k),), self._cohort_jit,
                       self.carry, rows, idx)
         self._exe("stream_advance", (), self._advance_jit, self.carry)
@@ -236,11 +319,12 @@ class StreamEngine:
                 f"ingesting {b} minutes past slot {self.minutes} "
                 f"overruns the {carry_mod.N_SLOTS}-slot day")
         n_bars = int(present.sum())
+        bars_d = self._put_in(bars, "minutes")
+        present_d = self._put_in(present, "minutes")
         exe = self._exe("stream_update_scan", (b,), self._scan_jit,
-                        self.carry, bars, present)
+                        self.carry, bars_d, present_d)
         t0 = time.perf_counter()
-        self.carry = exe(self.carry, jax.device_put(bars),
-                         jax.device_put(present))
+        self.carry = exe(self.carry, bars_d, present_d)
         tel = self.telemetry
         tel.observe("stream.update_seconds",
                     time.perf_counter() - t0, kind="scan")
@@ -264,11 +348,12 @@ class StreamEngine:
             raise TypeError(f"idx must be int32, got {idx.dtype}")
         k = len(idx)
         n_real = int((idx < self.n_tickers).sum())
+        rows_d = self._put_in(rows, "repl")
+        idx_d = self._put_in(idx, "repl")
         exe = self._exe("stream_update_cohort", (k,), self._cohort_jit,
-                        self.carry, rows, idx)
+                        self.carry, rows_d, idx_d)
         t0 = time.perf_counter()
-        self.carry = exe(self.carry, jax.device_put(rows),
-                         jax.device_put(idx))
+        self.carry = exe(self.carry, rows_d, idx_d)
         tel = self.telemetry
         tel.observe("stream.update_seconds",
                     time.perf_counter() - t0, kind="cohort")

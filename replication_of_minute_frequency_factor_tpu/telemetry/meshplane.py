@@ -93,6 +93,8 @@ class MeshPlane:
         self._last_skew: Optional[float] = None
         self._slow_shard: Optional[str] = None
         self._pad_waste: Optional[float] = None
+        self._pad_waste_axes: Dict[str, float] = {}
+        self._axes: Dict[str, dict] = {}
         self._occupancy: Optional[float] = None
         self._collectives = 0
 
@@ -213,6 +215,93 @@ class MeshPlane:
             return {}
         return self.record_shard_times(times, boundary=boundary)
 
+    # --- 2-D per-axis watermarks (ISSUE 13) --------------------------------
+    def record_axis_times(self, axis: str, times: Dict) -> dict:
+        """One per-AXIS balance sample: ``times`` maps an axis
+        coordinate (day-shard row / ticker-shard column) to its
+        completion watermark. Publishes ``mesh.shard_time_s{axis=,
+        shard=}`` gauges and ``mesh.shard_skew_ratio{axis=}`` — the
+        instrument that says whether the day PIPELINE balances apart
+        from whether the ticker split does. Does not advance the
+        skew-burst trigger (the flat per-device sample owns that);
+        returns the axis summary."""
+        try:
+            clean = {str(k): max(0.0, float(v))
+                     for k, v in dict(times).items()}
+        except (TypeError, ValueError):
+            return {}
+        if not clean:
+            return {}
+        tel = self._tel()
+        for k, v in sorted(clean.items()):
+            tel.gauge("mesh.shard_time_s", round(v, 6), shard=k,
+                      axis=axis)
+        med = _median(list(clean.values()))
+        worst = max(clean, key=clean.get)
+        skew = (clean[worst] / med) if med > 0 else 1.0
+        tel.gauge("mesh.shard_skew_ratio", round(skew, 4), axis=axis)
+        summary = {"shard_time_s": {k: round(v, 6)
+                                    for k, v in clean.items()},
+                   "skew_ratio": round(skew, 4), "slow_shard": worst}
+        with self._lock:
+            self._axes[axis] = summary
+        return summary
+
+    def measure_ready_mesh(self, out, mesh, boundary: str = "manual",
+                           t0: Optional[float] = None) -> dict:
+        """:meth:`measure_ready` for a 2-D ``(days, tickers)`` mesh:
+        block per addressable shard, map each device back to its mesh
+        coordinate, and publish BOTH the flat per-device sample (burst
+        trigger included) and the per-axis aggregations — a day-shard
+        row's watermark is the max over its ticker shards (the row is
+        done when its straggler is) and vice versa. Never raises."""
+        if t0 is None:
+            t0 = time.perf_counter()
+        try:
+            devs = mesh.devices  # [d, t] grid of device objects
+            coord = {}
+            for i in range(devs.shape[0]):
+                for j in range(devs.shape[1]):
+                    d = devs[i, j]
+                    coord[f"{d.platform}:{d.id}"] = (i, j)
+            times: Dict[str, float] = {}
+            shards = getattr(out, "addressable_shards", None) or []
+            for s in shards:
+                s.data.block_until_ready()
+                d = s.device if not callable(s.device) else s.device()
+                times[f"{d.platform}:{d.id}"] = time.perf_counter() - t0
+        except Exception:  # noqa: BLE001 — observation must not kill work
+            self._tel().counter("mesh.sample_failures", boundary=boundary)
+            return {}
+        if not times:
+            return {}
+        rows: Dict[str, float] = {}
+        cols: Dict[str, float] = {}
+        for key, v in times.items():
+            if key not in coord:
+                continue
+            i, j = coord[key]
+            rows[f"day{i}"] = max(rows.get(f"day{i}", 0.0), v)
+            cols[f"ticker{j}"] = max(cols.get(f"ticker{j}", 0.0), v)
+        flat = self.record_shard_times(times, boundary=boundary)
+        axes = {"days": self.record_axis_times("days", rows),
+                "tickers": self.record_axis_times("tickers", cols)}
+        return {**flat, "axes": axes}
+
+    def watch_async_mesh(self, out, mesh, boundary: str = "manual",
+                         t0: Optional[float] = None) -> None:
+        """:meth:`measure_ready_mesh` on a daemon thread — same
+        zero-perturbation contract as :meth:`watch_async`."""
+        if t0 is None:
+            t0 = time.perf_counter()
+        th = threading.Thread(target=self.measure_ready_mesh,
+                              args=(out, mesh, boundary, t0),
+                              daemon=True, name="meshplane-watch-2d")
+        with self._lock:
+            self._threads = [t for t in self._threads if t.is_alive()]
+            self._threads.append(th)
+        th.start()
+
     def watch_async(self, out, boundary: str = "manual",
                     t0: Optional[float] = None) -> None:
         """``measure_ready`` on a daemon thread: the hot loop keeps
@@ -256,6 +345,7 @@ class MeshPlane:
                           axis=axis)
         with self._lock:
             self._pad_waste = frac
+            self._pad_waste_axes[str(axis)] = frac
         return frac
 
     def record_occupancy(self, frac, boundary: str = "manual") -> None:
@@ -303,6 +393,14 @@ class MeshPlane:
                 "pad_waste_frac": (round(self._pad_waste, 6)
                                    if self._pad_waste is not None
                                    else None),
+                # per-axis views (ISSUE 13): the (days, tickers) mesh
+                # balances — or doesn't — per axis; ``axes`` carries
+                # the last per-axis watermarks/skew (2-D samples only)
+                # and pad waste keyed by the padded axis (both layouts)
+                "pad_waste_frac_by_axis": {
+                    k: round(v, 6)
+                    for k, v in self._pad_waste_axes.items()},
+                "axes": {k: dict(v) for k, v in self._axes.items()},
                 "occupancy_frac": (round(self._occupancy, 6)
                                    if self._occupancy is not None
                                    else None),

@@ -56,7 +56,14 @@ smeared):
   the PR 9 merge contract) under ``pod``, and ``live_replicas``
   stamping how many replicas actually served; a new workload and a
   new topology, so its records start their own baseline — a
-  single-replica record can never smear onto the serve series).
+  single-replica record can never smear onto the serve series),
+  ``r12_resident_2d_v1`` (ISSUE 13: the 2-D ``(days, tickers)``
+  pipelined resident scan — day-axis split of every batch, groups of
+  scan steps pipelined across day-shards, the cross-day carry handed
+  off through a ppermute leg — changes both the module and the loop;
+  bench stamps it only when the mesh genuinely resolved to d > 1 AND
+  t > 1 (``mesh_shape`` is the discriminator), so a 1-D fallback
+  stays on the r7/r10 sharded series).
 
 Byte sub-series (ISSUE 10): every bench record that carries the
 ``wire.bytes_per_day`` / ``result.bytes_per_day`` gauges contributes
@@ -81,6 +88,12 @@ sampled — occupancy/pad numbers alone never qualify) contributes
 regression the wall-clock headline hides until it IS the wall) and
 ``<metric>.pad_waste_frac`` (the lcm ticker-padding waste — a universe
 or shard-count change that silently doubles dead lanes flags here).
+A 2-D record (ISSUE 13) whose ``mesh.axes`` carries per-axis
+watermarks additionally contributes ``<metric>.skew_days`` /
+``<metric>.skew_tickers`` — the day-pipeline and ticker-split balance
+gate SEPARATELY, because a flat 8-shard skew of 1.0 can hide a day
+axis whose two rows alternate straggling (each row's max hides inside
+the global max/median).
 
 Factor-health sub-series (ISSUE 12, same availability contract): a
 record whose ``factor_health.available`` is true (the fused per-factor
@@ -353,6 +366,23 @@ def derive_records(record: dict) -> List[dict]:
                         "value": float(waste), "unit": "frac",
                         "methodology": meth,
                         "derived_from": "mesh.pad_waste_frac"})
+        # per-axis skew sub-series from 2-D records (ISSUE 13): only
+        # axes with REAL per-axis watermarks qualify — 1-D records
+        # carry no ``axes`` block and derive nothing here
+        axes = mesh.get("axes")
+        if isinstance(axes, dict):
+            for axis, info in sorted(axes.items()):
+                if not isinstance(info, dict):
+                    continue
+                askew = info.get("skew_ratio")
+                if isinstance(askew, (int, float)) \
+                        and not isinstance(askew, bool) and askew > 0 \
+                        and info.get("shard_time_s"):
+                    out.append({"metric": f"{metric}.skew_{axis}",
+                                "value": float(askew), "unit": "ratio",
+                                "methodology": meth,
+                                "derived_from":
+                                    f"mesh.axes.{axis}.skew_ratio"})
     return out
 
 

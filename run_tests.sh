@@ -51,6 +51,17 @@ print(json.dumps(_smoke()))"
     run env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python -c "import json, sys, bench; r = bench.sharded_smoke(); \
 print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
+    # resident-2d smoke (ISSUE 13): the 2-D (days, tickers) pipelined
+    # resident scan on an 8-virtual-device (2, 4) mesh — one JSON
+    # verdict asserting 58-factor parity vs the single-device scan
+    # (bitwise outside the two documented ulp-level ratio kernels),
+    # zero extra host-blocking syncs per group vs the 1-D sharded
+    # loop (the cross-day carry threads on device), a nonzero
+    # carry-handoff collective count, and the handed-off year-end
+    # carry bit-equal to the stream/carry prefix-state fold
+    run env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -c "import json, sys, bench; r = bench.resident_2d_smoke(); \
+print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     # serve smoke (ISSUE 6): an in-process FactorServer on CPU under a
     # handful of concurrent synthetic queries — second identical
     # request compiles nothing, >=1 coalesced multi-request dispatch,

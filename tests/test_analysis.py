@@ -484,8 +484,13 @@ def test_report_carries_resident_wrapper_fingerprints():
     wrappers = rep["jaxpr"]["resident_wrappers"]
     assert set(wrappers) == {"__resident_scan__",
                              "__resident_scan_sharded__",
+                             "__resident_scan_2d__",
                              "__stream_update__",
                              "__result_encode__"}
     for name, fp in wrappers.items():
         want = 0 if name == "__result_encode__" else 1
         assert fp["primitives"].get("scan", 0) == want, name
+    # the 2-D wrapper's committed fingerprint pins the cross-day carry
+    # handoff in the collective class (ISSUE 13)
+    assert wrappers["__resident_scan_2d__"]["primitives"].get(
+        "ppermute", 0) > 0

@@ -112,6 +112,65 @@ def test_measure_ready_watermarks_a_sharded_array():
                                       for d in mesh.devices.flat}
 
 
+def test_record_axis_times_publishes_per_axis_gauges():
+    """ISSUE 13: per-axis watermark samples land under axis-labeled
+    gauges and in summary()['axes'] — without advancing the flat
+    sample's burst machinery."""
+    tel = _tel()
+    r = tel.meshplane.record_axis_times(
+        "days", {"day0": 0.1, "day1": 0.3})
+    assert r["skew_ratio"] == 1.5 and r["slow_shard"] == "day1"
+    tel.meshplane.record_axis_times("tickers", {"ticker0": 0.2})
+    g = tel.registry.snapshot()["gauges"]
+    assert g["mesh.shard_time_s{axis=days,shard=day1}"] == 0.3
+    assert g["mesh.shard_skew_ratio{axis=days}"] == 1.5
+    s = tel.meshplane.summary()
+    assert not s["available"]  # axis samples alone are not a flat one
+    assert s["axes"]["days"]["skew_ratio"] == 1.5
+    assert s["axes"]["tickers"]["shard_time_s"] == {"ticker0": 0.2}
+
+
+def test_measure_ready_mesh_aggregates_rows_and_columns():
+    """The 2-D watcher maps devices back to (day-shard, ticker-shard)
+    coordinates: a row's watermark is the max over its ticker shards,
+    a column's the max over its day shards, and the flat per-device
+    sample (burst machinery included) still happens."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from replication_of_minute_frequency_factor_tpu.parallel import (
+        resident_mesh)
+
+    tel = _tel()
+    mesh = resident_mesh(shape=(2, 4))
+    x = jax.device_put(
+        jnp.zeros((4, 8), jnp.float32),
+        NamedSharding(mesh, P("days", "tickers")))
+    r = tel.meshplane.measure_ready_mesh(x, mesh, boundary="b2d")
+    assert r["n_shards"] == 8
+    assert set(r["axes"]) == {"days", "tickers"}
+    assert set(r["axes"]["days"]["shard_time_s"]) == {"day0", "day1"}
+    assert set(r["axes"]["tickers"]["shard_time_s"]) == {
+        "ticker0", "ticker1", "ticker2", "ticker3"}
+    s = tel.meshplane.summary()
+    assert s["available"] and s["boundaries"] == {"b2d": 1}
+    assert set(s["axes"]) == {"days", "tickers"}
+
+
+def test_pad_waste_by_axis_keeps_both_axes():
+    """Recording tickers then days waste keeps BOTH in the per-axis
+    summary (the flat pad_waste_frac stays last-write for
+    compatibility)."""
+    tel = _tel()
+    tel.meshplane.record_pad_waste(30, 32, axis="tickers")
+    tel.meshplane.record_pad_waste(3, 4, axis="days")
+    s = tel.meshplane.summary()
+    assert s["pad_waste_frac_by_axis"]["tickers"] == 0.0625
+    assert s["pad_waste_frac_by_axis"]["days"] == 0.25
+    assert s["pad_waste_frac"] == 0.25
+
+
 def test_watch_async_does_not_block_and_drains():
     tel = _tel()
     arr = jax.device_put(np.arange(8.0))

@@ -597,3 +597,74 @@ def test_cli_check_r10_break_is_declared(tmp_path):
                               for g in r10_groups)
     assert any("declared break" in g.get("note", "")
                for g in r10_groups)
+
+
+def _rec_2d(skew_days=1.05, skew_tickers=1.0, available=True):
+    rec = _sharded_rec(available=available,
+                       methodology="r12_resident_2d_v1")
+    rec["metric"] = "cicc58_2d_wall"
+    rec["mesh_shape"] = [2, 4]
+    rec["mesh"]["axes"] = {
+        "days": {"shard_time_s": {"day0": 1.0, "day1": skew_days},
+                 "skew_ratio": skew_days},
+        "tickers": {"shard_time_s": {"ticker0": 1.0},
+                    "skew_ratio": skew_tickers}}
+    return rec
+
+
+def test_derive_records_lifts_per_axis_skew_from_2d_records():
+    """ISSUE 13 satellite: a 2-D record's per-axis watermark blocks
+    derive <metric>.skew_days / <metric>.skew_tickers sub-series under
+    the r12 methodology — the day pipeline and the ticker split gate
+    separately."""
+    recs = regress.derive_records(_rec_2d())
+    metrics = [r["metric"] for r in recs]
+    assert "cicc58_2d_wall.skew_days" in metrics
+    assert "cicc58_2d_wall.skew_tickers" in metrics
+    by = {r["metric"]: r for r in recs}
+    assert by["cicc58_2d_wall.skew_days"]["value"] == 1.05
+    assert by["cicc58_2d_wall.skew_days"]["methodology"] \
+        == "r12_resident_2d_v1"
+    assert by["cicc58_2d_wall.skew_days"]["derived_from"] \
+        == "mesh.axes.days.skew_ratio"
+
+
+def test_per_axis_skew_gated_on_availability_and_watermarks():
+    """available: false blocks the whole mesh family; an axis entry
+    with no real watermarks (empty shard_time_s) derives nothing; 1-D
+    records (no axes block) derive only the flat series."""
+    assert all(".skew_" not in r["metric"]
+               for r in regress.derive_records(
+                   _rec_2d(available=False)))
+    hollow = _rec_2d()
+    hollow["mesh"]["axes"]["days"]["shard_time_s"] = {}
+    metrics = [r["metric"] for r in regress.derive_records(hollow)]
+    assert "cicc58_2d_wall.skew_days" not in metrics
+    assert "cicc58_2d_wall.skew_tickers" in metrics
+    flat = [r["metric"] for r in regress.derive_records(_sharded_rec())]
+    assert not any(".skew_" in m for m in flat)
+
+
+def test_cli_check_r12_2d_break_is_declared(tmp_path):
+    """The first r12 record gates as a declared break (reported, never
+    flagged) against a repo whose trajectory holds only earlier
+    series."""
+    with open(tmp_path / "BENCH_r01.json", "w") as fh:
+        json.dump(_sharded_rec(), fh)
+    cand = tmp_path / "cand.json"
+    with open(cand, "w") as fh:
+        json.dump(_rec_2d(), fh)
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = regress.main([str(tmp_path), "--check", str(cand)])
+    assert rc == 0
+    verdict = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert verdict["ok"]
+    r12_groups = [g for g in verdict["groups"]
+                  if g["methodology"] == "r12_resident_2d_v1"]
+    assert r12_groups and all(g["n_baseline"] == 0
+                              for g in r12_groups)
+    assert any(g["metric"].endswith(".skew_days")
+               for g in r12_groups)

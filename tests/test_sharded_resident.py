@@ -27,11 +27,16 @@ from replication_of_minute_frequency_factor_tpu.data import wire
 from replication_of_minute_frequency_factor_tpu.models.registry import (
     factor_names)
 from replication_of_minute_frequency_factor_tpu.parallel import (
-    put_packed_year, resident_mesh)
+    put_packed_year, put_packed_year_2d, put_span_carry, resident_mesh)
+from replication_of_minute_frequency_factor_tpu.stream import (
+    carry as scarry)
 from replication_of_minute_frequency_factor_tpu.telemetry import (
     get_telemetry)
 
 N_SHARDS = 8
+#: the ISSUE 13 acceptance mesh: 2 day-shards x 4 ticker-shards over
+#: the 8 virtual devices
+MESH_2D = (2, 4)
 
 
 def _make_year(n_batches=3, days=2, tickers=32, seed=0):
@@ -137,6 +142,166 @@ def test_sharded_and_plain_scan_twins_share_one_function():
 
 
 # --------------------------------------------------------------------------
+# ISSUE 13: the 2-D (days, tickers) pipelined resident scan
+# --------------------------------------------------------------------------
+
+
+def _run_2d(batches, names, mesh_shape=MESH_2D, group=None,
+            keep_carry=False):
+    """One pass through the real bench loop (encode_year_2d + carry
+    threading + consolidated fetch) at test scale."""
+    mesh = resident_mesh(shape=mesh_shape)
+    group = group or len(batches)
+    return bench.run_resident_2d(batches, names, True, group, mesh,
+                                 keep_results=True,
+                                 keep_carry=keep_carry)
+
+
+def test_resident_2d_matches_single_device_all_58():
+    """THE r12 parity gate: all 58 factors, the (2, 4) 2-D scan vs the
+    single-device resident scan at the same scan-group structure —
+    bitwise for 56, the documented _ULP_FACTORS pair pinned <= 16 f32
+    ulps (the same pin set as the 1-D gate: the day split adds NO new
+    divergence)."""
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+    names = tuple(factor_names())
+    assert len(names) == 58
+    batches = _make_year(n_batches=3, days=4, tickers=32)
+    _, _, single = bench.run_resident(batches, names, True,
+                                      group=3, keep_results=True)
+    _, _, sharded, _ = _run_2d(batches, names)
+    ulp_pair = bench._ULP_FACTORS
+    for s, r in zip(single, sharded):
+        for j, n in enumerate(names):
+            a, b = np.asarray(s[j]), np.asarray(r[j])
+            assert a.shape == b.shape, n  # both paddings sliced back
+            if n in ulp_pair:
+                assert np.array_equal(np.isnan(a), np.isnan(b)), n
+                f = np.isfinite(a)
+                scale = np.abs(a[f]).max(initial=1.0) or 1.0
+                assert np.abs(a[f] - b[f]).max(initial=0.0) \
+                    <= 16 * np.finfo(np.float32).eps * scale, n
+            else:
+                np.testing.assert_array_equal(
+                    a, b, err_msg=f"factor {n} diverged on the 2-D "
+                    "mesh")
+
+
+def test_cross_day_carry_handoff_at_shard_boundary():
+    """ISSUE 13 satellite: a day-shard boundary mid-window. With 4-day
+    batches on (2, 4), days 0-1 and 2-3 of EVERY batch live on
+    different day-shards; the rolling-moment family must stay bitwise
+    across that split, and the year-end carry handed off through the
+    ppermute leg must bit-equal the single-device stream/carry
+    prefix-state fold over the same decoded days."""
+    names = ("mmt_ols_qrs", "doc_kurt", "doc_vol10_ratio",
+             "vol_return1min")
+    batches = _make_year(n_batches=2, days=4, tickers=32, seed=21)
+    _, _, single = bench.run_resident(batches, names, True,
+                                      group=2, keep_results=True)
+    _, _, sharded, carry = _run_2d(batches, names, keep_carry=True)
+    for s, r in zip(single, sharded):
+        np.testing.assert_array_equal(np.asarray(s), np.asarray(r))
+    # reference fold: the SAME wire-decoded days through
+    # stream/carry's span_prefix_state, single device, global order
+    bufs, spec, kind = bench.encode_year(batches, True)
+    assert kind == "wire"
+    dec = jax.jit(lambda b: wire.decode(*wire.unpack(b, spec)))
+    state = jax.device_put({**scarry.init_span_state(32),
+                            "day": np.full(32, -1, np.int32)})
+    fold = jax.jit(lambda s, b, n: scarry.combine_span_state(
+        s, scarry.span_prefix_state(*dec(b), day_base=n * 4)))
+    for n_, b in enumerate(bufs):
+        state = fold(state, jax.device_put(b), np.int32(n_))
+    ref = jax.device_get(state)
+    assert np.array_equal(ref["n_bars"], carry["n_bars"])
+    assert np.array_equal(ref["has"], carry["has"])
+    assert np.array_equal(np.isnan(ref["last_close"]),
+                          np.isnan(carry["last_close"]))
+    f = ref["has"]
+    np.testing.assert_array_equal(ref["last_close"][f],
+                                  carry["last_close"][f])
+    # the carry is the finalize-inject pair: a lane's n_bars is its
+    # LAST day's bar count, not a year total
+    assert carry["n_bars"].max() <= 240
+
+
+def test_carry_threads_across_pipelined_groups():
+    """Pipelining must not change the carry: two scan groups threading
+    the carry on device (group=1) end in the SAME year-end state as
+    one group over the whole year — newer days win per lane across the
+    group boundary exactly as they do across scan steps."""
+    names = ("vol_return1min",)
+    batches = _make_year(n_batches=2, days=2, tickers=32, seed=5)
+    _, _, _, one = _run_2d(batches, names, group=2, keep_carry=True)
+    _, _, _, piped = _run_2d(batches, names, group=1, keep_carry=True)
+    for k in ("last_close", "n_bars", "has"):
+        np.testing.assert_array_equal(one[k], piped[k], err_msg=k)
+
+
+def test_resident_2d_pads_both_axes():
+    """3-day x 30-ticker batches on (2, 4): the days axis pads to 4
+    with fully-masked filler days, tickers to 32 with masked lanes —
+    values must equal the single-device run on the UNPADDED batches,
+    and both paddings must land in the per-axis pad-waste gauges."""
+    names = ("vol_return1min", "doc_pdf60", "trade_headRatio")
+    batches = _make_year(n_batches=2, days=3, tickers=30, seed=3)
+    _, _, single = bench.run_resident(batches, names, True,
+                                      group=2, keep_results=True)
+    _, _, sharded, _ = _run_2d(batches, names)
+    for s, r in zip(single, sharded):
+        assert np.asarray(r).shape == np.asarray(s).shape
+        np.testing.assert_array_equal(np.asarray(s), np.asarray(r))
+    waste = get_telemetry().meshplane.summary()[
+        "pad_waste_frac_by_axis"]
+    assert waste["days"] == pytest.approx(0.25)
+    assert waste["tickers"] == pytest.approx(1 - 30 / 32)
+
+
+def test_resident_2d_sync_budget_and_handoff_count():
+    """The acceptance sync budget: the 2-D loop's measured
+    host-blocking syncs per year stay <= the 1-D sharded loop's
+    1 + n_groups (the carry threads on device, never fetched by the
+    timed loop), and every group counts one carry-handoff collective
+    dispatch."""
+    names = ("vol_return1min", "mmt_am")
+    batches = _make_year(n_batches=4, days=2, tickers=16, seed=7)
+    reg = get_telemetry().registry
+    before = reg.counter_total("bench.host_blocking_syncs")
+    h0 = reg.counter_value("mesh.collective_dispatches",
+                           label="carry_handoff")
+    phases, _, _, carry = _run_2d(batches, names, mesh_shape=(2, 2),
+                                  group=2)
+    syncs = int(reg.counter_total("bench.host_blocking_syncs") - before)
+    handoffs = int(reg.counter_value("mesh.collective_dispatches",
+                                     label="carry_handoff") - h0)
+    n_groups = 2
+    assert syncs <= 1 + n_groups, syncs
+    assert carry is None  # not fetched unless asked
+    assert handoffs == n_groups
+    assert phases["ingest_hidden_s"] > 0  # pipelined ingest overlapped
+
+
+def test_resident_2d_smoke_verdict():
+    """The run_tests.sh --quick smoke's one-line verdict is green on
+    the virtual (2, 4) mesh (restricted family set here; the shell
+    smoke runs all 58)."""
+    r = bench.resident_2d_smoke(names=bench._SMOKE_FACTORS)
+    assert r["ok"] is True, r
+    assert r["mesh_shape"] == [2, 4]
+    assert r["carry_handoffs"] > 0 and r["carry_ok"] is True
+    assert r["syncs_2d"] <= r["syncs_1d"]
+    assert r["mismatched"] == []
+
+
+def test_2d_scan_twins_share_one_function():
+    """Same pin as the r6/r7 twins: a graph fix must land in both the
+    donated and plain 2-D executables."""
+    assert (pipeline._compute_packed_scan_2d_jit.__wrapped__
+            is pipeline._compute_packed_scan_2d_jit_donated.__wrapped__)
+
+
+# --------------------------------------------------------------------------
 # donation contract (ISSUE 5 satellite: pipeline.py:196-199 docstring,
 # machine-checked)
 # --------------------------------------------------------------------------
@@ -194,6 +359,33 @@ def test_debug_validate_guard_names_the_contract(_force_donation):
         with pytest.raises(pipeline.DonatedBufferError,
                            match="donated.*device_put a fresh"):
             pipeline.compute_packed_resident((d,), spec, "raw", names)
+    finally:
+        cfg.debug_validate = old
+
+
+def test_resident_2d_donation_contract(_force_donation):
+    """The 2-D twin enforces the stacked-year contract too; the tiny
+    threaded carry is NOT donated — the caller reuses it across
+    groups."""
+    names = ("vol_return1min",)
+    batches = _make_year(n_batches=2, days=2, tickers=16, seed=9)
+    stacks, spec, kind, t_pad, _d_pad = bench.encode_year_2d(
+        batches, True, *MESH_2D)
+    mesh = resident_mesh(shape=MESH_2D)
+    d = put_packed_year_2d(np.stack(stacks), mesh)
+    cin = put_span_carry(scarry.init_span_state(t_pad), mesh)
+    ys, carry = pipeline.compute_packed_resident_2d(
+        d, spec, kind, mesh, names, carry_in=cin)
+    np.asarray(ys)
+    assert d.is_deleted()
+    assert not cin["n_bars"].is_deleted()  # the carry stays usable
+    cfg = get_config()
+    old = cfg.debug_validate
+    cfg.debug_validate = True
+    try:
+        with pytest.raises(pipeline.DonatedBufferError):
+            pipeline.compute_packed_resident_2d(
+                d, spec, kind, mesh, names, carry_in=cin)
     finally:
         cfg.debug_validate = old
 

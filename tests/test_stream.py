@@ -191,6 +191,59 @@ def test_midday_restart_produces_identical_tail():
         np.testing.assert_array_equal(sa[k], sb[k], err_msg=k)
 
 
+def test_restored_carry_on_different_ticker_sharding_finalizes_identically():
+    """ISSUE 13 satellite pin: a mid-day stream carry saved from an
+    UNSHARDED engine and restored onto a tickers-``NamedSharding``
+    placement (a 4-shard submesh of the 8 virtual devices) must
+    finalize identically — snapshot exposures, readiness AND the
+    continued fold after more ingest are all bitwise. The carry is
+    pure state; placement is an execution detail."""
+    from replication_of_minute_frequency_factor_tpu.parallel import (
+        resident_mesh)
+
+    T = 16
+    bars, mask = _day(tickers=T, seed=17)
+    names = _FAMILY_NAMES[:4]  # incl. doc_pdf60: the one global rank
+    plain = StreamEngine(T, names=names)
+    _feed(plain, bars, mask, 0, 97)  # mid-day, mid-micro-batch
+    snap = plain.save()
+    sharded = StreamEngine(T, names=names,
+                           mesh=resident_mesh(4)).restore(snap)
+    carry_leaf = sharded.carry["bars"]
+    assert len(carry_leaf.sharding.device_set) == 4  # really placed
+    ea, ra = jax.device_get(plain.snapshot())
+    eb, rb = jax.device_get(sharded.snapshot())
+    np.testing.assert_array_equal(ea, eb)
+    np.testing.assert_array_equal(ra, rb)
+    # the continued fold stays bitwise through scan, cohort and
+    # advance updates on the sharded placement
+    _feed(plain, bars, mask, 97, 140)
+    _feed(sharded, bars, mask, 97, 140)
+    rows = np.ascontiguousarray(bars[:3, 140]).astype(np.float32)
+    idx = np.array([0, 5, T], np.int32)  # incl. a dropped pad row
+    for eng in (plain, sharded):
+        eng.ingest_cohort(rows, idx)
+        eng.advance()
+    ea2, _ = jax.device_get(plain.snapshot())
+    eb2, _ = jax.device_get(sharded.snapshot())
+    np.testing.assert_array_equal(ea2, eb2)
+    # and the round trip BACK to an unsharded engine is lossless
+    back = StreamEngine(T, names=names).restore(sharded.save())
+    ea3, _ = jax.device_get(back.snapshot())
+    np.testing.assert_array_equal(ea2, ea3)
+
+
+def test_sharded_engine_rejects_nondividing_universe():
+    """A universe that does not divide over the mesh's ticker shards
+    must fail loudly at construction, not as a GSPMD shape error at
+    first ingest."""
+    from replication_of_minute_frequency_factor_tpu.parallel import (
+        resident_mesh)
+
+    with pytest.raises(ValueError, match="divide"):
+        StreamEngine(15, names=_FAMILY_NAMES[:1], mesh=resident_mesh(4))
+
+
 def test_carry_roundtrip_preserves_every_leaf():
     """carry_to_host / carry_from_host is a lossless flat snapshot."""
     c = sc.init_carry(4)

@@ -516,3 +516,104 @@ def test_fleet_in_default_steps(tpu_session):
     src = open(os.path.join(REPO, "benchmarks", "tpu_session.py")).read()
     assert "stream_intraday,fleet," in src
     assert '"fleet": step_fleet' in src
+
+
+def _rec_2d(**over):
+    """A bankable r12 resident_2d record, override-able per test."""
+    rec = {"metric": "cicc58_5000tickers_1yr_wall_2d", "value": 60.0,
+           "mode": "resident", "tickers": 5000,
+           "methodology": "r12_resident_2d_v1",
+           "mesh_shape": [2, 4],
+           "mesh": {"available": True, "shard_skew_ratio": 1.01,
+                    "axes": {
+                        "days": {"shard_time_s": {"day0": 1.0,
+                                                  "day1": 1.02},
+                                 "skew_ratio": 1.01},
+                        "tickers": {"shard_time_s": {"ticker0": 1.0,
+                                                     "ticker1": 1.0},
+                                    "skew_ratio": 1.0}}},
+           "result_wire": {"enabled": True, "ratio_vs_f32": 1.9},
+           "factor_health": {"available": True, "coverage_frac": 0.97}}
+    rec.update(over)
+    return rec
+
+
+def test_resident_2d_carry_requires_true_2d(tpu_session):
+    """ISSUE 13: a 'resident_2d' entry only carries when the scan
+    genuinely ran 2-D with its evidence — r12 methodology, mesh_shape
+    d > 1 AND t > 1, per-axis watermarks on BOTH axes, the result_wire
+    block and an available factor_health block. A 1-D fallback, a
+    flat-only mesh block, a wire-off run or a dark data-quality plane
+    must re-run."""
+    def entry(rec):
+        return {"resident_2d": {"ok": True, "results": [rec]}}
+
+    good = entry(_rec_2d())
+    assert tpu_session.drop_conv_only_rolling(good) == good
+    # 1-D fallback shapes cannot bank
+    assert tpu_session.drop_conv_only_rolling(
+        entry(_rec_2d(mesh_shape=[1, 8]))) == {}
+    assert tpu_session.drop_conv_only_rolling(
+        entry(_rec_2d(mesh_shape=[8, 1]))) == {}
+    assert tpu_session.drop_conv_only_rolling(
+        entry(_rec_2d(mesh_shape=None))) == {}
+    # wrong series (the 1-D methodology under the _2d suffix)
+    assert tpu_session.drop_conv_only_rolling(
+        entry(_rec_2d(methodology="r10_resident_sharded_v2"))) == {}
+    # flat mesh block without the per-axis watermarks
+    flat = _rec_2d()
+    flat["mesh"] = {"available": True, "shard_skew_ratio": 1.0}
+    assert tpu_session.drop_conv_only_rolling(entry(flat)) == {}
+    one_axis = _rec_2d()
+    del one_axis["mesh"]["axes"]["days"]
+    assert tpu_session.drop_conv_only_rolling(entry(one_axis)) == {}
+    # silent result-wire fallback / dark factor-health plane
+    assert tpu_session.drop_conv_only_rolling(
+        entry(_rec_2d(result_wire={"enabled": False}))) == {}
+    assert tpu_session.drop_conv_only_rolling(
+        entry(_rec_2d(factor_health={"available": False}))) == {}
+    # the 1-D sharded step's own rule is untouched by the 2-D rule
+    sharded = {"resident_sharded": {"ok": True, "results": [
+        {"metric": "cicc58_5000tickers_1yr_wall_sharded",
+         "mode": "resident", "n_shards": 8, "tickers": 5000,
+         "mesh": {"available": True}}]}}
+    assert tpu_session.drop_conv_only_rolling(sharded) == sharded
+
+
+def test_resident_2d_step_refuses_1d_fallback(tpu_session, monkeypatch):
+    """The step flips ok=False when bench fell back to the 1-D loop
+    (mesh_shape [1, n] — fewer than 4 devices) or the evidence blocks
+    are missing, and passes a genuinely 2-D record."""
+    def fake_1d(extra_env):
+        assert extra_env["BENCH_MESH_DAYS"] == "2"
+        assert extra_env["BENCH_METRIC_SUFFIX"] == "_2d"
+        return {"ok": True, "rc": 0,
+                "results": [_rec_2d(mesh_shape=[1, 8],
+                                    methodology="r10_resident_sharded_v2")]}
+    monkeypatch.setattr(tpu_session, "_run_bench_gated", fake_1d)
+    r = tpu_session.step_resident_2d()
+    assert r["ok"] is False and "mesh_shape" in r["error"]
+
+    def fake_no_axes(extra_env):
+        rec = _rec_2d()
+        rec["mesh"]["axes"] = {}
+        return {"ok": True, "rc": 0, "results": [rec]}
+    monkeypatch.setattr(tpu_session, "_run_bench_gated", fake_no_axes)
+    assert tpu_session.step_resident_2d()["ok"] is False
+
+    def fake_good(extra_env):
+        return {"ok": True, "rc": 0, "results": [_rec_2d()]}
+    monkeypatch.setattr(tpu_session, "_run_bench_gated", fake_good)
+    assert tpu_session.step_resident_2d()["ok"] is True
+
+
+def test_resident_2d_in_default_steps(tpu_session):
+    """The first multi-device window banks r12 alongside the r7-r11
+    backlog in one capture: resident_2d rides the default list right
+    behind resident_sharded."""
+    src = open(os.path.join(REPO, "benchmarks", "tpu_session.py")).read()
+    assert '"resident_2d,' in src  # in the default --steps list
+    assert '"resident_2d": step_resident_2d' in src
+    # ordering: the 2-D step rides directly behind resident_sharded
+    flat = src.replace('"\n                    "', "")
+    assert "resident_sharded,resident_2d,pallas" in flat
