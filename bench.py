@@ -2125,6 +2125,281 @@ def stream_main():
 
 
 # --------------------------------------------------------------------------
+# discover mode (ISSUE 14): the factor-discovery engine's candidates/sec
+# --------------------------------------------------------------------------
+
+#: discover-mode knobs (python bench.py discover): population levels
+#: per generation, bounded generation count, and the day-slab shape
+#: the fitness backtest runs over. The headline value is the TOP
+#: level's candidates/sec.
+DISCOVER_POP = os.environ.get("BENCH_DISCOVER_POP", "512,2048,8192")
+DISCOVER_GENERATIONS = int(os.environ.get("BENCH_DISCOVER_GENERATIONS",
+                                          "6"))
+DISCOVER_DAYS = int(os.environ.get("BENCH_DISCOVER_DAYS", "16"))
+DISCOVER_TICKERS = int(os.environ.get("BENCH_DISCOVER_TICKERS", "512"))
+
+
+def discover_bench(pops=None, generations=None, days=None, tickers=None,
+                   skeleton="default", seed=0, telemetry=None,
+                   mesh=None):
+    """Run the bounded evolutionary search at each population level
+    and return the ``r13_discover_v1`` record: banked
+    **candidates/sec** (population x generations / loop wall) plus
+    per-generation p50/p99 and the MEASURED syncs-per-generation
+    (the ``research.host_blocking_syncs`` counter delta over the loop
+    divided by generations — the loop's contract is exactly 1) and
+    the ``xla.compiles`` delta over the generation loop (contract: 0;
+    warmup compiles before the loop).
+
+    The population shards over a ``parallel.resident_mesh`` when more
+    than one device is visible (``discover.n_shards`` in the record
+    says what resolved); the only collective is the end-of-generation
+    top-k gather, counted in
+    ``mesh.collective_dispatches{label=discover_topk}``.
+    """
+    from replication_of_minute_frequency_factor_tpu import search
+    from replication_of_minute_frequency_factor_tpu.research.evolve import (
+        DiscoveryEngine)
+    from replication_of_minute_frequency_factor_tpu.research.fitness import (
+        host_forward_returns)
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        Telemetry, set_telemetry)
+
+    pops = tuple(pops if pops is not None else
+                 (int(s) for s in DISCOVER_POP.split(",") if s.strip()))
+    generations = generations or DISCOVER_GENERATIONS
+    days = days or DISCOVER_DAYS
+    tickers = tickers or DISCOVER_TICKERS
+    tel = telemetry if telemetry is not None else set_telemetry(Telemetry())
+    reg = tel.registry
+
+    if mesh is None and len(jax.devices()) > 1:
+        from replication_of_minute_frequency_factor_tpu.parallel.mesh import (
+            resident_mesh)
+        mesh = resident_mesh(len(jax.devices()))
+
+    rng = np.random.default_rng(11)
+    bars, mask = make_batch(rng, n_days=days, n_tickers=tickers)
+    fwd_ret, fwd_valid = host_forward_returns(bars, mask, horizon=1)
+
+    engine = DiscoveryEngine(skeleton=skeleton, telemetry=tel, mesh=mesh)
+    data = engine.prepare(bars, mask, fwd_ret, fwd_valid)
+
+    stages = {}
+    level_stats = {}
+    results = {}
+    for pop in pops:
+        t0 = time.perf_counter()
+        engine.warmup(data, pop)  # compiles land OUTSIDE the loop
+        stages[f"warm_{pop}_s"] = round(time.perf_counter() - t0, 3)
+        t0 = time.perf_counter()
+        res = engine.evolve(data, pop=pop, generations=generations,
+                            rng=np.random.default_rng(seed))
+        wall = time.perf_counter() - t0
+        stages[f"evolve_{pop}_s"] = round(wall, 3)
+        walls = np.sort(np.asarray(res.gen_walls_s))
+        level_stats[str(pop)] = {
+            "candidates_per_s": round(pop * generations / wall, 1),
+            "gen_p50_ms": round(
+                float(np.percentile(walls, 50)) * 1e3, 2),
+            "gen_p99_ms": round(
+                float(np.percentile(walls, 99)) * 1e3, 2),
+            "syncs_per_generation": res.syncs_per_generation,
+            "compiles_during_loop": res.compiles_during_loop,
+            "best_fitness": round(res.fitness, 6),
+            "occupancy": round(res.occupancy, 4),
+        }
+        results[pop] = res
+        tel.hbm.sample(f"discover.load_{pop}", force=True)
+
+    top = max(pops)
+    top_res = results[top]
+    top_stats = level_stats[str(top)]
+    record = {
+        # metric name derives from the ACTUAL search shape, like every
+        # other mode (a restricted smoke can never print under the
+        # full-shape name)
+        "metric": (f"discover{len(engine.skeleton)}slot_"
+                   f"{tickers}tickers_candidates_per_s" + _SUFFIX),
+        "value": top_stats["candidates_per_s"],
+        "unit": "candidates/s",
+        "tickers": tickers,
+        "days": days,
+        "skeleton_slots": len(engine.skeleton),
+        # DECLARED series (telemetry/regress.py): the discovery engine
+        # is a new workload — candidates/sec records start their own
+        # baseline (the r8/r9/r11 pattern)
+        "methodology": "r13_discover_v1",
+        "p50_ms": top_stats["gen_p50_ms"],
+        "p99_ms": top_stats["gen_p99_ms"],
+        "levels": level_stats,
+        # the discovery block the tpu_session carry rule and the
+        # regress `<metric>.candidates_per_s` sub-series read: zero
+        # completed generations, any loop compile, or a sync budget
+        # past 1/generation all refuse to bank
+        "discover": {
+            "population": top,
+            "generations": top_res.generations,
+            "candidates_per_s": top_stats["candidates_per_s"],
+            "syncs_per_generation": top_stats["syncs_per_generation"],
+            "compiles_during_loop": top_stats["compiles_during_loop"],
+            "best_fitness": round(top_res.fitness, 6),
+            "best_ic": round(top_res.mean_ic, 6),
+            "best_rank_ic": round(top_res.mean_rank_ic, 6),
+            "best_spread": round(top_res.spread, 8),
+            "best_describe": search.describe(top_res.genome,
+                                             engine.skeleton),
+            "n_shards": engine.n_shards,
+            "occupancy": top_stats["occupancy"],
+            "collective_dispatches": int(reg.counter_value(
+                "mesh.collective_dispatches", label="discover_topk")),
+            "data_fingerprint": data.fingerprint,
+        },
+        "hbm": tel.hbm.summary(),
+        "mesh": tel.meshplane.summary(),
+        "factor_health": tel.factorplane.summary(),
+        "stages": stages,
+    }
+    return record
+
+
+def discover_smoke(pop=32, generations=3, days=4, tickers=24):
+    """run_tests.sh --quick smoke (8 virtual CPU devices): the
+    population-sharded generation graph vs the single-device one on a
+    seeded population — finite-fitness COUNT and the device top-k
+    selection set bitwise, the fitness moments ulp-pinned (different
+    module shapes may fuse differently, the vol_upRatio class), zero
+    compiles after warmup across the sharded loop, exactly 1 measured
+    host-blocking sync per generation, and >= 1 top-k collective
+    dispatch counted. One JSON verdict line, nonzero exit on drift."""
+    from replication_of_minute_frequency_factor_tpu import search
+    from replication_of_minute_frequency_factor_tpu.research import (
+        fitness as rf)
+    from replication_of_minute_frequency_factor_tpu.research.evolve import (
+        DiscoveryEngine)
+    from replication_of_minute_frequency_factor_tpu.parallel.mesh import (
+        resident_mesh)
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        Telemetry, set_telemetry)
+
+    tel = set_telemetry(Telemetry())
+    reg = tel.registry
+    n_dev = len(jax.devices())
+    rng = np.random.default_rng(1)
+    bars, mask = make_batch(rng, n_days=days, n_tickers=tickers)
+    fwd_ret, fwd_valid = rf.host_forward_returns(bars, mask, horizon=1)
+
+    # --- fitness equality: one seeded population through BOTH layouts
+    genomes = search.random_population(np.random.default_rng(5), pop)
+    n_elite = 4
+    s1, tv1, ti1 = rf.generation_fitness(
+        genomes, bars, mask, fwd_ret, fwd_valid, chunk=pop // 2,
+        n_elite=n_elite)
+    mesh = resident_mesh(n_dev)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    pad = -pop % n_dev
+    gp = (genomes if not pad else
+          np.concatenate([genomes,
+                          np.zeros((pad, genomes.shape[1]), np.int32)]))
+    rep = NamedSharding(mesh, P())
+    gd = jax.device_put(gp, NamedSharding(mesh, P("tickers", None)))
+    ss, tvs, tis = rf.generation_fitness_sharded(
+        gd, jax.device_put(bars, rep),
+        jax.device_put(mask, rep), jax.device_put(fwd_ret, rep),
+        jax.device_put(fwd_valid, rep), mesh=mesh,
+        skeleton=search.DEFAULT_SKELETON, group_num=5,
+        chunk=max(1, (pop + pad) // n_dev // 2), n_elite=n_elite,
+        n_pop=pop)
+    s1, ti1 = np.asarray(s1), np.asarray(ti1)
+    ss, tis = np.asarray(ss)[:pop], np.asarray(tis)
+    finite_match = bool(np.array_equal(np.isfinite(s1),
+                                       np.isfinite(ss)))
+    topk_match = bool(set(ti1.tolist()) == set(tis.tolist()))
+    scale = np.maximum(np.abs(np.nan_to_num(s1)), 1e-6)
+    max_ulp = float(np.nanmax(
+        np.abs(np.nan_to_num(s1) - np.nan_to_num(ss))
+        / (np.finfo(np.float32).eps * scale)))
+
+    # --- the sharded loop's measured contract on the same data
+    sharded = DiscoveryEngine(telemetry=tel, mesh=mesh)
+    single = DiscoveryEngine(telemetry=tel)
+    data_s = sharded.prepare(bars, mask, fwd_ret, fwd_valid)
+    data_1 = single.prepare(bars, mask, fwd_ret, fwd_valid)
+    sharded.warmup(data_s, pop)
+    single.warmup(data_1, pop)
+    compiles_before = reg.counter_total("xla.compiles")
+    res_s = sharded.evolve(data_s, pop=pop, generations=generations,
+                           rng=np.random.default_rng(3))
+    res_1 = single.evolve(data_1, pop=pop, generations=generations,
+                          rng=np.random.default_rng(3))
+    compiles_during = int(reg.counter_total("xla.compiles")
+                          - compiles_before)
+    same_genome = bool(np.array_equal(res_s.genome, res_1.genome))
+    collectives = int(reg.counter_value("mesh.collective_dispatches",
+                                        label="discover_topk"))
+    ok = (finite_match and topk_match and max_ulp <= 16.0
+          and same_genome
+          and res_s.syncs_per_generation == 1.0
+          and res_s.compiles_during_loop == 0
+          and compiles_during == 0
+          and res_s.n_shards == n_dev and n_dev > 1
+          and collectives >= 1)
+    return {
+        "smoke": "discover",
+        "n_devices": n_dev,
+        "n_shards": res_s.n_shards,
+        "finite_count_match": finite_match,
+        "topk_set_match": topk_match,
+        "fitness_max_ulp": round(max_ulp, 3),
+        "same_best_genome": same_genome,
+        "syncs_per_generation": res_s.syncs_per_generation,
+        "compiles_during_loop": compiles_during,
+        "topk_collective_dispatches": collectives,
+        "ok": bool(ok),
+    }
+
+
+def discover_main():
+    """``python bench.py discover`` — the discovery-mode entry point.
+    Tunnel handling mirrors serve_main/stream_main: preserve the
+    ``discover`` argv through the CPU-fallback execve and flip the
+    metric suffix so a CPU candidates/sec can never be read as a TPU
+    one."""
+    if "PALLAS_AXON_POOL_IPS" in os.environ and not _tunnel_alive():
+        if os.environ.get("BENCH_REQUIRE_TPU"):
+            print("# BENCH_REQUIRE_TPU set and tunnel unreachable; "
+                  "aborting instead of CPU fallback", file=sys.stderr,
+                  flush=True)
+            return 17
+        env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}
+        env["JAX_PLATFORMS"] = "cpu"
+        env["BENCH_METRIC_SUFFIX"] = "_cpu_fallback_tunnel_down"
+        os.execve(sys.executable,
+                  [sys.executable, os.path.abspath(__file__),
+                   "discover"], env)
+    if os.environ.get("BENCH_REQUIRE_TPU") \
+            and jax.devices()[0].platform == "cpu":
+        print("# BENCH_REQUIRE_TPU set but jax resolved to CPU; aborting",
+              file=sys.stderr, flush=True)
+        return 17
+    _wait_host_quiet()
+    from replication_of_minute_frequency_factor_tpu.config import (
+        apply_compilation_cache, get_config)
+    apply_compilation_cache(get_config())
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        Telemetry, set_telemetry, get_telemetry)
+    set_telemetry(Telemetry())
+    record = discover_bench(telemetry=get_telemetry())
+    print(json.dumps(record))
+    tdir = os.environ.get("BENCH_TELEMETRY_DIR")
+    if tdir:
+        get_telemetry().write(
+            tdir, manifest_extra={"run_kind": "bench_discover"})
+    return 0
+
+
+# --------------------------------------------------------------------------
 # ops-plane smoke (ISSUE 8): tracing + flight recorder + watermarks +
 # Prometheus, end to end
 # --------------------------------------------------------------------------
@@ -3452,4 +3727,6 @@ if __name__ == "__main__":
         sys.exit(stream_main())
     if len(sys.argv) > 1 and sys.argv[1] == "fleet":
         sys.exit(fleet_main())
+    if len(sys.argv) > 1 and sys.argv[1] == "discover":
+        sys.exit(discover_main())
     main()

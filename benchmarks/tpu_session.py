@@ -240,6 +240,13 @@ def drop_conv_only_rolling(steps):
             # 2-D validation — it fails loudly and re-runs on the next
             # multi-device window
             return any(_resident_2d_record_banks(r) for r in recs)
+        if name == "discover":
+            # ISSUE 14: zero completed generations means the loop
+            # never ran, a loop compile means the fitness executable
+            # was not warm, and more than one sync per generation
+            # means fitness round-tripped the host mid-generation —
+            # none of those measured the discovery engine's contract
+            return any(_discover_record_banks(r) for r in recs)
         return True
 
     return {k: v for k, v in steps.items() if keep(k, v)}
@@ -563,6 +570,59 @@ def _fleet_record_banks(rec) -> bool:
             and pod["counter_totals"].get("mismatched") == 0)
 
 
+def step_discover():
+    """The r13 factor-discovery engine (ISSUE 14) on the chip:
+    ``bench.py discover`` runs the bounded evolutionary search at the
+    first two declared population levels and banks candidates/sec +
+    per-generation p50/p99 under ``r13_discover_v1`` (8192-candidate
+    sweeps stay for dedicated windows via BENCH_DISCOVER_POP). The
+    carry rule (:func:`_discover_record_banks`) refuses records with
+    zero completed generations, any compile during the generation
+    loop, or more than one measured host-blocking sync per generation
+    — a cold or chatty loop measures dispatch overhead, not the
+    engine."""
+    r = _run_json_lines(
+        [sys.executable, "bench.py", "discover"], timeout=1800,
+        env=dict(os.environ, BENCH_REQUIRE_TPU="1",
+                 BENCH_DISCOVER_POP="512,2048"))
+    if r.get("ok"):
+        recs = [rec for rec in r.get("results") or []
+                if isinstance(rec, dict)]
+        if any("_cpu_fallback" in str(rec.get("metric", ""))
+               for rec in recs):
+            r["ok"] = False
+            r["error"] = "discover bench printed a CPU-fallback metric"
+        elif not any(_discover_record_banks(rec) for rec in recs):
+            r["ok"] = False
+            r["error"] = ("no r13_discover_v1 record with completed "
+                          "generations, a warm loop and <= 1 sync per "
+                          "generation — cannot bank")
+    return r
+
+
+def _discover_record_banks(rec) -> bool:
+    """A discover record banks only when the generation loop really
+    ran warm and inside its sync budget: declared methodology,
+    ``generations > 0`` (zero completed generations measured
+    nothing), ``compiles_during_loop == 0`` (a compile inside the
+    loop means the fitness executable was cold — the number measures
+    XLA, not the engine), and ``syncs_per_generation <= 1`` (the
+    loop's whole point is ONE labeled host sync per generation; a
+    chattier loop is the r5-era round-trip regression). The ``hbm``
+    watermark block rides along like every post-ISSUE-8 carry."""
+    d = rec.get("discover")
+    hbm = rec.get("hbm")
+    return (rec.get("methodology") == "r13_discover_v1"
+            and isinstance(d, dict)
+            and isinstance(d.get("generations"), int)
+            and d["generations"] > 0
+            and d.get("compiles_during_loop") == 0
+            and isinstance(d.get("syncs_per_generation"), (int, float))
+            and not isinstance(d.get("syncs_per_generation"), bool)
+            and d["syncs_per_generation"] <= 1
+            and isinstance(hbm, dict) and "available" in hbm)
+
+
 def step_ladder():
     return _run_json_lines(
         [sys.executable, "benchmarks/ladder.py", "--configs", "1,2,4,5"],
@@ -679,9 +739,12 @@ def main():
     # pipelined scan's hardware validation is this round's must-bank
     # evidence (ISSUE 13), and it only banks when the mesh genuinely
     # resolved to d > 1 AND t > 1 (>= 4 devices)
+    # discover rides directly behind fleet: the r13 discovery engine's
+    # hardware candidates/sec is this round's must-bank evidence
+    # (ISSUE 14), and its carry rule refuses cold or chatty loops
     ap.add_argument("--steps", default="headline,resident_sharded,"
                     "resident_2d,pallas,link,stream,"
-                    "serve,stream_intraday,fleet,"
+                    "serve,stream_intraday,fleet,discover,"
                     "lad1,lad2,lad4,lad5,spot,sweep,pipeline")
     ap.add_argument("--one-step", default=None,
                     help="internal: run one step's body in-process and "
@@ -754,6 +817,7 @@ def main():
              "serve": step_serve,
              "stream_intraday": step_stream_intraday,
              "fleet": step_fleet,
+             "discover": step_discover,
              "lad1": _step_ladder_one("1"), "lad2": _step_ladder_one("2"),
              "lad4": _step_ladder_one("4"), "lad5": _step_ladder_one("5")}
     want = [s.strip() for s in args.steps.split(",") if s.strip()]

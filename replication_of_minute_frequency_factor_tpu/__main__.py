@@ -139,6 +139,16 @@ def _add_serve(sub: "argparse._SubParsersAction") -> None:
     p.add_argument("--stream-batches", default="1",
                    help="comma-separated ingest micro-batch minute "
                         "counts warmed at startup (default: 1)")
+    p.add_argument("--research", action="store_true",
+                   help="also host the factor-discovery engine "
+                        "(ISSUE 14): POST /v1/discover runs a "
+                        "bounded-generations evolutionary search, the "
+                        "winning genome registers as a live "
+                        "disc_<hash> factor, GET /v1/factors lists "
+                        "built-in + discovered (docs/discovery.md)")
+    p.add_argument("--research-dir", default=None, metavar="DIR",
+                   help="persist discovered-genome records as "
+                        "<name>.json under DIR")
     p.add_argument("--fleet", type=int, default=0, metavar="N",
                    help="run N FactorServer replicas over DISJOINT "
                         "device submeshes behind the coalescing-"
@@ -178,7 +188,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
         source = SyntheticSource(n_days=args.synthetic_days,
                                  n_tickers=args.synthetic_tickers)
     scfg = ServeConfig(batch_window_s=args.batch_window_ms / 1e3,
-                       cache_bytes=args.cache_mb * 1024 * 1024)
+                       cache_bytes=args.cache_mb * 1024 * 1024,
+                       research_dir=args.research_dir)
     telemetry_dir = getattr(args, "telemetry_dir", None)
 
     def _write_bundle():
@@ -196,7 +207,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
                                 _write_bundle)
     with FactorServer(source, names=names, serve_cfg=scfg,
                       telemetry=tel, stream=args.stream,
-                      stream_batches=stream_batches or (1,)) as server:
+                      stream_batches=stream_batches or (1,),
+                      research=args.research) as server:
         if args.demo is not None:
             client = server.client()
             w = max(2, min(8, source.n_days))

@@ -48,8 +48,13 @@ LOOP_SCOPE = ("ops", "models")
 #: replicas' dispatch queues — a sync on the routing path would stall
 #: the whole pod, so the layer keeps the full rule with two declared
 #: boundary modules (below).
+#: ``research`` joined with ISSUE 14: the discovery loop's whole
+#: contract is ONE host-blocking sync per generation — any stray sync
+#: in the layer silently doubles the budget — so the layer keeps the
+#: full rule with ``research/evolve.py`` as its declared boundary
+#: (the per-generation fitness fetch).
 HOST_SYNC_SCOPE = ("ops", "models", "parallel", "serve", "stream",
-                   "telemetry", "fleet")
+                   "telemetry", "fleet", "research")
 #: module-granular GL-A3 extensions (ISSUE 10): ``data/`` as a layer is
 #: host-side by design (the ingest encoder and the parquet IO live
 #: there), but ``data/result_wire.py`` is device-hot — its encode fuses
@@ -88,9 +93,15 @@ MASKED_SCOPE = ("models",)
 #: fused ``[F, 9]`` stats side-output (telemetry/factorplane.py) —
 #: the stats ride a fetch that already happened, and the
 #: materialization stays centralized there, never in an instrumented
-#: hot path.
+#: hot path. ISSUE 14 adds the research layer's one boundary: the
+#: evolutionary loop's single ``np.asarray`` materializes one
+#: generation's ``[P, 4]`` stats matrix — the ONE labeled
+#: host-blocking sync of the generation contract
+#: (research/evolve.py); the fitness graph and the genome registry
+#: keep the full rule.
 GLA3_BOUNDARY_SYNCS = {
     "serve/service.py": frozenset({"np.asarray"}),
+    "research/evolve.py": frozenset({"np.asarray"}),
     "telemetry/opsplane.py": frozenset({".memory_stats()",
                                         "jax.live_arrays"}),
     "telemetry/meshplane.py": frozenset({".block_until_ready()"}),

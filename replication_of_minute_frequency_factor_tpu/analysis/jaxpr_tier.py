@@ -179,9 +179,20 @@ def run_jaxpr_tier(names: Optional[Sequence[str]] = None, days: int = 2,
 #: (the cross-day carry handoff is counted in the collective class;
 #: the leg is emitted even on the one-device trace mesh precisely so
 #: the reserved symbol's committed fingerprint pins it).
+#: ``__discover_generation__`` (ISSUE 14) is the factor-discovery
+#: engine's per-generation fitness graph
+#: (``research/fitness.generation_fitness_sharded``): evaluation +
+#: IC + decile spread fused per population chunk, folded through ONE
+#: sequential ``lax.map`` (the HBM-bounding driving scan — traced
+#: with chunk < pop so the scan is always in the fingerprint), zero
+#: while/f64/host-callbacks, and the fingerprint pins the
+#: end-of-generation top-k gather's collective class (all_gather +
+#: top_k — emitted on the one-device trace mesh like the 2-D scan's
+#: ppermute).
 RESIDENT_WRAPPERS = ("__resident_scan__", "__resident_scan_sharded__",
                      "__resident_scan_2d__",
-                     "__stream_update__", "__result_encode__")
+                     "__stream_update__", "__result_encode__",
+                     "__discover_generation__")
 
 #: allowed driving-scan count per wrapper symbol (default 1)
 WRAPPER_SCAN_ALLOWANCE = {"__result_encode__": 0}
@@ -270,6 +281,26 @@ def resident_wrapper_jaxprs(n_batches: int = 2, days: int = 2,
         lambda x: result_wire.encode_block(x, rspec))(
             jax.ShapeDtypeStruct((len(names), days, tickers),
                                  np.float32))
+    # the discovery generation graph (ISSUE 14) on the same one-device
+    # mesh at a canonical pop=4/chunk=2 shape: chunk < pop forces the
+    # HBM-bounding lax.map into the trace (it IS the allowed driving
+    # scan), and the top-k gather emits its all_gather even at mesh
+    # extent 1 so the committed fingerprint pins the collective class
+    from ..research import fitness as research_fitness
+    from ..search import DEFAULT_SKELETON
+
+    pop = 4
+    out["__discover_generation__"] = jax.make_jaxpr(
+        lambda g, b, m, r, v:
+        research_fitness.generation_fitness_sharded(
+            g, b, m, r, v, mesh=mesh, skeleton=DEFAULT_SKELETON,
+            group_num=5, chunk=2, n_elite=2, n_pop=pop))(
+        jax.ShapeDtypeStruct((pop, len(DEFAULT_SKELETON)), np.int32),
+        jax.ShapeDtypeStruct((days, tickers, SLOTS, N_FIELDS),
+                             np.float32),
+        jax.ShapeDtypeStruct((days, tickers, SLOTS), np.bool_),
+        jax.ShapeDtypeStruct((days, tickers), np.float32),
+        jax.ShapeDtypeStruct((days, tickers), np.bool_))
     return out
 
 

@@ -96,3 +96,26 @@ def _qcut_labels_jit(exposure, valid, group_num: int):
 def coverage_counts(valid):
     """Per-date count of usable exposures (Factor.py:92-105)."""
     return jnp.sum(valid, axis=-1)
+
+
+def decile_spread(exposure, fwd_ret, valid, group_num: int = 5):
+    """Per-date long-short spread of the exposure's quantile buckets.
+
+    ``exposure``/``fwd_ret``/``valid``: ``[dates, tickers]``. Buckets
+    come from :func:`_qcut_labels_jit` (the production qcut core —
+    reused, not reimplemented, so a discovered factor's backtest
+    buckets can never drift from the serving layer's decile answers);
+    the spread is ``mean(fwd_ret | top bucket) - mean(fwd_ret | bottom
+    bucket)`` per date, NaN where either end bucket is empty. This is
+    the decile half of the research fitness graph
+    (:mod:`.research.fitness`): IC says *monotone association*, the
+    end-bucket spread says *tradeable separation* — a factor can have
+    a decent IC and an untradeably flat tail.
+    """
+    labels = _qcut_labels_jit(exposure, valid, group_num)  # [D, T]
+    onehot = labels[..., None] == jnp.arange(group_num)    # [D, T, G]
+    okr = onehot & (valid & jnp.isfinite(fwd_ret))[..., None]
+    n = jnp.sum(okr, axis=-2)                              # [D, G]
+    s = jnp.sum(jnp.where(okr, fwd_ret[..., None], 0.0), axis=-2)
+    mean_ret = jnp.where(n > 0, s / jnp.maximum(n, 1), jnp.nan)
+    return mean_ret[..., -1] - mean_ret[..., 0]            # [D]

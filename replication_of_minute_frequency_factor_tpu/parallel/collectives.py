@@ -189,6 +189,37 @@ def xs_qcut_local(x, mask, group_num: int, axis_name=TICKERS_AXIS):
         lab, idx * x.shape[-1], x.shape[-1], axis=-1)
 
 
+def xs_population_topk_local(stats_local, k: int, n_pop: int,
+                             axis_name=TICKERS_AXIS):
+    """End-of-generation top-k gather for the population-sharded
+    discovery loop (ISSUE 14) — the ONE collective of
+    ``research/fitness.generation_fitness_sharded``.
+
+    ``stats_local [P_local, 4]`` is this shard's slice of the
+    generation's stats matrix (column 0 = the selection fitness).
+    One tiled ``all_gather`` along the population axis reassembles the
+    global ``[P_pad, 4]`` matrix in shard order — exactly the
+    single-device layout, since the host sharded the genome matrix
+    contiguously — then every shard computes the identical top-k
+    locally (the gather-compute shape of :func:`xs_global_rank_local`:
+    the gathered frame is tiny, ``P x 4`` f32). Rows at or past
+    ``n_pop`` are shard-multiple padding and are masked to -inf before
+    the top-k (a padding genome must never be selected); NaN fitness
+    ranks below every finite candidate, matching host selection's
+    ``nan_to_num(-1)``. Returns ``(stats [P_pad, 4], top_vals [k],
+    top_idx [k])``, replicated.
+
+    Host-side dispatch counting lives with the caller
+    (``mesh.collective_dispatches{label=discover_topk}`` via
+    ``research/evolve.py``), exactly like the ``_xs_wrap``
+    collectives."""
+    full = jax.lax.all_gather(stats_local, axis_name, axis=0, tiled=True)
+    fit = jnp.nan_to_num(full[:, 0], nan=-1.0)
+    fit = jnp.where(jnp.arange(fit.shape[0]) < n_pop, fit, -jnp.inf)
+    top_vals, top_idx = jax.lax.top_k(fit, k)
+    return full, top_vals, top_idx
+
+
 def xs_carry_handoff_local(state, combine, axis_name=DAYS_AXIS,
                            axis_size: int = 1):
     """Cross-day carry handoff between day-shards (ISSUE 13): combine

@@ -1,0 +1,25 @@
+"""research/ — the distributed factor-discovery engine (ISSUE 14).
+
+The fourth resident subsystem (after ``serve/``, ``stream/``,
+``fleet/``): mass-produces candidate factors by evolutionary search
+over :mod:`..search`'s genome space, with each generation's fitness a
+fused on-device backtest (per-candidate exposures -> per-date
+Pearson/rank IC + decile long-short spread in ONE XLA module,
+:mod:`.fitness`), the population sharded across
+``parallel.resident_mesh`` (:mod:`.evolve`), and every discovered
+genome registered as a stable, serveable factor name
+(:mod:`.registry`). ``serve/`` grows a ``research=True`` mode that
+runs discovery jobs on the request queue and serves the results live
+(docs/discovery.md).
+"""
+
+from .evolve import DiscoveryEngine, DiscoveryResult
+from .fitness import host_forward_returns
+from .registry import (DiscoveredFactor, discovered_names, genome_name,
+                       load_record, register_genome)
+
+__all__ = [
+    "DiscoveryEngine", "DiscoveryResult", "DiscoveredFactor",
+    "discovered_names", "genome_name", "host_forward_returns",
+    "load_record", "register_genome",
+]

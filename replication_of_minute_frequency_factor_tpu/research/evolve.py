@@ -1,0 +1,342 @@
+"""The population-sharded evolutionary loop over the resident mesh.
+
+``DiscoveryEngine`` owns the per-generation device graph
+(:mod:`.fitness`) as a warm AOT executable in the serving layer's
+:class:`..serve.executables.ExecutableCache` (built through
+``compile_with_telemetry``, so ``xla.compiles`` is the ground truth
+for "did the generation loop compile") and runs the host-side GA
+around it: selection, mutation and crossover stay host-side on the
+int genome matrix — cheap numpy on a ``[P, L]`` int32 array — and
+consume ONLY the fetched ``[P, 4]`` stats matrix.
+
+Sync budget (counter-asserted like the resident scan's
+``1 + n_groups``): each generation performs exactly ONE host-blocking
+sync — the ``np.asarray`` that materializes the generation's stats
+matrix — counted at the call site in
+``research.host_blocking_syncs{point=generation_fetch}``. The genome
+upload is an async ``device_put`` ordered by the executable's data
+dependency; nothing else crosses the boundary until the next
+generation's fetch.
+
+graftlint note (docs/static-analysis.md): this file is the declared
+GL-A3 *boundary module* of the ``research/`` layer — its one allowed
+host sync is that per-generation fitness fetch. Everything device-side
+stays in :mod:`.fitness`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import search
+
+#: named skeletons a service request can address without shipping slot
+#: lists over the wire (the genome record persists the resolved ints)
+SKELETONS = {"default": search.DEFAULT_SKELETON,
+             "rich": search.RICH_SKELETON}
+
+
+def resolve_skeleton(skeleton) -> Tuple[int, ...]:
+    """A skeleton argument as the canonical int tuple: a name from
+    :data:`SKELETONS` or an explicit slot sequence."""
+    if isinstance(skeleton, str):
+        try:
+            return SKELETONS[skeleton]
+        except KeyError:
+            raise ValueError(
+                f"unknown skeleton {skeleton!r} (one of "
+                f"{tuple(SKELETONS)})") from None
+    return tuple(int(s) for s in skeleton)
+
+
+@dataclasses.dataclass
+class DiscoveryData:
+    """Device-resident day tensor + forward returns for one search
+    job: put once in :meth:`DiscoveryEngine.prepare`, reused by every
+    generation (the loop ships only genomes)."""
+    bars: object
+    mask: object
+    fwd_ret: object
+    fwd_valid: object
+    shape: Tuple[int, ...]          # mask shape [D, T, 240]
+    fingerprint: str                # data provenance (registry record)
+    horizon: int = 1
+
+    @property
+    def device_args(self) -> tuple:
+        return (self.bars, self.mask, self.fwd_ret, self.fwd_valid)
+
+
+@dataclasses.dataclass
+class DiscoveryResult:
+    """One bounded-generations search: the best genome with its full
+    backtest stats, plus the loop's measured evidence (sync budget,
+    compile count, per-generation walls) — what the bench record, the
+    serve answer and the registry all consume."""
+    genome: np.ndarray              # [L] int32
+    skeleton: Tuple[int, ...]
+    fitness: float                  # |mean IC| of the best genome
+    mean_ic: float
+    mean_rank_ic: float
+    spread: float
+    history: np.ndarray             # best fitness per generation
+    generations: int
+    pop: int
+    occupancy: float                # pop / padded population
+    n_shards: int
+    syncs_per_generation: float     # measured counter delta / gens
+    compiles_during_loop: int       # xla.compiles delta over the loop
+    gen_walls_s: Sequence[float]
+    fingerprint: str
+    #: the final generation's on-device top-k (values, indices) —
+    #: still device arrays; tests fetch them to cross-check the
+    #: collective's selection against the host argsort
+    device_topk: tuple = ()
+
+
+class DiscoveryEngine:
+    """Bounded evolutionary search with a warm fused fitness graph.
+
+    ``mesh`` (a ``parallel.resident_mesh``) shards the population over
+    the tickers axis; ``None`` runs single-device. The engine shares
+    an :class:`..serve.executables.ExecutableCache` with its caller
+    (the serving layer passes its own, so a server's discovery jobs
+    and its query graphs live in one compile-count ground truth).
+    """
+
+    def __init__(self, skeleton="default", group_num: int = 5,
+                 device_batch: int = 1024, telemetry=None,
+                 executables=None, mesh=None):
+        from ..serve.executables import ExecutableCache
+        self.skeleton = resolve_skeleton(skeleton)
+        self.group_num = int(group_num)
+        self.device_batch = int(device_batch)
+        self.telemetry = telemetry
+        self.executables = (executables if executables is not None
+                            else ExecutableCache(telemetry=telemetry))
+        self.mesh = mesh
+
+    def _tel(self):
+        if self.telemetry is not None:
+            return self.telemetry
+        from ..telemetry import get_telemetry
+        return get_telemetry()
+
+    @property
+    def n_shards(self) -> int:
+        if self.mesh is None:
+            return 1
+        from ..parallel.mesh import TICKERS_AXIS
+        return int(self.mesh.shape[TICKERS_AXIS])
+
+    # --- data placement -------------------------------------------------
+    def _replicated_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, P())
+
+    def _genome_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import TICKERS_AXIS
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, P(TICKERS_AXIS, None))
+
+    def prepare(self, bars, mask, fwd_ret, fwd_valid,
+                horizon: int = 1) -> DiscoveryData:
+        """device_put the job's day tensor + forward returns (host
+        numpy in, device handles out — replicated over the mesh when
+        sharded). One put per job; generations reuse the handles."""
+        import jax
+
+        from .registry import data_fingerprint
+        bars = np.ascontiguousarray(bars, np.float32)
+        mask = np.ascontiguousarray(mask, bool)
+        fwd_ret = np.ascontiguousarray(fwd_ret, np.float32)
+        fwd_valid = np.ascontiguousarray(fwd_valid, bool)
+        fp = data_fingerprint(bars, mask)
+        s = self._replicated_sharding()
+        put = (jax.device_put if s is None
+               else (lambda x: jax.device_put(x, s)))
+        return DiscoveryData(bars=put(bars), mask=put(mask),
+                             fwd_ret=put(fwd_ret),
+                             fwd_valid=put(fwd_valid),
+                             shape=mask.shape, fingerprint=fp,
+                             horizon=int(horizon))
+
+    # --- the generation executable --------------------------------------
+    def _pad_pop(self, pop: int) -> int:
+        return pop + (-pop % self.n_shards)
+
+    def _generation_exe(self, data: DiscoveryData, pop: int,
+                        n_elite: int):
+        """The warm per-generation executable for ``(data shape, pop,
+        n_elite)`` — AOT-lowered from ShapeDtypeStructs (zero data
+        moved at build), compiled once into the shared cache."""
+        import jax
+
+        from . import fitness as F
+        p_pad = self._pad_pop(pop)
+        chunk = min(self.device_batch,
+                    max(1, p_pad // self.n_shards),
+                    search.auto_chunk(data.shape))
+        gshape = (p_pad, len(self.skeleton))
+        mesh_key = (None if self.mesh is None
+                    else tuple(str(d) for d in
+                               self.mesh.devices.ravel()))
+        key = ("discover_generation", self.skeleton, self.group_num,
+               chunk, int(n_elite), pop, p_pad, data.shape, mesh_key)
+
+        def sds(shape, dtype, sharding):
+            if sharding is None:
+                return jax.ShapeDtypeStruct(shape, dtype)
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+
+        rep = self._replicated_sharding()
+        g_sds = sds(gshape, np.int32, self._genome_sharding())
+        b_sds = sds(data.shape[:2] + (data.shape[-1], 5), np.float32,
+                    rep)
+        m_sds = sds(data.shape, bool, rep)
+        fr_sds = sds(data.shape[:2], np.float32, rep)
+        fv_sds = sds(data.shape[:2], bool, rep)
+
+        if self.mesh is None:
+            lower = lambda: F.generation_fitness.lower(
+                g_sds, b_sds, m_sds, fr_sds, fv_sds,
+                skeleton=self.skeleton, group_num=self.group_num,
+                chunk=chunk, n_elite=int(n_elite))
+        else:
+            lower = lambda: F.generation_fitness_sharded.lower(
+                g_sds, b_sds, m_sds, fr_sds, fv_sds, mesh=self.mesh,
+                skeleton=self.skeleton, group_num=self.group_num,
+                chunk=chunk, n_elite=int(n_elite), n_pop=pop)
+        return self.executables.get("discover_generation", key, lower)
+
+    def warmup(self, data: DiscoveryData, pop: int,
+               elite_frac: float = 0.1) -> None:
+        """Compile the generation executable for this (data, pop)
+        shape — after this the generation loop compiles NOTHING
+        (``xla.compiles`` delta == 0, the r13 acceptance gate)."""
+        self._generation_exe(data, pop, self._n_elite(pop, elite_frac))
+
+    @staticmethod
+    def _n_elite(pop: int, elite_frac: float) -> int:
+        return max(2, min(pop, int(pop * elite_frac)))
+
+    # --- the loop -------------------------------------------------------
+    def evolve(self, data: DiscoveryData, pop: int = 256,
+               generations: int = 8, elite_frac: float = 0.1,
+               mutate_p: float = 0.15,
+               rng: Optional[np.random.Generator] = None,
+               seed: int = 0) -> DiscoveryResult:
+        """Run a bounded-generations GA over ``data``.
+
+        Reproducibility contract (docs/discovery.md): the search is a
+        a pure function of ``(data, pop, generations, elite_frac,
+        mutate_p, rng state, skeleton)`` — ``rng`` is the EXPLICIT
+        generator threaded through every random draw (``seed`` seeds a
+        fresh one when absent), mirroring the determinism fix in
+        :func:`..search.evolve`.
+        """
+        import jax
+
+        tel = self._tel()
+        reg = tel.registry
+        if rng is None:
+            rng = np.random.default_rng(seed)
+        pop = int(pop)
+        generations = int(generations)
+        n_elite = self._n_elite(pop, elite_frac)
+        exe = self._generation_exe(data, pop, n_elite)
+        p_pad = self._pad_pop(pop)
+        occupancy = pop / p_pad
+        tel.gauge("discover.population_occupancy", occupancy)
+
+        bounds = search._gene_bounds(self.skeleton)
+        genomes = search.random_population(rng, pop, self.skeleton)
+        pad_rows = np.zeros((p_pad - pop, len(self.skeleton)), np.int32)
+        g_sharding = self._genome_sharding()
+
+        best_g = genomes[0].copy()
+        best_stats = np.full(4, np.nan, np.float32)
+        best_stats[0] = -1.0
+        history = []
+        gen_walls = []
+        device_topk: tuple = ()
+
+        def syncs():
+            return reg.counter_value("research.host_blocking_syncs",
+                                     point="generation_fetch")
+        syncs_before = syncs()
+        compiles_before = reg.counter_total("xla.compiles")
+        t_loop = time.perf_counter()
+        for _ in range(generations):
+            t0 = time.perf_counter()
+            gp = (genomes if not len(pad_rows)
+                  else np.concatenate([genomes, pad_rows]))
+            gd = (jax.device_put(gp) if g_sharding is None
+                  else jax.device_put(gp, g_sharding))
+            if self.mesh is not None:
+                # host-dispatch accounting for the one collective in
+                # the module (the end-of-generation top-k gather) —
+                # same counting seat as parallel/collectives._xs_wrap
+                tel.meshplane.note_collective("discover_topk")
+            stats_dev, top_vals, top_idx = exe(gd, *data.device_args)
+            with tel.tracer("research.generation_fetch"):
+                # the ONE host-blocking sync of the generation (the
+                # declared GL-A3 boundary of research/): everything
+                # below is numpy on the fetched [P, 4] matrix
+                stats = np.asarray(stats_dev)[:pop]
+            tel.counter("research.host_blocking_syncs",
+                        point="generation_fetch")
+            device_topk = (top_vals, top_idx)
+
+            fits = np.nan_to_num(stats[:, 0], nan=-1.0)
+            order = np.argsort(-fits, kind="stable")
+            if fits[order[0]] > best_stats[0]:
+                best_stats = stats[order[0]].copy()
+                best_stats[0] = fits[order[0]]
+                best_g = genomes[order[0]].copy()
+            history.append(float(fits[order[0]]))
+            tel.counter("discover.generations")
+            tel.gauge("discover.best_ic", float(best_stats[1]))
+            # refill: uniform crossover of random elite pairs +
+            # per-gene mutation — search.evolve's operators, threaded
+            # through THIS loop's explicit rng
+            elite = genomes[order[:n_elite]]
+            pa = elite[rng.integers(0, n_elite, pop - n_elite)]
+            pb = elite[rng.integers(0, n_elite, pop - n_elite)]
+            take = rng.random(pa.shape) < 0.5
+            children = np.where(take, pa, pb)
+            mut = rng.random(children.shape) < mutate_p
+            children = np.where(
+                mut,
+                (rng.random(children.shape) * bounds).astype(np.int32),
+                children)
+            genomes = np.concatenate([elite, children])
+            gen_walls.append(time.perf_counter() - t0)
+        wall = time.perf_counter() - t_loop
+        cps = (pop * generations / wall) if wall > 0 else 0.0
+        tel.gauge("discover.candidates_per_s", cps)
+        n_syncs = syncs() - syncs_before
+        return DiscoveryResult(
+            genome=best_g, skeleton=self.skeleton,
+            fitness=float(best_stats[0]),
+            mean_ic=float(best_stats[1]),
+            mean_rank_ic=float(best_stats[2]),
+            spread=float(best_stats[3]),
+            history=np.asarray(history), generations=generations,
+            pop=pop, occupancy=occupancy, n_shards=self.n_shards,
+            syncs_per_generation=(n_syncs / generations
+                                  if generations else 0.0),
+            compiles_during_loop=int(
+                reg.counter_total("xla.compiles") - compiles_before),
+            gen_walls_s=[round(w, 6) for w in gen_walls],
+            fingerprint=data.fingerprint,
+            device_topk=device_topk)

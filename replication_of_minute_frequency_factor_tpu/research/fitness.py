@@ -1,0 +1,183 @@
+"""Fused per-generation backtest fitness: evaluation IS the fitness.
+
+One generation of the discovery loop is ONE XLA module:
+``search.eval_programs`` evaluates the whole candidate population into
+per-candidate exposures ``[P, D, T]``, then — without leaving the
+device — the per-date cross-sectional Pearson/rank IC
+(:func:`..eval_ops.ic_series`) and the decile long-short spread
+(:func:`..eval_ops.decile_spread`, the production qcut core) reduce
+each candidate to four scalars. There is NO host fetch between
+evaluation and fitness; the host sees one ``[P, 4]`` stats matrix per
+generation (the evolutionary loop's single labeled sync,
+:mod:`.evolve`).
+
+HBM stays bounded exactly like :func:`..search.fitness`: populations
+larger than ``chunk`` fold through a sequential ``lax.map`` over
+chunk-sized slices — the ONE driving scan the reserved Tier B symbol
+``__discover_generation__`` allows (analysis/jaxpr_tier.py), and the
+same ``[chunk, D, T, 240]`` temporary budget BENCHMARKS cfg5 measured
+at 3.6 ms/candidate-class.
+
+Sharding (ISSUE 14): fitness is embarrassingly parallel per candidate,
+so the population axis maps onto the mesh tickers axis via
+``shard_map`` with the day tensor replicated; the only collective is
+the end-of-generation top-k gather
+(:func:`..parallel.collectives.xs_population_topk_local`).
+
+Stats column order (the ``[P, 4]`` matrix): ``fitness`` (=|mean IC|,
+the selection scalar — NaN when no date produced an IC), ``mean_ic``
+(signed), ``mean_rank_ic`` (signed Spearman), ``spread`` (mean decile
+long-short spread).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import search
+from ..eval_ops import decile_spread, ic_series
+
+#: stats-matrix column order (see module docstring)
+STAT_COLUMNS = ("fitness", "mean_ic", "mean_rank_ic", "spread")
+
+
+def host_forward_returns(bars: np.ndarray, mask: np.ndarray,
+                         horizon: int = 1
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side ``(fwd_ret [D, T], fwd_valid [D, T])`` from a day
+    slab: each day's last present bar's close, then
+    ``close[d+h]/close[d] - 1`` with the final ``h`` days invalid —
+    numpy-on-numpy (no device round trip; the slab is already host
+    data in every discovery caller), mirroring the serve engine's
+    on-device ``_fwd_returns`` so the two legs agree on semantics."""
+    bars = np.ascontiguousarray(bars, np.float32)
+    mask = np.ascontiguousarray(mask, bool)
+    slots = np.arange(mask.shape[-1])
+    last = np.max(np.where(mask, slots, -1), axis=-1)       # [D, T]
+    valid = last >= 0
+    close = np.take_along_axis(
+        bars[..., 3], np.maximum(last, 0)[..., None], axis=-1)[..., 0]
+    close = np.where(valid, close, np.nan).astype(np.float32)
+    h = int(horizon)
+    pad_c = np.full((h,) + close.shape[1:], np.nan, np.float32)
+    pad_v = np.zeros((h,) + valid.shape[1:], bool)
+    fwd_close = np.concatenate([close[h:], pad_c])
+    fwd_ok = np.concatenate([valid[h:], pad_v])
+    with np.errstate(invalid="ignore", divide="ignore"):
+        ret = (fwd_close / close - 1.0).astype(np.float32)
+    return ret, fwd_ok & valid
+
+
+def _candidate_stats(genomes, bars, mask, fwd_ret, fwd_valid,
+                     skeleton, group_num: int):
+    """The fused body for one population slice: ``[p, L]`` genomes ->
+    ``[p, 4]`` stats. Evaluation (exposures), IC and decile spread
+    trace into one graph — no intermediate leaves the module."""
+    vals = search.eval_programs(genomes, bars, mask, skeleton)  # [p, D, T]
+    valid = jnp.isfinite(vals) & fwd_valid[None]
+    x = jnp.where(valid, vals, 0.0)
+    y = jnp.broadcast_to(jnp.where(valid, fwd_ret[None], 0.0), vals.shape)
+    ic, rank_ic = ic_series(x, y, valid)                        # [p, D] x2
+    mean_ic = jnp.nanmean(ic, axis=-1)
+    mean_rank_ic = jnp.nanmean(rank_ic, axis=-1)
+    spread = jax.vmap(
+        lambda e, v: decile_spread(e, fwd_ret, v, group_num))(vals, valid)
+    mean_spread = jnp.nanmean(spread, axis=-1)
+    fitness = jnp.abs(mean_ic)  # the selection scalar (search.fitness)
+    return jnp.stack([fitness, mean_ic, mean_rank_ic, mean_spread],
+                     axis=-1)
+
+
+def generation_stats(genomes, bars, mask, fwd_ret, fwd_valid,
+                     skeleton: Tuple[int, ...], group_num: int = 5,
+                     chunk: Optional[int] = None):
+    """One generation's fused fitness: ``[P, L]`` int32 genomes ->
+    ``[P, 4]`` f32 stats (column order :data:`STAT_COLUMNS`).
+
+    ``chunk`` bounds the live ``[chunk, D, T, 240]`` stack temporaries
+    (default: :func:`..search.auto_chunk` of the day-tensor shape);
+    populations past it fold through ONE sequential ``lax.map`` —
+    the driving scan of the ``__discover_generation__`` contract.
+    """
+    p_total = genomes.shape[0]
+    if chunk is None:
+        chunk = search.auto_chunk(mask.shape)
+
+    def one_chunk(g):
+        return _candidate_stats(g, bars, mask, fwd_ret, fwd_valid,
+                                skeleton, group_num)
+
+    if p_total <= chunk:
+        return one_chunk(genomes)
+    pad = -p_total % chunk
+    g = genomes
+    if pad:
+        g = jnp.concatenate([g, jnp.zeros((pad, g.shape[1]), g.dtype)])
+    out = jax.lax.map(one_chunk, g.reshape(-1, chunk, g.shape[1]))
+    return out.reshape(-1, out.shape[-1])[:p_total]
+
+
+@functools.partial(jax.jit, static_argnames=("skeleton", "group_num",
+                                             "chunk", "n_elite"))
+def generation_fitness(genomes, bars, mask, fwd_ret, fwd_valid,
+                       skeleton: Tuple[int, ...] = search.DEFAULT_SKELETON,
+                       group_num: int = 5, chunk: Optional[int] = None,
+                       n_elite: int = 2):
+    """Single-device generation graph: ``(stats [P, 4], top_vals [k],
+    top_idx [k])`` — the device top-k mirrors the sharded path's
+    post-gather top-k so both layouts return the same signature (NaN
+    fitness ranks below every finite candidate, as host selection's
+    ``nan_to_num(-1)``)."""
+    stats = generation_stats(genomes, bars, mask, fwd_ret, fwd_valid,
+                             skeleton, group_num, chunk)
+    fit = jnp.nan_to_num(stats[:, 0], nan=-1.0)
+    top_vals, top_idx = jax.lax.top_k(fit, n_elite)
+    return stats, top_vals, top_idx
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "skeleton",
+                                             "group_num", "chunk",
+                                             "n_elite", "n_pop"))
+def generation_fitness_sharded(genomes, bars, mask, fwd_ret, fwd_valid,
+                               mesh, skeleton: Tuple[int, ...],
+                               group_num: int, chunk: Optional[int],
+                               n_elite: int, n_pop: int):
+    """Population-sharded generation graph over a tickers mesh.
+
+    ``genomes [P_pad, L]`` shard ``P('tickers', None)`` (the
+    population rides the mesh's wide axis; ``P_pad`` is the
+    shard-multiple padding, ``n_pop`` the logical population — pad
+    rows are masked to -inf before the top-k so a zero genome can
+    never be selected); the day tensor is replicated. Each shard
+    evaluates its local slice through the SAME fused body as the
+    single-device graph; the one collective is the end-of-generation
+    top-k gather (``collectives.xs_population_topk_local``), after
+    which stats and top-k are replicated on every shard.
+    """
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.collectives import xs_population_topk_local
+    from ..parallel.mesh import TICKERS_AXIS
+
+    def body(g_local, b, m, fr, fv):
+        local = generation_stats(g_local, b, m, fr, fv, skeleton,
+                                 group_num, chunk)
+        return xs_population_topk_local(local, n_elite, n_pop,
+                                        axis_name=TICKERS_AXIS)
+
+    rep = P()
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(TICKERS_AXIS, None), rep, rep, rep, rep),
+        out_specs=(rep, rep, rep),
+        check_rep=False)
+    return fn(genomes, bars, mask, fwd_ret, fwd_valid)

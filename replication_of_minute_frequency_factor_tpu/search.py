@@ -44,7 +44,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -399,15 +399,25 @@ def evolve(bars, mask, fwd_ret, fwd_valid,
            pop: int = 1024, generations: int = 10,
            elite_frac: float = 0.1, mutate_p: float = 0.15,
            skeleton=DEFAULT_SKELETON, seed: int = 0,
-           device_batch: int = 1024) -> SearchResult:
+           device_batch: int = 1024,
+           rng: Optional[np.random.Generator] = None) -> SearchResult:
     """Host-side GA around the device fitness kernel.
 
     Tournament-free truncation GA: keep the elite, refill with uniform
     crossover of elite pairs + per-gene mutation. Each generation is ONE
     fused device call; HBM stays bounded by ``fitness``'s internal
     ``lax.map`` chunking, capped at ``min(device_batch, auto_chunk)``.
+
+    Reproducibility (ISSUE 14): ``rng`` threads ONE explicit
+    ``np.random.Generator`` through population init, crossover and
+    mutation — the discovered genome is a pure function of
+    ``(inputs, skeleton, GA knobs, rng state)``, so a caller can
+    reproduce (or resume) a search in another process by shipping the
+    generator state instead of trusting ambient RNG. ``seed`` seeds a
+    fresh generator when ``rng`` is absent (the historical surface).
     """
-    rng = np.random.default_rng(seed)
+    if rng is None:
+        rng = np.random.default_rng(seed)
     bounds = _gene_bounds(skeleton)
     genomes = random_population(rng, pop, skeleton)
     n_elite = max(2, int(pop * elite_frac))

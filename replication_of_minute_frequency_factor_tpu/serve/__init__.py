@@ -33,6 +33,14 @@ same request queue (:class:`Ingest`, ``POST /v1/ingest``) and
 ``Query(kind="intraday")`` serves the carry's partial-day exposures;
 see docs/streaming.md.
 
+Research (ISSUE 14): ``FactorServer(research=True)`` additionally owns
+a :class:`..research.evolve.DiscoveryEngine` — ``POST /v1/discover``
+runs a bounded-generations evolutionary factor search on the request
+queue, the winning genome registers as a live ``disc_<hash>`` factor
+name (``GET /v1/factors`` lists built-in + discovered), and the new
+name is immediately queryable through ``/v1/query``; see
+docs/discovery.md.
+
 Run it: ``python -m replication_of_minute_frequency_factor_tpu serve``
 (see docs/serving.md); load-bench it: ``python bench.py serve``.
 """
@@ -42,12 +50,13 @@ from __future__ import annotations
 from .executables import ExecutableCache
 from .expcache import DeviceExposureCache
 from .source import MinuteDirSource, SyntheticSource
-from .service import (FactorServer, Ingest, LoadShedError, Query,
-                      ServeConfig, ServeClient)
+from .service import (Discover, FactorServer, Ingest, LoadShedError,
+                      Query, ServeConfig, ServeClient)
 from .http import serve_http
 
 __all__ = [
-    "DeviceExposureCache", "ExecutableCache", "FactorServer", "Ingest",
-    "LoadShedError", "MinuteDirSource", "Query", "ServeClient",
-    "ServeConfig", "SyntheticSource", "serve_http",
+    "DeviceExposureCache", "Discover", "ExecutableCache",
+    "FactorServer", "Ingest", "LoadShedError", "MinuteDirSource",
+    "Query", "ServeClient", "ServeConfig", "SyntheticSource",
+    "serve_http",
 ]

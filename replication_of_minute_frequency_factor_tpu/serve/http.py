@@ -23,6 +23,17 @@ Endpoints:
   "present": [[bool×T]×B]}`` advances the streaming carry by ``B``
   minutes; -> ``{"minute", "bars"}``. Same error mapping as query
   (the JSON body bound is wider: a full universe-minute is big).
+* ``POST /v1/discover`` — body ``{"start": int, "end": int,
+  "generations"?: int, "pop"?: int, "seed"?: int, "horizon"?: int,
+  "skeleton"?: "default"|"rich"}`` runs a bounded-generations
+  factor-discovery job on the request queue (ISSUE 14; needs a
+  ``research=True`` server) -> the discovery answer (the registered
+  ``disc_<hash>`` name, its backtest stats, the persisted record
+  path). Same error mapping as query; discovery jobs respect the
+  breaker and the bounded queue like any other request.
+* ``GET /v1/factors`` — the live factor universe: built-in names plus
+  every factor discovered since startup, each immediately queryable
+  by name through ``POST /v1/query``.
 * ``POST /v1/debug/dump`` — on-demand flight-recorder capture
   (ISSUE 8): dumps the request ring + last-dispatch metadata +
   registry counter deltas; -> ``{"path", "requests"}`` (409 when no
@@ -119,6 +130,9 @@ def _make_handler(server: FactorServer, timeout: Optional[float]):
             if parsed.path == "/healthz":
                 self._reply(200, self._health_payload())
                 return
+            if parsed.path == "/v1/factors":
+                self._reply(200, server.factor_list())
+                return
             if parsed.path == "/v1/metrics":
                 accept = self.headers.get("Accept", "")
                 query = urllib.parse.parse_qs(parsed.query)
@@ -147,6 +161,9 @@ def _make_handler(server: FactorServer, timeout: Optional[float]):
         def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
             if self.path == "/v1/ingest":
                 self._post_ingest()
+                return
+            if self.path == "/v1/discover":
+                self._post_discover()
                 return
             if self.path == "/v1/debug/dump":
                 self._post_dump()
@@ -208,6 +225,41 @@ def _make_handler(server: FactorServer, timeout: Optional[float]):
                 return
             try:
                 fut = server.ingest(bars, present, trace_id=tid)
+            except LoadShedError as e:
+                self._reply(503, {"error": str(e), "shed": True}, tid,
+                            retry_after_s=e.retry_after_s)
+                return
+            except ValueError as e:
+                self._reply(400, {"error": str(e)}, tid)
+                return
+            try:
+                self._reply(200, fut.result(timeout), tid)
+            except Exception as e:  # noqa: BLE001 — dispatch failure
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"},
+                            tid)
+
+        def _post_discover(self):
+            tid = self._trace_id()
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                if length > MAX_BODY_BYTES:
+                    self._reply(413, {"error": "body too large"}, tid)
+                    return
+                doc = json.loads(self.rfile.read(length) or b"{}")
+                kwargs = dict(
+                    start=int(doc["start"]), end=int(doc["end"]),
+                    generations=int(doc.get("generations", 4)),
+                    pop=int(doc.get("pop", 128)),
+                    seed=int(doc.get("seed", 0)),
+                    horizon=int(doc.get("horizon", 1)),
+                    skeleton=str(doc.get("skeleton", "default")))
+            except (KeyError, ValueError, TypeError,
+                    json.JSONDecodeError) as e:
+                self._reply(400, {"error": f"malformed discover: {e}"},
+                            tid)
+                return
+            try:
+                fut = server.discover(trace_id=tid, **kwargs)
             except LoadShedError as e:
                 self._reply(503, {"error": str(e), "shed": True}, tid,
                             retry_after_s=e.retry_after_s)

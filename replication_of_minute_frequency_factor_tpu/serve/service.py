@@ -87,6 +87,27 @@ class Query:
 
 
 @dataclasses.dataclass(frozen=True)
+class Discover:
+    """One bounded-generations factor-discovery job (ISSUE 14): an
+    evolutionary search over the source's days ``[start, end)``
+    through the SAME request queue as every other request —
+    breaker/shed/trace-ID semantics unchanged. The worker runs the
+    search (``research/evolve.DiscoveryEngine``, warm executables,
+    one labeled host sync per generation), registers the best genome
+    as a live factor name (``disc_<hash>``), persists its genome
+    record when ``ServeConfig.research_dir`` is set, and resolves the
+    future with the name + backtest stats. Generations/population are
+    bounded by ``ServeConfig.discover_max_*`` at validation."""
+    start: int
+    end: int
+    generations: int = 4
+    pop: int = 128
+    seed: int = 0
+    horizon: int = 1
+    skeleton: str = "default"
+
+
+@dataclasses.dataclass(frozen=True)
 class Ingest:
     """Minute bars for the streaming carry (ISSUE 7): ``bars
     [B, T, 5]`` f32 / ``present [B, T]`` bool host arrays advance the
@@ -141,6 +162,22 @@ class ServeConfig:
     #: (data/result_wire.RESULT_BOUNDS), which answer consumers must
     #: accept; widened slices stay bitwise.
     result_wire: bool = False
+    #: where discovered-genome records persist as ``disc_<hash>.json``
+    #: (ISSUE 14; None = in-memory registration only). Set it beside
+    #: the telemetry bundle so a discovery's provenance ships with the
+    #: run's evidence.
+    research_dir: Optional[str] = None
+    #: upper bounds a ``POST /v1/discover`` request is validated
+    #: against — a research server stays a bounded-latency service,
+    #: not an unbounded compute endpoint
+    discover_max_generations: int = 64
+    discover_max_pop: int = 8192
+    #: shard discovery populations over this server's visible devices
+    #: (``parallel.resident_mesh``; ISSUE 14). Applied only when more
+    #: than one device is visible — otherwise the engine runs
+    #: single-device, silently (the ``discover.n_shards`` gauge says
+    #: which ran), mirroring ``stream_sharded``.
+    discover_sharded: bool = False
     #: place the streaming carry over a tickers mesh spanning this
     #: server's devices (ISSUE 13): cohort ingest and snapshot stop
     #: being single-device-bound — every carry leaf gets a
@@ -169,7 +206,8 @@ class FactorServer:
                  stream: bool = False,
                  stream_batches: Sequence[int] = (1,),
                  replica_label: Optional[str] = None,
-                 devices: Optional[Sequence] = None):
+                 devices: Optional[Sequence] = None,
+                 research: bool = False):
         from ..models.registry import factor_names
         from ..telemetry import get_telemetry
         self.source = source
@@ -226,6 +264,28 @@ class FactorServer:
                     rolling_impl=rolling_impl, telemetry=self.telemetry,
                     executables=self.executables, mesh=stream_mesh)
                 self.stream_engine.warmup(micro_batches=stream_batches)
+            #: ISSUE 14: the factor-discovery engine, sharing THE
+            #: executable cache (a server's discovery jobs and its
+            #: query graphs live under one compile-count ground
+            #: truth). Built-in names are pinned at construction so
+            #: ``factor_list`` can split built-in from discovered
+            #: after registrations grow ``self.names``.
+            self.research_engine = None
+            if research:
+                import jax as _jax
+
+                from ..research.evolve import DiscoveryEngine
+                research_mesh = None
+                if self.scfg.discover_sharded:
+                    from ..parallel.mesh import resident_mesh
+                    devs = (list(self.devices) if self.devices
+                            else list(_jax.devices()))
+                    if len(devs) > 1:
+                        research_mesh = resident_mesh(len(devs), devs)
+                self.research_engine = DiscoveryEngine(
+                    telemetry=self.telemetry,
+                    executables=self.executables, mesh=research_mesh)
+        self._builtin_names: Tuple[str, ...] = self.names
         self._q: "queue.Queue" = queue.Queue(maxsize=self.scfg.queue_limit)
         self._state_lock = threading.Lock()
         self._consecutive = 0
@@ -303,10 +363,20 @@ class FactorServer:
             if self.stream_engine is None:
                 raise ValueError("intraday queries need a server "
                                  "constructed with stream=True")
-            unknown = [n for n in (q.names or ()) if n not in self.names]
+            # validate against the STREAM engine's factor set: a
+            # discovered factor (ISSUE 14) grows self.names for block
+            # queries, but the streaming carry's warm executables
+            # were compiled over the construction-time set — genome
+            # factors have no incremental-finalize class yet
+            # (ROADMAP residue), so intraday must refuse them loudly
+            unknown = [n for n in (q.names or ())
+                       if n not in self.stream_engine.names]
             if unknown:
-                raise ValueError(f"unknown factor(s) {unknown}; server "
-                                 f"holds {len(self.names)}")
+                raise ValueError(
+                    f"unknown factor(s) {unknown} for intraday — "
+                    f"non-streamable (a discovered factor) or "
+                    f"unregistered; the stream engine holds "
+                    f"{len(self.stream_engine.names)}")
             return
         n_days = self.source.n_days
         if not (0 <= q.start < q.end <= n_days):
@@ -365,6 +435,55 @@ class FactorServer:
                 f"got {present.shape[1]} tickers; the stream engine "
                 f"holds {self.stream_engine.n_tickers}")
         return self._enqueue(Ingest(bars, present), "ingest", trace_id)
+
+    def discover(self, start: int, end: int, generations: int = 4,
+                 pop: int = 128, seed: int = 0, horizon: int = 1,
+                 skeleton: str = "default",
+                 trace_id: Optional[str] = None) -> Future:
+        """Enqueue a bounded-generations discovery job over days
+        ``[start, end)`` (ISSUE 14). Returns a Future resolving to
+        the discovery answer (name, backtest stats, record path);
+        sheds and validates exactly like :meth:`submit` — the breaker
+        and the bounded queue apply to research traffic unchanged."""
+        from ..research.evolve import resolve_skeleton
+        if self._closed:
+            raise RuntimeError("server is closed")
+        if self.research_engine is None:
+            raise ValueError("discover needs a server constructed "
+                             "with research=True")
+        n_days = self.source.n_days
+        if not (0 <= start < end <= n_days):
+            raise ValueError(f"day range [{start}, {end}) outside the "
+                             f"source's {n_days} days")
+        if not (1 <= horizon < end - start):
+            raise ValueError(
+                f"horizon {horizon} needs a range longer than itself "
+                f"(got {end - start} days)")
+        if not (1 <= generations
+                <= self.scfg.discover_max_generations):
+            raise ValueError(
+                f"generations must be in [1, "
+                f"{self.scfg.discover_max_generations}]")
+        if not (2 <= pop <= self.scfg.discover_max_pop):
+            raise ValueError(
+                f"pop must be in [2, {self.scfg.discover_max_pop}]")
+        resolve_skeleton(skeleton)  # raises on an unknown name
+        return self._enqueue(
+            Discover(int(start), int(end), int(generations), int(pop),
+                     int(seed), int(horizon), skeleton),
+            "discover", trace_id)
+
+    def factor_list(self) -> dict:
+        """``GET /v1/factors``: the server's live factor universe —
+        the built-in names it was constructed over plus every factor
+        discovered since, each immediately queryable by name through
+        the normal ``/v1/query`` leg."""
+        names = self.names  # one atomic read (registration swaps it)
+        builtin = [n for n in names if n in self._builtin_names]
+        discovered = [n for n in names if n not in self._builtin_names]
+        return {"builtin": builtin, "discovered": discovered,
+                "count": len(names),
+                "research": self.research_engine is not None}
 
     def _enqueue(self, item, kind: str,
                  trace_id: Optional[str] = None) -> Future:
@@ -470,6 +589,7 @@ class FactorServer:
             "flight": {"requests": len(self.flight),
                        "dumps": self.flight.dump_count},
             "hbm_available": bool(hbm.get("available")),
+            "research": self.research_engine is not None,
             "replica": {"label": self.replica_label,
                         "devices": device_names,
                         "breaker": self.breaker_state()},
@@ -567,8 +687,14 @@ class FactorServer:
             # every intraday answer in this micro-batch sees every bar
             # that arrived before the batch was drained)
             ingests = [p for p in batch if isinstance(p.query, Ingest)]
-            queries = [p for p in batch if not isinstance(p.query,
-                                                          Ingest)]
+            # discovery jobs (ISSUE 14) run after ingests and BEFORE
+            # query groups: a factor registered by this micro-batch's
+            # job is queryable by the NEXT request, and a query group
+            # dispatched after it already sees the grown name set
+            discovers = [p for p in batch
+                         if isinstance(p.query, Discover)]
+            queries = [p for p in batch
+                       if not isinstance(p.query, (Ingest, Discover))]
             groups: Dict[Tuple[int, int], list] = {}
             for p in queries:
                 key = ("intraday" if p.query.kind == "intraday"
@@ -577,6 +703,8 @@ class FactorServer:
             self.telemetry.gauge("serve.inflight", len(batch))
             for p in ingests:
                 self._apply_ingest(p)
+            for p in discovers:
+                self._apply_discover(p)
             for key, group in groups.items():
                 if key == "intraday":
                     self._dispatch_intraday(group)
@@ -622,6 +750,98 @@ class FactorServer:
         tel.hbm.sample("serve.ingest")
         self._breaker_ok()
 
+    def _apply_discover(self, p: _Pending) -> None:
+        """Run one bounded-generations discovery job (ISSUE 14):
+        prepare + warm the fitness executable (compiles land HERE,
+        before the generation loop — the job's measured
+        ``compiles_during_loop`` must be 0), evolve, register the
+        best genome into the live factor universe, and invalidate the
+        exposure cache (cached blocks predate the new name and hold
+        the wrong ``[F]`` extent). A failed job fails only its own
+        future but bumps the breaker, like ingest."""
+        from ..research import fitness as research_fitness
+        from ..research import registry as research_registry
+        from ..research.evolve import resolve_skeleton
+        tel = self.telemetry
+        did = self._next_dispatch()
+        t_dispatch = time.monotonic()
+        d: Discover = p.query
+        with tel.tracer("serve.discover", trace_id=p.trace_id):
+            t0 = time.perf_counter()
+            try:
+                bars, mask = self.source.slab(d.start, d.end)
+                fwd_ret, fwd_valid = \
+                    research_fitness.host_forward_returns(
+                        bars, mask, d.horizon)
+                eng = self.research_engine
+                eng.skeleton = resolve_skeleton(d.skeleton)
+                data = eng.prepare(bars, mask, fwd_ret, fwd_valid,
+                                   horizon=d.horizon)
+                eng.warmup(data, d.pop)
+                result = eng.evolve(
+                    data, pop=d.pop, generations=d.generations,
+                    rng=np.random.default_rng(d.seed))
+                rec = research_registry.register_genome(
+                    result.genome, result.skeleton,
+                    fitness=result.fitness, mean_ic=result.mean_ic,
+                    mean_rank_ic=result.mean_rank_ic,
+                    spread=result.spread,
+                    generations=result.generations, pop=result.pop,
+                    data_fingerprint=result.fingerprint,
+                    save_dir=self.scfg.research_dir, telemetry=tel)
+                if rec.name not in self.names:
+                    # atomic tuple swap: submit-side validation reads
+                    # self.names without the state lock. The engine's
+                    # copy grows with it (block builds trace over
+                    # engine.names; both writes happen on the worker
+                    # thread, the only thread that dispatches), and
+                    # cached blocks are dropped — they predate the new
+                    # name and hold the wrong [F] extent.
+                    self.names = self.names + (rec.name,)
+                    self.engine.names = self.names
+                    self.cache.clear()
+                job_s = time.perf_counter() - t0
+                tel.observe("serve.stage_seconds", job_s,
+                            stage="discover")
+            except Exception as e:  # noqa: BLE001 — per-job + breaker
+                p.future.set_exception(e)
+                tel.counter("serve.failures", stage="discover")
+                self._complete(p, "discover", "error", did, 1,
+                               time.perf_counter() - t0, 0.0,
+                               t_dispatch, error=e)
+                self._breaker_failure()
+                return
+            record_path = None
+            if self.scfg.research_dir:
+                import os as _os
+                record_path = _os.path.join(self.scfg.research_dir,
+                                            f"{rec.name}.json")
+            p.future.set_result({
+                "trace_id": p.trace_id,
+                "name": rec.name,
+                "describe": rec.description,
+                "fitness": result.fitness,
+                "mean_ic": result.mean_ic,
+                "mean_rank_ic": result.mean_rank_ic,
+                "spread": result.spread,
+                "generations": result.generations,
+                "pop": result.pop,
+                "n_shards": result.n_shards,
+                "syncs_per_generation": result.syncs_per_generation,
+                "compiles_during_loop": result.compiles_during_loop,
+                "history": [round(h, 6) for h in result.history],
+                "record_path": record_path,
+            })
+            tel.observe("serve.request_seconds",
+                        time.monotonic() - p.t_enqueue, kind="discover")
+            self._complete(p, "discover", "ok", did, 1, job_s, 0.0,
+                           t_dispatch)
+        self.flight.note_dispatch({"dispatch_id": did, "op": "discover",
+                                   "name": rec.name,
+                                   "generations": result.generations})
+        tel.hbm.sample("serve.discover")
+        self._breaker_ok()
+
     def _dispatch_intraday(self, group: list) -> None:
         """ONE warm snapshot dispatch (+ one host fetch) answers every
         intraday request in ``group`` — the same coalescing contract as
@@ -648,7 +868,7 @@ class FactorServer:
                         pay, len(eng.names), 1, eng.n_tickers,
                         eng.result_spec.spill_rows,
                         telemetry=self.telemetry,
-                        names=self.names)
+                        names=eng.names)
                     exp = exp[:, 0, :]
                     self.telemetry.counter("serve.result_wire_answers")
                     self.telemetry.counter("serve.result_wire_bytes",
@@ -663,7 +883,7 @@ class FactorServer:
                 # per-factor readiness fraction + the carry's minute —
                 # the stream's data-level lag signal
                 tel.factorplane.observe_stream(
-                    self.names, st,
+                    self.stream_engine.names, st,
                     ready_frac=rdy.mean(axis=1),
                     minute=self.stream_engine.minutes,
                     boundary="serve.intraday")
@@ -721,8 +941,12 @@ class FactorServer:
 
     def _answer_intraday(self, exp: np.ndarray, rdy: np.ndarray,
                          minute: int, q: Query) -> dict:
-        names = q.names or self.names
-        idx = [self.names.index(n) for n in names]
+        # index by the STREAM engine's names: the snapshot's [F, T]
+        # rows follow its construction-time set, which a later
+        # discovery registration never grows (see _validate)
+        stream_names = self.stream_engine.names
+        names = q.names or stream_names
+        idx = [stream_names.index(n) for n in names]
         return {
             "minute": minute,
             "codes": list(self.source.codes),
@@ -927,3 +1151,16 @@ class ServeClient:
         7)."""
         q = Query("intraday", names=tuple(names) if names else None)
         return self._server.submit(q).result(self._timeout)
+
+    def discover(self, start: int, end: int, generations: int = 4,
+                 pop: int = 128, seed: int = 0, horizon: int = 1,
+                 skeleton: str = "default") -> dict:
+        """Run a bounded-generations discovery job and block for its
+        answer (the registered name + backtest stats; ISSUE 14)."""
+        return self._server.discover(
+            start, end, generations=generations, pop=pop, seed=seed,
+            horizon=horizon, skeleton=skeleton).result(self._timeout)
+
+    def factor_list(self) -> dict:
+        """Built-in + discovered factor names (``GET /v1/factors``)."""
+        return self._server.factor_list()

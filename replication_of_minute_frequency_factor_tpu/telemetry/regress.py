@@ -63,7 +63,16 @@ smeared):
   off through a ppermute leg — changes both the module and the loop;
   bench stamps it only when the mesh genuinely resolved to d > 1 AND
   t > 1 (``mesh_shape`` is the discriminator), so a 1-D fallback
-  stays on the r7/r10 sharded series).
+  stays on the r7/r10 sharded series),
+  ``r13_discover_v1`` (ISSUE 14: the factor-discovery engine,
+  ``bench.py discover`` — the bounded evolutionary search with the
+  fused on-device backtest fitness, population-sharded over the
+  resident mesh; the ``value`` is candidates/sec at the record's
+  highest population level, with per-level candidates/sec and
+  per-generation p50/p99 under ``levels`` and the loop's measured
+  contract — syncs-per-generation, compiles-during-loop — under
+  ``discover``; a new workload, so its records start their own
+  baseline).
 
 Byte sub-series (ISSUE 10): every bench record that carries the
 ``wire.bytes_per_day`` / ``result.bytes_per_day`` gauges contributes
@@ -105,6 +114,16 @@ day) slices that failed their pinned round-trip bound and shipped
 bitwise f32 — the ROADMAP's log-transform decision input). Declared-
 break semantics ride the parent's methodology like every derived
 series.
+
+Discovery sub-series (ISSUE 14, same availability contract): a record
+whose ``discover`` block shows a loop that genuinely ran warm and
+inside its sync budget (``generations > 0``, ``compiles_during_loop
+== 0``, ``syncs_per_generation <= 1`` — the tpu_session carry rule's
+exact gate) contributes ``<metric>.candidates_per_s``. Both deviation
+directions flag: a throughput DROP is the obvious regression, a JUMP
+without a declared break usually means the fitness graph lost work
+(e.g. a silently narrower skeleton or day slab). Cold or chatty loops
+never seed the baseline.
 
 Baseline = median of every record in the group EXCEPT the latest; the
 latest is the record under test. ``--check FILE`` instead gates a fresh
@@ -347,6 +366,27 @@ def derive_records(record: dict) -> List[dict]:
                         "methodology": meth,
                         "derived_from":
                             "factor_health.coverage_frac"})
+    # discovery sub-series (ISSUE 14): gated on the discover block's
+    # own evidence — only loops that completed generations WARM
+    # (zero loop compiles) and inside the 1-sync/generation budget
+    # seed or gate the candidates/sec baseline (a cold loop measures
+    # XLA, a chatty one measures the host round trip)
+    disc = record.get("discover")
+    if isinstance(disc, dict) \
+            and isinstance(disc.get("generations"), int) \
+            and disc["generations"] > 0 \
+            and disc.get("compiles_during_loop") == 0 \
+            and isinstance(disc.get("syncs_per_generation"),
+                           (int, float)) \
+            and not isinstance(disc.get("syncs_per_generation"), bool) \
+            and disc["syncs_per_generation"] <= 1:
+        cps = disc.get("candidates_per_s")
+        if isinstance(cps, (int, float)) and not isinstance(cps, bool) \
+                and cps > 0:
+            out.append({"metric": f"{metric}.candidates_per_s",
+                        "value": float(cps), "unit": "candidates/s",
+                        "methodology": meth,
+                        "derived_from": "discover.candidates_per_s"})
     # mesh balance sub-series (ISSUE 9): gated on mesh.available — only
     # records with REAL shard watermarks (telemetry/meshplane.py) seed
     # or gate the balance baselines

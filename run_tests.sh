@@ -125,6 +125,17 @@ print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     run env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python -c "import json, sys, bench; r = bench.fleet_smoke(); \
 print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
+    # discover smoke (ISSUE 14): the factor-discovery engine on 8
+    # virtual CPU devices — one seeded population's fused backtest
+    # fitness through the population-sharded generation graph vs the
+    # single-device one (finite counts + device top-k selection set
+    # bitwise, fitness ulp-pinned), the sharded loop's measured
+    # contract (exactly 1 host-blocking sync per generation, zero
+    # compiles after warmup), and >= 1 top-k gather collective
+    # dispatch counted; one JSON verdict line, nonzero on drift
+    run env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -c "import json, sys, bench; r = bench.discover_smoke(); \
+print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     # graftlint (ISSUE 4): AST rules over the whole package + jaxpr
     # contracts over all 58 registered kernels AND the resident scan
     # wrappers (abstract trace on CPU), gated on the committed baseline

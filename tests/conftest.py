@@ -57,9 +57,13 @@ def rng():
 #: — replicas' device work stays on their worker threads, the one
 #: declared fan-out normalization is host-on-host, and the replica
 #: liveness probe moves data only by explicit put.
+#: test_research joins (ISSUE 14): the discovery loop moves data only
+#: by explicit put (genomes, the day slab) and its one per-generation
+#: fetch is the explicit ``np.asarray`` boundary sync — the whole
+#: 1-sync/generation budget is exercised under the guard.
 TRANSFER_GUARDED_MODULES = {"test_kernel_purity", "test_serve",
                             "test_stream", "test_opsplane",
-                            "test_fleet"}
+                            "test_fleet", "test_research"}
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,14 @@
+"""GL-A3 boundary-policy fixture (ISSUE 14): this path matches the
+policy key ``research/evolve.py`` (ast_tier.GLA3_BOUNDARY_SYNCS),
+whose allowed set is exactly ``{"np.asarray"}`` — the per-generation
+fitness fetch must NOT flag, every other sync symbol still must (a
+boundary module is not a blanket exclusion)."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def generation_fetch(stats_dev):
+    stats = np.asarray(stats_dev)       # allowed: the fitness fetch
+    x = jnp.sum(stats_dev)
+    x.block_until_ready()               # NOT allowed: still flags
+    return stats, x.item()              # NOT allowed: still flags

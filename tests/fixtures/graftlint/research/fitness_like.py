@@ -1,0 +1,9 @@
+"""GL-A3 research-scope fixture: a non-boundary module under
+``research/`` gets the full rule — np.asarray flags here even though
+the boundary module next door is allowed it (the generation loop's
+one-sync budget would silently double otherwise)."""
+import numpy as np
+
+
+def fetch(stats_dev):
+    return np.asarray(stats_dev)  # flags: only research/evolve.py may

@@ -41,7 +41,7 @@ def _codes_by_file(violations):
 @pytest.fixture(scope="module")
 def fixture_violations():
     violations, n_files = run_ast_tier(FIXTURES, display_base=REPO)
-    assert n_files == 15
+    assert n_files == 17
     return violations
 
 
@@ -114,19 +114,21 @@ def test_a3_boundary_policy_is_not_a_blanket_exclusion(
 
 
 def test_a3_policy_matches_the_real_request_loop():
-    """The committed policy has exactly six entries — the serving
+    """The committed policy has exactly seven entries — the serving
     request loop with its one declared sync, the ops-plane sampler
     with its device-memory reads (ISSUE 8), the mesh-plane
     shard-watermark prober with its per-shard blocking (ISSUE 9), the
     factor-health plane's one fused-stats materialization (ISSUE 12),
-    and the fleet layer's two boundaries (ISSUE 11: the router's one
+    the fleet layer's two boundaries (ISSUE 11: the router's one
     ingest normalization, the replica lifecycle's one device-liveness
-    block) — and scanning the real package stays clean under it (the
-    policy is load-bearing: docs list it)."""
+    block), and the discovery loop's one per-generation fitness fetch
+    (ISSUE 14) — and scanning the real package stays clean under it
+    (the policy is load-bearing: docs list it)."""
     from replication_of_minute_frequency_factor_tpu.analysis import (
         ast_tier)
     assert ast_tier.GLA3_BOUNDARY_SYNCS == {
         "serve/service.py": frozenset({"np.asarray"}),
+        "research/evolve.py": frozenset({"np.asarray"}),
         "telemetry/opsplane.py": frozenset({".memory_stats()",
                                             "jax.live_arrays"}),
         "telemetry/meshplane.py": frozenset({".block_until_ready()"}),
@@ -137,6 +139,27 @@ def test_a3_policy_matches_the_real_request_loop():
     assert not [v for v in violations if "/serve/" in v.path]
     assert not [v for v in violations if "/telemetry/" in v.path]
     assert not [v for v in violations if "/fleet/" in v.path]
+    assert not [v for v in violations if "/research/" in v.path]
+
+
+def test_a3_research_evolve_boundary_allows_asarray_only(
+        fixture_violations):
+    """ISSUE 14: the research boundary fixture uses its one allowed
+    symbol (np.asarray, the per-generation fitness fetch) plus two
+    banned ones — only the banned ones flag."""
+    hits = _codes_by_file(fixture_violations)["evolve.py"]
+    symbols = {s for _, _, s in hits}
+    assert symbols == {".block_until_ready()", ".item()"}
+    assert all(c == "GL-A3" for c, _, _ in hits)
+
+
+def test_a3_research_scope_is_not_a_blanket_exclusion(
+        fixture_violations):
+    """A research/ module that is NOT the declared boundary gets the
+    full rule: its np.asarray flags (the generation loop's one-sync
+    budget would silently double otherwise)."""
+    hits = _codes_by_file(fixture_violations)["fitness_like.py"]
+    assert [(c, s) for c, _, s in hits] == [("GL-A3", "np.asarray")]
 
 
 def test_a3_fleet_router_boundary_allows_asarray_only(
@@ -384,7 +407,7 @@ def test_cli_flags_fixtures_then_baseline_clears_them(tmp_path):
             "--report", report)
     out = _run_cli(*args)
     assert out.returncode == 1
-    assert json.loads(out.stdout.strip().splitlines()[-1])["new"] == 26
+    assert json.loads(out.stdout.strip().splitlines()[-1])["new"] == 29
     # refuse to baseline without a why
     out = _run_cli(*args, "--update-baseline")
     assert out.returncode == 2
@@ -397,7 +420,7 @@ def test_cli_flags_fixtures_then_baseline_clears_them(tmp_path):
     out = _run_cli(*args)
     assert out.returncode == 0
     assert json.loads(
-        out.stdout.strip().splitlines()[-1])["baselined"] == 26
+        out.stdout.strip().splitlines()[-1])["baselined"] == 29
 
 
 def test_manifest_carries_the_analysis_block(tmp_path):
@@ -432,6 +455,7 @@ def test_resident_wrappers_trace_clean_and_scan_exempt_by_symbol():
     assert set(fps) == set(jaxpr_tier.RESIDENT_WRAPPERS)
     assert "__stream_update__" in fps
     assert "__result_encode__" in fps      # ISSUE 10
+    assert "__discover_generation__" in fps  # ISSUE 14
     for name, fp in fps.items():
         assert fp["traced"] is True
         allowed = jaxpr_tier.WRAPPER_SCAN_ALLOWANCE.get(name, 1)
@@ -486,7 +510,8 @@ def test_report_carries_resident_wrapper_fingerprints():
                              "__resident_scan_sharded__",
                              "__resident_scan_2d__",
                              "__stream_update__",
-                             "__result_encode__"}
+                             "__result_encode__",
+                             "__discover_generation__"}
     for name, fp in wrappers.items():
         want = 0 if name == "__result_encode__" else 1
         assert fp["primitives"].get("scan", 0) == want, name
@@ -494,3 +519,7 @@ def test_report_carries_resident_wrapper_fingerprints():
     # handoff in the collective class (ISSUE 13)
     assert wrappers["__resident_scan_2d__"]["primitives"].get(
         "ppermute", 0) > 0
+    # the discovery wrapper's committed fingerprint pins the
+    # end-of-generation top-k gather's collective class (ISSUE 14)
+    assert wrappers["__discover_generation__"]["primitives"].get(
+        "all_gather", 0) > 0

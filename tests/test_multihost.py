@@ -25,12 +25,9 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def test_two_process_global_mesh(tmp_path):
-    env = {k: v for k, v in os.environ.items()
-           if k != "PALLAS_AXON_POOL_IPS"}
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    port = _free_port()
+def _spawn_pair(env, port, tmp_path):
+    """One 2-process run: spawn both children, wait, drain outputs.
+    Returns ``(procs, outs, timed_out)``."""
     procs = [subprocess.Popen(
         [sys.executable, CHILD, str(i), str(port), str(tmp_path)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -55,6 +52,37 @@ def test_two_process_global_mesh(tmp_path):
                     outs[i], _ = p.communicate(timeout=30)
                 except subprocess.TimeoutExpired:
                     outs[i] = "<no output drained>"
+    return procs, outs, timed_out
+
+
+def _gloo_transport_race(procs, outs) -> bool:
+    """The ONE retryable failure shape: a child killed by SIGABRT with
+    gloo's TCP-pair preamble enforce in its stderr
+    (``op.preamble.length <= op.nbytes``) — a known localhost
+    transport race when ephemeral ports recycle across rapid
+    successive rendezvous (TIME-WAIT reuse crosses two streams).
+    Observed machine-state-dependent on this container, INCLUDING on
+    pristine checkouts. Anything else — nonzero exits, assertion
+    text, timeouts — is a real failure and must not retry."""
+    return any(p.returncode == -6 and "gloo" in out
+               and "preamble" in out
+               for p, out in zip(procs, outs))
+
+
+def test_two_process_global_mesh(tmp_path):
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    for attempt in range(3):
+        port = _free_port()
+        procs, outs, timed_out = _spawn_pair(env, port, tmp_path)
+        if not timed_out and _gloo_transport_race(procs, outs) \
+                and attempt < 2:
+            import time
+            time.sleep(5)  # let the stale TIME-WAIT pairs drain
+            continue
+        break
     assert not timed_out, (
         "multihost children timed out; outputs:\n"
         f"--- process 0 ---\n{outs[0][-2000:]}\n"

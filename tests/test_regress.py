@@ -668,3 +668,107 @@ def test_cli_check_r12_2d_break_is_declared(tmp_path):
                               for g in r12_groups)
     assert any(g["metric"].endswith(".skew_days")
                for g in r12_groups)
+
+
+def _discover_rec(value=5000.0, cps=None, generations=6, compiles=0,
+                  syncs=1.0, methodology="r13_discover_v1"):
+    """A bankable r13 discover record, override-able per test."""
+    return {"metric": "discover15slot_512tickers_candidates_per_s",
+            "value": value, "unit": "candidates/s",
+            "methodology": methodology,
+            "discover": {"population": 2048,
+                         "generations": generations,
+                         "candidates_per_s": (value if cps is None
+                                              else cps),
+                         "compiles_during_loop": compiles,
+                         "syncs_per_generation": syncs,
+                         "n_shards": 4}}
+
+
+def test_derive_records_lifts_warm_discover_series():
+    """ISSUE 14 satellite: a discover record whose loop genuinely ran
+    warm and inside its sync budget derives the
+    <metric>.candidates_per_s sub-series under r13."""
+    recs = regress.derive_records(_discover_rec())
+    by = {r["metric"]: r for r in recs}
+    key = "discover15slot_512tickers_candidates_per_s.candidates_per_s"
+    assert key in by
+    assert by[key]["value"] == 5000.0
+    assert by[key]["methodology"] == "r13_discover_v1"
+    assert by[key]["derived_from"] == "discover.candidates_per_s"
+
+
+def test_cold_or_chatty_discover_never_seeds():
+    """Zero completed generations, any loop compile, or a sync budget
+    past 1/generation blocks the sub-series — a cold loop measures
+    XLA and a chatty one measures the host round trip; neither may
+    seed (or gate) the throughput baseline. A record with no discover
+    block derives no candidates series at all."""
+    for bad in (_discover_rec(generations=0),
+                _discover_rec(compiles=2),
+                _discover_rec(syncs=2.0)):
+        assert not any(".candidates_per_s" in r["metric"]
+                       for r in regress.derive_records(bad))
+    plain = {"metric": "cicc58_wall", "value": 60.0,
+             "methodology": "r6_resident_v2"}
+    assert not any(".candidates_per_s" in r["metric"]
+                   for r in regress.derive_records(plain))
+
+
+def test_discover_series_gate_both_directions(tmp_path):
+    """The satellite's acceptance: both deviation directions flag on
+    the derived candidates/sec group — a throughput DROP is the
+    obvious regression, an undeclared JUMP usually means the fitness
+    graph lost work; an in-band candidate stays quiet and a declared
+    break opens fresh."""
+    for i, v in enumerate((5000.0, 5100.0)):
+        with open(tmp_path / f"BENCH_r{i + 1:02d}.json", "w") as fh:
+            json.dump({"n": i + 1, "parsed": _discover_rec(value=v)},
+                      fh)
+    entries = regress.load_bench_series(str(tmp_path))
+    metrics = {e["record"]["metric"] for e in entries}
+    assert ("discover15slot_512tickers_candidates_per_s"
+            ".candidates_per_s") in metrics
+    assert regress.evaluate(entries,
+                            candidate=_discover_rec(value=5040.0))["ok"]
+    v = regress.evaluate(entries, candidate=_discover_rec(value=2000.0))
+    assert not v["ok"]
+    assert any(f["metric"].endswith(".candidates_per_s")
+               for f in v["flagged"])
+    v = regress.evaluate(entries,
+                         candidate=_discover_rec(value=9000.0))
+    assert not v["ok"]
+    # a chatty candidate cannot trip (or ride) the derived gate — it
+    # never derives, and its own headline still gates
+    chatty = _discover_rec(value=5050.0, syncs=3.0)
+    assert regress.evaluate(entries, candidate=chatty)["ok"]
+    # a declared methodology break opens fresh series, never flagged
+    assert regress.evaluate(
+        entries,
+        candidate=_discover_rec(value=900.0,
+                                methodology="r14_discover_v2"))["ok"]
+
+
+def test_cli_check_r13_break_is_declared(tmp_path):
+    """The first r13 record gates as a declared break (reported,
+    never flagged) against a repo whose trajectory holds only earlier
+    series."""
+    with open(tmp_path / "BENCH_r01.json", "w") as fh:
+        json.dump(_sharded_rec(), fh)
+    cand = tmp_path / "cand.json"
+    with open(cand, "w") as fh:
+        json.dump(_discover_rec(), fh)
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = regress.main([str(tmp_path), "--check", str(cand)])
+    assert rc == 0
+    verdict = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert verdict["ok"]
+    r13_groups = [g for g in verdict["groups"]
+                  if g["methodology"] == "r13_discover_v1"]
+    assert r13_groups and all(g["n_baseline"] == 0
+                              for g in r13_groups)
+    assert any(g["metric"].endswith(".candidates_per_s")
+               for g in r13_groups)

@@ -1,0 +1,491 @@
+"""research/ — the distributed factor-discovery engine (ISSUE 14).
+
+Coverage map:
+
+* the fused generation graph's fitness column is BITWISE the existing
+  ``search.fitness`` (evaluation is shared, the backtest extras ride
+  the same module);
+* population-sharded == single-device on the 8-virtual-device mesh
+  (finite counts + device top-k selection bitwise, moments
+  ulp-pinned), including non-dividing populations whose padding rows
+  must never be selected;
+* the loop's measured contract: exactly ONE labeled host-blocking
+  sync per generation and ZERO compiles during the generation loop,
+  both counter-asserted under ``jax.transfer_guard`` (this module is
+  in ``conftest.TRANSFER_GUARDED_MODULES``);
+* the registry: content-addressed names, persisted records
+  round-tripping through ``search.describe``, corrupted records
+  refused, registered kernels computing next to the built-ins;
+* the serve integration end to end on CPU: ``research=True`` +
+  ``POST /v1/discover`` registers a factor, ``GET /v1/factors`` lists
+  it, and ``/v1/query`` answers for it match a host re-evaluation of
+  the PERSISTED genome within f32 tolerance — the acceptance demo.
+"""
+
+import json
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from replication_of_minute_frequency_factor_tpu import search
+from replication_of_minute_frequency_factor_tpu.parallel.mesh import (
+    resident_mesh)
+from replication_of_minute_frequency_factor_tpu.research import (
+    DiscoveryEngine, genome_name, host_forward_returns, load_record,
+    register_genome)
+from replication_of_minute_frequency_factor_tpu.research import (
+    fitness as research_fitness)
+from replication_of_minute_frequency_factor_tpu.research.evolve import (
+    resolve_skeleton)
+from replication_of_minute_frequency_factor_tpu.serve import (
+    FactorServer, Query, ServeConfig, SyntheticSource, serve_http)
+from replication_of_minute_frequency_factor_tpu.telemetry import (
+    Telemetry, set_telemetry)
+
+
+def _day_data(days=5, tickers=12, seed=0, horizon=1):
+    rng = np.random.default_rng(seed)
+    shape = (days, tickers, 240)
+    close = 10.0 * np.exp(np.cumsum(
+        rng.standard_normal(shape, dtype=np.float32)
+        * np.float32(1e-3), axis=-1))
+    open_ = close * (1 + rng.standard_normal(shape, dtype=np.float32)
+                     * np.float32(1e-4))
+    bars = np.stack([open_, np.maximum(open_, close) * 1.0002,
+                     np.minimum(open_, close) * 0.9998, close,
+                     (rng.integers(0, 1000, shape) * 100.0
+                      ).astype(np.float32)], axis=-1).astype(np.float32)
+    mask = rng.random(shape) > 0.05
+    fwd_ret, fwd_valid = host_forward_returns(bars, mask, horizon)
+    return bars, mask, fwd_ret, fwd_valid
+
+
+@pytest.fixture()
+def tel():
+    return set_telemetry(Telemetry())
+
+
+# --------------------------------------------------------------------------
+# the fused generation graph
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.transfers
+def test_fused_fitness_column_matches_search_fitness(tel):
+    """Column 0 of the generation stats is bitwise ``search.fitness``:
+    the IC/decile extras fused into the module must not perturb the
+    selection scalar the GA already trusted."""
+    bars, mask, fr, fv = _day_data()
+    genomes = search.random_population(np.random.default_rng(2), 12)
+    stats, _tv, _ti = research_fitness.generation_fitness(
+        genomes, bars, mask, fr, fv, chunk=6, n_elite=3)
+    ref = search.fitness(genomes, bars, mask, fr, fv, chunk=6)
+    stats, ref = np.asarray(stats), np.asarray(ref)
+    assert np.array_equal(np.nan_to_num(stats[:, 0], nan=-1.0),
+                          np.nan_to_num(ref, nan=-1.0))
+    # fitness IS |mean_ic| where defined
+    fin = np.isfinite(stats[:, 1])
+    assert np.array_equal(stats[fin, 0], np.abs(stats[fin, 1]))
+
+
+@pytest.mark.transfers
+def test_generation_stats_components_match_unfused(tel):
+    """The rank-IC and decile-spread columns equal the standalone
+    eval_ops computations on the candidate's exposures (the fused
+    module reuses — not reimplements — the production stats)."""
+    from replication_of_minute_frequency_factor_tpu.eval_ops import (
+        decile_spread, ic_series)
+    bars, mask, fr, fv = _day_data(seed=3)
+    genomes = search.random_population(np.random.default_rng(4), 4)
+    stats = np.asarray(research_fitness.generation_stats(
+        genomes, bars, mask, fr, fv, search.DEFAULT_SKELETON, 5, None))
+    vals = np.asarray(search.eval_programs(genomes, bars, mask))
+    for i in range(len(genomes)):
+        valid = np.isfinite(vals[i]) & fv
+        x = np.where(valid, vals[i], 0.0).astype(np.float32)
+        y = np.where(valid, fr, 0.0).astype(np.float32)
+        ic, rank_ic = ic_series(x, y, valid)
+        np.testing.assert_allclose(
+            stats[i, 2], np.nanmean(np.asarray(rank_ic)),
+            rtol=1e-5, atol=1e-7)
+        spr = np.asarray(decile_spread(
+            jax.numpy.asarray(vals[i]), jax.numpy.asarray(fr),
+            jax.numpy.asarray(valid), 5))
+        np.testing.assert_allclose(
+            stats[i, 3], np.nanmean(spr), rtol=1e-5, atol=1e-7)
+
+
+@pytest.mark.transfers
+def test_sharded_generation_matches_single_device(tel):
+    """Population-sharded over the 8-virtual-device mesh vs
+    single-device at MATCHED chunk structure (chunk=1 on both — the
+    per-candidate module bodies are then the same shape): the full
+    stats matrix and the device top-k selection are BITWISE equal,
+    including a NON-dividing population (pop=10 over 8 shards) whose
+    padding rows must never be selected.
+
+    The matched chunk matters: different chunk extents fuse the
+    per-candidate body differently (ulp-level exposure drift, the
+    vol_upRatio class), and the discrete statistics downstream — rank
+    IC with T=12 lanes, qcut buckets of 2-3 tickers — amplify one
+    exposure ulp at a tie into an O(1/T) stat jump. The engine pins
+    its chunk into the executable key for exactly this reason (a
+    resumed search must reproduce bitwise)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    bars, mask, fr, fv = _day_data(seed=5)
+    pop = 10
+    n_dev = len(jax.devices())
+    assert n_dev == 8
+    genomes = search.random_population(np.random.default_rng(6), pop)
+    s1, tv1, ti1 = research_fitness.generation_fitness(
+        genomes, bars, mask, fr, fv, chunk=1, n_elite=4)
+    mesh = resident_mesh(n_dev)
+    pad = -pop % n_dev
+    gp = np.concatenate(
+        [genomes, np.zeros((pad, genomes.shape[1]), np.int32)])
+    rep = NamedSharding(mesh, P())
+    ss, tvs, tis = research_fitness.generation_fitness_sharded(
+        jax.device_put(gp, NamedSharding(mesh, P("tickers", None))),
+        jax.device_put(bars, rep), jax.device_put(mask, rep),
+        jax.device_put(fr, rep), jax.device_put(fv, rep), mesh=mesh,
+        skeleton=search.DEFAULT_SKELETON, group_num=5, chunk=1,
+        n_elite=4, n_pop=pop)
+    s1 = np.asarray(s1)
+    ss = np.asarray(ss)[:pop]
+    assert np.array_equal(np.isfinite(s1), np.isfinite(ss))
+    assert np.array_equal(np.nan_to_num(s1), np.nan_to_num(ss))
+    np.testing.assert_array_equal(np.asarray(ti1), np.asarray(tis))
+    np.testing.assert_array_equal(np.asarray(tv1), np.asarray(tvs))
+    assert np.all(np.asarray(tis) < pop)  # padding never selected
+
+
+def test_evolve_sync_budget_and_zero_compiles(tel):
+    """The acceptance counters, asserted at the engine level under the
+    transfer guard: exactly ONE measured host-blocking sync per
+    generation (the labeled ``research.host_blocking_syncs`` counter)
+    and ZERO ``xla.compiles`` during the generation loop."""
+    bars, mask, fr, fv = _day_data(seed=7)
+    eng = DiscoveryEngine(telemetry=tel)
+    data = eng.prepare(bars, mask, fr, fv)
+    eng.warmup(data, 12)
+    reg = tel.registry
+    syncs0 = reg.counter_value("research.host_blocking_syncs",
+                               point="generation_fetch")
+    compiles0 = reg.counter_total("xla.compiles")
+    res = eng.evolve(data, pop=12, generations=4,
+                     rng=np.random.default_rng(8))
+    assert reg.counter_value("research.host_blocking_syncs",
+                             point="generation_fetch") - syncs0 == 4
+    assert reg.counter_total("xla.compiles") == compiles0
+    assert res.syncs_per_generation == 1.0
+    assert res.compiles_during_loop == 0
+    assert res.generations == 4
+    assert len(res.history) == 4
+    # the final generation's device top-k agrees with host selection
+    tv, ti = res.device_topk
+    assert np.asarray(ti).shape[0] >= 2
+
+
+def test_evolve_deterministic_under_explicit_rng(tel):
+    """Identical generator state -> identical discovered genome AND
+    identical content-addressed name, across engine instances (the
+    reproducibility contract)."""
+    bars, mask, fr, fv = _day_data(seed=9)
+    out = []
+    for _ in range(2):
+        eng = DiscoveryEngine(telemetry=tel)
+        data = eng.prepare(bars, mask, fr, fv)
+        res = eng.evolve(data, pop=14, generations=3,
+                         rng=np.random.default_rng(42))
+        out.append(res)
+    assert np.array_equal(out[0].genome, out[1].genome)
+    assert out[0].fitness == out[1].fitness
+    assert (genome_name(out[0].genome)
+            == genome_name(out[1].genome))
+    assert out[0].fingerprint == out[1].fingerprint
+
+
+@pytest.mark.transfers
+def test_search_evolve_threads_explicit_rng(tel):
+    """ISSUE 14 determinism fix in search.py: an explicit generator
+    reproduces ``seed``'s result exactly, and two searches with
+    identically-seeded generators agree genome-for-genome."""
+    bars, mask, fr, fv = _day_data(seed=10, days=4, tickers=8)
+    a = search.evolve(bars, mask, fr, fv, pop=10, generations=2,
+                      seed=5)
+    b = search.evolve(bars, mask, fr, fv, pop=10, generations=2,
+                      rng=np.random.default_rng(5))
+    assert np.array_equal(a.genome, b.genome)
+    assert a.fitness == b.fitness
+    np.testing.assert_array_equal(a.history, b.history)
+
+
+# --------------------------------------------------------------------------
+# the registry
+# --------------------------------------------------------------------------
+
+
+def test_registry_roundtrip_and_describe(tmp_path, tel):
+    g = search.random_population(np.random.default_rng(11), 1)[0]
+    rec = register_genome(g, fitness=0.4, mean_ic=-0.4, spread=0.01,
+                          generations=3, pop=16,
+                          data_fingerprint="abc123",
+                          save_dir=str(tmp_path), telemetry=tel)
+    assert rec.name.startswith("disc_") and len(rec.name) == 15
+    assert rec.description == search.describe(g)
+    back = load_record(str(tmp_path / f"{rec.name}.json"))
+    assert back.name == rec.name
+    assert back.genome == rec.genome
+    assert back.description == rec.description
+    assert back.data_fingerprint == "abc123"
+    # registration is idempotent on the content-addressed name
+    again = register_genome(g, telemetry=tel)
+    assert again.name == rec.name
+
+
+def test_registry_refuses_corrupted_records(tmp_path, tel):
+    g = search.random_population(np.random.default_rng(12), 1)[0]
+    rec = register_genome(g, save_dir=str(tmp_path), telemetry=tel)
+    path = str(tmp_path / f"{rec.name}.json")
+    doc = json.load(open(path))
+    doc["genome"][0] = (doc["genome"][0] + 1) % 12
+    json.dump(doc, open(path, "w"))
+    with pytest.raises(ValueError, match="hashes to"):
+        load_record(path)
+
+
+@pytest.mark.transfers
+def test_registered_kernel_computes_next_to_builtins(tel):
+    """A discovered factor computes through the normal
+    ``compute_factors`` path (DayContext + alias resolution), matching
+    the jitted evaluator bitwise."""
+    from replication_of_minute_frequency_factor_tpu.models.registry import (
+        compute_factors_jit)
+    bars, mask, _fr, _fv = _day_data(seed=13)
+    g = search.random_population(np.random.default_rng(14), 1)[0]
+    rec = register_genome(g, telemetry=tel)
+    out = compute_factors_jit(bars, mask,
+                              names=("vol_return1min", rec.name))
+    got = np.asarray(out[rec.name])
+    import functools
+    ref = np.asarray(jax.jit(functools.partial(
+        search.eval_programs, skeleton=search.DEFAULT_SKELETON))(
+            np.asarray(rec.genome, np.int32)[None], bars, mask)[0])
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_resolve_skeleton_names():
+    assert resolve_skeleton("default") == search.DEFAULT_SKELETON
+    assert resolve_skeleton("rich") == search.RICH_SKELETON
+    assert resolve_skeleton((0, 1)) == (0, 1)
+    with pytest.raises(ValueError, match="unknown skeleton"):
+        resolve_skeleton("nope")
+
+
+# --------------------------------------------------------------------------
+# serve integration — the CPU acceptance demo
+# --------------------------------------------------------------------------
+
+
+def _research_server(tmp_path, tel, **kw):
+    src = SyntheticSource(n_days=10, n_tickers=24, seed=21)
+    scfg = ServeConfig(research_dir=str(tmp_path),
+                       hbm_sample_period_s=0)
+    return src, FactorServer(src, names=("vol_return1min", "mmt_am"),
+                             serve_cfg=scfg, telemetry=tel,
+                             research=True, **kw)
+
+
+def test_serve_discover_end_to_end(tmp_path, tel):
+    """The acceptance demo: a research server discovers a factor on
+    the request queue, registers it, persists its genome record, and
+    answers ``/v1/query`` for the new name with exposures matching a
+    host re-evaluation of the PERSISTED genome within f32 tolerance —
+    with the generation loop's sync/compile counters asserted."""
+    from replication_of_minute_frequency_factor_tpu.data import wire
+    src, server = _research_server(tmp_path, tel)
+    try:
+        reg = tel.registry
+        syncs0 = reg.counter_value("research.host_blocking_syncs",
+                                   point="generation_fetch")
+        ans = server.discover(0, 8, generations=3, pop=24,
+                              seed=7).result(600)
+        # the loop's measured contract, from the answer AND the registry
+        assert ans["generations"] == 3
+        assert ans["syncs_per_generation"] == 1.0
+        assert ans["compiles_during_loop"] == 0
+        assert reg.counter_value("research.host_blocking_syncs",
+                                 point="generation_fetch") - syncs0 == 3
+        name = ans["name"]
+        assert name.startswith("disc_")
+        assert name in server.names
+        fl = server.factor_list()
+        assert fl["builtin"] == ["vol_return1min", "mmt_am"]
+        assert name in fl["discovered"]
+        assert fl["research"] is True
+        # the persisted record round-trips and re-derives the answer
+        rec = load_record(ans["record_path"])
+        assert rec.description == ans["describe"]
+        assert rec.fitness == pytest.approx(ans["fitness"])
+        # /v1/query for the discovered name: parity vs a host
+        # re-evaluation of the PERSISTED genome, built entirely from
+        # the record + the source slab (no serve machinery): the same
+        # ingest-wire encode the block graph consumes, decoded and
+        # evaluated in one fused module. Observed bitwise; asserted at
+        # f32 tolerance (module-shape fusion may move ulps). A
+        # raw-bars re-evaluation differs by the wire's quantization
+        # envelope (~1e-4 here) — the wire, not the genome, owns that
+        # gap (docs/discovery.md).
+        q = server.submit(Query("factors", 0, 8,
+                                names=(name,))).result(120)
+        got = np.asarray(q["exposures"][name], dtype=np.float32)
+        bars, mask = src.slab(0, 8)
+        w = wire.encode(bars, mask)
+        buf, spec = wire.pack_arrays(w.arrays)
+        genome_dev = jax.device_put(
+            np.ascontiguousarray(rec.genome, np.int32)[None])
+        skeleton = tuple(rec.skeleton)
+
+        def host_reeval(packed):
+            arrs = wire.unpack(packed, spec)
+            b, m = wire.decode(*arrs)
+            return search.eval_programs(genome_dev, b,
+                                        m.astype(bool), skeleton)
+        ref = np.asarray(jax.jit(host_reeval)(
+            jax.device_put(buf)))[0]
+        assert np.array_equal(np.isnan(got), np.isnan(ref))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        # IC/decile queries work on the discovered name too
+        ic = server.submit(Query("ic", 0, 8, factor=name)).result(120)
+        assert ic["factor"] == name
+        assert isinstance(ic["mean_ic"], float)
+    finally:
+        server.close()
+
+
+def test_serve_discover_idempotent_and_cache_invalidation(tmp_path,
+                                                          tel):
+    """The same seed re-discovers the same genome -> same name, no
+    duplicate registration; block queries before and after discovery
+    both answer (the exposure cache invalidates cleanly on the [F]
+    extent change)."""
+    _src, server = _research_server(tmp_path, tel)
+    try:
+        before = server.submit(Query("factors", 0, 8,
+                                     names=("mmt_am",))).result(120)
+        assert "mmt_am" in before["exposures"]
+        a = server.discover(0, 8, generations=2, pop=16,
+                            seed=3).result(600)
+        b = server.discover(0, 8, generations=2, pop=16,
+                            seed=3).result(600)
+        assert a["name"] == b["name"]
+        assert list(server.names).count(a["name"]) == 1
+        after = server.submit(Query("factors", 0, 8,
+                                    names=("mmt_am",
+                                           a["name"]))).result(120)
+        assert set(after["exposures"]) == {"mmt_am", a["name"]}
+    finally:
+        server.close()
+
+
+def test_serve_discover_validation(tmp_path, tel):
+    src, server = _research_server(tmp_path, tel)
+    try:
+        with pytest.raises(ValueError, match="day range"):
+            server.discover(0, src.n_days + 1)
+        with pytest.raises(ValueError, match="generations"):
+            server.discover(0, 8, generations=10_000)
+        with pytest.raises(ValueError, match="pop"):
+            server.discover(0, 8, pop=10 ** 9)
+        with pytest.raises(ValueError, match="horizon"):
+            server.discover(0, 2, horizon=2)
+        with pytest.raises(ValueError, match="unknown skeleton"):
+            server.discover(0, 8, skeleton="nope")
+    finally:
+        server.close()
+
+
+def test_streamed_server_refuses_intraday_on_discovered(tmp_path,
+                                                        tel):
+    """A stream+research server: discovery grows the BLOCK factor set
+    but the streaming carry's warm executables were compiled over the
+    construction-time set (genome factors have no incremental class
+    yet — ROADMAP residue), so an intraday query for a discovered
+    name must refuse loudly while plain intraday keeps answering over
+    the original names."""
+    src = SyntheticSource(n_days=10, n_tickers=16, seed=22)
+    server = FactorServer(
+        src, names=("vol_return1min", "mmt_am"),
+        serve_cfg=ServeConfig(research_dir=str(tmp_path),
+                              hbm_sample_period_s=0),
+        telemetry=tel, research=True, stream=True)
+    try:
+        ans = server.discover(0, 8, generations=2, pop=16,
+                              seed=4).result(600)
+        with pytest.raises(ValueError, match="non-streamable"):
+            server.submit(Query("intraday", names=(ans["name"],)))
+        intra = server.submit(Query("intraday")).result(120)
+        assert set(intra["exposures"]) == {"vol_return1min", "mmt_am"}
+        # the block leg still serves the discovered name
+        blk = server.submit(Query("factors", 0, 8,
+                                  names=(ans["name"],))).result(120)
+        assert ans["name"] in blk["exposures"]
+    finally:
+        server.close()
+
+
+def test_discover_needs_research_mode(tel):
+    src = SyntheticSource(n_days=6, n_tickers=8, seed=1)
+    server = FactorServer(src, names=("vol_return1min",),
+                          serve_cfg=ServeConfig(hbm_sample_period_s=0),
+                          telemetry=tel)
+    try:
+        with pytest.raises(ValueError, match="research=True"):
+            server.discover(0, 4)
+    finally:
+        server.close()
+
+
+@pytest.mark.transfers
+def test_http_discover_and_factor_routes(tmp_path, tel):
+    """The HTTP face: POST /v1/discover round-trips the job (with the
+    trace-ID header echoed), GET /v1/factors lists the result, and a
+    malformed discover body 400s."""
+    _src, server = _research_server(tmp_path, tel)
+    httpd, _thread = serve_http(server, port=0, timeout=600)
+    port = httpd.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    try:
+        req = urllib.request.Request(
+            f"{base}/v1/discover",
+            data=json.dumps({"start": 0, "end": 8, "generations": 2,
+                             "pop": 16, "seed": 1}).encode(),
+            headers={"X-Trace-Id": "disc-test-1"})
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            assert resp.headers["X-Trace-Id"] == "disc-test-1"
+            ans = json.loads(resp.read())
+        assert ans["name"].startswith("disc_")
+        assert ans["trace_id"] == "disc-test-1"
+        with urllib.request.urlopen(f"{base}/v1/factors",
+                                    timeout=60) as resp:
+            fl = json.loads(resp.read())
+        assert ans["name"] in fl["discovered"]
+        # malformed body -> 400
+        bad = urllib.request.Request(f"{base}/v1/discover",
+                                     data=b'{"start": 0}')
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad, timeout=60)
+        assert ei.value.code == 400
+        # query the discovered factor over HTTP
+        qreq = urllib.request.Request(
+            f"{base}/v1/query",
+            data=json.dumps({"kind": "factors", "start": 0, "end": 8,
+                             "names": [ans["name"]]}).encode())
+        with urllib.request.urlopen(qreq, timeout=120) as resp:
+            q = json.loads(resp.read())
+        assert ans["name"] in q["exposures"]
+    finally:
+        httpd.shutdown()
+        server.close()

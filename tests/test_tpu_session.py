@@ -617,3 +617,90 @@ def test_resident_2d_in_default_steps(tpu_session):
     # ordering: the 2-D step rides directly behind resident_sharded
     flat = src.replace('"\n                    "', "")
     assert "resident_sharded,resident_2d,pallas" in flat
+
+
+def _discover_bank_rec(**over):
+    """A bankable r13 discover record, override-able per test."""
+    rec = {"metric": "discover15slot_512tickers_candidates_per_s",
+           "value": 5000.0,
+           "methodology": "r13_discover_v1",
+           "discover": {"population": 2048, "generations": 6,
+                        "candidates_per_s": 5000.0,
+                        "compiles_during_loop": 0,
+                        "syncs_per_generation": 1.0,
+                        "n_shards": 4},
+           "hbm": {"available": True, "peak_bytes": 1 << 30}}
+    disc_over = over.pop("discover", None)
+    rec.update(over)
+    if disc_over:
+        rec["discover"].update(disc_over)
+    return rec
+
+
+def test_discover_carry_requires_warm_bounded_loop(tpu_session):
+    """ISSUE 14: a 'discover' entry only carries when the generation
+    loop really ran warm and inside its sync budget — generations >
+    0, zero loop compiles, <= 1 measured host-blocking sync per
+    generation, and the hbm watermark block. Cold, chatty or empty
+    loops re-run."""
+    def entry(**over):
+        return {"discover": {"ok": True,
+                             "results": [_discover_bank_rec(**over)]}}
+
+    good = entry()
+    assert tpu_session.drop_conv_only_rolling(good) == good
+    assert tpu_session.drop_conv_only_rolling(
+        entry(discover={"generations": 0})) == {}
+    assert tpu_session.drop_conv_only_rolling(
+        entry(discover={"compiles_during_loop": 3})) == {}
+    assert tpu_session.drop_conv_only_rolling(
+        entry(discover={"syncs_per_generation": 2.0})) == {}
+    assert tpu_session.drop_conv_only_rolling(
+        entry(hbm=None)) == {}
+    wrong_series = entry()
+    wrong_series["discover"]["results"][0]["methodology"] = \
+        "r8_serve_v1"
+    assert tpu_session.drop_conv_only_rolling(wrong_series) == {}
+    no_block = entry()
+    del no_block["discover"]["results"][0]["discover"]
+    assert tpu_session.drop_conv_only_rolling(no_block) == {}
+
+
+def test_discover_step_refuses_unbankable_records(tpu_session,
+                                                  monkeypatch):
+    """The step flips ok=False on a cold or chatty loop so the next
+    window re-runs it; a bankable record passes; a CPU-fallback
+    metric can never bank."""
+    def fake_chatty(cmd, timeout, env=None):
+        assert cmd[1:] == ["bench.py", "discover"]
+        assert env["BENCH_REQUIRE_TPU"] == "1"
+        assert env["BENCH_DISCOVER_POP"] == "512,2048"
+        return {"ok": True, "rc": 0, "results": [
+            _discover_bank_rec(
+                discover={"syncs_per_generation": 4.0})]}
+    monkeypatch.setattr(tpu_session, "_run_json_lines", fake_chatty)
+    r = tpu_session.step_discover()
+    assert r["ok"] is False and "cannot bank" in r["error"]
+
+    def fake_good(cmd, timeout, env=None):
+        return {"ok": True, "rc": 0,
+                "results": [_discover_bank_rec()]}
+    monkeypatch.setattr(tpu_session, "_run_json_lines", fake_good)
+    assert tpu_session.step_discover()["ok"] is True
+
+    def fake_cpu(cmd, timeout, env=None):
+        rec = _discover_bank_rec(
+            metric=("discover15slot_512tickers_candidates_per_s"
+                    "_cpu_fallback_tunnel_down"))
+        return {"ok": True, "rc": 0, "results": [rec]}
+    monkeypatch.setattr(tpu_session, "_run_json_lines", fake_cpu)
+    r = tpu_session.step_discover()
+    assert r["ok"] is False and "CPU-fallback" in r["error"]
+
+
+def test_discover_in_default_steps(tpu_session):
+    """The r13 discovery engine's hardware validation rides the
+    default list, directly behind fleet."""
+    src = open(os.path.join(REPO, "benchmarks", "tpu_session.py")).read()
+    assert "fleet,discover," in src
+    assert '"discover": step_discover' in src
