@@ -95,6 +95,14 @@ RESULT_WIRE = os.environ.get("BENCH_RESULT_WIRE", "1") != "0"
 
 _SUFFIX = os.environ.get("BENCH_METRIC_SUFFIX", "")
 
+# ISSUE 15: the market session the loops run (markets/registry.py).
+# Stamped on EVERY record; telemetry/regress.py keys its sub-series on
+# it (a non-default session suffixes the methodology with
+# ``+session=<name>``), so a us_390 or crypto_1440 number can never
+# smear into the banked 240-day baselines — the same declared-break
+# discipline as BENCH_RESULT_WIRE and the mesh discriminators.
+SESSION = os.environ.get("BENCH_SESSION", "cn_ashare_240")
+
 
 def _tunnel_alive(timeout=90, require_tpu=False):
     """One reachability probe from a killable child (a wedged tunnel
@@ -235,7 +243,7 @@ def _encode_kind_delta(before: dict) -> str:
     return "mixed" if (dw and dr) else None
 
 
-def make_batch(rng, n_days=None, n_tickers=None):
+def make_batch(rng, n_days=None, n_tickers=None, session=None):
     # f32 draws throughout (standard_normal/random with dtype=) — the
     # synth preamble runs on one host core inside a precious tunnel
     # up-window, and f64-draw-then-cast doubled its cost for bytes the
@@ -248,7 +256,11 @@ def make_batch(rng, n_days=None, n_tickers=None):
         n_days = DAYS_PER_BATCH
     if n_tickers is None:
         n_tickers = N_TICKERS
-    shape = (n_days, n_tickers, 240)
+    from replication_of_minute_frequency_factor_tpu.markets import (
+        get_session)
+    shape = (n_days, n_tickers,
+             get_session(session if session is not None
+                         else SESSION).n_slots)
     close = (10.0 * np.exp(np.cumsum(
         rng.standard_normal(shape, dtype=np.float32) * np.float32(1e-3),
         axis=-1)))
@@ -1387,6 +1399,9 @@ def serve_bench(levels=None, total_requests=None, tickers=None,
         "value": level_stats[top]["qps"],
         "unit": "req/s",
         "tickers": tickers,
+        # market session discriminator (ISSUE 15): regress keys every
+        # sub-series on it, so non-240 records start their own baseline
+        "session": SESSION,
         "days": days,
         "window_days": window_days,
         "factors": len(names),
@@ -1754,6 +1769,7 @@ def fleet_bench(replica_counts=None, levels=None, total_requests=None,
         # DECLARED series (telemetry/regress.py): a new workload AND a
         # new topology — fleet records start their own baseline
         "methodology": "r11_fleet_v1",
+        "session": SESSION,
         "p50_ms": per_count[top]["levels"][top_level]["p50_ms"],
         "p99_ms": per_count[top]["levels"][top_level]["p99_ms"],
         "replicas": per_count,
@@ -2032,6 +2048,9 @@ def stream_bench(cohorts=None, tickers=None, updates=None, names=None,
         "value": level_stats[top]["bars_per_s"],
         "unit": "bars/s",
         "tickers": tickers,
+        # market session discriminator (ISSUE 15): regress keys every
+        # sub-series on it, so non-240 records start their own baseline
+        "session": SESSION,
         "factors": len(names),
         "cohorts": list(cohorts),
         # DECLARED series (telemetry/regress.py): per-bar intraday
@@ -2230,6 +2249,7 @@ def discover_bench(pops=None, generations=None, days=None, tickers=None,
         # is a new workload — candidates/sec records start their own
         # baseline (the r8/r9/r11 pattern)
         "methodology": "r13_discover_v1",
+        "session": SESSION,
         "p50_ms": top_stats["gen_p50_ms"],
         "p99_ms": top_stats["gen_p99_ms"],
         "levels": level_stats,
@@ -2716,6 +2736,135 @@ def result_wire_smoke(days=2, tickers=48, names=None):
         "parity_bad": chk["bad_factors"],
         "ok": (chk["ok"] and v["overflow"] == 0 and ratio >= 1.5),
     }
+
+
+# --------------------------------------------------------------------------
+# session smoke (ISSUE 15): a non-default market end to end
+# --------------------------------------------------------------------------
+
+
+def session_smoke(session="us_390", days=2, tickers=32, names=None):
+    """run_tests.sh --quick smoke: one NON-DEFAULT session (us_390)
+    through the full device path — wire encode -> packed resident scan
+    -> S-increment stream parity. ``ok`` iff:
+
+      * the seeded batch ENCODES (wire, not the raw fallback) at the
+        session's slot count and the on-device decode round-trips the
+        mask exactly;
+      * the resident-scan executable's exposures at the session shape
+        are BITWISE the direct fused graph's on the same (bars, mask);
+      * streaming day 0 through the session-sized carry (every one of
+        the S minutes) finalizes BITWISE equal to the batch graph —
+        the 240-increment parity gate generalized to S increments;
+      * the readiness plane is SOUND at end of day (every present-bar
+        lane of every kernel reports ready).
+
+    One JSON line; nonzero exit on drift."""
+    from replication_of_minute_frequency_factor_tpu.data import wire
+    from replication_of_minute_frequency_factor_tpu.markets import (
+        get_session)
+    from replication_of_minute_frequency_factor_tpu.models.registry import (
+        compute_factors_jit, factor_names as _fnames)
+    from replication_of_minute_frequency_factor_tpu.pipeline import (
+        compute_packed_resident)
+    from replication_of_minute_frequency_factor_tpu.stream.engine import (
+        StreamEngine)
+
+    spec_s = get_session(session)
+    n_slots = spec_s.n_slots
+    names = tuple(names or _fnames())
+    rng = np.random.default_rng(15)
+    bars, mask = make_batch(rng, n_days=days, n_tickers=tickers,
+                            session=spec_s)
+
+    # 1. wire encode at the session layout (widen-only floor like the
+    # year loops; a raw fallback fails the smoke — the session must be
+    # REPRESENTABLE, not merely runnable)
+    w = wire.encode(bars, mask)
+    encoded = w is not None
+    decode_ok = False
+    if encoded:
+        dec_bars, dec_m = (np.asarray(x) for x in jax.device_get(
+            wire.decode(*[jax.device_put(a) for a in w.arrays])))
+        decode_ok = bool((dec_m == mask).all()
+                         and np.allclose(dec_bars, np.where(
+                             mask[..., None], bars, 0.0), rtol=3e-7))
+    else:
+        dec_bars, dec_m = bars, mask
+
+    # 2. resident scan vs the direct fused graph on the SAME decoded
+    # inputs, bitwise (the decode's documented ~1-ulp tick wobble vs
+    # the raw cast is gated by decode_ok above, not smeared into the
+    # executable-parity check — the 240 smokes' contract)
+    direct = compute_factors_jit(jax.device_put(dec_bars),
+                                 jax.device_put(dec_m), names=names,
+                                 session=spec_s)
+    direct_stack = np.stack([np.asarray(direct[n]) for n in names])
+    if encoded:
+        buf, spec = wire.pack_arrays(w.arrays)
+        kind = "wire"
+    else:
+        buf, spec = wire.pack_arrays((bars, mask.view(np.uint8)))
+        kind = "raw"
+    ys = compute_packed_resident((jax.device_put(buf),), spec, kind,
+                                 names, session=spec_s)
+    scan_stack = np.asarray(ys)[0]
+    # the scan executable and the direct jit are DIFFERENT XLA
+    # modules; shape-dependent fusion wobbles a handful of sqrt/
+    # division kernels at the tens-of-ulps level (the PR 5
+    # vol_upRatio observation — measured ~45 ulps on corr_pv at 240
+    # AND 390, i.e. not a session effect). Bitwise where possible,
+    # pinned <= 64 f32 ulps relative otherwise.
+    eps = np.finfo(np.float32).eps
+    resident_mismatch = []
+    resident_ulp_pinned = []
+    for j, n in enumerate(names):
+        if _bitwise_equal(scan_stack[j], direct_stack[j]):
+            continue
+        a, b = scan_stack[j], direct_stack[j]
+        if bool((np.isnan(a) != np.isnan(b)).any()):
+            resident_mismatch.append(n)
+            continue
+        rel = np.abs(a - b) / np.maximum(np.abs(b), 1e-30)
+        if np.nanmax(np.where(np.isnan(a), 0.0, rel)) <= 64 * eps:
+            resident_ulp_pinned.append(n)
+        else:
+            resident_mismatch.append(n)
+
+    # 3. S-increment stream parity on day 0 (generalizes the 240 gate)
+    eng = StreamEngine(tickers, names=names, session=spec_s)
+    eng.warmup(micro_batches=(n_slots,))
+    eng.ingest_minutes(
+        np.ascontiguousarray(np.swapaxes(dec_bars[0], 0, 1)),
+        np.ascontiguousarray(dec_m[0].T))
+    exposures, ready = (np.asarray(x) for x in eng.snapshot())
+    stream_mismatch = [
+        n for j, n in enumerate(names)
+        if not _bitwise_equal(exposures[j], direct_stack[j][0])]
+
+    # 4. end-of-day readiness soundness: a NaN exposure on a ready lane
+    # is allowed (degenerate data); a FINITE exposure on a not-ready
+    # lane is the soundness violation
+    unsound = int((np.isfinite(exposures) & ~ready).sum())
+
+    return {
+        "smoke": "session", "session": spec_s.name, "n_slots": n_slots,
+        "days": days, "tickers": tickers, "factors": len(names),
+        "encoded": encoded, "decode_ok": decode_ok,
+        "resident_mismatched": resident_mismatch,
+        "resident_ulp_pinned": resident_ulp_pinned,
+        "stream_mismatched": stream_mismatch,
+        "ready_frac": round(float(ready.mean()), 4),
+        "unsound_lanes": unsound,
+        "ok": (encoded and decode_ok and not resident_mismatch
+               and not stream_mismatch and unsound == 0),
+    }
+
+
+def _bitwise_equal(a, b):
+    """NaN-aware bitwise equality of two f32 arrays."""
+    a, b = np.asarray(a), np.asarray(b)
+    return bool((a.view(np.uint32) == b.view(np.uint32)).all())
 
 
 # --------------------------------------------------------------------------
@@ -3574,6 +3723,9 @@ def main():
         "value": round(full_year, 3),
         "unit": "s",
         "tickers": N_TICKERS,
+        # market session discriminator (ISSUE 15): regress keys every
+        # sub-series on it, so non-240 records start their own baseline
+        "session": SESSION,
         # BENCH_YEARS workload multiplier (r12: the decades-x-global-
         # universe shape); 1 keeps the historical "1yr" metric name
         "years": YEARS,

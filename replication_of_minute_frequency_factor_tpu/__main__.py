@@ -122,6 +122,12 @@ def _add_serve(sub: "argparse._SubParsersAction") -> None:
                         "synthetic source)")
     p.add_argument("--synthetic-days", type=int, default=32)
     p.add_argument("--synthetic-tickers", type=int, default=64)
+    p.add_argument("--session", default=None, metavar="NAME",
+                   help="market session of the SYNTHETIC source "
+                        "(markets/registry.py: cn_ashare_240 us_390 "
+                        "hk_halfday crypto_1440; default cn_ashare_240"
+                        " — docs/sessions.md). --minute-dir sources "
+                        "carry cn wall-clock stamps and ignore this.")
     p.add_argument("--factors", default="all",
                    help="comma-separated factor names, or 'all' (default)")
     p.add_argument("--host", default="127.0.0.1")
@@ -186,7 +192,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
         source = MinuteDirSource(args.minute_dir)
     else:
         source = SyntheticSource(n_days=args.synthetic_days,
-                                 n_tickers=args.synthetic_tickers)
+                                 n_tickers=args.synthetic_tickers,
+                                 session=args.session)
     scfg = ServeConfig(batch_window_s=args.batch_window_ms / 1e3,
                        cache_bytes=args.cache_mb * 1024 * 1024,
                        research_dir=args.research_dir)

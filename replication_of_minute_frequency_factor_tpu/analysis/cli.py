@@ -65,9 +65,10 @@ def run(args: argparse.Namespace) -> int:
             n_files += nf
     fingerprints = None
     resident_fps = None
+    session_fps = None
     shape = None
     if args.tier in ("jaxpr", "all"):
-        from .jaxpr_tier import run_resident_tier
+        from .jaxpr_tier import run_resident_tier, run_session_tier
 
         shape = (args.days, args.tickers, SLOTS)
         vs, fingerprints = run_jaxpr_tier(
@@ -80,6 +81,13 @@ def run(args: argparse.Namespace) -> int:
         # from GL-B1 by symbol (jaxpr_tier.RESIDENT_WRAPPERS), never
         # by baseline entry
         vs, resident_fps = run_resident_tier(
+            days=args.days, tickers=args.tickers,
+            rolling_impl=args.rolling_impl)
+        violations += vs
+        # per-session wrapper traces (ISSUE 15): every registered
+        # market session's canonical shape fingerprints under the same
+        # one-scan/zero-f64/zero-callback contract
+        vs, session_fps = run_session_tier(
             days=args.days, tickers=args.tickers,
             rolling_impl=args.rolling_impl)
         violations += vs
@@ -101,7 +109,8 @@ def run(args: argparse.Namespace) -> int:
     report = build_report(new, accepted, stale,
                           fingerprints=fingerprints,
                           files_scanned=n_files, shape=shape,
-                          resident_fingerprints=resident_fps)
+                          resident_fingerprints=resident_fps,
+                          session_fingerprints=session_fps)
     report_path = args.report
     if report_path is None:
         import os
@@ -120,6 +129,8 @@ def run(args: argparse.Namespace) -> int:
         verdict["kernels"] = len(fingerprints)
     if resident_fps is not None:
         verdict["resident_wrappers"] = len(resident_fps)
+    if session_fps is not None:
+        verdict["sessions"] = len(session_fps)
     if report_path != "-":
         verdict["report"] = report_path
     print(json.dumps(verdict))

@@ -351,6 +351,98 @@ def check_resident_wrapper(name: str, closed) -> Tuple[List[Violation],
     return out, fingerprint
 
 
+#: wrapper symbols re-traced per REGISTERED SESSION (ISSUE 15): the
+#: session-coupled contract surface is (a) the fused
+#: unpack+decode+kernel scan and (b) the streaming minute fold — the
+#: 2-D/discover/result wrappers layer sharding or [F, D, T] blocks on
+#: top of (a) and add no further slot-count coupling, so re-tracing
+#: them per session buys no new contract coverage.
+SESSION_TRACE_WRAPPERS = ("__resident_scan__", "__stream_update__")
+
+
+def session_wrapper_jaxprs(session, n_batches: int = 2, days: int = 2,
+                           tickers: int = 3,
+                           rolling_impl: str = "conv") -> Dict[str, object]:
+    """Abstractly trace :data:`SESSION_TRACE_WRAPPERS` at one
+    registered session's canonical shape (``(days, tickers,
+    session.n_slots)``): the resident scan over raw packed buffers of
+    that day shape, and the streaming minute fold over that session's
+    carry. Same contracts as the canonical wrappers (one driving
+    scan, zero while/f64/callbacks)."""
+    import jax
+    import numpy as np
+
+    from .. import pipeline
+    from ..data import wire
+    from ..markets import get_session
+    from ..stream import carry as stream_carry
+    from ..stream.engine import scan_update
+
+    spec_s = get_session(session)
+    n_slots = spec_s.n_slots
+    bars = np.zeros((days, tickers, n_slots, N_FIELDS), np.float32)
+    mask = np.zeros((days, tickers, n_slots), np.uint8)
+    buf, spec = wire.pack_arrays((bars, mask))
+    names = RESIDENT_TRACE_NAMES
+    bufs = tuple(jax.ShapeDtypeStruct(buf.shape, buf.dtype)
+                 for _ in range(n_batches))
+    out = {"__resident_scan__": jax.make_jaxpr(
+        lambda b: pipeline._compute_packed_scan(
+            b, spec, "raw", names, True, rolling_impl, None, False,
+            spec_s))(bufs)}
+    carry_sds = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x),
+                                       np.asarray(x).dtype),
+        stream_carry.init_carry(tickers, session=spec_s))
+    out["__stream_update__"] = jax.make_jaxpr(
+        lambda c, b, p: scan_update(c, b, p, session=spec_s))(
+        carry_sds,
+        jax.ShapeDtypeStruct((n_batches, tickers, N_FIELDS),
+                             np.float32),
+        jax.ShapeDtypeStruct((n_batches, tickers), np.bool_))
+    return out
+
+
+def run_session_tier(n_batches: int = 2, days: int = 2, tickers: int = 3,
+                     rolling_impl: str = "conv"
+                     ) -> Tuple[List[Violation],
+                                Dict[str, Dict[str, Dict]]]:
+    """Per-session wrapper contracts + fingerprints (ISSUE 15): every
+    REGISTERED session's canonical shape is traced and fingerprinted,
+    so registering a market puts its graph shape under the same
+    drift-diffable commit as the canonical 240 one. The canonical
+    session is included — its rows must agree with the canonical
+    wrapper fingerprints' session-coupled subset."""
+    from ..markets import session_names
+
+    violations: List[Violation] = []
+    fingerprints: Dict[str, Dict[str, Dict]] = {}
+    for sname in session_names():
+        try:
+            jaxprs = session_wrapper_jaxprs(
+                sname, n_batches=n_batches, days=days, tickers=tickers,
+                rolling_impl=rolling_impl)
+        except Exception as e:  # noqa: BLE001 — the failure IS the finding
+            for wname in SESSION_TRACE_WRAPPERS:
+                violations.append(Violation(
+                    code="GL-B0", path="", line=0,
+                    symbol=f"{type(e).__name__}",
+                    message=f"session {sname!r} wrapper failed to "
+                            f"trace at ({days}, {tickers}, "
+                            f"session.n_slots): {e}",
+                    kernel=f"{sname}:{wname}"))
+            fingerprints[sname] = {w: {"traced": False}
+                                   for w in SESSION_TRACE_WRAPPERS}
+            continue
+        rows: Dict[str, Dict] = {}
+        for wname, closed in jaxprs.items():
+            vs, fp = check_resident_wrapper(f"{sname}:{wname}", closed)
+            violations += vs
+            rows[wname] = fp
+        fingerprints[sname] = rows
+    return violations, fingerprints
+
+
 def run_resident_tier(n_batches: int = 2, days: int = 2,
                       tickers: int = 3, rolling_impl: str = "conv"
                       ) -> Tuple[List[Violation], Dict[str, Dict]]:

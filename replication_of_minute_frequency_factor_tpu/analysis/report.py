@@ -37,7 +37,8 @@ def build_report(new: List[Violation], accepted: List[Violation],
                  fingerprints: Optional[Dict[str, Dict]] = None,
                  files_scanned: int = 0,
                  shape: Optional[tuple] = None,
-                 resident_fingerprints: Optional[Dict[str, Dict]] = None
+                 resident_fingerprints: Optional[Dict[str, Dict]] = None,
+                 session_fingerprints: Optional[Dict[str, Dict]] = None
                  ) -> dict:
     try:
         import jax
@@ -75,6 +76,15 @@ def build_report(new: List[Violation], accepted: List[Violation],
             report["jaxpr"]["resident_wrappers"] = {
                 k: resident_fingerprints[k]
                 for k in sorted(resident_fingerprints)}
+        if session_fingerprints:
+            # per-session wrapper fingerprints (ISSUE 15): one row per
+            # REGISTERED market session, traced at that session's
+            # canonical (days, tickers, n_slots) shape — registering a
+            # new market lands its graph shape here, where a drift
+            # shows up as a reviewable diff like the kernel rows above
+            report["jaxpr"]["sessions"] = {
+                k: session_fingerprints[k]
+                for k in sorted(session_fingerprints)}
     return report
 
 

@@ -16,7 +16,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from .. import sessions
+from ..markets import get_session
 
 FIELDS = ("open", "high", "low", "close", "volume")
 F_OPEN, F_HIGH, F_LOW, F_CLOSE, F_VOLUME = range(5)
@@ -54,6 +54,7 @@ def grid_day(
     codes: Optional[Sequence] = None,
     dtype=np.float32,
     use_native: Optional[bool] = None,
+    session=None,
 ) -> DayGrid:
     """Scatter long-format rows of one day onto the dense minute grid.
 
@@ -65,8 +66,13 @@ def grid_day(
       the sorted unique codes present;
     * ``use_native`` selects the C++ one-pass packer (:mod:`..native`);
       default: native when built, numpy otherwise (identical results —
-      tests/test_native.py).
+      tests/test_native.py). The native packer is baked to the
+      canonical 240 layout, so non-default sessions always grid
+      through the numpy path;
+    * ``session`` picks the market grid (ISSUE 15; None = the
+      240-slot cn_ashare day).
     """
+    sess = get_session(session)
     code = np.asarray(code)
 
     if codes is None:
@@ -79,7 +85,8 @@ def grid_day(
     known = (tidx < len(codes)) & (np.take(codes, np.minimum(tidx, len(codes) - 1)) == code)
 
     T = len(codes)
-    if use_native is None or use_native:
+    is_default_240 = sess.n_slots == 240 and sess.segments[0][0] == 570
+    if (use_native is None or use_native) and is_default_240:
         from .. import native
         if native.available() and dtype == np.float32:
             bars, mask = native.grid_pack_native(
@@ -89,10 +96,10 @@ def grid_day(
         if use_native:
             raise RuntimeError("native gridpack requested but unavailable")
 
-    slots = sessions.time_to_slot(np.asarray(time))
+    slots = sess.time_to_slot(np.asarray(time))
     ok = (slots >= 0) & known
-    bars = np.zeros((T, sessions.N_SLOTS, len(FIELDS)), dtype=dtype)
-    mask = np.zeros((T, sessions.N_SLOTS), dtype=bool)
+    bars = np.zeros((T, sess.n_slots, len(FIELDS)), dtype=dtype)
+    mask = np.zeros((T, sess.n_slots), dtype=bool)
     ti, si = tidx[ok], slots[ok]
     for f, col in zip(range(5), (open_, high, low, close, volume)):
         bars[ti, si, f] = np.asarray(col)[ok]

@@ -12,7 +12,7 @@ from typing import Dict
 
 import numpy as np
 
-from .. import sessions
+from ..markets import get_session
 
 
 def synth_day(
@@ -24,6 +24,7 @@ def synth_day(
     short_day_codes: int = 0,
     tick_decimals: int = 2,
     date: str = "2024-01-02",
+    session=None,
 ) -> Dict[str, np.ndarray]:
     """Return long-format columns sorted by (code, time).
 
@@ -32,11 +33,12 @@ def synth_day(
       (<50 bars: the rolling-window drop rule);
     * prices are rounded to ``tick_decimals`` so duplicate values occur.
     """
+    sess = get_session(session)
     rows_code, rows_time = [], []
     rows = {k: [] for k in ("open", "high", "low", "close", "volume")}
     for i in range(n_codes):
         code = f"{600000 + i:06d}"
-        slots = np.arange(sessions.N_SLOTS)
+        slots = np.arange(sess.n_slots)
         if i >= n_codes - short_day_codes:
             slots = slots[-30:]
         if missing_prob > 0:
@@ -69,7 +71,7 @@ def synth_day(
         if zero_volume_prob > 0:
             volume[rng.random(n) < zero_volume_prob] = 0.0
         rows_code.append(np.full(n, code))
-        rows_time.append(sessions.GRID_TIMES[slots])
+        rows_time.append(sess.grid_times[slots])
         rows["open"].append(open_)
         rows["high"].append(high)
         rows["low"].append(low)

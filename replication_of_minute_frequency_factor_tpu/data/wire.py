@@ -54,10 +54,29 @@ from ..telemetry import get_telemetry
 
 TICK = 0.01
 _I16 = 32767
+#: the canonical cn_ashare_240 slot count. The format itself is
+#: session-generic (ISSUE 15): encode reads the slot extent off the
+#: mask, decode re-derives it from ``dohl``'s slot axis (every dohl
+#: mode keeps a full slot axis), and the sub-byte packings gate on
+#: divisibility (``pack_dclose4`` needs an even slot count, ``vol10``
+#: a multiple of 4) — a session that misses a packing's divisor simply
+#: never produces that mode, it does not reject the batch.
 N_SLOTS = 240
 MASK_BYTES = N_SLOTS // 8
 VOL10_MAX = 1023
 VOL10_BYTES = N_SLOTS // 4 * 5  # four 10-bit values per 5 bytes = 300
+
+
+def mask_bytes(n_slots: int) -> int:
+    """Bit-packed mask bytes per (ticker, day) for a slot count
+    (np.packbits zero-pads the final byte)."""
+    return -(-n_slots // 8)
+
+
+def vol10_bytes(n_slots: int) -> int:
+    """10-bit-packed volume bytes for a slot count (only produced when
+    ``n_slots % 4 == 0``; see :func:`..native.narrow_wire`)."""
+    return n_slots // 4 * 5
 
 
 @dataclasses.dataclass
@@ -83,7 +102,8 @@ class WireBatch:
 
 
 def pack_mask(mask: np.ndarray) -> np.ndarray:
-    """[..., 240] bool -> [..., 30] uint8, little-endian bit order."""
+    """[..., S] bool -> [..., ceil(S/8)] uint8, little-endian bit
+    order (packbits zero-pads the final byte; decode slices back)."""
     return np.packbits(np.asarray(mask, bool), axis=-1, bitorder="little")
 
 
@@ -116,7 +136,7 @@ def _encode_impl(bars, mask, tick, use_native, floor):
     mask = np.asarray(mask)
     if use_native is None or use_native:
         from .. import native
-        if native.available():
+        if native.available() and mask.shape[-1] == N_SLOTS:
             out = native.wire_encode_native(bars, mask, round(1.0 / tick),
                                             floor=floor)
             if out is not None:
@@ -207,15 +227,24 @@ def decode(base, dclose, dohl, volume, maskbits, vol_scale,
     Fuses into the factor graph: XLA keeps the int->f32 expansion in
     HBM-local registers instead of shipping wide floats over the wire.
     """
+    # slot count from dohl's slot axis (every dohl mode keeps it),
+    # NOT a module constant: the same decode graph serves every
+    # registered session's layout (ISSUE 15), and at 240 the traced
+    # jaxpr is unchanged — all branches below are static-shape
+    n_slots = dohl.shape[-2]
     bits = (maskbits[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
-    m = bits.reshape(maskbits.shape[:-1] + (N_SLOTS,)).astype(bool)
+    m = bits.reshape(maskbits.shape[:-1] + (maskbits.shape[-1] * 8,))
+    if maskbits.shape[-1] * 8 != n_slots:  # static: pad-bit slice only
+        m = m[..., :n_slots]               # when S % 8 != 0 (us_390)
+    m = m.astype(bool)
     inv = jnp.float32(round(1.0 / tick))
-    if dclose.shape[-1] == N_SLOTS // 2:  # int4-pair packing
+    if dclose.shape[-1] == n_slots // 2 and n_slots % 2 == 0 \
+            and dclose.shape[-1] != n_slots:  # int4-pair packing
         b = dclose.astype(jnp.int32)
         lo = ((b & 0xF) ^ 8) - 8          # even slots, sign-extended
         hi = (((b >> 4) & 0xF) ^ 8) - 8   # odd slots
         dc = jnp.stack([lo, hi], axis=-1) \
-            .reshape(dclose.shape[:-1] + (N_SLOTS,))
+            .reshape(dclose.shape[:-1] + (n_slots,))
     else:
         dc = dclose.astype(jnp.int32)
     ct = jnp.round(base * inv).astype(jnp.int32)[..., None] \
@@ -242,15 +271,17 @@ def decode(base, dclose, dohl, volume, maskbits, vol_scale,
     open_ = ot.astype(jnp.float32) / inv
     high = ht.astype(jnp.float32) / inv
     low = lt.astype(jnp.float32) / inv
-    if volume.shape[-1] == VOL10_BYTES:  # 10-bit packed (4 values/5 bytes)
-        g = volume.reshape(volume.shape[:-1] + (N_SLOTS // 4, 5)) \
+    if n_slots % 4 == 0 and volume.dtype == jnp.uint8 \
+            and volume.shape[-1] == vol10_bytes(n_slots):
+        # 10-bit packed (4 values/5 bytes)
+        g = volume.reshape(volume.shape[:-1] + (n_slots // 4, 5)) \
             .astype(jnp.int32)
         b0, b1, b2, b3, b4 = (g[..., i] for i in range(5))
         vals = jnp.stack([b0 | ((b1 & 0x3) << 8),
                           (b1 >> 2) | ((b2 & 0xF) << 6),
                           (b2 >> 4) | ((b3 & 0x3F) << 4),
                           (b3 >> 6) | (b4 << 2)], axis=-1)
-        vol_units = vals.reshape(volume.shape[:-1] + (N_SLOTS,))
+        vol_units = vals.reshape(volume.shape[:-1] + (n_slots,))
     else:
         vol_units = volume
     vol = vol_units.astype(jnp.float32) * vol_scale.astype(jnp.float32)

@@ -12,8 +12,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .. import sessions
 from ..data.minute import F_CLOSE, F_HIGH, F_LOW, F_OPEN, F_VOLUME
+from ..markets import get_session
 from ..ops import (
     ffill,
     masked_last,
@@ -35,9 +35,15 @@ class DayContext:
 
     def __init__(self, bars, mask, replicate_quirks: bool = True,
                  rolling_impl: str = None, xs_axis_name: str = None,
-                 inject: dict = None):
+                 inject: dict = None, session=None):
         self.bars = bars
         self.mask = mask
+        #: the market session spec (ISSUE 15): slot count, grid times
+        #: and the sentinel boundaries the time-filter kernels consult
+        #: (``ctx.session.T_CLOSE_AUCTION`` etc.). None resolves the
+        #: canonical ``cn_ashare_240``, whose sentinels are the seed's
+        #: byte-for-byte — the 240-shape jaxprs are unchanged.
+        self.session = get_session(session)
         self.replicate_quirks = replicate_quirks
         self.rolling_impl = rolling_impl  # None -> Config.rolling_impl
         #: mesh axis name the tickers dim is sharded over when this
@@ -55,8 +61,8 @@ class DayContext:
         #: ops/incremental.py); the 240-increment parity gate enforces
         #: it end to end.
         self._memo = dict(inject) if inject else {}
-        #: HHMMSSmmm per slot, broadcastable against [..., T, 240]
-        self.times = jnp.asarray(sessions.GRID_TIMES)
+        #: HHMMSSmmm per slot, broadcastable against [..., T, S]
+        self.times = jnp.asarray(self.session.grid_times)
 
     # --- raw fields -----------------------------------------------------
     @property

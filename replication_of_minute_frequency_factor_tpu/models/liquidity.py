@@ -8,7 +8,6 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .. import sessions as S
 from ..ops import masked_first, masked_sum
 from .context import DayContext
 from .registry import register, stream_requirement
@@ -35,14 +34,14 @@ def liq_amihud_1min(ctx: DayContext):
 def liq_closeprevol(ctx: DayContext):
     """Total volume before 14:57. Ref :764-775 (filter-then-group: a stock
     with no pre-auction bars is absent -> NaN)."""
-    sel = ctx.time_mask(hi=S.T_CLOSE_AUCTION, hi_strict=True)
+    sel = ctx.time_mask(hi=ctx.session.T_CLOSE_AUCTION, hi_strict=True)
     return jnp.where(jnp.any(sel, axis=-1), masked_sum(ctx.volume, sel), _NAN)
 
 
 @register("liq_closevol")
 def liq_closevol(ctx: DayContext):
     """Total volume in the last 3 minutes (>= 14:57). Ref :778-789."""
-    sel = ctx.time_mask(lo=S.T_CLOSE_AUCTION)
+    sel = ctx.time_mask(lo=ctx.session.T_CLOSE_AUCTION)
     return jnp.where(jnp.any(sel, axis=-1), masked_sum(ctx.volume, sel), _NAN)
 
 
@@ -57,7 +56,7 @@ def liq_firstCallR(ctx: DayContext):
 def liq_lastCallR(ctx: DayContext):
     """Volume share of the >= 14:57 window (filter *inside* the agg, so the
     group always exists; an empty window sums to 0). Ref :805-820."""
-    sel = ctx.time_mask(lo=S.T_CLOSE_AUCTION)
+    sel = ctx.time_mask(lo=ctx.session.T_CLOSE_AUCTION)
     out = masked_sum(ctx.volume, sel) / ctx.vol_sum
     return jnp.where(ctx.has_bars, out, _NAN)
 

@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .. import sessions as S
 from ..ops import (
     masked_first,
     masked_last,
@@ -37,26 +36,26 @@ def _sentinel_ratio(ctx: DayContext, t_first: int, t_last: int):
 @register("mmt_pm")
 def mmt_pm(ctx: DayContext):
     """PM-session momentum: close(14:59)/open(13:00). Ref :12-24."""
-    return _sentinel_ratio(ctx, S.T_PM_OPEN, S.T_PM_CLOSE)
+    return _sentinel_ratio(ctx, ctx.session.T_PM_OPEN, ctx.session.T_PM_CLOSE)
 
 
 @register("mmt_last30")
 def mmt_last30(ctx: DayContext):
     """Last-30-minute momentum: close(14:59)/open(14:30). Ref :27-39."""
-    return _sentinel_ratio(ctx, S.T_LAST30_OPEN, S.T_PM_CLOSE)
+    return _sentinel_ratio(ctx, ctx.session.T_LAST30_OPEN, ctx.session.T_PM_CLOSE)
 
 
 @register("mmt_am")
 def mmt_am(ctx: DayContext):
     """AM-session momentum: close(11:29)/open(09:30). Ref :63-75."""
-    return _sentinel_ratio(ctx, S.T_AM_OPEN, S.T_AM_CLOSE)
+    return _sentinel_ratio(ctx, ctx.session.T_AM_OPEN, ctx.session.T_AM_CLOSE)
 
 
 @register("mmt_between")
 def mmt_between(ctx: DayContext):
     """Momentum excluding first/last 30 min: close(14:29)/open(10:00).
     Ref :78-90."""
-    return _sentinel_ratio(ctx, S.T_BETWEEN_OPEN, S.T_BETWEEN_CLOSE)
+    return _sentinel_ratio(ctx, ctx.session.T_BETWEEN_OPEN, ctx.session.T_BETWEEN_CLOSE)
 
 
 @register("mmt_paratio")
@@ -68,8 +67,8 @@ def mmt_paratio(ctx: DayContext):
     (AM, PM) ascending — the intended sign. A single-session day yields 0
     (last == first row); an empty day NaN.
     """
-    am = ctx.mask & (ctx.times <= S.T_NOON)
-    pm = ctx.mask & (ctx.times > S.T_NOON)
+    am = ctx.mask & (ctx.times <= ctx.session.T_NOON)
+    pm = ctx.mask & (ctx.times > ctx.session.T_NOON)
     mmt_am_v = masked_last(ctx.close, am) / masked_first(ctx.open, am) - 1.0
     mmt_pm_v = masked_last(ctx.close, pm) / masked_first(ctx.open, pm) - 1.0
     has_am = jnp.any(am, axis=-1)

@@ -103,7 +103,8 @@ def compute_factors(bars, mask, names: Optional[Sequence[str]] = None,
                     replicate_quirks: bool = True,
                     rolling_impl: Optional[str] = None,
                     xs_axis_name: Optional[str] = None,
-                    inject: Optional[dict] = None):
+                    inject: Optional[dict] = None,
+                    session=None):
     """Compute the named factors (default: all 58) over a day tensor.
 
     Pure function of ``(bars [..., T, 240, 5], mask [..., T, 240])``;
@@ -118,33 +119,42 @@ def compute_factors(bars, mask, names: Optional[Sequence[str]] = None,
     cross-sectional ``doc_pdf*`` rank gathers (DayContext).
     ``inject`` seeds the DayContext memo with carry-native
     intermediates (the streaming finalize; see DayContext's bitwise
-    injection contract).
+    injection contract). ``session`` (a ``markets.SessionSpec`` or
+    registry name, ISSUE 15) sets the day shape and the sentinel
+    boundaries; None is the canonical ``cn_ashare_240`` — the slot
+    axis of ``bars``/``mask`` must match ``session.n_slots``.
     """
     _load_all()
     if names is None:
         names = tuple(FACTORS)
     ctx = DayContext(bars, mask, replicate_quirks=replicate_quirks,
                      rolling_impl=rolling_impl, xs_axis_name=xs_axis_name,
-                     inject=inject)
+                     inject=inject, session=session)
     return {n: resolve(n)(ctx) for n in names}
 
 
 @functools.partial(jax.jit, static_argnames=("names", "replicate_quirks",
-                                             "rolling_impl"))
-def _compute_factors_jit(bars, mask, names, replicate_quirks, rolling_impl):
-    return compute_factors(bars, mask, names, replicate_quirks, rolling_impl)
+                                             "rolling_impl", "session"))
+def _compute_factors_jit(bars, mask, names, replicate_quirks, rolling_impl,
+                         session=None):
+    return compute_factors(bars, mask, names, replicate_quirks, rolling_impl,
+                           session=session)
 
 
 def compute_factors_jit(bars, mask, names: Optional[Tuple[str, ...]] = None,
                         replicate_quirks: bool = True,
-                        rolling_impl: Optional[str] = None):
+                        rolling_impl: Optional[str] = None,
+                        session=None):
     """One fused XLA graph computing every requested factor.
 
     ``rolling_impl=None`` resolves ``Config.rolling_impl`` here, *outside*
     the jit boundary, so the resolved value is the cache key and flipping
-    the config can never serve a stale compiled graph."""
+    the config can never serve a stale compiled graph. ``session``
+    resolves to its frozen spec here for the same reason — the spec
+    VALUE is the cache key."""
     if rolling_impl is None:
         from ..config import get_config
         rolling_impl = get_config().rolling_impl
+    from ..markets import get_session
     return _compute_factors_jit(bars, mask, names, replicate_quirks,
-                                rolling_impl)
+                                rolling_impl, get_session(session))

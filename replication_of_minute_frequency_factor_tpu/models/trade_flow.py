@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .. import sessions as S
 from ..ops import masked_mean, masked_sum
 from .context import DayContext
 from .registry import register, stream_requirement
@@ -23,7 +22,7 @@ _NAN = jnp.nan
 def trade_bottom20retRatio(ctx: DayContext):
     """sum(ret * volume/(window volume + 1)) over bars >= 14:40.
     Ref :1206-1224."""
-    sel = ctx.time_mask(lo=S.T_TAIL20)
+    sel = ctx.time_mask(lo=ctx.session.T_TAIL20)
     denom = masked_sum(ctx.volume, sel) + 1.0
     term = ctx.ret_co * ctx.volume / denom[..., None]
     out = masked_sum(term, sel)
@@ -34,7 +33,7 @@ def trade_bottom20retRatio(ctx: DayContext):
 def trade_bottom50retRatio(ctx: DayContext):
     """Same over bars >= 14:10, denominator max(window volume, 1-if-zero).
     Ref :1227-1248."""
-    sel = ctx.time_mask(lo=S.T_TAIL50)
+    sel = ctx.time_mask(lo=ctx.session.T_TAIL50)
     s = masked_sum(ctx.volume, sel)
     denom = jnp.where(s == 0.0, 1.0, s)
     term = ctx.ret_co * ctx.volume / denom[..., None]
@@ -53,13 +52,13 @@ def _window_over_total(ctx: DayContext, sel):
 @register("trade_headRatio")
 def trade_headRatio(ctx: DayContext):
     """Volume share of bars <= 10:00. Ref :1251-1277."""
-    return _window_over_total(ctx, ctx.time_mask(hi=S.T_HEAD_END))
+    return _window_over_total(ctx, ctx.time_mask(hi=ctx.session.T_HEAD_END))
 
 
 @register("trade_tailRatio")
 def trade_tailRatio(ctx: DayContext):
     """Volume share of bars >= 14:30. Ref :1280-1306."""
-    return _window_over_total(ctx, ctx.time_mask(lo=S.T_LAST30_OPEN))
+    return _window_over_total(ctx, ctx.time_mask(lo=ctx.session.T_LAST30_OPEN))
 
 
 def _ret_over_share(ctx: DayContext, t_hi: int, sign: int):
@@ -85,25 +84,25 @@ def _ret_over_share(ctx: DayContext, t_hi: int, sign: int):
 @register("trade_top20retRatio")
 def trade_top20retRatio(ctx: DayContext):
     """mean(ret / volume share) over bars <= 09:50. Ref :1309-1328."""
-    return _ret_over_share(ctx, S.T_TOP20_END, 0)
+    return _ret_over_share(ctx, ctx.session.T_TOP20_END, 0)
 
 
 @register("trade_top50retRatio")
 def trade_top50retRatio(ctx: DayContext):
     """mean(ret / volume share) over bars <= 10:20. Ref :1331-1350."""
-    return _ret_over_share(ctx, S.T_TOP50_END, 0)
+    return _ret_over_share(ctx, ctx.session.T_TOP50_END, 0)
 
 
 @register("trade_topNeg20retRatio")
 def trade_topNeg20retRatio(ctx: DayContext):
     """Negative-return variant over bars <= 09:50. Ref :1353-1378."""
-    return _ret_over_share(ctx, S.T_TOP20_END, -1)
+    return _ret_over_share(ctx, ctx.session.T_TOP20_END, -1)
 
 
 @register("trade_topPos20retRatio")
 def trade_topPos20retRatio(ctx: DayContext):
     """Positive-return variant over bars <= 09:50. Ref :1381-1406."""
-    return _ret_over_share(ctx, S.T_TOP20_END, 1)
+    return _ret_over_share(ctx, ctx.session.T_TOP20_END, 1)
 
 
 # --- streaming readiness (ISSUE 7): each window kernel waits for its

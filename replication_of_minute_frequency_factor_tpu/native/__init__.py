@@ -257,18 +257,19 @@ def pack_dclose4(dclose: np.ndarray) -> np.ndarray:
 
 
 def pack_vol10(vol: np.ndarray) -> np.ndarray:
-    """int ``[..., 240]`` volumes (each <= 1023) -> uint8 ``[..., 300]``:
-    four 10-bit values per 5 bytes, little-endian bit order (value k's
-    bit b lands at stream bit 10k+b)."""
-    g = vol.reshape(vol.shape[:-1] + (60, 4)).astype(np.uint16)
+    """int ``[..., S]`` volumes (each <= 1023, ``S % 4 == 0``) -> uint8
+    ``[..., S//4*5]``: four 10-bit values per 5 bytes, little-endian
+    bit order (value k's bit b lands at stream bit 10k+b)."""
+    groups = vol.shape[-1] // 4
+    g = vol.reshape(vol.shape[:-1] + (groups, 4)).astype(np.uint16)
     v0, v1, v2, v3 = (g[..., i] for i in range(4))
-    out = np.empty(vol.shape[:-1] + (60, 5), np.uint8)
+    out = np.empty(vol.shape[:-1] + (groups, 5), np.uint8)
     out[..., 0] = v0 & 0xFF
     out[..., 1] = (v0 >> 8) | ((v1 & 0x3F) << 2)
     out[..., 2] = (v1 >> 6) | ((v2 & 0xF) << 4)
     out[..., 3] = (v2 >> 4) | ((v3 & 0x3) << 6)
     out[..., 4] = v3 >> 2
-    return out.reshape(vol.shape[:-1] + (300,))
+    return out.reshape(vol.shape[:-1] + (groups * 5,))
 
 
 def narrow_wire(base, dclose, dohl, volume, stats, floor=None):
@@ -280,6 +281,11 @@ def narrow_wire(base, dclose, dohl, volume, stats, floor=None):
     floor = floor if floor is not None else {}
     dmax_ohl, dmax_c, v_lots, vmax, wick_ok, tight_ok = \
         (int(s) for s in stats)
+    # sub-byte packings gate on the slot count's divisibility (ISSUE
+    # 15): int4-pair dclose needs an even S, 10-bit volume S % 4 == 0.
+    # A session missing a divisor (us_390's volume) just starts one
+    # rung wider — widen-only floors stay monotonic per run.
+    n_slots = dclose.shape[-1]
 
     def pick(key, fits):
         mode = floor.get(key, 0)
@@ -289,7 +295,8 @@ def narrow_wire(base, dclose, dohl, volume, stats, floor=None):
             floor[key] = mode
         return mode
 
-    cm = pick("dclose_mode", (dmax_c <= 7, dmax_c <= 127, True))
+    cm = pick("dclose_mode", (dmax_c <= 7 and n_slots % 2 == 0,
+                              dmax_c <= 127, True))
     if cm == 0:
         dclose = pack_dclose4(dclose)
     elif cm == 1:
@@ -302,8 +309,9 @@ def narrow_wire(base, dclose, dohl, volume, stats, floor=None):
         dohl = pack_wick(dohl)
     elif om == 2:
         dohl = dohl.astype(np.int8)
-    vm = pick("vol_mode", (vmax <= 1023,
-                           bool(v_lots) and vmax // 100 <= 1023,
+    vol4 = n_slots % 4 == 0
+    vm = pick("vol_mode", (vol4 and vmax <= 1023,
+                           vol4 and bool(v_lots) and vmax // 100 <= 1023,
                            vmax <= 0xFFFF,
                            bool(v_lots) and vmax // 100 <= 0xFFFF, True))
     vol_scale = 1.0

@@ -28,38 +28,54 @@ incremental form of the per-window bar counts every NaN-gating
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, Tuple
 
 import jax.numpy as jnp
 
-from .. import sessions as S
 from ..data.minute import F_CLOSE, F_OPEN, F_VOLUME
+from ..markets import get_session
 
 _NAN = jnp.nan
 
-#: counter name -> window spec. ``("range", lo, hi, lo_strict,
-#: hi_strict)`` bounds the slot time like ``DayContext.time_mask``
-#: (None = unbounded); ``("exact", times)`` matches the sentinel-bar
-#: kernels' 2-slot candidate sets. The per-kernel readiness
-#: requirements (``models.registry.STREAM_REQUIREMENTS``) name these
-#: counters.
-WINDOW_COUNTERS: Dict[str, Tuple] = {
-    "bars": ("range", None, None, False, False),
-    "am": ("range", None, S.T_NOON, False, False),
-    "pm": ("range", S.T_NOON, None, True, False),
-    "pre_auction": ("range", None, S.T_CLOSE_AUCTION, False, True),
-    "auction": ("range", S.T_CLOSE_AUCTION, None, False, False),
-    "head": ("range", None, S.T_HEAD_END, False, False),
-    "top20": ("range", None, S.T_TOP20_END, False, False),
-    "top50": ("range", None, S.T_TOP50_END, False, False),
-    "tail20": ("range", S.T_TAIL20, None, False, False),
-    "tail30": ("range", S.T_LAST30_OPEN, None, False, False),
-    "tail50": ("range", S.T_TAIL50, None, False, False),
-    "sent_pm": ("exact", (S.T_PM_OPEN, S.T_PM_CLOSE)),
-    "sent_last30": ("exact", (S.T_LAST30_OPEN, S.T_PM_CLOSE)),
-    "sent_am": ("exact", (S.T_AM_OPEN, S.T_AM_CLOSE)),
-    "sent_between": ("exact", (S.T_BETWEEN_OPEN, S.T_BETWEEN_CLOSE)),
-}
+
+@functools.lru_cache(maxsize=None)
+def window_counters_for(session=None) -> Dict[str, Tuple]:
+    """Counter name -> window spec for one market session (ISSUE 15).
+
+    ``("range", lo, hi, lo_strict, hi_strict)`` bounds the slot time
+    like ``DayContext.time_mask`` (None = unbounded); ``("exact",
+    times)`` matches the sentinel-bar kernels' 2-slot candidate sets.
+    The per-kernel readiness requirements
+    (``models.registry.STREAM_REQUIREMENTS``) name these counters —
+    the NAMES are session-relative (every spec defines the same
+    windows at its own boundaries), so one readiness contract serves
+    every registered market. Cached per spec: specs are frozen, and
+    the dict is consulted at trace time."""
+    s = get_session(session)
+    return {
+        "bars": ("range", None, None, False, False),
+        "am": ("range", None, s.T_NOON, False, False),
+        "pm": ("range", s.T_NOON, None, True, False),
+        "pre_auction": ("range", None, s.T_CLOSE_AUCTION, False, True),
+        "auction": ("range", s.T_CLOSE_AUCTION, None, False, False),
+        "head": ("range", None, s.T_HEAD_END, False, False),
+        "top20": ("range", None, s.T_TOP20_END, False, False),
+        "top50": ("range", None, s.T_TOP50_END, False, False),
+        "tail20": ("range", s.T_TAIL20, None, False, False),
+        "tail30": ("range", s.T_LAST30_OPEN, None, False, False),
+        "tail50": ("range", s.T_TAIL50, None, False, False),
+        "sent_pm": ("exact", (s.T_PM_OPEN, s.T_PM_CLOSE)),
+        "sent_last30": ("exact", (s.T_LAST30_OPEN, s.T_PM_CLOSE)),
+        "sent_am": ("exact", (s.T_AM_OPEN, s.T_AM_CLOSE)),
+        "sent_between": ("exact", (s.T_BETWEEN_OPEN, s.T_BETWEEN_CLOSE)),
+    }
+
+
+#: the canonical cn_ashare_240 windows (the seed's module constant;
+#: counter NAMES — what the readiness contract validates against — are
+#: identical for every session)
+WINDOW_COUNTERS: Dict[str, Tuple] = window_counters_for(None)
 
 
 def window_contains(spec: Tuple, time):
@@ -94,7 +110,7 @@ def init_inc(n_tickers: int) -> Dict[str, object]:
     return out
 
 
-def update_inc(inc, t, values, present):
+def update_inc(inc, t, values, present, session=None):
     """One-minute fold step: bump every window counter for the present
     lanes and advance the selection trackers.
 
@@ -102,12 +118,14 @@ def update_inc(inc, t, values, present):
     the bar fields, ``present [T]`` which tickers traded this minute.
     Integer counters and first/last selections stay bitwise-equal to
     their batch forms (module docstring); ``vol_sum`` is the
-    order-sensitive diagnostic accumulator.
+    order-sensitive diagnostic accumulator. ``session`` picks the
+    window boundaries (trace-time static; None = cn_ashare_240).
     """
-    time = jnp.asarray(S.GRID_TIMES)[t]
+    sess = get_session(session)
+    time = jnp.asarray(sess.grid_times)[t]
     out = dict(inc)
     one = jnp.int32(1)
-    for name, spec in WINDOW_COUNTERS.items():
+    for name, spec in window_counters_for(sess).items():
         out[name] = inc[name] + jnp.where(
             present & window_contains(spec, time), one, jnp.int32(0))
     out["vol_sum"] = inc["vol_sum"] + jnp.where(
@@ -120,16 +138,17 @@ def update_inc(inc, t, values, present):
     return out
 
 
-def update_inc_at(inc, t, rows, idx):
+def update_inc_at(inc, t, rows, idx, session=None):
     """Cohort (scatter) twin of :func:`update_inc`: ``rows [K, 5]`` are
     bars for tickers ``idx [K]`` at slot ``t``. Padding rows carry an
     out-of-bounds index (``idx == n_tickers``) and are dropped by the
     scatter. Each ticker appears at most once per call (live feeds
     deliver one bar per ticker per minute); duplicates are undefined.
     """
-    time = jnp.asarray(S.GRID_TIMES)[t]
+    sess = get_session(session)
+    time = jnp.asarray(sess.grid_times)[t]
     out = dict(inc)
-    for name, spec in WINDOW_COUNTERS.items():
+    for name, spec in window_counters_for(sess).items():
         bump = jnp.where(window_contains(spec, time), jnp.int32(1),
                          jnp.int32(0))
         bump = jnp.broadcast_to(bump, idx.shape)

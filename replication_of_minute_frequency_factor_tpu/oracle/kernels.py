@@ -23,7 +23,7 @@ from typing import Callable, Dict, Optional, Sequence
 import numpy as np
 import pandas as pd
 
-from .. import sessions as S
+from ..markets import get_session
 from .stats import kurt_excess, pearson, pct_change, rank_average, skew_g1, std1
 
 ORACLE_FACTORS: Dict[str, Callable] = {}
@@ -47,8 +47,16 @@ class Group:
     close: np.ndarray
     volume: np.ndarray
     grank: Optional[np.ndarray] = None  # global eod-return rank (doc_pdf*)
+    #: market session spec (ISSUE 15): the sentinel boundaries the
+    #: time-filter kernels consult; None = cn_ashare_240, so the
+    #: oracle gates every registered session with the same kernels
+    session: Optional[object] = None
     _rolling_cache: Optional[dict] = dataclasses.field(
         default=None, repr=False, compare=False)
+
+    @property
+    def sess(self):
+        return get_session(self.session)
 
     @property
     def n(self) -> int:
@@ -81,29 +89,29 @@ def _sentinel_ratio(g: Group, t_first: int, t_last: int):
 
 @_register("mmt_pm")
 def mmt_pm(g: Group):
-    return _sentinel_ratio(g, S.T_PM_OPEN, S.T_PM_CLOSE)  # ref :12-24
+    return _sentinel_ratio(g, g.sess.T_PM_OPEN, g.sess.T_PM_CLOSE)  # ref :12-24
 
 
 @_register("mmt_last30")
 def mmt_last30(g: Group):
-    return _sentinel_ratio(g, S.T_LAST30_OPEN, S.T_PM_CLOSE)  # ref :27-39
+    return _sentinel_ratio(g, g.sess.T_LAST30_OPEN, g.sess.T_PM_CLOSE)  # ref :27-39
 
 
 @_register("mmt_am")
 def mmt_am(g: Group):
-    return _sentinel_ratio(g, S.T_AM_OPEN, S.T_AM_CLOSE)  # ref :63-75
+    return _sentinel_ratio(g, g.sess.T_AM_OPEN, g.sess.T_AM_CLOSE)  # ref :63-75
 
 
 @_register("mmt_between")
 def mmt_between(g: Group):
-    return _sentinel_ratio(g, S.T_BETWEEN_OPEN, S.T_BETWEEN_CLOSE)  # ref :78-90
+    return _sentinel_ratio(g, g.sess.T_BETWEEN_OPEN, g.sess.T_BETWEEN_CLOSE)  # ref :78-90
 
 
 @_register("mmt_paratio")
 def mmt_paratio(g: Group):
     """ref :42-60; session order pinned AM-then-PM (polars group order is
     nondeterministic there)."""
-    am = g.time <= S.T_NOON
+    am = g.time <= g.sess.T_NOON
     vals = []
     for sel in (am, ~am):
         if sel.any():
@@ -129,7 +137,7 @@ def _rolling50(g: Group):
         return g._rolling_cache
     from replication_of_minute_frequency_factor_tpu import pins
 
-    slots = S.time_to_slot(g.time)
+    slots = g.sess.time_to_slot(g.time)
     xa = g.low.astype(np.float64)
     ya = g.high.astype(np.float64)
     if pins.reading("constant_window") == "degenerate":
@@ -362,7 +370,7 @@ def liq_amihud_1min(g: Group):
 
 @_register("liq_closeprevol")
 def liq_closeprevol(g: Group):
-    sel = g.time < S.T_CLOSE_AUCTION  # ref :764-775
+    sel = g.time < g.sess.T_CLOSE_AUCTION  # ref :764-775
     if not sel.any():
         return None
     return float(g.volume[sel].sum())
@@ -370,7 +378,7 @@ def liq_closeprevol(g: Group):
 
 @_register("liq_closevol")
 def liq_closevol(g: Group):
-    sel = g.time >= S.T_CLOSE_AUCTION  # ref :778-789
+    sel = g.time >= g.sess.T_CLOSE_AUCTION  # ref :778-789
     if not sel.any():
         return None
     return float(g.volume[sel].sum())
@@ -384,7 +392,7 @@ def liq_firstCallR(g: Group):
 
 @_register("liq_lastCallR")
 def liq_lastCallR(g: Group):
-    sel = g.time >= S.T_CLOSE_AUCTION  # ref :805-820
+    sel = g.time >= g.sess.T_CLOSE_AUCTION  # ref :805-820
     with np.errstate(divide="ignore", invalid="ignore"):
         return float(g.volume[sel].sum() / g.volume.sum())
 
@@ -532,7 +540,7 @@ def doc_vol50_ratio(g: Group):
 
 @_register("trade_bottom20retRatio")
 def trade_bottom20retRatio(g: Group):
-    sel = g.time >= S.T_TAIL20  # ref :1206-1224
+    sel = g.time >= g.sess.T_TAIL20  # ref :1206-1224
     if not sel.any():
         return None
     v, ret = g.volume[sel], g.ret_co[sel]
@@ -541,7 +549,7 @@ def trade_bottom20retRatio(g: Group):
 
 @_register("trade_bottom50retRatio")
 def trade_bottom50retRatio(g: Group):
-    sel = g.time >= S.T_TAIL50  # ref :1227-1248
+    sel = g.time >= g.sess.T_TAIL50  # ref :1227-1248
     if not sel.any():
         return None
     v, ret = g.volume[sel], g.ret_co[sel]
@@ -558,12 +566,12 @@ def _window_over_total(g: Group, sel):
 
 @_register("trade_headRatio")
 def trade_headRatio(g: Group):
-    return _window_over_total(g, g.time <= S.T_HEAD_END)  # ref :1251-1277
+    return _window_over_total(g, g.time <= g.sess.T_HEAD_END)  # ref :1251-1277
 
 
 @_register("trade_tailRatio")
 def trade_tailRatio(g: Group):
-    return _window_over_total(g, g.time >= S.T_LAST30_OPEN)  # ref :1280-1306
+    return _window_over_total(g, g.time >= g.sess.T_LAST30_OPEN)  # ref :1280-1306
 
 
 def _ret_over_share(g: Group, t_hi: int, sign: int):
@@ -584,35 +592,39 @@ def _ret_over_share(g: Group, t_hi: int, sign: int):
 
 @_register("trade_top20retRatio")
 def trade_top20retRatio(g: Group):
-    return _ret_over_share(g, S.T_TOP20_END, 0)  # ref :1309-1328
+    return _ret_over_share(g, g.sess.T_TOP20_END, 0)  # ref :1309-1328
 
 
 @_register("trade_top50retRatio")
 def trade_top50retRatio(g: Group):
-    return _ret_over_share(g, S.T_TOP50_END, 0)  # ref :1331-1350
+    return _ret_over_share(g, g.sess.T_TOP50_END, 0)  # ref :1331-1350
 
 
 @_register("trade_topNeg20retRatio")
 def trade_topNeg20retRatio(g: Group):
-    return _ret_over_share(g, S.T_TOP20_END, -1)  # ref :1353-1378
+    return _ret_over_share(g, g.sess.T_TOP20_END, -1)  # ref :1353-1378
 
 
 @_register("trade_topPos20retRatio")
 def trade_topPos20retRatio(g: Group):
-    return _ret_over_share(g, S.T_TOP20_END, 1)  # ref :1381-1406
+    return _ret_over_share(g, g.sess.T_TOP20_END, 1)  # ref :1381-1406
 
 
 # --- driver ---------------------------------------------------------------
 
 def compute_oracle(df: pd.DataFrame,
-                   names: Optional[Sequence[str]] = None) -> pd.DataFrame:
+                   names: Optional[Sequence[str]] = None,
+                   session=None) -> pd.DataFrame:
     """Compute factors over a long-format frame; returns one wide frame
     ``(code, date, <name>...)``; absent groups become NaN in the wide form.
 
     ``df`` needs columns code/date/time/open/high/low/close/volume; rows are
     sorted (code, time) internally, matching the reference's reliance on
-    file row order.
+    file row order. ``session`` picks the market grid's sentinel
+    boundaries (ISSUE 15; None = cn_ashare_240), so the same oracle
+    gates the parity harness at every registered session shape.
     """
+    session = get_session(session)
     if names is None:
         names = list(ORACLE_FACTORS)
     df = df.sort_values(["code", "date", "time"], kind="stable")
@@ -648,6 +660,7 @@ def compute_oracle(df: pd.DataFrame,
             close=arr["close"][sl].astype(np.float64),
             volume=arr["volume"][sl].astype(np.float64),
             grank=None if grank_all is None else grank_all[sl],
+            session=session,
         )
         key = (keys[b0][0], keys[b0][1])
         vals = {}

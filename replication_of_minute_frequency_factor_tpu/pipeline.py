@@ -35,14 +35,15 @@ from .telemetry.factorplane import factor_stats_block as _factor_stats_block
 
 
 def _compute_from_wire_fn(base, dclose, dohl, volume, maskbits, vol_scale,
-                          names, replicate_quirks, rolling_impl):
+                          names, replicate_quirks, rolling_impl,
+                          session=None):
     bars, m = wire.decode(base, dclose, dohl, volume, maskbits, vol_scale)
     return compute_factors(bars, m, names=names,
                            replicate_quirks=replicate_quirks,
-                           rolling_impl=rolling_impl)
+                           rolling_impl=rolling_impl, session=session)
 
 
-_WIRE_STATIC = ("names", "replicate_quirks", "rolling_impl")
+_WIRE_STATIC = ("names", "replicate_quirks", "rolling_impl", "session")
 _compute_from_wire_jit = functools.partial(
     jax.jit, static_argnames=_WIRE_STATIC)(_compute_from_wire_fn)
 #: donated twin (accelerator backends): the six wire arrays die at the
@@ -53,7 +54,8 @@ _compute_from_wire_jit_donated = functools.partial(
 
 
 def _compute_from_wire(base, dclose, dohl, volume, maskbits, vol_scale,
-                       names, replicate_quirks, rolling_impl=None):
+                       names, replicate_quirks, rolling_impl=None,
+                       session=None):
     """Fused on-device wire-decode + all-factor graph (one XLA module).
 
     A None ``rolling_impl`` resolves the config value before the jit
@@ -66,11 +68,12 @@ def _compute_from_wire(base, dclose, dohl, volume, maskbits, vol_scale,
           else _compute_from_wire_jit)
     return fn(base, dclose, dohl, volume, maskbits,
               vol_scale, names, replicate_quirks,
-              rolling_impl)
+              rolling_impl, session)
 
 
 def _compute_packed(buf, spec, kind, names, replicate_quirks,
-                    rolling_impl, result_spec=None, factor_stats=False):
+                    rolling_impl, result_spec=None, factor_stats=False,
+                    session=None):
     """Single-buffer variant of the fused graph: ONE uint8 input (unpacked
     by static-offset bitcasts on device) and ONE stacked ``[F, ...]``
     output, so a batch costs one transfer each way over the tunnel instead
@@ -97,7 +100,7 @@ def _compute_packed(buf, spec, kind, names, replicate_quirks,
         m = m.astype(bool)
     out = compute_factors(bars, m, names=names,
                           replicate_quirks=replicate_quirks,
-                          rolling_impl=rolling_impl)
+                          rolling_impl=rolling_impl, session=session)
     stacked = jnp.stack([out[n] for n in names])
     stats = (_factor_stats_block(
         stacked if factor_stats is True
@@ -111,7 +114,8 @@ def _compute_packed(buf, spec, kind, names, replicate_quirks,
 
 
 _PACKED_STATIC = ("spec", "kind", "names", "replicate_quirks",
-                  "rolling_impl", "result_spec", "factor_stats")
+                  "rolling_impl", "result_spec", "factor_stats",
+                  "session")
 _compute_packed_jit = functools.partial(
     jax.jit, static_argnames=_PACKED_STATIC)(_compute_packed)
 #: donated twin: the multi-MB packed day buffer is dead the moment the
@@ -139,7 +143,7 @@ def _donate_device_buffers(cfg: Optional["Config"] = None) -> bool:
 
 def compute_packed_prepared(buf, spec, kind, names, replicate_quirks=True,
                             rolling_impl=None, result_spec=None,
-                            factor_stats=False):
+                            factor_stats=False, session=None):
     """Device half of the packed path: one device_put of an already-packed
     buffer -> fused graph -> stacked [len(names), D, T] result (still on
     device). The streaming pipeline packs on its producer thread and
@@ -156,22 +160,23 @@ def compute_packed_prepared(buf, spec, kind, names, replicate_quirks=True,
           else _compute_packed_jit)
     return fn(jax.device_put(buf), spec, kind, names,
               replicate_quirks, rolling_impl, result_spec,
-              factor_stats)
+              factor_stats, _resolve_session(session))
 
 
 def compute_packed(arrays, kind, names, replicate_quirks=True,
                    rolling_impl=None, result_spec=None,
-                   factor_stats=False):
+                   factor_stats=False, session=None):
     """One-call packed path: pack + transfer + compute (see above)."""
     buf, spec = wire.pack_arrays(arrays)
     return compute_packed_prepared(buf, spec, kind, names,
                                    replicate_quirks, rolling_impl,
-                                   result_spec, factor_stats)
+                                   result_spec, factor_stats,
+                                   session=session)
 
 
 def _compute_packed_scan(bufs, spec, kind, names, replicate_quirks,
                          rolling_impl, result_spec=None,
-                         factor_stats=False):
+                         factor_stats=False, session=None):
     """Device-resident multi-batch variant: a whole year of packed
     buffers in ONE executable.
 
@@ -200,7 +205,7 @@ def _compute_packed_scan(bufs, spec, kind, names, replicate_quirks,
             m = m.astype(bool)
         out = compute_factors(bars, m, names=names,
                               replicate_quirks=replicate_quirks,
-                              rolling_impl=rolling_impl)
+                              rolling_impl=rolling_impl, session=session)
         y = jnp.stack([out[n] for n in names])
         # per-factor data-quality sketch as a fused side-output
         # (ISSUE 12): computed from the raw stacked block BEFORE any
@@ -237,6 +242,17 @@ _compute_packed_scan_jit = functools.partial(
 _compute_packed_scan_jit_donated = functools.partial(
     jax.jit, static_argnames=_PACKED_STATIC,
     donate_argnums=(0,))(_compute_packed_scan)
+
+
+def _resolve_session(session):
+    """Resolve a session name to its frozen spec OUTSIDE the jit
+    boundary (the spec VALUE is the cache key, like rolling_impl).
+    None stays None — the canonical default's cache keys, and every
+    pre-ISSUE-15 call site, are unchanged."""
+    if session is None:
+        return None
+    from .markets import get_session
+    return get_session(session)
 
 
 class DonatedBufferError(RuntimeError):
@@ -292,7 +308,8 @@ def _invalidate_donated(arrs) -> None:
 
 def compute_packed_resident(dbufs, spec, kind, names,
                             replicate_quirks=True, rolling_impl=None,
-                            result_spec=None, factor_stats=False):
+                            result_spec=None, factor_stats=False,
+                            session=None):
     """Run N device-resident packed buffers through one fused scan
     executable; returns the stacked [N, F, D, T] result STILL ON DEVICE
     (callers fetch once). ``dbufs``: tuple of device uint8 buffers that
@@ -312,7 +329,8 @@ def compute_packed_resident(dbufs, spec, kind, names,
     fn = (_compute_packed_scan_jit_donated if donating
           else _compute_packed_scan_jit)
     out = fn(tuple(dbufs), spec, kind, names,
-             replicate_quirks, rolling_impl, result_spec, factor_stats)
+             replicate_quirks, rolling_impl, result_spec, factor_stats,
+             _resolve_session(session))
     if donating:
         _invalidate_donated(dbufs)
     return out
@@ -320,7 +338,8 @@ def compute_packed_resident(dbufs, spec, kind, names,
 
 def lower_packed_resident(dbufs, spec, kind, names,
                           replicate_quirks=True, rolling_impl=None,
-                          result_spec=None, factor_stats=False):
+                          result_spec=None, factor_stats=False,
+                          session=None):
     """AOT lowering of the resident scan executable (same twin
     selection as :func:`compute_packed_resident`). bench routes the
     first build through ``telemetry.attribution.compile_with_telemetry``
@@ -333,12 +352,13 @@ def lower_packed_resident(dbufs, spec, kind, names,
           else _compute_packed_scan_jit)
     return fn.lower(tuple(dbufs), spec, kind, names,
                     replicate_quirks, rolling_impl, result_spec,
-                    factor_stats)
+                    factor_stats, _resolve_session(session))
 
 
 def _compute_packed_scan_sharded(stacked, spec, kind, names,
                                  replicate_quirks, rolling_impl, mesh,
-                                 result_spec=None, factor_stats=False):
+                                 result_spec=None, factor_stats=False,
+                                 session=None):
     """Mesh-native twin of :func:`_compute_packed_scan`: the resident
     year as ONE scan executable whose data parallelism spans the
     tickers axis of a ``(days=1, tickers=n)`` mesh.
@@ -371,7 +391,8 @@ def _compute_packed_scan_sharded(stacked, spec, kind, names,
             out = compute_factors(bars, m, names=names,
                                   replicate_quirks=replicate_quirks,
                                   rolling_impl=rolling_impl,
-                                  xs_axis_name=TICKERS_AXIS)
+                                  xs_axis_name=TICKERS_AXIS,
+                                  session=session)
             return None, jnp.stack([out[n] for n in names])
 
         _, ys = jax.lax.scan(body, None, bufs)
@@ -423,7 +444,7 @@ def compute_packed_resident_sharded(stacked, spec, kind, mesh, names,
                                     replicate_quirks=True,
                                     rolling_impl=None,
                                     result_spec=None,
-                                    factor_stats=False):
+                                    factor_stats=False, session=None):
     """Sharded resident scan over a mesh-placed ``[N, S, L]`` packed
     year (see :func:`_compute_packed_scan_sharded`); returns
     ``[N, F, D, T]`` STILL SHARDED on device — fetch once per scan
@@ -439,7 +460,8 @@ def compute_packed_resident_sharded(stacked, spec, kind, mesh, names,
     fn = (_compute_packed_scan_sharded_jit_donated if donating
           else _compute_packed_scan_sharded_jit)
     out = fn(stacked, spec, kind, names, replicate_quirks,
-             rolling_impl, mesh, result_spec, factor_stats)
+             rolling_impl, mesh, result_spec, factor_stats,
+             _resolve_session(session))
     if donating:
         _invalidate_donated((stacked,))
     return out
@@ -449,7 +471,7 @@ def lower_packed_resident_sharded(stacked, spec, kind, mesh, names,
                                   replicate_quirks=True,
                                   rolling_impl=None,
                                   result_spec=None,
-                                  factor_stats=False):
+                                  factor_stats=False, session=None):
     """AOT lowering of the SHARDED resident scan (twin selection as
     :func:`compute_packed_resident_sharded`); call the compiled
     executable with ``compiled(stacked)``. See
@@ -461,12 +483,14 @@ def lower_packed_resident_sharded(stacked, spec, kind, mesh, names,
           if _donate_device_buffers()
           else _compute_packed_scan_sharded_jit)
     return fn.lower(stacked, spec, kind, names, replicate_quirks,
-                    rolling_impl, mesh, result_spec, factor_stats)
+                    rolling_impl, mesh, result_spec, factor_stats,
+                    _resolve_session(session))
 
 
 def _compute_packed_scan_2d(stacked, carry_in, spec, kind, names,
                             replicate_quirks, rolling_impl, mesh,
-                            result_spec=None, factor_stats=False):
+                            result_spec=None, factor_stats=False,
+                            session=None):
     """2-D mesh-native resident scan (ISSUE 13): the year as ONE scan
     executable whose data parallelism spans BOTH axes of a
     ``(days=d, tickers=t)`` mesh.
@@ -534,7 +558,8 @@ def _compute_packed_scan_2d(stacked, carry_in, spec, kind, names,
             out = compute_factors(bars, m, names=names,
                                   replicate_quirks=replicate_quirks,
                                   rolling_impl=rolling_impl,
-                                  xs_axis_name=TICKERS_AXIS)
+                                  xs_axis_name=TICKERS_AXIS,
+                                  session=session)
             y = jnp.stack([out[k] for k in names])
             d_local = bars.shape[0]
             # global day order is batch-major, day-shard-minor: step n
@@ -598,7 +623,8 @@ def _compute_packed_scan_2d(stacked, carry_in, spec, kind, names,
 
 
 _SCAN_2D_STATIC = ("spec", "kind", "names", "replicate_quirks",
-                   "rolling_impl", "mesh", "result_spec", "factor_stats")
+                   "rolling_impl", "mesh", "result_spec", "factor_stats",
+                   "session")
 _compute_packed_scan_2d_jit = functools.partial(
     jax.jit, static_argnames=_SCAN_2D_STATIC)(_compute_packed_scan_2d)
 #: donated twin — the HBM rationale of the 1-D scans, per tile: each
@@ -613,7 +639,8 @@ _compute_packed_scan_2d_jit_donated = functools.partial(
 def compute_packed_resident_2d(stacked, spec, kind, mesh, names,
                                replicate_quirks=True, rolling_impl=None,
                                result_spec=None, factor_stats=False,
-                               carry_in=None, n_tickers=None):
+                               carry_in=None, n_tickers=None,
+                               session=None):
     """Run a mesh-placed ``[N, Sd, St, L]`` packed year through the
     2-D pipelined scan (see :func:`_compute_packed_scan_2d`); returns
     ``(ys, carry)`` (or ``(ys, stats, carry)``) STILL SHARDED on
@@ -641,7 +668,8 @@ def compute_packed_resident_2d(stacked, spec, kind, mesh, names,
     fn = (_compute_packed_scan_2d_jit_donated if donating
           else _compute_packed_scan_2d_jit)
     out = fn(stacked, carry_in, spec, kind, names, replicate_quirks,
-             rolling_impl, mesh, result_spec, factor_stats)
+             rolling_impl, mesh, result_spec, factor_stats,
+             _resolve_session(session))
     if donating:
         _invalidate_donated((stacked,))
     return out
@@ -649,7 +677,8 @@ def compute_packed_resident_2d(stacked, spec, kind, mesh, names,
 
 def lower_packed_resident_2d(stacked, carry_in, spec, kind, mesh, names,
                              replicate_quirks=True, rolling_impl=None,
-                             result_spec=None, factor_stats=False):
+                             result_spec=None, factor_stats=False,
+                             session=None):
     """AOT lowering of the 2-D pipelined scan (twin selection as
     :func:`compute_packed_resident_2d`); call the compiled executable
     with ``compiled(stacked, carry_in)``. See
@@ -662,12 +691,12 @@ def lower_packed_resident_2d(stacked, carry_in, spec, kind, mesh, names,
           else _compute_packed_scan_2d_jit)
     return fn.lower(stacked, carry_in, spec, kind, names,
                     replicate_quirks, rolling_impl, mesh, result_spec,
-                    factor_stats)
+                    factor_stats, _resolve_session(session))
 
 
 def compute_exposures_streamed(bars, mask, names=None, micro_batch=16,
                                replicate_quirks=True, rolling_impl=None,
-                               engine=None):
+                               engine=None, session=None):
     """One day of minute bars folded through the streaming engine
     (ISSUE 7): ``bars [T, 240, 5]`` / ``mask [T, 240]`` host arrays in,
     ``{name: np [T]}`` out — the batch pipeline's answer by way of 240
@@ -683,7 +712,7 @@ def compute_exposures_streamed(bars, mask, names=None, micro_batch=16,
     if engine is None:
         engine = StreamEngine(mask.shape[0], names=names,
                               replicate_quirks=replicate_quirks,
-                              rolling_impl=rolling_impl)
+                              rolling_impl=rolling_impl, session=session)
     else:
         engine.reset()
     s = 0

@@ -36,7 +36,8 @@ from ..telemetry.factorplane import factor_stats_block
 from .executables import ExecutableCache
 
 
-def _block_fn(buf, spec, kind, names, replicate_quirks, rolling_impl):
+def _block_fn(buf, spec, kind, names, replicate_quirks, rolling_impl,
+              session=None):
     """The fused block graph: one packed uint8 buffer in, the whole
     query-answering state out. ``close`` is each (day, ticker)'s last
     valid bar's close (NaN when the day has no valid bar) — the basis
@@ -53,7 +54,7 @@ def _block_fn(buf, spec, kind, names, replicate_quirks, rolling_impl):
         m = m.astype(bool)
     out = compute_factors(bars, m, names=names,
                           replicate_quirks=replicate_quirks,
-                          rolling_impl=rolling_impl)
+                          rolling_impl=rolling_impl, session=session)
     exposures = jnp.stack([out[n] for n in names])  # [F, D, T]
     slots = jnp.arange(m.shape[-1])
     last = jnp.max(jnp.where(m, slots, -1), axis=-1)  # [D, T]
@@ -65,7 +66,7 @@ def _block_fn(buf, spec, kind, names, replicate_quirks, rolling_impl):
 
 
 _BLOCK_STATIC = ("spec", "kind", "names", "replicate_quirks",
-                 "rolling_impl")
+                 "rolling_impl", "session")
 _block_jit = functools.partial(jax.jit,
                                static_argnames=_BLOCK_STATIC)(_block_fn)
 
@@ -136,8 +137,13 @@ class ServeEngine:
 
     def __init__(self, names: Sequence[str], replicate_quirks: bool = True,
                  rolling_impl: Optional[str] = None, telemetry=None,
-                 executables: Optional[ExecutableCache] = None):
+                 executables: Optional[ExecutableCache] = None,
+                 session=None):
         from ..config import get_config
+        from ..markets import get_session
+        #: the source's market session (ISSUE 15): the block graph and
+        #: every query trace over its slot grid; None = cn_ashare_240
+        self.session = get_session(session)
         self.names: Tuple[str, ...] = tuple(names)
         self.replicate_quirks = replicate_quirks
         self.rolling_impl = (rolling_impl if rolling_impl is not None
@@ -169,12 +175,13 @@ class ServeEngine:
             kind = "raw"
         dbuf = jax.device_put(buf)
         key = ("block", len(buf), spec, kind, self.names,
-               self.replicate_quirks, self.rolling_impl)
+               self.replicate_quirks, self.rolling_impl,
+               self.session.name)
         compiled = self.executables.get(
             "serve_block", key,
             lambda: _block_jit.lower(dbuf, spec, kind, self.names,
                                      self.replicate_quirks,
-                                     self.rolling_impl))
+                                     self.rolling_impl, self.session))
         exposures, close, valid, stats = compiled(dbuf)
         block = {"exposures": exposures, "close": close, "valid": valid,
                  "stats": stats}

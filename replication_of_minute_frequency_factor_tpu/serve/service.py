@@ -227,13 +227,20 @@ class FactorServer:
         self.replica_label = replica_label or "standalone"
         self.devices: Optional[tuple] = (tuple(devices) if devices
                                          else None)
+        #: market session (ISSUE 15): adopted from the source (a
+        #: source built for us_390 serves us_390 — the session is a
+        #: property of the DATA, not a request knob); sources without
+        #: the attribute serve the canonical cn_ashare_240 day
+        from ..markets import get_session
+        self.session = get_session(getattr(source, "session", None))
         self.executables = ExecutableCache(telemetry=self.telemetry)
         with self._device_ctx():
             self.engine = ServeEngine(self.names,
                                       replicate_quirks=replicate_quirks,
                                       rolling_impl=rolling_impl,
                                       telemetry=self.telemetry,
-                                      executables=self.executables)
+                                      executables=self.executables,
+                                      session=self.session)
             self.cache = DeviceExposureCache(self.scfg.cache_bytes,
                                              telemetry=self.telemetry)
             #: ISSUE 7: the live intraday engine over the source's
@@ -262,7 +269,8 @@ class FactorServer:
                     source.n_tickers, names=self.names,
                     replicate_quirks=replicate_quirks,
                     rolling_impl=rolling_impl, telemetry=self.telemetry,
-                    executables=self.executables, mesh=stream_mesh)
+                    executables=self.executables, mesh=stream_mesh,
+                    session=self.session)
                 self.stream_engine.warmup(micro_batches=stream_batches)
             #: ISSUE 14: the factor-discovery engine, sharing THE
             #: executable cache (a server's discovery jobs and its
@@ -286,6 +294,14 @@ class FactorServer:
                     telemetry=self.telemetry,
                     executables=self.executables, mesh=research_mesh)
         self._builtin_names: Tuple[str, ...] = self.names
+        #: PR 14 residue (ISSUE 15 satellite): a research server's
+        #: discoveries survive the process — restart reloads every
+        #: persisted ``disc_<hash>.json`` under ``research_dir`` back
+        #: into the live registry and this server's factor set, so a
+        #: previously discovered name is queryable the moment the
+        #: server is up (round-trip gated in tests/test_serve.py)
+        if research and self.scfg.research_dir:
+            self._reload_discoveries()
         self._q: "queue.Queue" = queue.Queue(maxsize=self.scfg.queue_limit)
         self._state_lock = threading.Lock()
         self._consecutive = 0
@@ -582,6 +598,7 @@ class FactorServer:
         payload = {
             "ok": True, "factors": len(self.names),
             "days": self.source.n_days,
+            "session": self.session.name,
             "breaker_open": open_until is not None,
             "breaker_consecutive_failures": consecutive,
             "uptime_s": round(time.monotonic() - self._t_start, 3),
@@ -749,6 +766,42 @@ class FactorServer:
                                    "minute": self.stream_engine.minutes})
         tel.hbm.sample("serve.ingest")
         self._breaker_ok()
+
+    def _reload_discoveries(self) -> int:
+        """Reload persisted ``disc_*.json`` records from
+        ``research_dir`` into ``research/registry`` and this server's
+        factor universe (construction-time; no worker is running yet,
+        so growing ``self.names`` here is single-threaded). Corrupted
+        records are skipped loudly — one bad file must not take the
+        server down. Returns the number of reloaded records."""
+        import glob as _glob
+        import os as _os
+
+        from ..research import registry as research_registry
+        from ..utils.logging import get_logger
+        n = 0
+        for path in sorted(_glob.glob(_os.path.join(
+                self.scfg.research_dir, "disc_*.json"))):
+            try:
+                rec = research_registry.load_record(path)
+            except (OSError, ValueError, KeyError) as e:
+                get_logger(__name__).warning(
+                    "skipping unloadable discovery record %s: %s",
+                    path, e)
+                self.telemetry.counter("discover.reload_failures")
+                continue
+            research_registry.register_genome(
+                rec.genome, rec.skeleton, fitness=rec.fitness,
+                mean_ic=rec.mean_ic, mean_rank_ic=rec.mean_rank_ic,
+                spread=rec.spread, generations=rec.generations,
+                pop=rec.pop, data_fingerprint=rec.data_fingerprint,
+                telemetry=self.telemetry)
+            if rec.name not in self.names:
+                self.names = self.names + (rec.name,)
+                self.engine.names = self.names
+            self.telemetry.counter("discover.reloaded")
+            n += 1
+        return n
 
     def _apply_discover(self, p: _Pending) -> None:
         """Run one bounded-generations discovery job (ISSUE 14):

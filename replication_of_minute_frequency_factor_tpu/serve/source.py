@@ -24,9 +24,12 @@ class SyntheticSource:
     source, sized by the caller."""
 
     def __init__(self, n_days: int = 32, n_tickers: int = 256,
-                 seed: int = 0, missing_prob: float = 0.02):
+                 seed: int = 0, missing_prob: float = 0.02,
+                 session=None):
+        from ..markets import get_session
+        self.session = get_session(session)
         rng = np.random.default_rng(seed)
-        shape = (n_days, n_tickers, 240)
+        shape = (n_days, n_tickers, self.session.n_slots)
         close = 10.0 * np.exp(np.cumsum(
             rng.standard_normal(shape, dtype=np.float32)
             * np.float32(1e-3), axis=-1))
@@ -70,6 +73,10 @@ class MinuteDirSource:
     source, to the host). A production deployment would page day groups
     from disk; this source is the correctness-first resident form.
     """
+
+    #: day files carry cn_ashare wall-clock timestamps; the dir
+    #: source grids on the canonical session
+    session = None
 
     def __init__(self, minute_dir: str):
         from ..data import io as dio

@@ -14,38 +14,34 @@ Bars are labelled by window *start*: the morning session is 09:30..11:29
 dense 240-slot grid. Note 11:30 would collide with 13:00 at slot 120 under the
 reference's formula; canonical data carries no 11:30 bar, and our loader
 rejects off-grid timestamps rather than silently aliasing them.
+
+ISSUE 15: this module IS the ``cn_ashare_240`` instance of
+:mod:`.markets` — every constant below re-exports that frozen
+:class:`~.markets.SessionSpec`'s values byte-for-byte (pinned by
+tests/test_markets.py), so the seed's import surface keeps working
+while everything session-shaped (``ops/``, ``stream/``, the wire, the
+parity harness) parameterizes on a spec. New markets register in
+``markets/registry.py``; see docs/sessions.md.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-N_SLOTS = 240
-AM_SLOTS = 120  # 09:30..11:29
-PM_SLOTS = 120  # 13:00..14:59
+from .markets.registry import CN_ASHARE_240 as SPEC
 
-_AM_OPEN_MSM = 9 * 60 + 30   # 570
-_PM_OPEN_MSM = 13 * 60       # 780
+N_SLOTS = SPEC.n_slots
+AM_SLOTS = SPEC.segments[0][1]  # 09:30..11:29
+PM_SLOTS = SPEC.segments[1][1]  # 13:00..14:59
+
+_AM_OPEN_MSM = SPEC.segments[0][0]   # 570
+_PM_OPEN_MSM = SPEC.segments[1][0]   # 780
 _NOON_MSM = 720
-
-
-def _msm_to_time(msm: np.ndarray) -> np.ndarray:
-    """minutes-since-midnight -> HHMMSSmmm integer."""
-    return (msm // 60) * 10_000_000 + (msm % 60) * 100_000
-
-
-def _grid_times() -> np.ndarray:
-    slots = np.arange(N_SLOTS)
-    msm = np.where(slots < AM_SLOTS, _AM_OPEN_MSM + slots,
-                   _PM_OPEN_MSM + (slots - AM_SLOTS))
-    return _msm_to_time(msm).astype(np.int64)
-
 
 #: HHMMSSmmm timestamp of every slot (length 240). Kernels express the
 #: reference's time filters as boolean masks over this array, e.g.
 #: ``GRID_TIMES >= 145700000`` for the last-3-minute window.
-GRID_TIMES: np.ndarray = _grid_times()
-GRID_TIMES.setflags(write=False)
+GRID_TIMES: np.ndarray = SPEC.grid_times
 
 
 def time_to_slot(time_int: np.ndarray) -> np.ndarray:
@@ -54,35 +50,29 @@ def time_to_slot(time_int: np.ndarray) -> np.ndarray:
     Off-grid = outside [09:30, 11:30) ∪ [13:00, 15:00), or with a non-zero
     seconds/millis component (the grid is whole minutes).
     """
-    time_int = np.asarray(time_int, dtype=np.int64)
-    hm = time_int // 10_000_000 * 60 + (time_int % 10_000_000) // 100_000
-    sub_minute = time_int % 100_000 != 0  # seconds/millis present
-    am = (hm >= _AM_OPEN_MSM) & (hm < _AM_OPEN_MSM + AM_SLOTS)
-    pm = (hm >= _PM_OPEN_MSM) & (hm < _PM_OPEN_MSM + PM_SLOTS)
-    slot = np.where(am, hm - (_AM_OPEN_MSM),
-                    np.where(pm, hm - _PM_OPEN_MSM + AM_SLOTS, -1))
-    slot = np.where(sub_minute, -1, slot)
-    return slot.astype(np.int64)
+    return SPEC.time_to_slot(time_int)
 
 
 def slot_to_time(slot: np.ndarray) -> np.ndarray:
     """Slot index -> HHMMSSmmm (inverse of :func:`time_to_slot`)."""
-    return GRID_TIMES[np.asarray(slot)]
+    return SPEC.slot_to_time(slot)
 
 
 # Named sentinel times used by the reference kernels
 # (MinuteFrequentFactorCalculateMethodsCICC.py:18,33,69,84,770,1212,...).
-T_AM_OPEN = 93000000
-T_AM_CLOSE = 112900000
-T_NOON = 113000000
-T_PM_OPEN = 130000000
-T_PM_CLOSE = 145900000
-T_LAST30_OPEN = 143000000
-T_BETWEEN_OPEN = 100000000
-T_BETWEEN_CLOSE = 142900000
-T_CLOSE_AUCTION = 145700000  # last-3-minutes boundary
-T_TAIL20 = 144000000
-T_TAIL50 = 141000000
-T_HEAD_END = 100000000
-T_TOP20_END = 95000000
-T_TOP50_END = 102000000
+# Values come from the cn_ashare_240 spec (derived semantically from the
+# grid, with T_NOON pinned to the historical 11:30 constant).
+T_AM_OPEN = SPEC.T_AM_OPEN
+T_AM_CLOSE = SPEC.T_AM_CLOSE
+T_NOON = SPEC.T_NOON
+T_PM_OPEN = SPEC.T_PM_OPEN
+T_PM_CLOSE = SPEC.T_PM_CLOSE
+T_LAST30_OPEN = SPEC.T_LAST30_OPEN
+T_BETWEEN_OPEN = SPEC.T_BETWEEN_OPEN
+T_BETWEEN_CLOSE = SPEC.T_BETWEEN_CLOSE
+T_CLOSE_AUCTION = SPEC.T_CLOSE_AUCTION  # last-3-minutes boundary
+T_TAIL20 = SPEC.T_TAIL20
+T_TAIL50 = SPEC.T_TAIL50
+T_HEAD_END = SPEC.T_HEAD_END
+T_TOP20_END = SPEC.T_TOP20_END
+T_TOP50_END = SPEC.T_TOP50_END

@@ -43,7 +43,7 @@ import jax
 import jax.numpy as jnp
 
 from ..data.minute import FIELDS
-from ..sessions import N_SLOTS
+from ..markets import get_session
 from ..models.registry import (
     compute_factors,
     factor_names,
@@ -55,20 +55,22 @@ from ..ops import incremental as inc_ops
 CARRY_KEYS = ("bars", "mask", "t", "inc")
 
 
-def init_carry(n_tickers: int) -> Dict[str, object]:
+def init_carry(n_tickers: int, session=None) -> Dict[str, object]:
     """Empty-day carry as HOST numpy (the engine device_puts it whole —
-    one explicit transfer, transfer-guard clean)."""
+    one explicit transfer, transfer-guard clean). ``session`` sizes the
+    day buffer (ISSUE 15; None = the 240-slot cn_ashare day)."""
     import numpy as np
 
+    n_slots = get_session(session).n_slots
     return {
-        "bars": np.zeros((n_tickers, N_SLOTS, len(FIELDS)), np.float32),
-        "mask": np.zeros((n_tickers, N_SLOTS), bool),
+        "bars": np.zeros((n_tickers, n_slots, len(FIELDS)), np.float32),
+        "mask": np.zeros((n_tickers, n_slots), bool),
         "t": np.int32(0),
         "inc": inc_ops.init_inc(n_tickers),
     }
 
 
-def update_minute(carry, values, present):
+def update_minute(carry, values, present, session=None):
     """One fold step: write minute ``t``'s bars and advance the cursor.
 
     ``values [T, 5]`` are the bar fields for every ticker (garbage
@@ -83,10 +85,11 @@ def update_minute(carry, values, present):
     mask = jax.lax.dynamic_update_slice(
         carry["mask"], present[:, None], (0, t))
     return {"bars": bars, "mask": mask, "t": t + 1,
-            "inc": inc_ops.update_inc(carry["inc"], t, values, present)}
+            "inc": inc_ops.update_inc(carry["inc"], t, values, present,
+                                      session=session)}
 
 
-def update_tickers(carry, rows, idx):
+def update_tickers(carry, rows, idx, session=None):
     """Cohort fold step: bars for ``K`` tickers at the CURRENT minute.
 
     ``rows [K, 5]`` land at ``(idx[k], t)``; the cursor does not move
@@ -101,7 +104,8 @@ def update_tickers(carry, rows, idx):
     bars = carry["bars"].at[idx, t].set(rows, mode="drop")
     mask = carry["mask"].at[idx, t].set(True, mode="drop")
     return {"bars": bars, "mask": mask, "t": t,
-            "inc": inc_ops.update_inc_at(carry["inc"], t, rows, idx)}
+            "inc": inc_ops.update_inc_at(carry["inc"], t, rows, idx,
+                                         session=session)}
 
 
 def advance(carry, minutes: int = 1):
@@ -125,7 +129,8 @@ def readiness(carry_inc, names: Sequence[str]):
 
 def finalize(carry, names: Optional[Tuple[str, ...]] = None,
              replicate_quirks: bool = True,
-             rolling_impl: Optional[str] = None) -> Dict[str, object]:
+             rolling_impl: Optional[str] = None,
+             session=None) -> Dict[str, object]:
     """Exposures of the partial day: ``{name: [T]}``.
 
     Runs the batch kernel graph over the carried ``(bars, mask)``
@@ -140,15 +145,18 @@ def finalize(carry, names: Optional[Tuple[str, ...]] = None,
               "last_close": carry["inc"]["last_close"]}
     return compute_factors(carry["bars"], carry["mask"], names=names,
                            replicate_quirks=replicate_quirks,
-                           rolling_impl=rolling_impl, inject=inject)
+                           rolling_impl=rolling_impl, inject=inject,
+                           session=session)
 
 
 def finalize_with_readiness(carry, names: Tuple[str, ...],
                             replicate_quirks: bool = True,
-                            rolling_impl: Optional[str] = None):
+                            rolling_impl: Optional[str] = None,
+                            session=None):
     """The engine's snapshot graph: stacked exposures ``[F, T]`` plus
     the readiness plane ``[F, T]`` in one dispatch."""
-    out = finalize(carry, names, replicate_quirks, rolling_impl)
+    out = finalize(carry, names, replicate_quirks, rolling_impl,
+                   session=session)
     exposures = jnp.stack([out[n] for n in names])
     return exposures, readiness(carry["inc"], names)
 
@@ -194,7 +202,8 @@ def span_prefix_state(bars, mask, day_base=0):
     from ..data.minute import F_CLOSE
 
     n_bars = jnp.sum(mask, axis=-1, dtype=jnp.int32)         # [D, T]
-    slot = jnp.where(mask, jnp.arange(N_SLOTS, dtype=jnp.int32),
+    slot = jnp.where(mask,
+                     jnp.arange(mask.shape[-1], dtype=jnp.int32),
                      jnp.int32(-1))
     last_slot = jnp.max(slot, axis=-1)                       # [D, T]
     close = bars[..., F_CLOSE]

@@ -50,13 +50,15 @@ from ..telemetry.factorplane import factor_stats_block
 from . import carry as carry_mod
 
 
-def scan_update(carry, bars_seq, present_seq):
+def scan_update(carry, bars_seq, present_seq, session=None):
     """The driving minutes-scan (reserved graftlint symbol
     ``__stream_update__``): fold ``B`` minutes into the carry in one
-    executable. ``bars_seq [B, T, 5]``, ``present_seq [B, T]``."""
+    executable. ``bars_seq [B, T, 5]``, ``present_seq [B, T]``.
+    ``session`` is trace-time static (None = cn_ashare_240)."""
     def body(c, xs):
         values, present = xs
-        return carry_mod.update_minute(c, values, present), None
+        return carry_mod.update_minute(c, values, present,
+                                       session=session), None
 
     out, _ = jax.lax.scan(body, carry, (bars_seq, present_seq))
     return out
@@ -95,12 +97,19 @@ class StreamEngine:
                  rolling_impl: Optional[str] = None,
                  telemetry=None,
                  executables: Optional[ExecutableCache] = None,
-                 mesh=None):
+                 mesh=None, session=None):
         from ..config import get_config
+        from ..markets import get_session
         from ..models.registry import factor_names
         from ..telemetry import get_telemetry
 
         self.n_tickers = int(n_tickers)
+        #: the market session spec (ISSUE 15): sizes the day buffer
+        #: ([T, S, 5]), bounds the minute cursor, and sets the window
+        #: boundaries of the incremental accumulators. The readiness
+        #: contract (window counter, min) is unchanged — counter NAMES
+        #: are session-relative. None = the 240-slot cn_ashare day.
+        self.session = get_session(session)
         #: ISSUE 13: a tickers mesh (e.g. ``parallel.resident_mesh``
         #: over a replica's submesh) places the carry — day buffer,
         #: mask and every per-lane accumulator — with a
@@ -150,12 +159,17 @@ class StreamEngine:
                           else get_telemetry())
         self.executables = (executables if executables is not None
                             else ExecutableCache(telemetry=telemetry))
-        self._scan_jit = jax.jit(scan_update)
-        self._cohort_jit = jax.jit(carry_mod.update_tickers)
+        sess = self.session
+        self._scan_jit = jax.jit(
+            lambda c, b, p: scan_update(c, b, p, session=sess))
+        self._cohort_jit = jax.jit(
+            lambda c, r, i: carry_mod.update_tickers(c, r, i,
+                                                     session=sess))
         self._advance_jit = jax.jit(carry_mod.advance)
         self._snapshot_jit = jax.jit(
             lambda c: carry_mod.finalize_with_readiness(
-                c, self.names, self.replicate_quirks, self.rolling_impl))
+                c, self.names, self.replicate_quirks, self.rolling_impl,
+                session=sess))
         #: snapshot through the result wire (ISSUE 10): finalize +
         #: on-device blocked-quantized encode of the [F, T] exposures
         #: (as an [F, 1, T] block — one day) fused in ONE executable;
@@ -165,7 +179,8 @@ class StreamEngine:
 
         def _snap_wire(c):
             exposures, ready = carry_mod.finalize_with_readiness(
-                c, self.names, self.replicate_quirks, self.rolling_impl)
+                c, self.names, self.replicate_quirks, self.rolling_impl,
+                session=sess)
             payload = result_wire.encode_block(
                 exposures[:, None, :], self.result_spec)
             return payload, ready
@@ -180,14 +195,16 @@ class StreamEngine:
         #: snapshot's (the stats read, never rewrite).
         def _snap_stats(c):
             exposures, ready = carry_mod.finalize_with_readiness(
-                c, self.names, self.replicate_quirks, self.rolling_impl)
+                c, self.names, self.replicate_quirks, self.rolling_impl,
+                session=sess)
             return exposures, ready, factor_stats_block(exposures)
 
         self._snapshot_stats_jit = jax.jit(_snap_stats)
 
         def _snap_wire_stats(c):
             exposures, ready = carry_mod.finalize_with_readiness(
-                c, self.names, self.replicate_quirks, self.rolling_impl)
+                c, self.names, self.replicate_quirks, self.rolling_impl,
+                session=sess)
             stats = factor_stats_block(exposures)
             payload = result_wire.encode_block(
                 exposures[:, None, :], self.result_spec)
@@ -203,7 +220,7 @@ class StreamEngine:
     # --- lifecycle ------------------------------------------------------
     def _graph_key(self):
         return (self.n_tickers, self.names, self.replicate_quirks,
-                self.rolling_impl)
+                self.rolling_impl, self.session.name)
 
     def cursor(self) -> dict:
         """The fan-out contract's progress stamp (ISSUE 11): where this
@@ -211,7 +228,8 @@ class StreamEngine:
         mirrors only (never a device read). Replicas fed the same
         broadcast ingest stream report equal cursors; the fleet health
         rollup surfaces any skew."""
-        return {"minute": self.minutes, "tickers": self.n_tickers}
+        return {"minute": self.minutes, "tickers": self.n_tickers,
+                "session": self.session.name}
 
     def _put_carry(self, host_tree):
         """One explicit host->device put of a whole carry pytree —
@@ -233,7 +251,8 @@ class StreamEngine:
 
     def reset(self) -> "StreamEngine":
         """Fresh empty-day carry (one explicit host->device put)."""
-        self.carry = self._put_carry(carry_mod.init_carry(self.n_tickers))
+        self.carry = self._put_carry(
+            carry_mod.init_carry(self.n_tickers, session=self.session))
         self.minutes = 0
         self._note_carry()
         return self
@@ -255,6 +274,11 @@ class StreamEngine:
             raise ValueError(
                 f"snapshot holds {host['mask'].shape[0]} tickers; engine "
                 f"is sized for {self.n_tickers}")
+        if host["mask"].shape[1] != self.session.n_slots:
+            raise ValueError(
+                f"snapshot holds a {host['mask'].shape[1]}-slot day "
+                f"buffer; engine runs session "
+                f"{self.session.name!r} ({self.session.n_slots} slots)")
         # re-placement is part of the contract (ISSUE 13): a snapshot
         # saved under ANY ticker sharding restores onto THIS engine's
         # placement — the carry is pure state, and the sharded finalize
@@ -314,10 +338,11 @@ class StreamEngine:
         if t != self.n_tickers:
             raise ValueError(f"got {t} tickers, engine holds "
                              f"{self.n_tickers}")
-        if self.minutes + b > carry_mod.N_SLOTS:
+        if self.minutes + b > self.session.n_slots:
             raise ValueError(
                 f"ingesting {b} minutes past slot {self.minutes} "
-                f"overruns the {carry_mod.N_SLOTS}-slot day")
+                f"overruns the {self.session.n_slots}-slot "
+                f"{self.session.name} day")
         n_bars = int(present.sum())
         bars_d = self._put_in(bars, "minutes")
         present_d = self._put_in(present, "minutes")
@@ -369,9 +394,10 @@ class StreamEngine:
 
     def advance(self) -> None:
         """Close the current minute (cohort path's minute boundary)."""
-        if self.minutes + 1 > carry_mod.N_SLOTS:
-            raise ValueError(f"advancing past the {carry_mod.N_SLOTS}-slot"
-                             " day")
+        if self.minutes + 1 > self.session.n_slots:
+            raise ValueError(
+                f"advancing past the {self.session.n_slots}-slot "
+                f"{self.session.name} day")
         exe = self._exe("stream_advance", (), self._advance_jit,
                         self.carry)
         self.carry = exe(self.carry)

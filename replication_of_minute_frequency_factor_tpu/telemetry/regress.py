@@ -74,6 +74,16 @@ smeared):
   ``discover``; a new workload, so its records start their own
   baseline).
 
+Session sub-series (ISSUE 15): every bench record stamps the market
+``session`` it ran (``bench.py``'s BENCH_SESSION; records predating
+the field are all 240-day cn_ashare runs and stay on their bare
+series). A non-default session (``us_390``, ``crypto_1440``, ...)
+suffixes the effective methodology with ``+session=<name>``, so its
+records form their own per-(metric, methodology) groups — the
+methodology break is DECLARED by the stamp itself, and a non-240
+number can never smear into a banked 240 baseline in either
+direction.
+
 Byte sub-series (ISSUE 10): every bench record that carries the
 ``wire.bytes_per_day`` / ``result.bytes_per_day`` gauges contributes
 ``<metric>.wire_bytes_per_day`` and ``<metric>.result_bytes_per_day``
@@ -281,9 +291,29 @@ def find_metrics_jsonl(path: str, max_depth: int = 3) -> List[str]:
 # --------------------------------------------------------------------------
 
 
+#: the canonical market session (ISSUE 15). Records without a
+#: ``session`` stamp — the whole banked trajectory predating the field
+#: — are all 240-day cn_ashare runs, so they stay on their bare
+#: methodology series; this is the same one pinned inference as
+#: LEGACY_METHODOLOGY above.
+DEFAULT_SESSION = "cn_ashare_240"
+
+
 def effective_methodology(record: dict) -> str:
     m = record.get("methodology")
-    return str(m) if m else LEGACY_METHODOLOGY
+    meth = str(m) if m else LEGACY_METHODOLOGY
+    # session sub-series keying (ISSUE 15): a non-default session is a
+    # DIFFERENT workload shape — 390 or 1440 slots change the module,
+    # the bytes and the loop — so its records suffix the methodology
+    # and start their own baseline. A us_390 record can never pollute
+    # (or be gated against) the banked 240 series, in either
+    # direction; derived sub-series inherit the suffixed methodology
+    # like every other declared break.
+    session = record.get("session")
+    if session and str(session) != DEFAULT_SESSION \
+            and "+session=" not in meth:
+        meth = f"{meth}+session={session}"
+    return meth
 
 
 def derive_records(record: dict) -> List[dict]:

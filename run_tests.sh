@@ -136,6 +136,15 @@ print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     run env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python -c "import json, sys, bench; r = bench.discover_smoke(); \
 print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
+    # session smoke (ISSUE 15): one NON-DEFAULT market session (us_390)
+    # end to end — wire encode at the 390-slot layout, the resident
+    # scan executable vs the direct fused graph on the same decoded
+    # inputs (bitwise outside the documented ulp-pinned fusion-wobble
+    # kernels), the 58-kernel S-increment stream parity gate BITWISE,
+    # and a sound end-of-day readiness plane; one JSON verdict line,
+    # nonzero on drift
+    run python -c "import json, sys, bench; r = bench.session_smoke(); \
+print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     # graftlint (ISSUE 4): AST rules over the whole package + jaxpr
     # contracts over all 58 registered kernels AND the resident scan
     # wrappers (abstract trace on CPU), gated on the committed baseline

@@ -1,0 +1,490 @@
+"""ISSUE 15: the ``markets/`` session-spec subsystem.
+
+Three gate families:
+
+* **spec pinning** — the ``cn_ashare_240`` instance reproduces every
+  seed constant of ``sessions.py`` byte-for-byte (the canonical-shape
+  bitwise acceptance rests on this), and the registry's conflict/
+  idempotence rules hold;
+* **session-generic device paths** — the ingest wire round-trips at
+  390/150/1440 slots, and the 58 kernels produce an answer at every
+  registered shape through the same fused graphs;
+* **S-increment stream parity** — the 240-increment bitwise gate of
+  tests/test_stream.py generalized: streaming every minute of a
+  us_390 and a crypto_1440 day through the session-sized carry
+  finalizes BITWISE equal to the batch graph, mid-day save/restore is
+  bit-identical to never stopping, and the readiness (window, min)
+  contract stays monotone and sound at a non-240 session length.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from replication_of_minute_frequency_factor_tpu import sessions
+from replication_of_minute_frequency_factor_tpu.data import wire
+from replication_of_minute_frequency_factor_tpu.markets import (
+    CN_ASHARE_240,
+    SESSIONS,
+    SessionSpec,
+    get_session,
+    is_default,
+    register_session,
+    session_names,
+)
+from replication_of_minute_frequency_factor_tpu.models.registry import (
+    compute_factors_jit,
+    factor_names,
+)
+from replication_of_minute_frequency_factor_tpu.stream.engine import (
+    StreamEngine,
+)
+
+SENTINELS = (
+    "T_AM_OPEN", "T_AM_CLOSE", "T_NOON", "T_PM_OPEN", "T_PM_CLOSE",
+    "T_LAST30_OPEN", "T_BETWEEN_OPEN", "T_BETWEEN_CLOSE",
+    "T_CLOSE_AUCTION", "T_TAIL20", "T_TAIL50", "T_HEAD_END",
+    "T_TOP20_END", "T_TOP50_END",
+)
+
+
+def _session_batch(rng, spec, n_days=2, n_tickers=8, missing=0.05):
+    """Tick-aligned synthetic bars on one session's grid."""
+    shape = (n_days, n_tickers, spec.n_slots)
+    close = np.round(10.0 * np.exp(np.cumsum(
+        rng.standard_normal(shape, dtype=np.float32) * np.float32(1e-3),
+        axis=-1)), 2)
+    open_ = np.round(close * (1 + rng.standard_normal(
+        shape, dtype=np.float32) * np.float32(1e-4)), 2)
+    high = np.maximum(open_, close)
+    low = np.minimum(open_, close)
+    volume = (rng.integers(0, 1000, shape) * 100).astype(np.float32)
+    bars = np.stack([open_, high, low, close, volume], axis=-1)
+    mask = rng.random(shape, dtype=np.float32) >= missing
+    bars = np.where(mask[..., None], bars, 0.0).astype(np.float32)
+    return bars, mask
+
+
+def _bitwise(a, b):
+    a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+    return (a.view(np.uint32) == b.view(np.uint32)).all()
+
+
+# --------------------------------------------------------------------------
+# spec pinning: cn_ashare_240 IS the seed's sessions.py
+# --------------------------------------------------------------------------
+
+
+def test_cn_spec_matches_seed_constants_bitwise():
+    assert CN_ASHARE_240.n_slots == sessions.N_SLOTS == 240
+    assert (CN_ASHARE_240.grid_times == sessions.GRID_TIMES).all()
+    assert sessions.GRID_TIMES is CN_ASHARE_240.grid_times
+    for name in SENTINELS:
+        assert getattr(CN_ASHARE_240, name) == getattr(sessions, name), \
+            name
+
+
+def test_cn_time_to_slot_matches_seed_formula():
+    # every whole minute of the day, plus sub-minute off-grid stamps
+    msm = np.arange(24 * 60)
+    times = (msm // 60) * 10_000_000 + (msm % 60) * 100_000
+    got = CN_ASHARE_240.time_to_slot(times)
+    # the seed formula, inlined (pre-ISSUE-15 sessions.py)
+    hm = times // 10_000_000 * 60 + (times % 10_000_000) // 100_000
+    am = (hm >= 570) & (hm < 690)
+    pm = (hm >= 780) & (hm < 900)
+    want = np.where(am, hm - 570, np.where(pm, hm - 780 + 120, -1))
+    assert (got == want).all()
+    # sub-minute components are off-grid
+    assert (CN_ASHARE_240.time_to_slot(times + 30_000) == -1).all()
+    # slot_to_time inverts on-grid slots
+    assert (CN_ASHARE_240.slot_to_time(np.arange(240))
+            == sessions.GRID_TIMES).all()
+
+
+def test_registry_ships_the_four_specs():
+    assert {"cn_ashare_240", "us_390", "hk_halfday",
+            "crypto_1440"} <= set(session_names())
+    assert get_session(None) is CN_ASHARE_240
+    assert get_session("us_390").n_slots == 390
+    assert get_session("hk_halfday").n_slots == 150
+    assert get_session("crypto_1440").n_slots == 1440
+    assert is_default(None) and is_default("cn_ashare_240")
+    assert not is_default("us_390")
+    with pytest.raises(KeyError):
+        get_session("nasdaq_totally_real")
+
+
+def test_register_session_conflict_and_idempotence():
+    spec = SessionSpec(name="test_tiny_60", segments=((600, 60),))
+    try:
+        assert register_session(spec) is spec
+        # same spec again: idempotent
+        register_session(SessionSpec(name="test_tiny_60",
+                                     segments=((600, 60),)))
+        # DIFFERENT layout under the same name: refused
+        with pytest.raises(ValueError, match="already registered"):
+            register_session(SessionSpec(name="test_tiny_60",
+                                         segments=((600, 61),)))
+    finally:
+        SESSIONS.pop("test_tiny_60", None)
+
+
+def test_spec_is_hashable_static_jit_key():
+    # two equal constructions hash equal (shared compiled executables);
+    # the derived-sentinel rules reproduce cn's constants semantically
+    a = SessionSpec(name="x", segments=((570, 120), (780, 120)))
+    b = SessionSpec(name="x", segments=((570, 120), (780, 120)))
+    assert a == b and hash(a) == hash(b)
+    us = get_session("us_390")
+    assert us.T_CLOSE_AUCTION == us.grid_times[390 - 3]
+    assert us.T_LAST30_OPEN == us.grid_times[390 - 30]
+    assert us.T_AM_OPEN == us.grid_times[0]
+    assert us.T_PM_CLOSE == us.grid_times[-1]
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError, match="no segments"):
+        SessionSpec(name="empty", segments=())
+    with pytest.raises(ValueError, match="leaves the day"):
+        SessionSpec(name="overflow", segments=((23 * 60, 120),))
+
+
+# --------------------------------------------------------------------------
+# wire: session-generic encode/decode
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("sname", ["us_390", "hk_halfday",
+                                   "crypto_1440"])
+def test_wire_roundtrip_non_default_sessions(rng, sname):
+    """Encode at a non-240 slot count (the sub-byte packings gate on
+    divisibility: us_390 misses the vol10 divisor and widens to u16,
+    crypto_1440 packs fully) and decode back exactly: mask bitwise,
+    prices within the documented 1-ulp tick wobble, volumes exact."""
+    spec = get_session(sname)
+    bars, mask = _session_batch(rng, spec, n_days=2, n_tickers=6)
+    w = wire.encode(bars, mask)
+    assert w is not None, f"{sname} batch must be representable"
+    # decode re-derives the slot count from dohl's slot axis
+    assert w.dohl.shape[-2] == spec.n_slots
+    dec_bars, dec_m = jax.device_get(
+        wire.decode(*[jax.device_put(a) for a in w.arrays]))
+    assert (np.asarray(dec_m) == mask).all()
+    dec_bars = np.asarray(dec_bars)
+    assert np.allclose(dec_bars[..., :4],
+                       np.where(mask[..., None], bars, 0.0)[..., :4],
+                       rtol=3e-7, atol=0)
+    assert (dec_bars[..., 4] == bars[..., 4] * mask).all()
+
+
+def test_wire_240_mask_path_unchanged(rng):
+    """The canonical layout must keep its exact pre-ISSUE-15 decode
+    graph: no pad-bit slice is traced when S % 8 == 0 (the jaxpr has
+    no ``slice`` over the mask bits beyond the packed-buffer ones)."""
+    bars, mask = _session_batch(rng, CN_ASHARE_240, n_days=1,
+                                n_tickers=4)
+    w = wire.encode(bars, mask)
+    assert w is not None
+    dec_bars, dec_m = jax.device_get(
+        wire.decode(*[jax.device_put(a) for a in w.arrays]))
+    assert (np.asarray(dec_m) == mask).all()
+
+
+# --------------------------------------------------------------------------
+# the 58 kernels at every registered shape
+# --------------------------------------------------------------------------
+
+
+def test_all_kernels_run_at_every_registered_session(rng):
+    names = factor_names()
+    for sname in session_names():
+        spec = get_session(sname)
+        bars, mask = _session_batch(rng, spec, n_days=1, n_tickers=4)
+        out = compute_factors_jit(jax.device_put(bars),
+                                  jax.device_put(mask), names=names,
+                                  session=spec)
+        for n in names:
+            assert np.asarray(out[n]).shape == (1, 4), (sname, n)
+
+
+def test_session_shape_mismatch_fails_loudly(rng):
+    """A 240-shaped tensor under a 390-slot session must error at
+    trace time (grid-times broadcast), never silently alias."""
+    bars, mask = _session_batch(rng, CN_ASHARE_240, n_days=1,
+                                n_tickers=4)
+    with pytest.raises(Exception):
+        compute_factors_jit(jax.device_put(bars), jax.device_put(mask),
+                            names=("mmt_pm",), session="us_390")
+
+
+# --------------------------------------------------------------------------
+# S-increment stream parity (the acceptance gate, tier-1)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("sname", ["us_390", "crypto_1440"])
+def test_stream_increment_parity_bitwise(rng, sname):
+    """The 240-increment parity gate generalized to S increments: all
+    58 kernels streamed minute-by-minute through the session-sized
+    carry finalize BITWISE equal to the full-day batch graph."""
+    spec = get_session(sname)
+    names = factor_names()
+    bars, mask = _session_batch(rng, spec, n_days=1, n_tickers=6)
+    day_bars, day_mask = bars[0], mask[0]
+
+    batch = compute_factors_jit(jax.device_put(day_bars),
+                                jax.device_put(day_mask), names=names,
+                                session=spec)
+    batch_stack = np.stack([np.asarray(batch[n]) for n in names])
+
+    eng = StreamEngine(6, names=names, session=spec)
+    eng.warmup(micro_batches=(spec.n_slots,))
+    eng.ingest_minutes(
+        np.ascontiguousarray(np.swapaxes(day_bars, 0, 1)),
+        np.ascontiguousarray(day_mask.T))
+    assert eng.minutes == spec.n_slots
+    exposures, ready = (np.asarray(x) for x in eng.snapshot())
+    bad = [n for j, n in enumerate(names)
+           if not _bitwise(exposures[j], batch_stack[j])]
+    assert not bad, f"{sname}: non-bitwise streamed kernels: {bad}"
+
+
+def test_stream_save_restore_midday_crypto(rng):
+    """Satellite: mid-day save/restore at the 1440-slot session is
+    bit-identical to never stopping — the carry IS the complete
+    streaming state at any slot count."""
+    spec = get_session("crypto_1440")
+    names = ("mmt_pm", "vol_return1min", "doc_pdf60", "mmt_ols_qrs")
+    bars, mask = _session_batch(rng, spec, n_days=1, n_tickers=4)
+    day_bars = np.ascontiguousarray(np.swapaxes(bars[0], 0, 1))
+    day_mask = np.ascontiguousarray(mask[0].T)
+    half = spec.n_slots // 2
+
+    straight = StreamEngine(4, names=names, session=spec)
+    straight.ingest_minutes(day_bars, day_mask)
+
+    first = StreamEngine(4, names=names, session=spec)
+    first.ingest_minutes(day_bars[:half], day_mask[:half])
+    snap = first.save()
+
+    resumed = StreamEngine(4, names=names, session=spec)
+    resumed.restore(snap)
+    assert resumed.minutes == half
+    resumed.ingest_minutes(day_bars[half:], day_mask[half:])
+
+    a, ra = (np.asarray(x) for x in straight.snapshot())
+    b, rb = (np.asarray(x) for x in resumed.snapshot())
+    assert _bitwise(a, b)
+    assert (ra == rb).all()
+
+
+def test_stream_restore_rejects_wrong_session(rng):
+    """A 240-day snapshot must not restore into a 1440-slot engine."""
+    cn = StreamEngine(4, names=("mmt_pm",))
+    snap = cn.save()
+    crypto = StreamEngine(4, names=("mmt_pm",), session="crypto_1440")
+    with pytest.raises(ValueError, match="slot"):
+        crypto.restore(snap)
+
+
+def test_readiness_monotone_and_sound_crypto(rng):
+    """Satellite: the (window counter, min) readiness contract at a
+    non-240 session length — monotone over the fold, and SOUND: a
+    not-ready lane's exposure is NaN at every probed prefix."""
+    spec = get_session("crypto_1440")
+    names = factor_names()
+    bars, mask = _session_batch(rng, spec, n_days=1, n_tickers=4,
+                                missing=0.3)
+    day_bars = np.ascontiguousarray(np.swapaxes(bars[0], 0, 1))
+    day_mask = np.ascontiguousarray(mask[0].T)
+
+    eng = StreamEngine(4, names=names, session=spec)
+    probes = (60, 360, 720, 1200, spec.n_slots)
+    prev_ready = np.zeros((len(names), 4), bool)
+    s = 0
+    for stop in probes:
+        eng.ingest_minutes(day_bars[s:stop], day_mask[s:stop])
+        s = stop
+        exposures, ready = (np.asarray(x) for x in eng.snapshot())
+        # monotone: a ready lane never un-readies
+        assert (ready | ~prev_ready).all()
+        # sound: not-ready => NaN (ready lanes may still be NaN)
+        assert np.isnan(exposures[~ready]).all(), stop
+        prev_ready = ready
+    assert prev_ready.any()
+
+
+def test_day_boundary_rolling_us390(rng):
+    """Satellite: rolling-moment day isolation across a us_390 session
+    break — the 50-minute windows of the mmt_ols_* family never reach
+    across the day boundary, so day 1's exposures in a 2-day batch are
+    BITWISE the same day computed alone, at the non-240 shape."""
+    spec = get_session("us_390")
+    names = ("mmt_ols_qrs", "mmt_ols_corr_mean", "mmt_ols_beta_mean",
+             "mmt_ols_beta_zscore_last", "mmt_ols_corr_square_mean")
+    bars, mask = _session_batch(rng, spec, n_days=2, n_tickers=5)
+    both = compute_factors_jit(jax.device_put(bars),
+                               jax.device_put(mask), names=names,
+                               session=spec)
+    solo = compute_factors_jit(jax.device_put(bars[1:]),
+                               jax.device_put(mask[1:]), names=names,
+                               session=spec)
+    for n in names:
+        assert _bitwise(np.asarray(both[n])[1], np.asarray(solo[n])[0]), n
+
+
+# --------------------------------------------------------------------------
+# regress: session sub-series keying (satellite, both directions)
+# --------------------------------------------------------------------------
+
+
+def test_regress_session_series_isolation():
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        regress)
+
+    base = {"metric": "m_x", "value": 100.0, "methodology": "r6_resident_v2"}
+    # direction 1: a non-default session suffixes the methodology —
+    # its records form their OWN group and never join the 240 series
+    us = dict(base, session="us_390", value=400.0)
+    assert regress.effective_methodology(us) == \
+        "r6_resident_v2+session=us_390"
+    entries = [
+        {"n": i, "source": f"BENCH_r0{i}.json",
+         "record": dict(base, value=100.0 + i)} for i in range(3)]
+    verdict = regress.evaluate(entries, candidate=us)
+    # a 4x value under a fresh session series is a DECLARED break:
+    # reported with no baseline, never flagged
+    assert verdict["ok"], verdict
+    row = next(r for r in verdict["groups"]
+               if r["methodology"].endswith("+session=us_390"))
+    assert row["n_baseline"] == 0
+    # direction 2: the same 4x value WITHOUT the session stamp (or
+    # stamped canonical) gates against the banked 240 baseline and
+    # flags
+    for cand in (dict(base, value=400.0),
+                 dict(base, value=400.0, session="cn_ashare_240")):
+        v2 = regress.evaluate(entries, candidate=cand)
+        assert not v2["ok"], cand
+        assert any(f["methodology"] == "r6_resident_v2"
+                   for f in v2["flagged"])
+
+
+def test_regress_derived_series_inherit_session_suffix():
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        regress)
+
+    rec = {"metric": "m_y", "value": 10.0, "methodology": "r8_serve_v1",
+           "session": "crypto_1440", "p99_ms": 5.0}
+    derived = regress.derive_records(rec)
+    assert derived, "p99 sub-series expected"
+    for d in derived:
+        assert d["methodology"] == "r8_serve_v1+session=crypto_1440"
+        # and re-keying the derived record does not double-suffix
+        assert regress.effective_methodology(d) == d["methodology"]
+
+
+# --------------------------------------------------------------------------
+# analysis: per-session Tier B fingerprints
+# --------------------------------------------------------------------------
+
+
+def test_session_tier_clean_and_fingerprinted():
+    from replication_of_minute_frequency_factor_tpu.analysis.jaxpr_tier \
+        import SESSION_TRACE_WRAPPERS, run_session_tier
+
+    violations, fps = run_session_tier()
+    assert not violations, [str(v) for v in violations]
+    assert set(fps) == set(session_names())
+    for sname, rows in fps.items():
+        assert set(rows) == set(SESSION_TRACE_WRAPPERS), sname
+        for fp in rows.values():
+            assert fp["traced"] and fp["n_eqns"] > 0
+
+
+def test_committed_report_carries_session_fingerprints():
+    import json
+    import os
+
+    from replication_of_minute_frequency_factor_tpu.analysis.report \
+        import repo_root
+
+    path = os.path.join(repo_root(), "analysis_report.json")
+    with open(path) as fh:
+        rep = json.load(fh)
+    sessions_blk = rep.get("jaxpr", {}).get("sessions")
+    assert sessions_blk, "per-session fingerprints must be committed"
+    assert {"cn_ashare_240", "us_390", "hk_halfday",
+            "crypto_1440"} <= set(sessions_blk)
+
+
+# --------------------------------------------------------------------------
+# serve: discovery persistence reload (satellite, PR 14 residue)
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.transfers
+def test_research_reload_round_trip(tmp_path):
+    """FactorServer(research=True) restart reloads persisted
+    ``disc_<hash>`` records from research_dir: the discovered name is
+    back in the live registry, in the server's factor set (split as
+    discovered, not builtin), and immediately queryable."""
+    from replication_of_minute_frequency_factor_tpu import search
+    from replication_of_minute_frequency_factor_tpu.research import (
+        registry as rreg)
+    from replication_of_minute_frequency_factor_tpu.serve.service import (
+        FactorServer, ServeConfig)
+    from replication_of_minute_frequency_factor_tpu.serve.source import (
+        SyntheticSource)
+
+    rdir = str(tmp_path / "research")
+    genome = search.random_population(np.random.default_rng(3), 1,
+                                      search.DEFAULT_SKELETON)[0]
+    rec = rreg.register_genome(genome, fitness=0.25, mean_ic=0.1,
+                               save_dir=rdir)
+    # simulate process death: in-memory registry gone, JSON survives
+    rreg.DISCOVERED.pop(rec.name, None)
+
+    cfg = ServeConfig(research_dir=rdir, hbm_sample_period_s=0)
+    server = FactorServer(SyntheticSource(n_days=4, n_tickers=8),
+                          names=("vol_return1min",), serve_cfg=cfg,
+                          start=True, research=True)
+    try:
+        assert rec.name in rreg.discovered_names()
+        listing = server.factor_list()
+        assert rec.name in listing["discovered"]
+        assert rec.name not in listing["builtin"]
+        # the reloaded factor answers through the normal query leg
+        from replication_of_minute_frequency_factor_tpu.serve.service \
+            import ServeClient
+        ans = ServeClient(server).factors(0, 2, names=(rec.name,))
+        assert np.asarray(
+            ans["exposures"][rec.name]).shape[-1] == 8
+    finally:
+        server.close()
+
+
+def test_research_reload_skips_corrupt_records(tmp_path):
+    """One corrupted record must be skipped loudly, not take the
+    server down (and must not register)."""
+    from replication_of_minute_frequency_factor_tpu.serve.service import (
+        FactorServer, ServeConfig)
+    from replication_of_minute_frequency_factor_tpu.serve.source import (
+        SyntheticSource)
+
+    rdir = tmp_path / "research"
+    rdir.mkdir()
+    (rdir / "disc_deadbeef00.json").write_text("{not json")
+    cfg = ServeConfig(research_dir=str(rdir), hbm_sample_period_s=0)
+    server = FactorServer(SyntheticSource(n_days=2, n_tickers=8),
+                          names=("vol_return1min",), serve_cfg=cfg,
+                          start=False, research=True)
+    try:
+        assert server.names == ("vol_return1min",)
+        assert server.telemetry.registry.counter_total(
+            "discover.reload_failures") >= 1
+    finally:
+        server.close()

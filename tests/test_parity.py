@@ -122,7 +122,7 @@ DEGENERATE_BETA_STD = 64 * np.finfo(np.float32).eps
 BETA_EPS_REL = 6e-6
 
 
-def _degenerate_beta_codes(df):
+def _degenerate_beta_codes(df, session=None):
     """Per-code beta z conditioning: returns ``(skip_set, num_scale)``
     where ``skip_set`` holds codes whose oracle beta z numerator is
     sub-noise (see above) and ``num_scale[code]`` is num/scale for the
@@ -139,7 +139,8 @@ def _degenerate_beta_codes(df):
     for code, sub in df.sort_values("time").groupby("code"):
         g = Group(sub["time"].to_numpy(), sub["open"].to_numpy(),
                   sub["high"].to_numpy(), sub["low"].to_numpy(),
-                  sub["close"].to_numpy(), sub["volume"].to_numpy())
+                  sub["close"].to_numpy(), sub["volume"].to_numpy(),
+                  session=session)
         st = _rolling50(g)
         if len(st["var_x"]) < 2:
             continue
@@ -181,7 +182,7 @@ def _eod_ret_device(bars, mask):
 _eod_ret_device_jit = jax.jit(_eod_ret_device)
 
 
-def _device_eod_rows(code, time, cols):
+def _device_eod_rows(code, time, cols, session=None):
     """Acceptance channel 3: the active backend's OWN f32 eod returns,
     one per (sorted) row. Channels 1-2 assume device f32 division is
     correctly rounded (true on XLA-CPU, where f64-divide-then-cast equals
@@ -194,13 +195,14 @@ def _device_eod_rows(code, time, cols):
     implements; share/cumsum rounding stays covered by PDF_EDGE_EPS.
     Returns None when a row can't be mapped onto the minute grid (never
     happens for synth days; bail rather than guess)."""
-    from replication_of_minute_frequency_factor_tpu import sessions
+    from replication_of_minute_frequency_factor_tpu.markets import (
+        get_session)
     g = grid_day(code, time, cols["open"], cols["high"], cols["low"],
-                 cols["close"], cols["volume"])
+                 cols["close"], cols["volume"], session=session)
     eod = np.asarray(_eod_ret_device_jit(g.bars, g.mask), np.float64)
     gcodes = np.asarray(g.codes)
     ti = np.searchsorted(gcodes, code)
-    si = sessions.time_to_slot(np.asarray(time))
+    si = get_session(session).time_to_slot(np.asarray(time))
     # NOTE: with codes=None above, gcodes is np.unique of this very
     # `code` array, so every row's code is always found and the guard
     # can't fire today — it only matters if a pinned ``codes=`` axis is
@@ -219,7 +221,7 @@ def _device_eod_rows(code, time, cols):
     return eod[ti, si]
 
 
-def _doc_pdf_acceptable(df: pd.DataFrame):
+def _doc_pdf_acceptable(df: pd.DataFrame, session=None):
     """Acceptance sets for doc_pdf* on a single-date frame.
 
     Three measure-zero channels make the rank legitimately backend-
@@ -274,7 +276,7 @@ def _doc_pdf_acceptable(df: pd.DataFrame):
         if quantize:
             eod = eod.astype(np.float32).astype(np.float64)
         channels.append(eod)
-    dev = _device_eod_rows(code, time, cols)
+    dev = _device_eod_rows(code, time, cols, session=session)
     if dev is not None:
         # The channel is only legitimate while the device's returns sit
         # within float rounding of the correctly-rounded f32 realization
@@ -398,23 +400,31 @@ def _lazy(build):
     return get
 
 
-def _compare(day, label, noisy=False, rolling_impl=None):
+def _compare(day, label, noisy=False, rolling_impl=None, session=None):
     """``rolling_impl`` pins the mmt_ols_* backend for the jax side
     (None = the config default, 'conv'): the same comparator protocol
     gates every backend, so the Pallas interpret path faces the full
-    f64-oracle sweep rather than a private softer one."""
+    f64-oracle sweep rather than a private softer one. ``session``
+    (ISSUE 15) runs the SAME comparator at another registered market's
+    day shape — the f64 oracle, the grid, the device graph and every
+    acceptance channel all parameterize on it, so a new session faces
+    the full harness, not a softer one."""
     df = pd.DataFrame(day)
-    oracle = compute_oracle(df).set_index("code")
-    beta_degenerate, beta_num_scale = _degenerate_beta_codes(df)
+    oracle = compute_oracle(df, session=session).set_index("code")
+    beta_degenerate, beta_num_scale = _degenerate_beta_codes(
+        df, session=session)
     g = grid_day(day["code"], day["time"], day["open"], day["high"],
-                 day["low"], day["close"], day["volume"])
+                 day["low"], day["close"], day["volume"],
+                 session=session)
     jax_out = {k: np.asarray(v)
                for k, v in compute_factors_jit(
-                   g.bars, g.mask, rolling_impl=rolling_impl).items()}
+                   g.bars, g.mask, rolling_impl=rolling_impl,
+                   session=session).items()}
     assert set(jax_out) == set(factor_names())
 
     failures = []
-    pdf_acceptance = _lazy(lambda: _doc_pdf_acceptable(df))
+    pdf_acceptance = _lazy(lambda: _doc_pdf_acceptable(
+        df, session=session))
     for name in factor_names():
         for ti, code in enumerate(g.codes):
             if (name in ("mmt_ols_qrs", "mmt_ols_beta_zscore_last")
@@ -448,6 +458,30 @@ def test_parity_degenerate_codes(rng):
     _compare(
         synth_day(rng, n_codes=8, constant_price_codes=2, short_day_codes=2),
         "degenerate", noisy=True)
+
+
+@pytest.mark.parametrize("sess", ["us_390", "hk_halfday"])
+def test_parity_session(rng, sess):
+    """ISSUE 15: the FULL f64-oracle comparator at a non-default
+    registered session's day shape (synth data generated on that
+    session's grid, ragged + zero-volume pathologies on). The 58
+    kernels' definitions are session-relative (sentinels derive from
+    the spec), so the same tolerance machinery gates every market."""
+    day = synth_day(rng, n_codes=6, missing_prob=0.05,
+                    zero_volume_prob=0.05, session=sess)
+    _compare(day, f"session-{sess}", noisy=True, session=sess)
+
+
+@pytest.mark.slow
+def test_parity_session_crypto(rng):
+    """The 1440-slot 24x7 day through the full comparator (slow tier:
+    the f64 oracle's python rolling pass walks ~1390 windows/code).
+    Tier-1 crypto coverage lives in the bitwise stream gates
+    (tests/test_markets.py) — this sweep is the oracle's word."""
+    day = synth_day(rng, n_codes=3, missing_prob=0.02,
+                    session="crypto_1440")
+    _compare(day, "session-crypto_1440", noisy=True,
+             session="crypto_1440")
 
 
 @pytest.mark.slow
