@@ -1371,6 +1371,10 @@ def serve_bench(levels=None, total_requests=None, tickers=None,
         }
         stages[f"load_{level}_s"] = round(wall, 3)
         tel.hbm.sample(f"serve.load_{level}", force=True)
+    # SLO block (ISSUE 16): one explicit frame before close so even the
+    # shortest run banks a nonzero timeline, then the objective verdicts
+    server.timeline.sample()
+    slo_block = server.sloplane.summary()
     server.close()
 
     top = str(levels[-1])
@@ -1424,6 +1428,11 @@ def serve_bench(levels=None, total_requests=None, tickers=None,
         # factor-health block (ISSUE 12): every block BUILD fed the
         # plane its fused [F, 9] sketch; IC queries fed realized-IC
         "factor_health": tel.factorplane.summary(),
+        # SLO block (ISSUE 16): timeline frame count + per-objective
+        # worst burn rate / alert count over the loaded window; the
+        # tpu_session serve carry rule refuses a record without it and
+        # regress derives the `<metric>.burn_rate_max` series from it
+        "slo": slo_block,
         "stages": stages,
     }
 
@@ -1596,6 +1605,7 @@ def fleet_bench(replica_counts=None, levels=None, total_requests=None,
     pod_block = None
     hbm_block = None
     fh_block = None
+    slo_block = None
 
     for c in runnable:
         tel_pod = Telemetry()
@@ -1743,6 +1753,11 @@ def fleet_bench(replica_counts=None, levels=None, total_requests=None,
             # factor per replica + the stream cursor skew beside it —
             # read from the same healthz rollup the front door serves
             fh_block = health["pod"].get("factor_health")
+            # pod SLO block (ISSUE 16): one explicit control-plane
+            # frame so the shortest run banks a nonzero timeline, then
+            # the pod objectives' verdicts
+            fleet.timeline.sample()
+            slo_block = fleet.sloplane.summary()
         fleet.close()
 
     top = str(runnable[-1])
@@ -1779,6 +1794,9 @@ def fleet_bench(replica_counts=None, levels=None, total_requests=None,
         # pod healthz rollup's data-quality view, banked so a replica
         # whose factors degraded is visible in the trajectory
         "factor_health": fh_block,
+        # pod SLO block (ISSUE 16): the control-plane burn-rate view at
+        # the top count; the tpu_session fleet carry rule requires it
+        "slo": slo_block,
         "stages": stages,
     }
 
@@ -1941,6 +1959,23 @@ def stream_bench(cohorts=None, tickers=None, updates=None, names=None,
     day_bars, day_mask = bars4[0], mask4[0]     # [T, 240, 5], [T, 240]
 
     engine = StreamEngine(tickers, names=names, telemetry=tel)
+    # SLO plane (ISSUE 16): ingest-freshness objective sampled on the
+    # timeline cadence while the bench runs — registry snapshots and
+    # the engine's host-side ingest stamp only, never a device read
+    from replication_of_minute_frequency_factor_tpu.telemetry.slo import (
+        Objective)
+
+    def _ingest_freshness(eng=engine):
+        s = eng.staleness_s()
+        return {} if s is None else {"stream.staleness_s": round(s, 6)}
+
+    tel.timeline.add_source(_ingest_freshness)
+    tel.sloplane.configure(
+        (Objective(name="ingest_freshness", kind="freshness",
+                   target=0.99, staleness_gauge="stream.staleness_s",
+                   threshold_s=60.0),),
+        timeline=tel.timeline)
+    tel.timeline.start(0.05)
     # --- warm: all compiles land here (micro-batch scan, cohorts,
     # advance, snapshot)
     t0 = time.perf_counter()
@@ -2026,6 +2061,9 @@ def stream_bench(cohorts=None, tickers=None, updates=None, names=None,
         ready_frac=np.asarray(_ready).mean(axis=1),
         minute=engine.minutes, boundary="stream.snapshot")
     tel.hbm.sample("stream.load_end", force=True)
+    tel.timeline.stop()
+    tel.timeline.sample()   # one final explicit frame: frames > 0 even
+    slo_block = tel.sloplane.summary()  # on the fastest machine
 
     top = str(cohorts[-1])
     stream_counters = {
@@ -2072,6 +2110,9 @@ def stream_bench(cohorts=None, tickers=None, updates=None, names=None,
         # snapshot's per-factor stats + readiness lag; tpu_session's
         # stream_intraday carry rule requires an available block
         "factor_health": tel.factorplane.summary(),
+        # SLO block (ISSUE 16): ingest-freshness burn over the load —
+        # a live feed that goes stale mid-run shows up here, not in p99
+        "slo": slo_block,
         "stages": stages,
     }
 
@@ -2204,6 +2245,21 @@ def discover_bench(pops=None, generations=None, days=None, tickers=None,
     engine = DiscoveryEngine(skeleton=skeleton, telemetry=tel, mesh=mesh)
     data = engine.prepare(bars, mask, fwd_ret, fwd_valid)
 
+    # SLO plane (ISSUE 16): discovery-progress freshness — the engine's
+    # host-mirror gauges (generations done, candidates/sec, seconds
+    # since the last completed generation) ride the timeline sampler,
+    # and the objective burns when generations stop landing. Host-side
+    # reads only; the loop's 1-sync/generation budget is untouched.
+    from replication_of_minute_frequency_factor_tpu.telemetry.slo import (
+        Objective)
+    tel.timeline.add_source(engine.progress)
+    tel.sloplane.configure(
+        (Objective(name="discovery_progress", kind="freshness",
+                   target=0.99, staleness_gauge="discover.stall_s",
+                   threshold_s=120.0),),
+        timeline=tel.timeline)
+    tel.timeline.start(0.05)
+
     stages = {}
     level_stats = {}
     results = {}
@@ -2230,6 +2286,10 @@ def discover_bench(pops=None, generations=None, days=None, tickers=None,
         }
         results[pop] = res
         tel.hbm.sample(f"discover.load_{pop}", force=True)
+
+    tel.timeline.stop()
+    tel.timeline.sample()   # one final explicit frame: frames > 0 even
+    slo_block = tel.sloplane.summary()  # on the fastest machine
 
     top = max(pops)
     top_res = results[top]
@@ -2278,6 +2338,9 @@ def discover_bench(pops=None, generations=None, days=None, tickers=None,
         "hbm": tel.hbm.summary(),
         "mesh": tel.meshplane.summary(),
         "factor_health": tel.factorplane.summary(),
+        # SLO block (ISSUE 16): discovery-progress freshness burn —
+        # a search whose generations stall reads as budget spend here
+        "slo": slo_block,
         "stages": stages,
     }
     return record
@@ -2581,6 +2644,146 @@ def opsplane_smoke():
         and any(k.startswith("device.hbm_stats_available")
                 for k in gauges))
     return {"smoke": "opsplane", **checks,
+            "ok": all(checks.values())}
+
+
+def slo_smoke():
+    """run_tests.sh --quick smoke: the SLO plane end to end on CPU
+    (ISSUE 16). Starts a streaming FactorServer with the timeline
+    sampler at a 20 ms cadence and ``slo_time_scale=3600`` (the SRE
+    5m/1h fast pair compressed to 83 ms/1 s of test time), then:
+
+      * drives warm synthetic load with propagated trace IDs and
+        checks frames accrue — and that a pure-sampling interval moves
+        ZERO device-work counters (``xla.compiles``,
+        ``research.host_blocking_syncs``): the sampler is host-side by
+        construction, counter-asserted here;
+      * forces the breaker open and keeps submitting — REAL
+        ``LoadShedError`` sheds, real ``serve.load_shed`` increments —
+        until the availability burn-rate alert fires and force-dumps
+        the flight recorder with trigger ``slo_burn``;
+      * validates the dump (schema + header) and that its ``extra``
+        names the objective, the burn rate, and the top-moving series;
+      * writes the bundle INTO the flight dir (one incident-replay
+        root), re-validates it at schema v4 (frame + slo records
+        included), and replays it through the actual
+        ``telemetry.timeline`` CLI — the report must reconstruct the
+        incident offline: frames spanning the alert window and request
+        traces cross-linked by trace ID.
+    """
+    import contextlib
+    import io
+    import tempfile
+
+    from replication_of_minute_frequency_factor_tpu.serve import (
+        FactorServer, Query, ServeConfig, SyntheticSource)
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        Telemetry, set_telemetry)
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        timeline as _tl)
+    from replication_of_minute_frequency_factor_tpu.telemetry.validate import (
+        validate_dir, validate_dump)
+
+    names = ("vol_return1min", "mmt_am")
+    tel = set_telemetry(Telemetry())
+    tmp = tempfile.mkdtemp(prefix="mff_slo_")
+    src = SyntheticSource(n_days=8, n_tickers=16, seed=11)
+    # slo_latency_ms is lifted out of the way: cold CPU dispatches
+    # would trip the latency objective and muddy the one injected
+    # incident this smoke asserts on (availability)
+    server = FactorServer(
+        src, names=names, telemetry=tel,
+        serve_cfg=ServeConfig(flight_dir=tmp,
+                              timeline_sample_period_s=0.02,
+                              slo_time_scale=3600.0,
+                              slo_latency_ms=10_000.0),
+        stream=True, stream_batches=(4,))
+    checks = {}
+    reg = tel.registry
+    try:
+        # warm load with trace IDs: the good half of the incident story
+        for i in range(8):
+            server.submit(Query("factors", 0, 4),
+                          trace_id=f"slo-trace-{i}").result(300)
+        # pure-sampling interval: frames accrue, device counters don't
+        frames0 = len(server.timeline)
+        compiles0 = reg.counter_total("xla.compiles")
+        syncs0 = reg.counter_total("research.host_blocking_syncs")
+        deadline = time.monotonic() + 5.0
+        while len(server.timeline) < frames0 + 5 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        checks["frames_sampled"] = len(server.timeline) >= frames0 + 5
+        checks["sampling_pure_host"] = (
+            reg.counter_total("xla.compiles") == compiles0
+            and reg.counter_total("research.host_blocking_syncs")
+            == syncs0)
+        # injected shed burst: force the breaker open and keep
+        # submitting until the multi-window availability burn fires
+        with server._state_lock:
+            server._open_until = time.monotonic() + 30.0
+        shed = 0
+        alert_dumps = []
+        deadline = time.monotonic() + 10.0
+        while not alert_dumps and time.monotonic() < deadline:
+            try:
+                server.submit(Query("factors", 0, 4),
+                              trace_id=f"slo-shed-{shed}")
+            except Exception:  # noqa: BLE001 — the shed IS the load
+                shed += 1
+            alert_dumps = [p for p in server.flight.dumps
+                           if "slo_burn" in p]
+            time.sleep(0.01)
+        checks["burn_alert_fired"] = bool(alert_dumps)
+        checks["real_sheds"] = shed > 0 and int(
+            reg.counter_value("serve.load_shed", reason="breaker")) > 0
+        if alert_dumps:
+            checks["dump_valid"] = validate_dump(alert_dumps[-1])["ok"]
+            header = next(
+                (r for r in _tl._load_jsonl(alert_dumps[-1])
+                 if r.get("kind") == "dump"), {})
+            extra = (header.get("data") or {}).get("extra") or {}
+            checks["dump_names_incident"] = (
+                extra.get("event") == "alert"
+                and extra.get("objective") == "availability"
+                and float(extra.get("burn_rate") or 0.0) > 0.0
+                and bool(extra.get("top_moving")))
+        else:
+            checks["dump_valid"] = False
+            checks["dump_names_incident"] = False
+        s = server.sloplane.summary()
+        checks["slo_summary"] = (
+            s["available"] and s["frames"] > 0
+            and s["objectives"]["availability"]["alerts"] >= 1
+            and s["worst_burn_rate"] > 1.0)
+        h = server.health()
+        checks["healthz_slo"] = (
+            "suppressed" in h.get("flight", {})
+            and "stream_staleness_s" in h)
+    finally:
+        server.close()
+    # one incident-replay root: the bundle lands NEXT TO the dumps
+    tel.write(tmp)
+    checks["bundle_valid"] = validate_dir(tmp)["ok"]
+    # offline replay through the ACTUAL CLI (stdout captured: the
+    # harness reads this smoke's own verdict line)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = _tl.main([tmp, "--require-incident"])
+    try:
+        report = json.loads(buf.getvalue().strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        report = {}
+    inc = [i for i in report.get("incidents", [])
+           if i.get("objective") == "availability"]
+    checks["cli_replays_incident"] = (
+        rc == 0 and report.get("ok") is True and bool(inc)
+        and inc[-1]["frames_in_window"] > 0
+        and inc[-1]["requests"]["linked"] > 0)
+    return {"smoke": "slo", **checks,
+            "frames": report.get("frames", 0),
+            "incidents": len(report.get("incidents", [])),
+            "sheds": shed,
             "ok": all(checks.values())}
 
 

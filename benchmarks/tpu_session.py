@@ -185,7 +185,14 @@ def drop_conv_only_rolling(steps):
       serve, not the fleet; it fails loudly and re-runs on the next
       multi-device window, the resident_sharded rule's mirror), the
       pod ``hbm`` watermark block, and the pod-folded counter block
-      (:func:`_fleet_record_banks`).
+      (:func:`_fleet_record_banks`);
+    * since ISSUE 16 'serve' and 'fleet' records must additionally
+      embed a non-empty ``slo`` block with ``frames > 0`` (the SLO
+      plane's timeline sampler genuinely ran): the banked trajectory
+      feeds the ``<metric>.burn_rate_max`` regress series, so a
+      record with no burn-rate evidence cannot bank — pre-ISSUE-16
+      green entries have no ``slo`` block and re-run under the new
+      contract.
     """
     def keep(name, v):
         recs = [r for r in v.get("results") or [] if isinstance(r, dict)]
@@ -449,8 +456,9 @@ def step_serve():
             r["error"] = "serve bench printed a CPU-fallback metric"
         elif not any(_serve_record_banks(rec) for rec in recs):
             r["ok"] = False
-            r["error"] = ("no r8_serve_v1 record with cache hits > 0 — "
-                          "a zero-hit serve run cannot bank")
+            r["error"] = ("no r8_serve_v1 record with cache hits > 0 "
+                          "and a sampled slo block — a zero-hit or "
+                          "unsampled serve run cannot bank")
     return r
 
 
@@ -461,13 +469,21 @@ def _serve_record_banks(rec) -> bool:
     explicit ``available`` marker) — the banked serve trajectory is the
     series the ``<metric>.hbm_peak_bytes`` regress gate reads, so a
     record without watermarks is a telemetry regression, not a bankable
-    measurement."""
+    measurement. Since ISSUE 16 the record must ALSO embed a non-empty
+    ``slo`` block with ``frames > 0`` (the timeline sampler genuinely
+    ran): the banked trajectory feeds the ``<metric>.burn_rate_max``
+    regress series, and a record whose SLO plane never sampled carries
+    no burn evidence — same contract as the watermark rule."""
     serve = rec.get("serve") or {}
     hbm = rec.get("hbm")
+    slo = rec.get("slo")
     return (rec.get("methodology") == "r8_serve_v1"
             and isinstance(serve.get("cache_hits"), int)
             and serve["cache_hits"] > 0
-            and isinstance(hbm, dict) and "available" in hbm)
+            and isinstance(hbm, dict) and "available" in hbm
+            and isinstance(slo, dict)
+            and isinstance(slo.get("frames"), int)
+            and slo["frames"] > 0)
 
 
 def step_stream_intraday():
@@ -546,8 +562,9 @@ def step_fleet():
         elif not any(_fleet_record_banks(rec) for rec in recs):
             r["ok"] = False
             r["error"] = ("no r11_fleet_v1 record with >= 2 live "
-                          "replicas, a pod hbm block and the pod "
-                          "counter fold — cannot bank")
+                          "replicas, a pod hbm block, the pod "
+                          "counter fold and a sampled slo block — "
+                          "cannot bank")
     return r
 
 
@@ -558,16 +575,24 @@ def _fleet_record_banks(rec) -> bool:
     fleet), the pod HBM watermark block (the degrade policy's input —
     same rationale as :func:`_serve_record_banks`), and the pod
     counter-fold block (the PR 9 exact-merge contract, re-verified in
-    the record, with zero mismatches)."""
+    the record, with zero mismatches). Since ISSUE 16 the record must
+    ALSO embed a non-empty pod ``slo`` block with ``frames > 0`` — the
+    control-plane timeline/burn evidence the ``<metric>.burn_rate_max``
+    regress series reads (same rationale as :func:`_serve_record_banks`
+    )."""
     hbm = rec.get("hbm")
     pod = rec.get("pod")
+    slo = rec.get("slo")
     return (rec.get("methodology") == "r11_fleet_v1"
             and isinstance(rec.get("live_replicas"), int)
             and rec["live_replicas"] >= 2
             and isinstance(hbm, dict) and "available" in hbm
             and isinstance(pod, dict)
             and isinstance(pod.get("counter_totals"), dict)
-            and pod["counter_totals"].get("mismatched") == 0)
+            and pod["counter_totals"].get("mismatched") == 0
+            and isinstance(slo, dict)
+            and isinstance(slo.get("frames"), int)
+            and slo["frames"] > 0)
 
 
 def step_discover():

@@ -98,10 +98,17 @@ MASKED_SCOPE = ("models",)
 #: generation's ``[P, 4]`` stats matrix — the ONE labeled
 #: host-blocking sync of the generation contract
 #: (research/evolve.py); the fitness graph and the genome registry
-#: keep the full rule.
+#: keep the full rule. ISSUE 16 adds the SLO plane's timeline: its
+#: one declared sync symbol is the ``np.asarray`` that ranks
+#: top-moving series over an alert window (telemetry/timeline.py —
+#: host lists only, but the AST tier cannot see dtypes, so the
+#: symbol is declared per-module like every other boundary); the
+#: sampler itself reads registry snapshots and host mirrors, never a
+#: device value.
 GLA3_BOUNDARY_SYNCS = {
     "serve/service.py": frozenset({"np.asarray"}),
     "research/evolve.py": frozenset({"np.asarray"}),
+    "telemetry/timeline.py": frozenset({"np.asarray"}),
     "telemetry/opsplane.py": frozenset({".memory_stats()",
                                         "jax.live_arrays"}),
     "telemetry/meshplane.py": frozenset({".block_until_ready()"}),

@@ -23,6 +23,13 @@ payloads:
   registry-merge (:func:`pod_registry` — counters exact, the PR 9
   contract; never an ad-hoc merger). JSON by default, Prometheus text
   on content negotiation, same as the single server.
+* ``GET /v1/slo`` — the POD SLO plane (ISSUE 16): the fleet's
+  burn-rate objectives (availability over routed vs pod sheds, pod
+  ingest freshness) as JSON, or the ``slo_*``-only Prometheus view of
+  the CONTROL-PLANE registry under the same content negotiation.
+* ``GET /v1/timeline?name=&since=`` — the pod timeline (ISSUE 16):
+  control-plane rates + derived per-replica liveness/freshness
+  series, same query surface as the single server.
 * ``POST /v1/debug/dump`` — fans the on-demand flight capture out to
   every replica; returns ``{label: path}``.
 
@@ -110,6 +117,46 @@ def _make_handler(fleet: FactorFleet, timeout: Optional[float]):
                         "text/plain; version=0.0.4; charset=utf-8")
                 else:
                     self._reply(200, reg.snapshot())
+                return
+            if parsed.path == "/v1/slo":
+                accept = self.headers.get("Accept", "")
+                query = urllib.parse.parse_qs(parsed.query)
+                want_text = ("text/plain" in accept
+                             or "openmetrics" in accept
+                             or query.get("format", [""])[0]
+                             == "prometheus")
+                if want_text:
+                    from ..telemetry.slo import slo_prometheus
+                    body = slo_prometheus(
+                        fleet.telemetry.registry).encode()
+                    self._reply_bytes(
+                        200, body,
+                        "text/plain; version=0.0.4; charset=utf-8")
+                else:
+                    self._reply(200, {
+                        "slo": fleet.sloplane.summary(),
+                        "evaluation": fleet.sloplane.evaluate(),
+                    })
+                return
+            if parsed.path == "/v1/timeline":
+                query = urllib.parse.parse_qs(parsed.query)
+                try:
+                    name = query.get("name", [None])[0]
+                    since_raw = query.get("since", [None])[0]
+                    since = (float(since_raw)
+                             if since_raw is not None else None)
+                    limit_raw = query.get("limit", [None])[0]
+                    limit = (int(limit_raw)
+                             if limit_raw is not None else None)
+                except (TypeError, ValueError) as e:
+                    self._reply(400,
+                                {"error": f"malformed timeline "
+                                          f"query: {e}"})
+                    return
+                frames = fleet.timeline.query(name=name, since=since,
+                                              limit=limit)
+                self._reply(200, {"frames": frames,
+                                  "count": len(frames)})
                 return
             self._reply(404, {"error": f"no route {self.path}"})
 

@@ -72,6 +72,20 @@ class FleetConfig:
     retry_after_default_s: float = 1.0
     #: routing keys remembered for the affinity hit-rate counter
     affinity_memory: int = 4096
+    #: where POD-level flight dumps (``slo_burn`` on a pod objective)
+    #: land (ISSUE 16; None = counters only). Replica anomaly dumps
+    #: keep landing in each replica's own ``ServeConfig.flight_dir``.
+    flight_dir: Optional[str] = None
+    #: pod timeline sampler period (ISSUE 16; 0 disables). Samples
+    #: the CONTROL-PLANE registry (router/policy counters) plus
+    #: derived per-replica signals — never the per-replica registry
+    #: merge, which is scrape-time work (``/v1/metrics``)
+    timeline_sample_period_s: float = 0.5
+    #: divides the SLO burn windows (telemetry/slo.BURN_WINDOWS)
+    slo_time_scale: float = 1.0
+    #: pod freshness objective threshold (s) on the worst live
+    #: replica's ingest staleness
+    slo_staleness_s: float = 120.0
 
 
 def _rendezvous_order(labels: Sequence[str], key: Tuple) -> List[str]:
@@ -325,6 +339,51 @@ class FactorFleet:
                                   cfg=self.cfg)
         self.telemetry.gauge("fleet.replicas", len(self.replicas))
         self._t_start = time.monotonic()
+        #: pod SLO plane (ISSUE 16): the fleet owns its OWN flight
+        #: recorder (pod-level ``slo_burn`` captures carry the
+        #: router's route/ingest_fanout request records) and a
+        #: sampler over the control-plane registry + derived
+        #: per-replica liveness/freshness signals. Replica-level
+        #: timelines run inside each FactorServer and are folded
+        #: offline by ``telemetry.aggregate``.
+        from ..telemetry.opsplane import FlightRecorder
+        from ..telemetry.slo import fleet_objectives
+        self.flight = FlightRecorder(telemetry=self.telemetry,
+                                     dump_dir=self.cfg.flight_dir)
+        self.timeline = self.telemetry.timeline
+        self.sloplane = self.telemetry.sloplane
+        self.timeline.add_source(self._pod_signals)
+        has_stream = any(r.stream for r in self.replicas)
+        self.sloplane.configure(
+            fleet_objectives(staleness_s=self.cfg.slo_staleness_s,
+                             streaming=has_stream),
+            flight=self.flight, timeline=self.timeline,
+            time_scale=self.cfg.slo_time_scale)
+        if self.cfg.timeline_sample_period_s > 0:
+            self.timeline.start(self.cfg.timeline_sample_period_s)
+
+    def _pod_signals(self) -> dict:
+        """Derived pod signals for the timeline sampler: live-replica
+        count, per-replica liveness, and the worst live carry's
+        ingest staleness — host-side policy/engine mirrors only."""
+        states = self.policy.snapshot()["states"]
+        out = {"fleet.live_replicas":
+               float(sum(1 for s in states.values()
+                         if s != "demoted"))}
+        for label, state in states.items():
+            out[f"fleet.replica_up{{replica={label}}}"] = (
+                0.0 if state == "demoted" else 1.0)
+        staleness = []
+        for r in self.replicas:
+            eng = getattr(r.server, "stream_engine", None)
+            if eng is None:
+                continue
+            s = eng.staleness_s()
+            if s is not None:
+                staleness.append(s)
+        if staleness:
+            out["fleet.stream_staleness_s"] = round(max(staleness), 6)
+        return out
 
     # --- request surface (the router's, re-exported) --------------------
     def submit(self, q: Query, trace_id: Optional[str] = None):
@@ -363,6 +422,14 @@ class FactorFleet:
             payload["pod"]["stream_minute"] = max(minutes)
             payload["pod"]["stream_minute_skew"] = (max(minutes)
                                                     - min(minutes))
+        # ISSUE 16 satellite: the pod's freshness is its WORST
+        # replica's wall-clock ingest staleness (read verbatim from
+        # the shared healthz key; replicas that never ingested
+        # report None and don't count)
+        staleness = [h["stream_staleness_s"] for h in reps.values()
+                     if h.get("stream_staleness_s") is not None]
+        if staleness:
+            payload["pod"]["stream_staleness_s"] = max(staleness)
         # pod factor-health rollup (ISSUE 12): the worst-coverage
         # factor PER REPLICA (read verbatim from the shared healthz
         # shape — nothing translated) with the stream cursor skew
@@ -399,6 +466,8 @@ class FactorFleet:
         return self
 
     def close(self, timeout: float = 10.0) -> None:
+        if self.cfg.timeline_sample_period_s > 0:
+            self.timeline.stop()
         for r in self.replicas:
             r.close(timeout=timeout)
 

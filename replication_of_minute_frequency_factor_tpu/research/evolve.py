@@ -119,6 +119,27 @@ class DiscoveryEngine:
         self.executables = (executables if executables is not None
                             else ExecutableCache(telemetry=telemetry))
         self.mesh = mesh
+        #: host-side progress mirrors (ISSUE 16): what the SLO plane's
+        #: timeline sampler reads through :meth:`progress` — updated
+        #: from values the loop already holds, never a device read
+        self.generations_done = 0
+        self.last_candidates_per_s = 0.0
+        self._last_gen_t: Optional[float] = None
+
+    def progress(self) -> dict:
+        """Derived throughput signals for the timeline sampler
+        (``gauge:discover.*`` series) — host mirrors only.
+        ``discover.stall_s`` (seconds since the last completed
+        generation) is the discovery freshness signal the SLO plane
+        burns against: a search whose generations stop landing goes
+        stale exactly like an idle ingest stream."""
+        out = {"discover.generations_done": float(self.generations_done),
+               "discover.candidates_per_s":
+                   float(self.last_candidates_per_s)}
+        if self._last_gen_t is not None:
+            out["discover.stall_s"] = round(
+                max(0.0, time.monotonic() - self._last_gen_t), 6)
+        return out
 
     def _tel(self):
         if self.telemetry is not None:
@@ -305,6 +326,8 @@ class DiscoveryEngine:
                 best_g = genomes[order[0]].copy()
             history.append(float(fits[order[0]]))
             tel.counter("discover.generations")
+            self.generations_done += 1
+            self._last_gen_t = time.monotonic()
             tel.gauge("discover.best_ic", float(best_stats[1]))
             # refill: uniform crossover of random elite pairs +
             # per-gene mutation — search.evolve's operators, threaded
@@ -324,6 +347,7 @@ class DiscoveryEngine:
         wall = time.perf_counter() - t_loop
         cps = (pop * generations / wall) if wall > 0 else 0.0
         tel.gauge("discover.candidates_per_s", cps)
+        self.last_candidates_per_s = cps
         n_syncs = syncs() - syncs_before
         return DiscoveryResult(
             genome=best_g, skeleton=self.skeleton,

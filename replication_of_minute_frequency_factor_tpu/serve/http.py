@@ -49,6 +49,16 @@ Endpoints:
   request asks for it (``Accept: text/plain`` / ``application/
   openmetrics-text``, or ``?format=prometheus``) — scrapeable by
   stock tooling (ISSUE 8).
+* ``GET /v1/slo`` — the SLO plane (ISSUE 16): per-objective burn
+  rates, budget remaining and alert state as JSON
+  (``SloPlane.summary`` + the latest evaluation), or the
+  ``slo_*``-only Prometheus view under the same content negotiation
+  as ``/v1/metrics`` — for alerting rules that poll the SLO surface
+  alone.
+* ``GET /v1/timeline?name=&since=`` — the continuous telemetry
+  timeline (ISSUE 16): the in-process frame ring, optionally
+  filtered to series containing ``name`` and frames at/after unix
+  second ``since`` (``limit`` bounds the tail).
 
 Request tracing (ISSUE 8): ``POST /v1/query`` and ``POST /v1/ingest``
 accept an ``X-Trace-Id`` header (``[A-Za-z0-9._-]{1,64}``; anything
@@ -149,6 +159,46 @@ def _make_handler(server: FactorServer, timeout: Optional[float]):
                 else:
                     self._reply(200,
                                 server.telemetry.registry.snapshot())
+                return
+            if parsed.path == "/v1/slo":
+                accept = self.headers.get("Accept", "")
+                query = urllib.parse.parse_qs(parsed.query)
+                want_text = ("text/plain" in accept
+                             or "openmetrics" in accept
+                             or query.get("format", [""])[0]
+                             == "prometheus")
+                if want_text:
+                    from ..telemetry.slo import slo_prometheus
+                    body = slo_prometheus(
+                        server.telemetry.registry).encode()
+                    self._reply_bytes(
+                        200, body,
+                        "text/plain; version=0.0.4; charset=utf-8")
+                else:
+                    self._reply(200, {
+                        "slo": server.sloplane.summary(),
+                        "evaluation": server.sloplane.evaluate(),
+                    })
+                return
+            if parsed.path == "/v1/timeline":
+                query = urllib.parse.parse_qs(parsed.query)
+                try:
+                    name = query.get("name", [None])[0]
+                    since_raw = query.get("since", [None])[0]
+                    since = (float(since_raw) if since_raw is not None
+                             else None)
+                    limit_raw = query.get("limit", [None])[0]
+                    limit = (int(limit_raw) if limit_raw is not None
+                             else None)
+                except (TypeError, ValueError) as e:
+                    self._reply(400,
+                                {"error": f"malformed timeline "
+                                          f"query: {e}"})
+                    return
+                frames = server.timeline.query(name=name, since=since,
+                                               limit=limit)
+                self._reply(200, {"frames": frames,
+                                  "count": len(frames)})
                 return
             self._reply(404, {"error": f"no route {self.path}"})
 

@@ -153,6 +153,21 @@ class ServeConfig:
     #: HBM watermark sampler thread period (0 disables the thread;
     #: dispatch-boundary sampling stays on either way)
     hbm_sample_period_s: float = 0.5
+    #: timeline sampler thread period (ISSUE 16; 0 disables the
+    #: thread — the SLO plane then evaluates only on explicit
+    #: ``timeline.sample()`` calls). Host-side registry reads only;
+    #: never a device sync.
+    timeline_sample_period_s: float = 0.5
+    #: divides every SLO burn window (telemetry/slo.BURN_WINDOWS):
+    #: 1.0 = the production SRE 5m/1h + 6h/3d pairs; tests/smokes set
+    #: thousands to compress hours into test seconds
+    slo_time_scale: float = 1.0
+    #: default latency objective: p99 of serve.request_seconds must
+    #: stay under this many ms
+    slo_latency_ms: float = 250.0
+    #: default freshness objective (streaming servers): seconds since
+    #: the last applied ingest must stay under this
+    slo_staleness_s: float = 120.0
     #: ship factors/intraday answers through the blocked-quantized
     #: result wire (ISSUE 10): the block's exposures encode on device
     #: (one warm dispatch from the cached RAW f32 block — never from a
@@ -323,6 +338,35 @@ class FactorServer:
         self._dispatch_seq = 0  # worker-thread-only; no lock needed
         if self.scfg.hbm_sample_period_s > 0:
             self.telemetry.hbm.start(self.scfg.hbm_sample_period_s)
+        #: SLO plane (ISSUE 16): the continuous timeline sampler +
+        #: declarative burn-rate objectives. The sampler reads only
+        #: host-side state (registry snapshots, the stream engine's
+        #: staleness mirror, the discovery engine's progress mirror);
+        #: an alert transition force-dumps THIS server's flight
+        #: recorder under the ``slo_burn`` trigger.
+        self.timeline = self.telemetry.timeline
+        self.sloplane = self.telemetry.sloplane
+        if self.stream_engine is not None:
+            eng = self.stream_engine
+
+            def _stream_freshness(eng=eng):
+                s = eng.staleness_s()
+                if s is None:
+                    return {}
+                return {"stream.staleness_s": round(s, 6)}
+
+            self.timeline.add_source(_stream_freshness)
+        if self.research_engine is not None:
+            self.timeline.add_source(self.research_engine.progress)
+        from ..telemetry.slo import serve_objectives
+        self.sloplane.configure(
+            serve_objectives(latency_ms=self.scfg.slo_latency_ms,
+                             staleness_s=self.scfg.slo_staleness_s,
+                             streaming=self.stream_engine is not None),
+            flight=self.flight, timeline=self.timeline,
+            time_scale=self.scfg.slo_time_scale)
+        if self.scfg.timeline_sample_period_s > 0:
+            self.timeline.start(self.scfg.timeline_sample_period_s)
         if start:
             self.start()
 
@@ -354,6 +398,8 @@ class FactorServer:
             self._thread.join(timeout)
         if self.scfg.hbm_sample_period_s > 0:
             self.telemetry.hbm.stop()
+        if self.scfg.timeline_sample_period_s > 0:
+            self.timeline.stop()
 
     def debug_dump(self, out_dir: Optional[str] = None) -> Optional[str]:
         """On-demand flight-recorder capture (``POST /v1/debug/dump``):
@@ -604,7 +650,10 @@ class FactorServer:
             "uptime_s": round(time.monotonic() - self._t_start, 3),
             "queue_depth": self._q.qsize(),
             "flight": {"requests": len(self.flight),
-                       "dumps": self.flight.dump_count},
+                       "dumps": self.flight.dump_count,
+                       # ISSUE 16 satellite: non-forced dumps the 1/s
+                       # rate limit dropped — no longer silent
+                       "suppressed": self.flight.suppressed_count},
             "hbm_available": bool(hbm.get("available")),
             "research": self.research_engine is not None,
             "replica": {"label": self.replica_label,
@@ -619,6 +668,12 @@ class FactorServer:
         }
         if self.stream_engine is not None:
             payload["stream_minute"] = self.stream_engine.minutes
+            # ISSUE 16 satellite: wall-clock freshness next to the
+            # cursor — shared VERBATIM standalone/replica (the fleet
+            # pod rollup reads this key), None until the first ingest
+            s = self.stream_engine.staleness_s()
+            payload["stream_staleness_s"] = (None if s is None
+                                             else round(s, 3))
         return payload
 
     # --- request-lifecycle recording (ISSUE 8) --------------------------

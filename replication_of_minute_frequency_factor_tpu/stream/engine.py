@@ -215,6 +215,10 @@ class StreamEngine:
         #: host-side minute cursor mirror (no device read needed for
         #: gauges or over-ingest guards)
         self.minutes = 0
+        #: monotone stamp of the last APPLIED ingest (ISSUE 16
+        #: satellite: healthz reported ``stream_minute`` but not
+        #: wall-clock staleness); None until the first ingest lands
+        self._last_ingest_t: Optional[float] = None
         self.reset()
 
     # --- lifecycle ------------------------------------------------------
@@ -230,6 +234,17 @@ class StreamEngine:
         rollup surfaces any skew."""
         return {"minute": self.minutes, "tickers": self.n_tickers,
                 "session": self.session.name}
+
+    def staleness_s(self) -> Optional[float]:
+        """Seconds since the last APPLIED ingest (monotone clock;
+        ISSUE 16) — the freshness signal healthz, the fleet pod
+        rollup and the SLO plane's timeline sampler all read. None
+        until the first ingest lands (a just-opened engine is not
+        'stale', it is unfed)."""
+        t = self._last_ingest_t
+        if t is None:
+            return None
+        return max(0.0, time.monotonic() - t)
 
     def _put_carry(self, host_tree):
         """One explicit host->device put of a whole carry pytree —
@@ -359,6 +374,7 @@ class StreamEngine:
         tel.meshplane.record_occupancy(
             n_bars / (b * t) if b * t else 0.0, boundary="stream.scan")
         self.minutes += b
+        self._last_ingest_t = time.monotonic()
         self._note_carry()
         # HBM watermark at the ingest dispatch boundary (ISSUE 8;
         # rate-limited inside the sampler, never raises)
@@ -390,6 +406,7 @@ class StreamEngine:
         # device time invisibly without this gauge
         tel.meshplane.record_occupancy(n_real / k if k else 0.0,
                                        boundary="stream.cohort")
+        self._last_ingest_t = time.monotonic()
         tel.hbm.sample("stream.ingest")
 
     def advance(self) -> None:

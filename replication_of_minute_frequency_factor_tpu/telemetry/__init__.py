@@ -37,15 +37,19 @@ from .opsplane import (FlightRecorder, HbmSampler, canonical_trace_id,
                        gen_trace_id, to_prometheus)
 from .registry import Histogram, MetricsRegistry, render_key
 from .sink import SCHEMA_VERSION, EventSink, validate_jsonl, validate_record
+from .slo import Objective, SloPlane, slo_prometheus
 from .spans import SpanTracer
+from .timeline import TimelineStore
 
 __all__ = [
     "SCHEMA_VERSION", "EventSink", "FactorPlane", "FlightRecorder",
-    "HbmSampler", "Histogram", "MeshPlane", "MetricsRegistry", "SpanTracer",
-    "StageTimer", "Telemetry", "TraceCapture", "canonical_trace_id",
+    "HbmSampler", "Histogram", "MeshPlane", "MetricsRegistry",
+    "Objective", "SloPlane", "SpanTracer",
+    "StageTimer", "Telemetry", "TimelineStore", "TraceCapture",
+    "canonical_trace_id",
     "gen_trace_id", "get_telemetry", "reconcile", "render_key",
-    "set_telemetry", "to_prometheus", "validate_jsonl",
-    "validate_record",
+    "set_telemetry", "slo_prometheus", "to_prometheus",
+    "validate_jsonl", "validate_record",
 ]
 
 #: retained free-form events bound (events past it count, not retain)
@@ -100,6 +104,8 @@ class Telemetry:
         self._hbm: Optional[HbmSampler] = None
         self._meshplane: Optional[MeshPlane] = None
         self._factorplane: Optional[FactorPlane] = None
+        self._timeline: Optional[TimelineStore] = None
+        self._sloplane: Optional[SloPlane] = None
         self._lock = threading.Lock()
 
     @property
@@ -139,6 +145,31 @@ class Telemetry:
                 if self._factorplane is None:
                     self._factorplane = FactorPlane(telemetry=self)
         return self._factorplane
+
+    @property
+    def timeline(self) -> TimelineStore:
+        """The continuous-telemetry timeline bound to this telemetry
+        (created on first use; ISSUE 16). Owners call
+        ``tel.timeline.start(period_s)`` for a sampler thread;
+        :meth:`write` persists the ring as schema-v4 ``frame``
+        records."""
+        if self._timeline is None:
+            with self._lock:
+                if self._timeline is None:
+                    self._timeline = TimelineStore(telemetry=self)
+        return self._timeline
+
+    @property
+    def sloplane(self) -> SloPlane:
+        """The SLO plane bound to this telemetry (created on first
+        use; ISSUE 16). Inert until ``configure(objectives, ...)``;
+        evaluated per timeline frame as multi-window burn rates —
+        never-raising and host-side by contract."""
+        if self._sloplane is None:
+            with self._lock:
+                if self._sloplane is None:
+                    self._sloplane = SloPlane(telemetry=self)
+        return self._sloplane
 
     # --- emit -----------------------------------------------------------
     def counter(self, name: str, value: float = 1.0, **labels) -> None:
@@ -240,6 +271,16 @@ class Telemetry:
                           op=str(tr.get("op", "")),
                           status=str(tr.get("status", "")),
                           data=dict(tr.get("data") or {}))
+            # ISSUE 16: the timeline ring and SLO events, when bound —
+            # frames carry their OWN wall-clock ts (explicit fields
+            # beat the sink's write-time stamp) so incident replay can
+            # window them against flight dumps and request records
+            if self._timeline is not None:
+                for fr in self._timeline.frame_records():
+                    sink.emit("frame", **fr)
+            if self._sloplane is not None:
+                for rec in self._sloplane.slo_records():
+                    sink.emit("slo", **rec)
         self.tracer.write_chrome_trace(paths["trace"])
         return paths
 

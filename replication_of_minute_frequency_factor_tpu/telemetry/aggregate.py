@@ -29,6 +29,11 @@ pod-level bundle:
 * **flight dumps ride along** — each host's ``flight_*.jsonl`` copies
   into the pod bundle under a host-prefixed name, so the directory
   validator checks them too.
+* **timelines fold onto one pod clock** (ISSUE 16) — per-host
+  schema-v4 ``frame`` records re-emit with provenance AND fold by
+  ``seq`` into pod frames stamped ``host="pod"``: ``rate:`` series
+  sum exactly (re-verified like counter totals), gauges/quantiles
+  fold as max (documented approximate, like merged percentiles).
 * **per-host skew summary** — the pod manifest's ``aggregate`` block
   reports per-host record/span totals and a max/median skew ratio
   over the hosts' attributed span seconds (the pod-level twin of
@@ -57,8 +62,11 @@ from .sink import EventSink
 _METRIC_KINDS = frozenset({"counter", "gauge", "histogram"})
 
 #: record kinds re-emitted verbatim (plus identity stamps) into the pod
-#: stream; ``manifest`` is rebuilt, not copied
-_STREAM_KINDS = frozenset({"span", "event", "request", "dump"})
+#: stream; ``manifest`` is rebuilt, not copied. ``frame``/``slo``
+#: (ISSUE 16) keep their per-host provenance this way AND fold into
+#: the pod timeline below.
+_STREAM_KINDS = frozenset({"span", "event", "request", "dump",
+                           "frame", "slo"})
 
 #: envelope fields the sink re-stamps itself — everything else of an
 #: input record passes through emit() as-is
@@ -199,6 +207,49 @@ def host_skew(per_host: Dict[str, dict]) -> Optional[dict]:
     }
 
 
+def fold_timelines(per_host_frames: List[List[dict]]) -> List[dict]:
+    """N per-host timeline frame streams -> one pod timeline (ISSUE
+    16), aligned by ``seq`` (each host's sampler counts frames on its
+    own monotone clock; the samplers run at the same period, so frame
+    k of every host covers the same slice of pod time — the same
+    alignment assumption the cross-host skew table makes explicit).
+
+    Series fold by prefix: ``rate:`` series SUM (a pod request rate is
+    the sum of replica rates — exact, re-verified by the caller);
+    ``gauge:``/``p50:``/``p95:``/``p99:`` series fold as MAX (a pod's
+    staleness is its worst replica's; a pod p99 is at least its worst
+    replica's p99 — approximate and documented, like merged histogram
+    percentiles). Returns pod frame record dicts (sink field shape)."""
+    by_seq: Dict[int, List[dict]] = {}
+    for frames in per_host_frames:
+        for f in frames:
+            seq = f.get("seq")
+            if isinstance(seq, int) and not isinstance(seq, bool):
+                by_seq.setdefault(seq, []).append(f)
+    out = []
+    for seq in sorted(by_seq):
+        members = by_seq[seq]
+        series: Dict[str, float] = {}
+        for f in members:
+            for key, v in (f.get("series") or {}).items():
+                if not isinstance(v, (int, float)) \
+                        or isinstance(v, bool):
+                    continue
+                if key.startswith("rate:"):
+                    series[key] = series.get(key, 0.0) + float(v)
+                else:
+                    series[key] = max(series.get(key, float(v)),
+                                      float(v))
+        out.append({
+            "seq": seq,
+            "ts": max(float(f.get("ts", 0.0)) for f in members),
+            "interval_s": max(float(f.get("interval_s", 0.0))
+                              for f in members),
+            "series": series,
+        })
+    return out
+
+
 def aggregate_dirs(dirs: List[str], out_dir: str) -> dict:
     """Merge per-host bundles under ``dirs`` into one pod bundle at
     ``out_dir``; returns the verdict dict (see module docstring)."""
@@ -244,6 +295,9 @@ def aggregate_dirs(dirs: List[str], out_dir: str) -> dict:
     # registry (pod totals, no host stamp), then every host's
     # span/event/request/dump records with identity stamped
     n_stream = 0
+    host_frames = [[r for r in b["records"]
+                    if r.get("kind") == "frame"] for b in bundles]
+    pod_frames = fold_timelines(host_frames)
     with EventSink(os.path.join(out_dir, "metrics.jsonl")) as sink:
         sink.emit("manifest", payload=base)
         for rec in merged.records():
@@ -258,6 +312,11 @@ def aggregate_dirs(dirs: List[str], out_dir: str) -> dict:
                 fields.setdefault("host", host)
                 sink.emit(rec["kind"], **fields)
                 n_stream += 1
+        # ISSUE 16: the folded pod timeline on one clock, stamped
+        # host="pod" so replay can tell the fold from the per-host
+        # frames re-emitted above
+        for fr in pod_frames:
+            sink.emit("frame", host="pod", **fr)
 
     # --- pod trace: remap pids per host so tracks never interleave
     events: List[dict] = []
@@ -296,8 +355,26 @@ def aggregate_dirs(dirs: List[str], out_dir: str) -> dict:
         checked += 1
         if abs(per - total) > 1e-9 * max(1.0, abs(total)):
             mismatched += 1
+
+    # ISSUE 16: same exactness property for the folded pod timeline —
+    # every pod-frame rate series equals the sum of its per-host
+    # values at that seq (re-verified from the emitted fold, not
+    # assumed from its construction)
+    frames_by_seq = [
+        {f.get("seq"): f for f in frames} for frames in host_frames]
+    rate_checked = rate_mismatched = 0
+    for fr in pod_frames:
+        for key, total in fr["series"].items():
+            if not key.startswith("rate:"):
+                continue
+            per = sum(float((hf.get(fr["seq"]) or {})
+                            .get("series", {}).get(key, 0.0))
+                      for hf in frames_by_seq)
+            rate_checked += 1
+            if abs(per - total) > 1e-9 * max(1.0, abs(total)):
+                rate_mismatched += 1
     return {
-        "ok": mismatched == 0,
+        "ok": mismatched == 0 and rate_mismatched == 0,
         "out": out_dir,
         "hosts": len(bundles),
         "merged_counters": len(snap["counters"]),
@@ -308,6 +385,10 @@ def aggregate_dirs(dirs: List[str], out_dir: str) -> dict:
         "flight_dumps": n_flights,
         "counter_totals": {"checked": checked,
                            "mismatched": mismatched},
+        "timeline": {"pod_frames": len(pod_frames),
+                     "per_host_frames": [len(f) for f in host_frames],
+                     "rate_sums": {"checked": rate_checked,
+                                   "mismatched": rate_mismatched}},
         "host_skew": skew,
     }
 

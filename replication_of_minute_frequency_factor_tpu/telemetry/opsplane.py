@@ -304,6 +304,10 @@ class FlightRecorder:
         self._seq = 0
         self.dump_count = 0
         self.dumps: List[str] = []
+        #: non-forced dumps dropped by the rate limit (ISSUE 16
+        #: satellite: the 1/s limit used to drop them SILENTLY —
+        #: now counted, surfaced in /healthz's flight block)
+        self.suppressed_count = 0
 
     def _tel(self):
         if self._telemetry is not None:
@@ -364,8 +368,16 @@ class FlightRecorder:
         with self._lock:
             if not force and now - self._last_dump_t \
                     < self.min_dump_interval_s:
-                return None
-            self._last_dump_t = now
+                self.suppressed_count += 1
+                suppressed = True
+            else:
+                suppressed = False
+            if not suppressed:
+                self._last_dump_t = now
+        if suppressed:
+            tel.counter("flight.suppressed_total", trigger=trigger)
+            return None
+        with self._lock:
             self._seq += 1
             seq = self._seq
             requests = list(self._ring)

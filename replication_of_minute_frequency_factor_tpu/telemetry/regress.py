@@ -135,6 +135,18 @@ without a declared break usually means the fitness graph lost work
 (e.g. a silently narrower skeleton or day slab). Cold or chatty loops
 never seed the baseline.
 
+SLO burn sub-series (ISSUE 16, same availability contract): a record
+whose ``slo`` block is available with a NONZERO frame count (the
+timeline sampler actually ran — a sampler that never fired measured
+nothing and must not seed a burn baseline at 0) contributes
+``<metric>.burn_rate_max`` — the worst multi-window burn rate any
+objective reached over the run (telemetry/slo.py, docs/slo.md). Both
+directions flag: a burn JUMP means the run spent error budget it
+never spent before (sheds, tail latency, stale ingest) even when the
+QPS headline held; a silent DROP to ~0 on a series that used to burn
+usually means the objective's signal went dark, not that the service
+got perfect.
+
 Baseline = median of every record in the group EXCEPT the latest; the
 latest is the record under test. ``--check FILE`` instead gates a fresh
 candidate record against the baseline of the FULL banked group (the
@@ -453,6 +465,24 @@ def derive_records(record: dict) -> List[dict]:
                                 "methodology": meth,
                                 "derived_from":
                                     f"mesh.axes.{axis}.skew_ratio"})
+    # SLO burn sub-series (ISSUE 16): gated on slo.available with a
+    # nonzero timeline (a record whose sampler never ran measured
+    # nothing — it must not seed or gate a burn baseline at 0). Both
+    # directions flag through the shared tolerance machinery: a burn
+    # JUMP means the run spent error budget it never spent before
+    # (sheds, tail latency, stale ingest), a silent DROP to ~0 on a
+    # series that used to burn usually means the objective's signal
+    # went dark, not that the service got perfect.
+    slo = record.get("slo")
+    if isinstance(slo, dict) and slo.get("available") \
+            and isinstance(slo.get("frames"), int) and slo["frames"] > 0:
+        wbr = slo.get("worst_burn_rate")
+        if isinstance(wbr, (int, float)) and not isinstance(wbr, bool) \
+                and wbr >= 0:
+            out.append({"metric": f"{metric}.burn_rate_max",
+                        "value": float(wbr), "unit": "ratio",
+                        "methodology": meth,
+                        "derived_from": "slo.worst_burn_rate"})
     return out
 
 

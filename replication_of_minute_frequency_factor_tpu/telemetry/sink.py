@@ -27,6 +27,16 @@ Version history:
   the collective dispatch spans). Same both-direction contract: a
   record declaring ``schema <= 2`` that carries any of these FLAGS
   (regression-tested in tests/test_meshplane.py).
+* **v4** (ISSUE 16, the SLO plane) — adds the ``frame`` kind (one
+  timeline sample: ``seq`` monotone per-process frame index,
+  ``interval_s`` the measured sampling interval, ``series`` the
+  name->value dict of counter rates / gauge values / histogram
+  quantiles — telemetry/timeline.py) and the ``slo`` kind (one SLO
+  plane event — an alert transition or end-of-run objective verdict,
+  ``name`` the objective, ``data`` the payload — telemetry/slo.py).
+  Same both-direction contract: a record declaring ``schema <= 3``
+  that carries either kind FLAGS (regression-tested in
+  tests/test_slo.py).
 """
 
 from __future__ import annotations
@@ -36,7 +46,7 @@ import threading
 import time
 from typing import IO, Iterator, List, Optional, Tuple
 
-SCHEMA_VERSION = 3
+SCHEMA_VERSION = 4
 
 #: kind -> required fields beyond the envelope (field, allowed types).
 #: histogram stat fields admit None (an empty histogram has no min/max).
@@ -60,11 +70,22 @@ KIND_FIELDS = {
     "request": (("trace_id", (str,)), ("op", (str,)),
                 ("status", (str,)), ("data", (dict,))),
     "dump": (("trigger", (str,)), ("data", (dict,))),
+    # v4: one timeline frame (telemetry/timeline.py — counter rates,
+    # gauge values and histogram quantiles sampled on one clock) and
+    # one SLO plane event (telemetry/slo.py — an alert transition or
+    # the end-of-run objective verdict)
+    "frame": (("seq", (int,)), ("interval_s", _NUM),
+              ("series", (dict,))),
+    "slo": (("name", (str,)), ("data", (dict,))),
 }
 
 #: kinds that did not exist before schema v2 — a record declaring
 #: ``schema: 1`` must not carry them
 V2_ONLY_KINDS = frozenset({"request", "dump"})
+
+#: kinds that did not exist before schema v4 (ISSUE 16) — a record
+#: declaring ``schema <= 3`` must not carry them
+V4_ONLY_KINDS = frozenset({"frame", "slo"})
 
 #: (kind, field) -> (allowed types, minimum schema): optional fields
 #: that are type-checked when present and version-gated. Kind ``"*"``
@@ -100,6 +121,9 @@ def validate_record(rec) -> List[str]:
         return problems
     if kind in V2_ONLY_KINDS and schema < 2:
         problems.append(f"kind={kind!r} needs schema>=2 "
+                        f"(record declares {schema})")
+    if kind in V4_ONLY_KINDS and schema < 4:
+        problems.append(f"kind={kind!r} needs schema>=4 "
                         f"(record declares {schema})")
     for field, types in KIND_FIELDS[kind]:
         v = rec.get(field, _MISSING)

@@ -145,6 +145,18 @@ print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     # nonzero on drift
     run python -c "import json, sys, bench; r = bench.session_smoke(); \
 print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
+    # slo smoke (ISSUE 16): the SLO plane end to end on CPU — a
+    # streaming FactorServer sampling frames at a 20 ms cadence with
+    # compressed burn windows (time_scale=3600), an injected breaker
+    # shed burst that must fire the multi-window availability burn
+    # alert and force a validated slo_burn flight dump, a
+    # pure-sampling interval asserted to move ZERO device-work
+    # counters, and the telemetry.timeline CLI replaying the written
+    # bundle into the incident report (frames spanning the alert
+    # window + request traces cross-linked by trace ID); one JSON
+    # verdict line, nonzero on any missing piece
+    run python -c "import json, sys, bench; r = bench.slo_smoke(); \
+print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     # graftlint (ISSUE 4): AST rules over the whole package + jaxpr
     # contracts over all 58 registered kernels AND the resident scan
     # wrappers (abstract trace on CPU), gated on the committed baseline

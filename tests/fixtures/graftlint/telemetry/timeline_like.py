@@ -1,0 +1,13 @@
+"""GL-A3 telemetry-scope fixture (ISSUE 16): a timeline-like module
+under telemetry/ that is NOT the declared boundary gets the full rule
+— ``np.asarray`` flags here even though telemetry/timeline.py next
+door declares exactly that symbol for its top-movers ranking; and a
+sync symbol BEYOND a boundary's declared set (``.item()``) must flag
+even in a module styled like the sampler."""
+import numpy as np
+
+
+def leaky_top_movers(series_vals, latest_dev):
+    arr = np.asarray(series_vals)        # flags: boundary-module-only
+    worst = latest_dev.item()            # flags: never declared
+    return arr, worst

@@ -41,7 +41,7 @@ def _codes_by_file(violations):
 @pytest.fixture(scope="module")
 def fixture_violations():
     violations, n_files = run_ast_tier(FIXTURES, display_base=REPO)
-    assert n_files == 17
+    assert n_files == 18
     return violations
 
 
@@ -114,16 +114,17 @@ def test_a3_boundary_policy_is_not_a_blanket_exclusion(
 
 
 def test_a3_policy_matches_the_real_request_loop():
-    """The committed policy has exactly seven entries — the serving
+    """The committed policy has exactly eight entries — the serving
     request loop with its one declared sync, the ops-plane sampler
     with its device-memory reads (ISSUE 8), the mesh-plane
     shard-watermark prober with its per-shard blocking (ISSUE 9), the
     factor-health plane's one fused-stats materialization (ISSUE 12),
     the fleet layer's two boundaries (ISSUE 11: the router's one
     ingest normalization, the replica lifecycle's one device-liveness
-    block), and the discovery loop's one per-generation fitness fetch
-    (ISSUE 14) — and scanning the real package stays clean under it
-    (the policy is load-bearing: docs list it)."""
+    block), the discovery loop's one per-generation fitness fetch
+    (ISSUE 14), and the timeline sampler's one top-movers
+    normalization (ISSUE 16) — and scanning the real package stays
+    clean under it (the policy is load-bearing: docs list it)."""
     from replication_of_minute_frequency_factor_tpu.analysis import (
         ast_tier)
     assert ast_tier.GLA3_BOUNDARY_SYNCS == {
@@ -133,6 +134,7 @@ def test_a3_policy_matches_the_real_request_loop():
                                             "jax.live_arrays"}),
         "telemetry/meshplane.py": frozenset({".block_until_ready()"}),
         "telemetry/factorplane.py": frozenset({"np.asarray"}),
+        "telemetry/timeline.py": frozenset({"np.asarray"}),
         "fleet/router.py": frozenset({"np.asarray"}),
         "fleet/replica.py": frozenset({".block_until_ready()"})}
     violations, _ = ast_tier.run_ast_tier()
@@ -160,6 +162,18 @@ def test_a3_research_scope_is_not_a_blanket_exclusion(
     budget would silently double otherwise)."""
     hits = _codes_by_file(fixture_violations)["fitness_like.py"]
     assert [(c, s) for c, _, s in hits] == [("GL-A3", "np.asarray")]
+
+
+def test_a3_timeline_scope_is_not_a_blanket_exclusion(
+        fixture_violations):
+    """ISSUE 16: ``telemetry/timeline.py`` declares ``np.asarray``
+    (the top-movers range normalization) — a telemetry/ module that is
+    NOT that boundary still gets the full rule (its np.asarray flags),
+    and a sync symbol beyond the declared set (.item()) flags even in
+    a sampler-styled module."""
+    hits = _codes_by_file(fixture_violations)["timeline_like.py"]
+    assert {s for _, _, s in hits} == {"np.asarray", ".item()"}
+    assert all(c == "GL-A3" for c, _, _ in hits)
 
 
 def test_a3_fleet_router_boundary_allows_asarray_only(
@@ -407,7 +421,7 @@ def test_cli_flags_fixtures_then_baseline_clears_them(tmp_path):
             "--report", report)
     out = _run_cli(*args)
     assert out.returncode == 1
-    assert json.loads(out.stdout.strip().splitlines()[-1])["new"] == 29
+    assert json.loads(out.stdout.strip().splitlines()[-1])["new"] == 31
     # refuse to baseline without a why
     out = _run_cli(*args, "--update-baseline")
     assert out.returncode == 2
@@ -420,7 +434,7 @@ def test_cli_flags_fixtures_then_baseline_clears_them(tmp_path):
     out = _run_cli(*args)
     assert out.returncode == 0
     assert json.loads(
-        out.stdout.strip().splitlines()[-1])["baselined"] == 29
+        out.stdout.strip().splitlines()[-1])["baselined"] == 31
 
 
 def test_manifest_carries_the_analysis_block(tmp_path):

@@ -454,3 +454,57 @@ def test_cli_fleet_demo(capsys):
     assert out["routed"] == 6
     assert sum(out["per_replica_dispatches"].values()) \
         == out["dispatches"]
+
+
+# --------------------------------------------------------------------------
+# pod SLO plane (ISSUE 16)
+# --------------------------------------------------------------------------
+
+
+def test_fleet_slo_plane_and_pod_staleness():
+    """The fleet runs its own pod-level SLO plane (``pod_availability``
+    + ``pod_freshness`` on a streaming fleet), the router timeline
+    samples the derived pod signals, the health rollup carries the
+    WORST replica staleness, and the front door serves ``/v1/slo`` and
+    ``/v1/timeline``."""
+    fleet = _fleet(stream=True)
+    httpd = None
+    try:
+        fleet.submit(Query("factors", 0, 2)).result(120)
+        bars, present = _day_minutes(fleet.source, 0, 2)
+        fleet.ingest(bars, present)
+        frame = fleet.timeline.sample()
+        s = fleet.sloplane.summary()
+        assert s["available"] and s["frames"] >= 1
+        assert {"pod_availability",
+                "pod_freshness"} <= set(s["objectives"])
+        assert s["alerts"] == 0
+        # derived pod signals ride the sampled frame
+        assert "gauge:fleet.live_replicas" in frame["series"]
+        assert "gauge:fleet.stream_staleness_s" in frame["series"]
+        # the health rollup: max staleness across streaming replicas
+        h = fleet.health()
+        assert isinstance(h["pod"]["stream_staleness_s"], float)
+        assert h["pod"]["stream_staleness_s"] >= 0.0
+        httpd, _t = serve_fleet_http(fleet)
+        port = httpd.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/slo", timeout=30) as resp:
+            doc = json.loads(resp.read())
+        assert set(doc["slo"]["objectives"]) == set(s["objectives"])
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/slo?format=prometheus",
+                timeout=30) as resp:
+            text = resp.read().decode()
+        assert "slo_burn_rate" in text and "fleet_routed" not in text
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/timeline?name=fleet.",
+                timeout=30) as resp:
+            t = json.loads(resp.read())
+        assert t["count"] >= 1 and len(t["frames"]) == t["count"]
+        assert all("fleet." in k
+                   for f in t["frames"] for k in f["series"])
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        fleet.close()

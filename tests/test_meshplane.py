@@ -238,7 +238,7 @@ def _v(schema, kind, **fields):
 
 
 def test_schema_v3_identity_stamps_validate():
-    assert SCHEMA_VERSION == 3
+    assert SCHEMA_VERSION == 4  # bumped by ISSUE 16; v3 stamps still valid
     for kind, fields in (
             ("counter", {"name": "c", "labels": {}, "value": 1}),
             ("event", {"name": "e", "data": {}}),

@@ -546,6 +546,31 @@ def test_flight_dump_rate_limit_and_counter_deltas(tmp_path):
     assert head["data"]["counters"]["some.counter"] == 7
 
 
+def test_flight_suppression_is_counted_per_trigger(tmp_path):
+    """ISSUE 16: rate-limited dumps are no longer silent — each
+    suppressed attempt increments ``suppressed_count`` and the
+    ``flight.suppressed_total{trigger=}`` counter, so the healthz
+    flight block and the SLO timeline can see dump pressure.  Forced
+    dumps (``slo_burn``) never suppress and never count."""
+    tel = Telemetry()
+    fr = FlightRecorder(telemetry=tel, dump_dir=str(tmp_path),
+                        min_dump_interval_s=60.0)
+    assert fr.dump("breaker_trip") is not None
+    assert fr.suppressed_count == 0
+    for _ in range(3):
+        assert fr.dump("breaker_trip") is None
+    assert fr.dump("load_shed_burst") is None
+    assert fr.suppressed_count == 4
+    assert tel.registry.counter_value(
+        "flight.suppressed_total", trigger="breaker_trip") == 3
+    assert tel.registry.counter_value(
+        "flight.suppressed_total", trigger="load_shed_burst") == 1
+    # a forced dump inside the interval still writes, still uncounted
+    assert fr.dump("slo_burn", force=True) is not None
+    assert fr.suppressed_count == 4
+    assert fr.dump_count == 2
+
+
 def test_flight_without_dir_records_but_writes_nothing(tmp_path):
     tel = Telemetry()
     fr = FlightRecorder(telemetry=tel)  # no dump_dir
@@ -670,7 +695,7 @@ def test_healthz_body_fields(tmp_path):
         assert h["factors"] == len(NAMES) and h["days"] == 8
         assert h["breaker_consecutive_failures"] == 0
         assert h["uptime_s"] >= 0 and h["queue_depth"] == 0
-        assert h["flight"] == {"requests": 0, "dumps": 0}
+        assert h["flight"] == {"requests": 0, "dumps": 0, "suppressed": 0}
         assert isinstance(h["hbm_available"], bool)
         assert h["stream_minute"] == 0
     finally:

@@ -772,3 +772,67 @@ def test_cli_check_r13_break_is_declared(tmp_path):
                               for g in r13_groups)
     assert any(g["metric"].endswith(".candidates_per_s")
                for g in r13_groups)
+
+
+# --------------------------------------------------------------------------
+# SLO burn sub-series (ISSUE 16)
+# --------------------------------------------------------------------------
+
+
+def _slo_block(available=True, frames=12, wbr=0.5):
+    return {"available": available, "frames": frames,
+            "worst_burn_rate": wbr, "alerts": 0, "objectives": {}}
+
+
+def test_derive_records_lifts_slo_burn_rate_series():
+    """A bench record with a sampled SLO plane grows a
+    ``<metric>.burn_rate_max`` sub-series under the SAME methodology —
+    an SLO-health regression gates like a latency one."""
+    rec = _serve_rec()
+    rec["slo"] = _slo_block(wbr=2.5)
+    (burn,) = [r for r in regress.derive_records(rec)
+               if r["metric"] == "serveN_qps.burn_rate_max"]
+    assert burn["value"] == 2.5 and burn["unit"] == "ratio"
+    assert burn["methodology"] == "r8_serve_v1"
+    assert burn["derived_from"] == "slo.worst_burn_rate"
+
+
+def test_unsampled_slo_never_seeds_burn_series():
+    """The other direction: missing/unavailable/zero-frame/malformed
+    ``slo`` blocks grow NO burn series — an unsampled run neither
+    seeds nor gates the SLO trajectory."""
+    for slo in (None, {}, "broken",
+                _slo_block(available=False),
+                _slo_block(frames=0),
+                _slo_block(frames="12"),
+                {"available": True, "frames": 12},        # no burn
+                _slo_block(wbr=True),                     # bool is not
+                _slo_block(wbr="2.5"),                    # a rate
+                _slo_block(wbr=-0.5)):                    # negative
+        rec = _serve_rec()
+        if slo is not None:
+            rec["slo"] = slo
+        metrics = [r["metric"] for r in regress.derive_records(rec)]
+        assert "serveN_qps.burn_rate_max" not in metrics, slo
+
+
+def test_burn_rate_series_gates_like_any_other(tmp_path):
+    """Steady QPS with a burn-rate spike flags on the derived group;
+    an in-band candidate stays quiet."""
+    for i, wbr in enumerate((0.5, 0.52)):
+        rec = _serve_rec()
+        rec["slo"] = _slo_block(wbr=wbr)
+        with open(tmp_path / f"BENCH_r{i + 1:02d}.json", "w") as fh:
+            json.dump({"n": i + 1, "parsed": rec}, fh)
+    entries = regress.load_bench_series(str(tmp_path))
+    assert "serveN_qps.burn_rate_max" in {
+        e["record"]["metric"] for e in entries}
+    quiet = _serve_rec()
+    quiet["slo"] = _slo_block(wbr=0.51)
+    assert regress.evaluate(entries, candidate=quiet)["ok"]
+    spike = _serve_rec()
+    spike["slo"] = _slo_block(wbr=5.0)
+    v = regress.evaluate(entries, candidate=spike)
+    assert not v["ok"]
+    flagged = [g for g in v["groups"] if g["flagged"]]
+    assert ["serveN_qps.burn_rate_max"] == [g["metric"] for g in flagged]

@@ -680,3 +680,80 @@ def test_stream_ingest_failure_bumps_breaker_and_sheds():
                                           stage="ingest") == 1
     finally:
         srv.close()
+
+
+# --------------------------------------------------------------------------
+# SLO plane surfaces (ISSUE 16)
+# --------------------------------------------------------------------------
+
+
+def test_http_slo_and_timeline_surfaces():
+    """``GET /v1/slo`` serves the burn-rate summary as JSON and the
+    ``slo_*``-only Prometheus view; ``GET /v1/timeline`` serves the
+    frame ring with name/since/limit filters and 400s a malformed
+    query."""
+    # cold CPU dispatches overrun the default 250 ms latency budget —
+    # lift it so the surface test reads a quiet plane
+    srv, tel = _server(stream=True, stream_batches=(2,),
+                       slo_latency_ms=10_000.0)
+    httpd = None
+    try:
+        srv.client().factors(0, 2)
+        srv.timeline.sample()  # bank a frame (and an SLO evaluation)
+        httpd, _t = serve_http(srv)
+        port = httpd.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/slo", timeout=30) as resp:
+            doc = json.loads(resp.read())
+        s = doc["slo"]
+        assert s["available"] and s["frames"] >= 1
+        # a streaming server declares all three serve objectives
+        assert {"availability", "latency",
+                "freshness"} <= set(s["objectives"])
+        assert s["alerts"] == 0 and doc["evaluation"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/slo?format=prometheus",
+                timeout=30) as resp:
+            text = resp.read().decode()
+        assert "slo_burn_rate" in text
+        assert "serve_requests" not in text  # the slo-only view
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}"
+                f"/v1/timeline?name=serve.requests&limit=5",
+                timeout=30) as resp:
+            t = json.loads(resp.read())
+        assert t["count"] >= 1 and len(t["frames"]) == t["count"]
+        assert all("serve.requests" in k
+                   for f in t["frames"] for k in f["series"])
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/timeline?since=yesterday",
+                timeout=30)
+        assert e.value.code == 400
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        srv.close()
+
+
+def test_healthz_reports_flight_and_staleness():
+    """ISSUE 16 satellites: the healthz flight block counts suppressed
+    dumps next to written ones, and a streaming server reports
+    wall-clock ``stream_staleness_s`` (None before the first ingest,
+    a number after)."""
+    srv, _ = _server(stream=True)
+    try:
+        h = srv.health()
+        assert h["flight"] == {"requests": 0, "dumps": 0,
+                               "suppressed": 0}
+        assert h["stream_staleness_s"] is None
+        srv.flight.dump("breaker_trip")
+        srv.flight.dump("breaker_trip")  # inside the 1 s rate limit
+        bars, present = _day_minutes(srv.source, 0, 2)
+        srv.ingest(bars, present).result(120)
+        h = srv.health()
+        assert h["flight"]["suppressed"] == 1
+        assert isinstance(h["stream_staleness_s"], float)
+        assert h["stream_staleness_s"] >= 0.0
+    finally:
+        srv.close()

@@ -1,0 +1,599 @@
+"""The SLO plane (ISSUE 16): the continuous telemetry timeline,
+multi-window burn-rate objectives, the ``slo_burn`` flight-dump
+correlation, schema-v4 ``frame``/``slo`` records (both directions),
+the pod timeline fold, and the offline incident replay CLI.
+
+Everything here is host-side by construction — no jax import: the
+sampler reads registry snapshots and host mirrors only, and the tests
+COUNTER-ASSERT that sampling moves no device-work counters.
+"""
+
+import json
+import os
+import time
+
+from replication_of_minute_frequency_factor_tpu.telemetry import (
+    FlightRecorder, MetricsRegistry, Telemetry, validate_record)
+from replication_of_minute_frequency_factor_tpu.telemetry.aggregate import (
+    aggregate_dirs, fold_timelines)
+from replication_of_minute_frequency_factor_tpu.telemetry.slo import (
+    BURN_WINDOWS, Objective, SloPlane, fleet_objectives,
+    serve_objectives, slo_prometheus)
+from replication_of_minute_frequency_factor_tpu.telemetry.timeline import (
+    TimelineStore, incident_report)
+from replication_of_minute_frequency_factor_tpu.telemetry.timeline import (
+    main as timeline_main)
+from replication_of_minute_frequency_factor_tpu.telemetry.validate import (
+    validate_dir, validate_dump)
+
+import pytest
+
+
+class _Clock:
+    """Controllable monotonic clock: burn windows become test time."""
+
+    def __init__(self, t=100.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+# --------------------------------------------------------------------------
+# timeline store
+# --------------------------------------------------------------------------
+
+
+def test_timeline_counter_rates_gauges_and_quantiles():
+    tel = Telemetry()
+    clk = _Clock()
+    tl = TimelineStore(telemetry=tel, clock=clk)
+    tel.counter("req", 5.0, kind="factors")
+    f0 = tl.sample()
+    # first frame has no prior interval: rates pin to 0
+    assert f0["interval_s"] == 0.0
+    assert f0["series"]["rate:req{kind=factors}"] == 0.0
+    clk.advance(2.0)
+    tel.counter("req", 10.0, kind="factors")
+    tel.gauge("depth", 7.0)
+    for v in (0.1, 0.2, 0.3):
+        tel.observe("lat", v)
+    f1 = tl.sample()
+    assert f1["interval_s"] == 2.0
+    assert f1["series"]["rate:req{kind=factors}"] == 5.0  # 10 over 2 s
+    assert f1["series"]["gauge:depth"] == 7.0
+    for q in ("p50", "p95", "p99"):
+        assert f"{q}:lat" in f1["series"]
+    # frames carry both clocks: the plane's monotone t and wall ts
+    assert f1["t"] == clk.t and f1["ts"] >= f0["ts"]
+    assert f1["seq"] == f0["seq"] + 1
+
+
+def test_timeline_counter_rate_never_negative():
+    """A registry swap/reset between samples must not print a negative
+    pod rate — rates clamp at 0."""
+    tel = Telemetry()
+    clk = _Clock()
+    tl = TimelineStore(telemetry=tel, clock=clk)
+    tel.counter("c", 10.0)
+    tl.sample()
+    clk.advance(1.0)
+    tl._last_counters["c"] = 100.0  # simulate a counter going backward
+    f = tl.sample()
+    assert f["series"]["rate:c"] == 0.0
+
+
+def test_timeline_sources_feed_gauge_series_and_never_kill():
+    tel = Telemetry()
+    tl = TimelineStore(telemetry=tel, clock=_Clock())
+
+    def good():
+        return {"stream.staleness_s": 1.25, "skipped": None}
+
+    def bad():
+        raise RuntimeError("a source must not kill the sampler")
+
+    tl.add_source(good)
+    tl.add_source(bad)
+    tl.add_source(good)  # re-registration is idempotent
+    f = tl.sample()
+    assert f["series"]["gauge:stream.staleness_s"] == 1.25
+    assert "gauge:skipped" not in f["series"]
+
+
+def test_timeline_query_filters_name_since_and_limit():
+    tel = Telemetry()
+    clk = _Clock()
+    tl = TimelineStore(telemetry=tel, clock=clk)
+    tel.counter("serve.requests", 1.0, kind="factors")
+    tel.gauge("other", 1.0)
+    for _ in range(4):
+        clk.advance(0.5)
+        tel.counter("serve.requests", 1.0, kind="factors")
+        tl.sample()
+        time.sleep(0.02)  # distinct wall-ms stamps for `since`
+    # substring filter matches the prefix-qualified labeled key
+    out = tl.query(name="serve.requests")
+    assert len(out) == 4
+    assert all(set(f["series"]) ==
+               {"rate:serve.requests{kind=factors}"} for f in out)
+    # since filters on the WALL clock (bundle correlation contract):
+    # ts >= since keeps the cut frame and everything after it
+    frames = tl.frames()
+    assert len(tl.query(since=frames[2]["ts"])) == 2
+    assert len(tl.query(limit=2)) == 2
+
+
+def test_timeline_ring_bound_keeps_seq_monotone():
+    tel = Telemetry()
+    clk = _Clock()
+    tl = TimelineStore(telemetry=tel, ring=4, clock=clk)
+    for _ in range(7):
+        clk.advance(0.1)
+        tl.sample()
+    frames = tl.frames()
+    assert len(tl) == 4
+    assert [f["seq"] for f in frames] == [4, 5, 6, 7]
+    assert tl.latest()["seq"] == 7
+
+
+def test_timeline_top_movers_ranks_by_normalized_delta():
+    tel = Telemetry()
+    clk = _Clock()
+    tl = TimelineStore(telemetry=tel, clock=clk)
+    for i in range(5):
+        tel.gauge("moving", float(i * 10))
+        tel.gauge("flat", 5.0)
+        tl.sample()
+        clk.advance(0.1)
+    top = tl.top_movers(window_s=10.0, k=2)
+    assert top and top[0]["series"] == "gauge:moving"
+    assert top[0]["delta"] == 40.0
+    flat = [r for r in top if r["series"] == "gauge:flat"]
+    assert all(r["delta"] == 0.0 for r in flat)
+
+
+def test_timeline_sampler_thread_start_stop_idempotent():
+    tel = Telemetry()
+    tl = TimelineStore(telemetry=tel)
+    tl.start(0.01)
+    tl.start(0.01)  # second start is a no-op, not a second thread
+    deadline = time.monotonic() + 5.0
+    while len(tl) < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(tl) >= 3
+    tl.stop()
+    n = len(tl)
+    time.sleep(0.05)
+    assert len(tl) == n  # joined, not leaked
+    tl.stop()  # idempotent
+
+
+def test_on_frame_callback_errors_are_swallowed():
+    tel = Telemetry()
+    tl = TimelineStore(telemetry=tel, clock=_Clock())
+    seen = []
+
+    def boom(frame):
+        raise RuntimeError("callback must not kill sampling")
+
+    tl.on_frame(boom)
+    tl.on_frame(seen.append)
+    tl.sample()
+    assert len(seen) == 1 and seen[0]["seq"] == 1
+
+
+def test_sampling_moves_no_counters():
+    """The tentpole's hot-path contract, counter-asserted: a sample
+    (and a no-alert SLO evaluation riding it) reads registry state and
+    publishes gauges — it must never increment ANY counter (the
+    device-work counters ``xla.compiles`` /
+    ``research.host_blocking_syncs`` included)."""
+    tel = Telemetry()
+    clk = _Clock()
+    tl = TimelineStore(telemetry=tel, clock=clk)
+    plane = SloPlane(telemetry=tel)
+    plane.configure(serve_objectives(streaming=True), timeline=tl,
+                    time_scale=3600.0, clock=clk)
+    tel.counter("xla.compiles", 3.0)
+    tel.counter("research.host_blocking_syncs", 2.0, point="g")
+    before = dict(tel.registry.snapshot()["counters"])
+    for _ in range(10):
+        clk.advance(0.05)
+        tl.sample()
+    assert dict(tel.registry.snapshot()["counters"]) == before
+
+
+# --------------------------------------------------------------------------
+# burn-rate objectives
+# --------------------------------------------------------------------------
+
+
+def _availability_plane(tmp_path, target=0.99):
+    tel = Telemetry()
+    clk = _Clock()
+    tl = TimelineStore(telemetry=tel, clock=clk)
+    fr = FlightRecorder(telemetry=tel, dump_dir=str(tmp_path))
+    plane = SloPlane(telemetry=tel)
+    plane.configure(
+        (Objective(name="availability", kind="availability",
+                   target=target, total_counter="serve.requests",
+                   bad_counter="serve.load_shed"),),
+        flight=fr, timeline=tl, time_scale=3600.0, clock=clk)
+    return tel, clk, tl, fr, plane
+
+
+def test_objective_validates_kind_and_target():
+    with pytest.raises(ValueError):
+        Objective(name="x", kind="vibes", target=0.5)
+    with pytest.raises(ValueError):
+        Objective(name="x", kind="latency", target=1.0)
+    assert len(serve_objectives(streaming=True)) == 3
+    assert len(serve_objectives(streaming=False)) == 2
+    assert len(fleet_objectives(streaming=True)) == 2
+
+
+def test_availability_burn_alert_fires_and_dumps(tmp_path):
+    """A sustained shed burst must fire (both fast windows over 14.4x)
+    exactly once, force a ``slo_burn`` flight dump naming the
+    objective, and count/retain the transition."""
+    tel, clk, tl, fr, plane = _availability_plane(tmp_path)
+    fr.record_request({"trace_id": "t-1", "op": "factors",
+                       "status": "ok", "total_s": 0.01})
+    for _ in range(30):                       # healthy history
+        tel.counter("serve.requests", 5.0, kind="factors")
+        clk.advance(0.05)
+        tl.sample()
+    assert plane.summary()["alerts"] == 0
+    for _ in range(30):                       # sustained pure shed
+        tel.counter("serve.load_shed", 5.0, reason="breaker")
+        clk.advance(0.05)
+        tl.sample()
+    s = plane.summary()
+    assert s["available"] and s["frames"] == 60
+    assert s["objectives"]["availability"]["alerts"] == 1  # one edge
+    assert s["objectives"]["availability"]["alerting"] is True
+    assert s["worst_burn_rate"] >= 14.4
+    assert int(tel.registry.counter_value(
+        "slo.alerts", objective="availability")) == 1
+    # the published scrape state
+    g = tel.registry.snapshot()["gauges"]
+    assert g["slo.alert{objective=availability}"] == 1.0
+    assert "slo.burn_rate{objective=availability,window=fast}" in g
+    assert "slo.error_budget_remaining{objective=availability}" in g
+    # the forced dump: validated, pre-correlated with the incident
+    dumps = [p for p in fr.dumps if "slo_burn" in p]
+    assert len(dumps) == 1
+    assert validate_dump(dumps[0])["ok"]
+    with open(dumps[0]) as fh:
+        recs = [json.loads(ln) for ln in fh if ln.strip()]
+    header = next(r for r in recs if r["kind"] == "dump")
+    extra = header["data"]["extra"]
+    assert extra["objective"] == "availability"
+    # the first edge may come from the slow pair (threshold 1x) while
+    # the fast pair is still filling — either way it is over budget
+    assert extra["event"] == "alert" and extra["burn_rate"] > 1.0
+    assert extra["top_moving"]  # the moved series ride the dump
+    assert any(r.get("kind") == "request"
+               and r.get("trace_id") == "t-1" for r in recs)
+    # retained as a schema-v4 slo event + per-objective verdict
+    recs = plane.slo_records()
+    assert any(r["data"].get("event") == "alert" for r in recs)
+    assert any(r["data"].get("event") == "verdict" for r in recs)
+
+
+def test_disjoint_counters_burn_on_pure_shed_window(tmp_path):
+    """``serve.requests`` counts only ADMITTED work (a shed raises
+    before it), so demand = total + bad: a window of nothing but sheds
+    must read error-rate 1, not 0/0 -> 0."""
+    tel, clk, tl, fr, plane = _availability_plane(tmp_path)
+    for _ in range(5):
+        tel.counter("serve.load_shed", 5.0, reason="breaker")
+        clk.advance(0.05)
+        tl.sample()
+    hist = list(plane._history["availability"])
+    err = plane._window_error_rate(plane.objectives[0], hist,
+                                   clk(), 10.0)
+    assert err == 1.0
+
+
+def test_transient_spike_stays_quiet(tmp_path):
+    """The multi-window pair's whole point: one bad frame amid heavy
+    good traffic saturates no pair (fast short alone is not an alert),
+    so nothing fires and no dump is written."""
+    tel, clk, tl, fr, plane = _availability_plane(tmp_path)
+    for i in range(40):
+        tel.counter("serve.requests", 20.0, kind="factors")
+        if i == 20:
+            tel.counter("serve.load_shed", 1.0, reason="breaker")
+        clk.advance(0.05)
+        tl.sample()
+    s = plane.summary()
+    assert s["alerts"] == 0
+    assert s["objectives"]["availability"]["alerting"] is False
+    assert not fr.dumps
+
+
+def test_latency_objective_burns_on_p99_over_threshold(tmp_path):
+    tel = Telemetry()
+    clk = _Clock()
+    tl = TimelineStore(telemetry=tel, clock=clk)
+    fr = FlightRecorder(telemetry=tel, dump_dir=str(tmp_path))
+    plane = SloPlane(telemetry=tel)
+    plane.configure(
+        (Objective(name="latency", kind="latency", target=0.99,
+                   latency_hist="serve.request_seconds",
+                   threshold_ms=250.0),),
+        flight=fr, timeline=tl, time_scale=3600.0, clock=clk)
+    for _ in range(40):                       # every frame over budget
+        tel.observe("serve.request_seconds", 0.5, kind="factors")
+        clk.advance(0.05)
+        tl.sample()
+    s = plane.summary()
+    assert s["objectives"]["latency"]["alerts"] >= 1
+    assert any("slo_burn" in p for p in fr.dumps)
+
+
+def test_freshness_objective_reads_source_gauge(tmp_path):
+    tel = Telemetry()
+    clk = _Clock()
+    tl = TimelineStore(telemetry=tel, clock=clk)
+    staleness = {"v": 0.5}
+    tl.add_source(lambda: {"stream.staleness_s": staleness["v"]})
+    plane = SloPlane(telemetry=tel)
+    plane.configure(
+        (Objective(name="freshness", kind="freshness", target=0.99,
+                   staleness_gauge="stream.staleness_s",
+                   threshold_s=120.0),),
+        timeline=tl, time_scale=3600.0, clock=clk)
+    for _ in range(10):                       # fresh: no burn
+        clk.advance(0.05)
+        tl.sample()
+    assert plane.summary()["worst_burn_rate"] == 0.0
+    staleness["v"] = 500.0                    # feed goes stale
+    for _ in range(30):
+        clk.advance(0.05)
+        tl.sample()
+    s = plane.summary()
+    assert s["objectives"]["freshness"]["alerts"] >= 1
+
+
+def test_slo_prometheus_renders_only_slo_metrics():
+    tel = Telemetry()
+    tel.gauge("slo.burn_rate", 2.5, objective="availability",
+              window="fast")
+    tel.counter("slo.alerts", 1.0, objective="availability")
+    tel.counter("serve.requests", 5.0, kind="factors")
+    text = slo_prometheus(tel.registry)
+    assert "slo_burn_rate" in text and "slo_alerts" in text
+    assert "serve_requests" not in text
+
+
+# --------------------------------------------------------------------------
+# schema v4: both directions
+# --------------------------------------------------------------------------
+
+
+def _v(schema, kind, **fields):
+    return {"schema": schema, "ts": 1.0, "kind": kind, **fields}
+
+
+def test_schema_v4_frame_and_slo_records_validate():
+    assert validate_record(_v(4, "frame", seq=1, interval_s=0.5,
+                              series={"rate:x": 2.0})) == []
+    assert validate_record(_v(4, "slo", name="availability",
+                              data={"event": "alert"})) == []
+    # identity stamps ride v4 records like every other kind
+    assert validate_record(_v(4, "frame", seq=1, interval_s=0.5,
+                              series={}, process_index=0,
+                              host="pod")) == []
+
+
+def test_v4_only_kinds_flag_on_older_records():
+    """The other direction: a record declaring ``schema <= 3`` cannot
+    carry the v4 kinds, and malformed v4 fields flag."""
+    for old in (1, 2, 3):
+        assert any("schema>=4" in p for p in validate_record(
+            _v(old, "frame", seq=1, interval_s=0.5, series={})))
+        assert any("schema>=4" in p for p in validate_record(
+            _v(old, "slo", name="x", data={})))
+    assert any("seq" in p for p in validate_record(
+        _v(4, "frame", seq="one", interval_s=0.5, series={})))
+    assert any("series" in p for p in validate_record(
+        _v(4, "frame", seq=1, interval_s=0.5, series=[1, 2])))
+    assert any("name" in p for p in validate_record(
+        _v(4, "slo", name=7, data={})))
+
+
+def test_bundle_persists_frames_and_slo_records(tmp_path):
+    """``Telemetry.write`` persists the ring as v4 ``frame`` records
+    carrying each frame's OWN wall ts (not write time) plus the SLO
+    events/verdicts, and the bundle re-validates."""
+    tel = Telemetry()
+    clk = _Clock()
+    tl = tel.timeline
+    tl.clock = clk
+    tel.sloplane.configure(serve_objectives(), timeline=tl,
+                           time_scale=3600.0, clock=clk)
+    tel.counter("serve.requests", 2.0, kind="factors")
+    tl.sample()
+    frame_ts = tl.latest()["ts"]
+    time.sleep(0.05)  # write time is measurably later
+    out = str(tmp_path / "bundle")
+    tel.write(out)
+    assert validate_dir(out)["ok"]
+    with open(os.path.join(out, "metrics.jsonl")) as fh:
+        recs = [json.loads(ln) for ln in fh if ln.strip()]
+    frames = [r for r in recs if r["kind"] == "frame"]
+    slo = [r for r in recs if r["kind"] == "slo"]
+    assert frames and frames[0]["schema"] == 4
+    assert frames[0]["ts"] == frame_ts
+    # one verdict per configured objective (no alerts fired)
+    assert {r["name"] for r in slo} == {"availability", "latency"}
+
+
+# --------------------------------------------------------------------------
+# pod timeline fold
+# --------------------------------------------------------------------------
+
+
+def _frame(seq, ts, series):
+    return {"seq": seq, "ts": ts, "interval_s": 0.5, "series": series}
+
+
+def test_fold_timelines_sums_rates_and_maxes_gauges():
+    a = [_frame(1, 10.0, {"rate:req": 5.0, "gauge:depth": 2.0,
+                          "p99:lat": 0.1})]
+    b = [_frame(1, 10.2, {"rate:req": 2.5, "gauge:depth": 7.0,
+                          "p99:lat": 0.4})]
+    pod = fold_timelines([a, b])
+    assert len(pod) == 1
+    s = pod[0]["series"]
+    assert s["rate:req"] == 7.5           # rates SUM exactly
+    assert s["gauge:depth"] == 7.0        # gauges fold MAX
+    assert s["p99:lat"] == 0.4            # quantiles fold MAX
+    assert pod[0]["ts"] == 10.2           # pod clock = latest host
+    # a seq only one host reached still folds (its own values)
+    pod2 = fold_timelines([a + [_frame(2, 11.0, {"rate:req": 1.0})], b])
+    assert len(pod2) == 2 and pod2[1]["series"]["rate:req"] == 1.0
+
+
+def test_aggregate_folds_replica_timelines_with_exact_rate_sums(
+        tmp_path):
+    """Two replica bundles with real sampled timelines -> one pod
+    bundle: the folded pod frames land stamped ``host="pod"``, every
+    pod rate series equals the per-host sum (re-verified by the
+    aggregator, asserted again here), and the pod bundle re-validates
+    at schema v4."""
+    dirs = []
+    rates = {}
+    for host, burst in (("r0", 6.0), ("r1", 10.0)):
+        tel = Telemetry()
+        clk = _Clock()
+        tl = tel.timeline
+        tl.clock = clk
+        tel.counter("serve.requests", 2.0, kind="factors")
+        tl.sample()
+        clk.advance(2.0)
+        tel.counter("serve.requests", burst, kind="factors")
+        tl.sample()
+        rates[host] = burst / 2.0
+        d = str(tmp_path / host)
+        tel.write(d, host=host,
+                  process_index=int(host[1]))
+        dirs.append(d)
+    out = str(tmp_path / "pod")
+    verdict = aggregate_dirs(dirs, out)
+    assert verdict["ok"]
+    t = verdict["timeline"]
+    assert t["pod_frames"] == 2 and t["per_host_frames"] == [2, 2]
+    assert t["rate_sums"]["checked"] > 0
+    assert t["rate_sums"]["mismatched"] == 0
+    assert validate_dir(out)["ok"]
+    with open(os.path.join(out, "metrics.jsonl")) as fh:
+        recs = [json.loads(ln) for ln in fh if ln.strip()]
+    pod_frames = [r for r in recs
+                  if r["kind"] == "frame" and r.get("host") == "pod"]
+    assert len(pod_frames) == 2
+    key = "rate:serve.requests{kind=factors}"
+    assert pod_frames[1]["series"][key] == sum(rates.values())
+    # per-host frames are re-emitted with identity, distinct from the
+    # fold
+    per_host = [r for r in recs
+                if r["kind"] == "frame" and r.get("host") in rates]
+    assert len(per_host) == 4
+
+
+# --------------------------------------------------------------------------
+# incident replay (the CLI)
+# --------------------------------------------------------------------------
+
+
+def _write_incident_bundle(root, with_incident=True):
+    """A hand-built bundle: frames spanning an alert window, request
+    records sharing trace IDs with the dump, one slo alert event."""
+    os.makedirs(root, exist_ok=True)
+    t1 = 1000.0
+    lines = []
+    for i in range(5):
+        lines.append({"schema": 4, "ts": t1 - 0.8 + i * 0.2,
+                      "kind": "frame", "seq": i + 1, "interval_s": 0.2,
+                      "series": {"rate:serve.load_shed{reason=breaker}":
+                                 float(i * 10)}})
+    lines.append({"schema": 4, "ts": t1, "kind": "slo",
+                  "name": "availability",
+                  "data": {"event": "alert", "burn_rate": 99.0}})
+    for tid in ("tr-a", "tr-b"):
+        lines.append({"schema": 4, "ts": t1 - 0.5, "kind": "request",
+                      "trace_id": tid, "op": "factors", "status": "ok",
+                      "data": {"total_s": 0.01}})
+    with open(os.path.join(root, "metrics.jsonl"), "w") as fh:
+        for rec in lines:
+            fh.write(json.dumps(rec) + "\n")
+    if not with_incident:
+        return
+    dump = [{"schema": 4, "ts": t1, "kind": "dump",
+             "trigger": "slo_burn",
+             "data": {"requests": 2,
+                      "extra": {"event": "alert",
+                                "objective": "availability",
+                                "burn_rate": 99.0, "window": "fast",
+                                "window_s": 1.0,
+                                "top_moving": [{"series": "x"}]}}},
+            {"schema": 4, "ts": t1 - 0.5, "kind": "request",
+             "trace_id": "tr-a", "op": "factors", "status": "ok",
+             "data": {"total_s": 0.01}},
+            {"schema": 4, "ts": t1 - 0.4, "kind": "request",
+             "trace_id": "tr-missing", "op": "factors",
+             "status": "error", "data": {"total_s": 0.02}}]
+    with open(os.path.join(root, "flight_1_1_slo_burn.jsonl"),
+              "w") as fh:
+        for rec in dump:
+            fh.write(json.dumps(rec) + "\n")
+
+
+def test_incident_report_reconstructs_burn_offline(tmp_path):
+    root = str(tmp_path / "bundle")
+    _write_incident_bundle(root)
+    rep = incident_report(root)
+    assert rep["ok"] and rep["frames"] == 5 and rep["slo_events"] == 1
+    assert len(rep["incidents"]) == 1
+    inc = rep["incidents"][0]
+    assert inc["objective"] == "availability"
+    assert inc["trigger"] == "slo_burn" and inc["window_s"] == 1.0
+    # frames inside [t1 - window_s, t1] (with edge slack) all land
+    assert inc["frames_in_window"] == 5
+    assert inc["frame_diff"][0]["series"] \
+        == "rate:serve.load_shed{reason=breaker}"
+    assert inc["frame_diff"][0]["delta"] == 40.0
+    # trace IDs cross-link dump <-> bundle request records; the dump's
+    # unmatched trace stays unlinked (counted, not invented)
+    assert inc["requests"] == {"in_dump": 2, "linked": 1,
+                               "trace_ids": ["tr-a"]}
+    assert inc["slo_events"] == 1
+
+
+def test_timeline_cli_verdict_and_exit_codes(tmp_path, capsys):
+    root = str(tmp_path / "bundle")
+    _write_incident_bundle(root)
+    rc = timeline_main([root, "--require-incident",
+                        "--out", str(tmp_path / "report.json")])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    rep = json.loads(out)
+    assert rc == 0 and rep["ok"] and len(rep["incidents"]) == 1
+    with open(str(tmp_path / "report.json")) as fh:
+        assert json.load(fh)["incidents"]
+    # no incident + --require-incident -> exit 1 (the smoke-harness
+    # mode); without the flag the empty report is still exit 0
+    quiet = str(tmp_path / "quiet")
+    _write_incident_bundle(quiet, with_incident=False)
+    assert timeline_main([quiet, "--require-incident"]) == 1
+    assert timeline_main([quiet]) == 0
+    capsys.readouterr()
+    # unreadable bundle -> exit 2 with a machine-readable error line
+    rc = timeline_main([str(tmp_path / "missing")])
+    err = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 2 and err["ok"] is False

@@ -17,6 +17,8 @@ Every test here runs under ``jax.transfer_guard("disallow")``
 explicit ``device_put``/``device_get``.
 """
 
+import time
+
 import jax
 import numpy as np
 import pytest
@@ -325,3 +327,22 @@ def test_warm_engine_ingest_compiles_nothing():
     eng.advance()
     eng.snapshot()
     assert int(reg.counter_total("xla.compiles") - before) == 0
+
+
+def test_staleness_none_until_first_ingest_then_counts_up():
+    """ISSUE 16 satellite: ``staleness_s`` is the wall-clock freshness
+    signal healthz / the fleet rollup / the SLO timeline sampler read.
+    A just-opened engine is unfed, not stale (None); after an applied
+    ingest it counts up from ~0 and a later ingest resets it."""
+    T = 6
+    eng = StreamEngine(T, names=_FAMILY_NAMES[:1])
+    assert eng.staleness_s() is None
+    bars, mask = _day(tickers=T)
+    _feed(eng, bars, mask, 0, 2)
+    s1 = eng.staleness_s()
+    assert s1 is not None and 0.0 <= s1 < 60.0
+    time.sleep(0.05)
+    s2 = eng.staleness_s()
+    assert s2 > s1
+    _feed(eng, bars, mask, 2, 4)
+    assert eng.staleness_s() < s2

@@ -442,7 +442,8 @@ def test_fleet_carry_requires_multiplied_pod(tpu_session):
     hbm block, and the zero-mismatch pod counter fold. A one-replica
     record (single-chip window), a watermark-less record, or a fold
     mismatch must re-run."""
-    def entry(hbm=True, pod=True, mismatched=0, **top):
+    def entry(hbm=True, pod=True, mismatched=0, slo=True, frames=12,
+              **top):
         rec = {"metric": "fleet58_1024tickers_qps", "value": 900.0,
                "methodology": "r11_fleet_v1", "live_replicas": 2}
         rec.update(top)
@@ -452,6 +453,9 @@ def test_fleet_carry_requires_multiplied_pod(tpu_session):
             rec["pod"] = {"counter_totals": {"checked": 40,
                                              "mismatched": mismatched},
                           "affinity_hits": 120}
+        if slo:
+            rec["slo"] = {"available": True, "frames": frames,
+                          "worst_burn_rate": 0.2, "alerts": 0}
         return {"fleet": {"ok": True, "results": [rec]}}
 
     good = entry()
@@ -461,14 +465,25 @@ def test_fleet_carry_requires_multiplied_pod(tpu_session):
     assert tpu_session.drop_conv_only_rolling(entry(hbm=False)) == {}
     assert tpu_session.drop_conv_only_rolling(entry(pod=False)) == {}
     assert tpu_session.drop_conv_only_rolling(entry(mismatched=3)) == {}
+    # ISSUE 16: a pre-ISSUE-16 entry (no slo block) or one whose SLO
+    # plane never sampled re-runs under the new contract
+    assert tpu_session.drop_conv_only_rolling(entry(slo=False)) == {}
+    assert tpu_session.drop_conv_only_rolling(entry(frames=0)) == {}
     wrong_series = entry()
     wrong_series["fleet"]["results"][0]["methodology"] = "r8_serve_v1"
     assert tpu_session.drop_conv_only_rolling(wrong_series) == {}
-    # the serve step's own carry rule is untouched by the fleet rule
-    serve = {"serve": {"ok": True, "results": [
-        {"methodology": "r8_serve_v1",
-         "hbm": {"available": True}, "serve": {"cache_hits": 5}}]}}
+    # the serve carry rule shares the slo requirement (and is otherwise
+    # untouched by the fleet rule)
+    serve_rec = {"methodology": "r8_serve_v1",
+                 "hbm": {"available": True}, "serve": {"cache_hits": 5},
+                 "slo": {"available": True, "frames": 3,
+                         "worst_burn_rate": 0.0}}
+    serve = {"serve": {"ok": True, "results": [dict(serve_rec)]}}
     assert tpu_session.drop_conv_only_rolling(serve) == serve
+    unsampled = dict(serve_rec)
+    del unsampled["slo"]
+    assert tpu_session.drop_conv_only_rolling(
+        {"serve": {"ok": True, "results": [unsampled]}}) == {}
 
 
 def test_fleet_step_refuses_single_replica(tpu_session, monkeypatch):
@@ -494,9 +509,20 @@ def test_fleet_step_refuses_single_replica(tpu_session, monkeypatch):
              "methodology": "r11_fleet_v1", "live_replicas": 2,
              "hbm": {"available": True},
              "pod": {"counter_totals": {"checked": 10,
-                                        "mismatched": 0}}}]}
+                                        "mismatched": 0}},
+             "slo": {"available": True, "frames": 7,
+                     "worst_burn_rate": 0.1, "alerts": 0}}]}
     monkeypatch.setattr(tpu_session, "_run_json_lines", fake_good)
     assert tpu_session.step_fleet()["ok"] is True
+
+    # ISSUE 16: a record whose pod SLO plane never sampled cannot bank
+    def fake_unsampled(cmd, timeout, env=None):
+        rec = fake_good(cmd, timeout, env)["results"][0]
+        rec = dict(rec, slo={"available": True, "frames": 0})
+        return {"ok": True, "rc": 0, "results": [rec]}
+    monkeypatch.setattr(tpu_session, "_run_json_lines", fake_unsampled)
+    r = tpu_session.step_fleet()
+    assert r["ok"] is False and "slo" in r["error"]
 
     def fake_cpu(cmd, timeout, env=None):
         return {"ok": True, "rc": 0, "results": [
