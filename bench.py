@@ -1909,10 +1909,18 @@ STREAM_TICKERS = int(os.environ.get("BENCH_STREAM_TICKERS", "1024"))
 #: independently of the universe size (1024 tickers at K=1 would
 #: otherwise be 245k dispatches per streamed day)
 STREAM_UPDATES = int(os.environ.get("BENCH_STREAM_UPDATES", "960"))
+#: snapshot-per-bar profile (ISSUE 18): any non-empty/non-"0" value
+#: flips ``python bench.py stream`` from the ingest-load record to the
+#: per-bar finalize profile (``r14_stream_snapshot_v1``); the literal
+#: values "exact"/"fast" additionally force that finalize impl,
+#: anything else profiles the configured one (MFF_FINALIZE_IMPL)
+STREAM_SNAPSHOT_PER_BAR = os.environ.get("BENCH_STREAM_SNAPSHOT_PER_BAR",
+                                         "")
+SNAPSHOT_TICKERS = int(os.environ.get("BENCH_SNAPSHOT_TICKERS", "64"))
 
 
 def stream_bench(cohorts=None, tickers=None, updates=None, names=None,
-                 telemetry=None):
+                 telemetry=None, finalize_impl=None):
     """Ingest-load the online intraday engine (stream/) and return the
     ``r9_stream_intraday_v1`` record: bars/sec + per-update p50/p99
     latency at each cohort ingest shape, the streaming counters, and
@@ -1932,6 +1940,13 @@ def stream_bench(cohorts=None, tickers=None, updates=None, names=None,
       load   — per cohort size K: minute-by-minute cohort ingest
                (K tickers per dispatch, cursor advance at each minute
                boundary), per-update wall collected host-side.
+
+    ``finalize_impl`` threads to the engine (ISSUE 18; None adopts
+    ``Config.finalize_impl``) and the record stamps the RESOLVED
+    choice. Under a resolved 'fast' the parity phase swaps the bitwise
+    gate for ``stream.fastpath.parity_report``'s three-class verdict:
+    exact_fold/batch_only stay bitwise, stat_fold factors check
+    against their pinned docs/PIN_BOUNDS.md envelopes.
     """
     from replication_of_minute_frequency_factor_tpu.models.registry import (
         compute_factors_jit, factor_names as _fnames)
@@ -1958,7 +1973,8 @@ def stream_bench(cohorts=None, tickers=None, updates=None, names=None,
     bars4, mask4 = make_batch(rng, n_days=1, n_tickers=tickers)
     day_bars, day_mask = bars4[0], mask4[0]     # [T, 240, 5], [T, 240]
 
-    engine = StreamEngine(tickers, names=names, telemetry=tel)
+    engine = StreamEngine(tickers, names=names, telemetry=tel,
+                          finalize_impl=finalize_impl)
     # SLO plane (ISSUE 16): ingest-freshness objective sampled on the
     # timeline cadence while the bench runs — registry snapshots and
     # the engine's host-side ingest stamp only, never a device read
@@ -1993,7 +2009,20 @@ def stream_bench(cohorts=None, tickers=None, updates=None, names=None,
     streamed = np.asarray(streamed)
     want = compute_factors_jit(day_bars, day_mask, names=names)
     mismatched = []
-    for j, n in enumerate(names):
+    if engine.finalize_impl_resolved == "fast":
+        # three-class verdict (ISSUE 18): exact_fold/batch_only
+        # bitwise, stat_fold within its pinned envelope — the bench
+        # twin of the tier-1 fast-parity gate
+        from replication_of_minute_frequency_factor_tpu.stream import (
+            fastpath)
+        for j, n in enumerate(names):
+            if not fastpath.parity_report(
+                    n, np.asarray(want[n]), streamed[j])["ok"]:
+                mismatched.append(n)
+        names_checked = ()
+    else:
+        names_checked = names
+    for j, n in enumerate(names_checked):
         a, b = np.asarray(want[n]), streamed[j]
         if np.array_equal(a, b, equal_nan=True):
             continue
@@ -2091,6 +2120,9 @@ def stream_bench(cohorts=None, tickers=None, updates=None, names=None,
         "session": SESSION,
         "factors": len(names),
         "cohorts": list(cohorts),
+        # RESOLVED snapshot finalize impl (ISSUE 18): 'fast' only when
+        # requested AND a foldable kernel is served
+        "finalize_impl": engine.finalize_impl_resolved,
         # DECLARED series (telemetry/regress.py): per-bar intraday
         # ingest is a new workload — its records start their own
         # baseline
@@ -2117,29 +2149,223 @@ def stream_bench(cohorts=None, tickers=None, updates=None, names=None,
     }
 
 
+def stream_snapshot_bench(tickers=None, names=None, finalize_impl=None,
+                          telemetry=None):
+    """Snapshot-PER-BAR finalize profile (ISSUE 18): one warm
+    ``snapshot()`` after every ingested minute of a seeded day, per-bar
+    finalize latency collected host-side. Returns the
+    ``r14_stream_snapshot_v1`` record: per-bar p50/p99 plus the
+    last-quartile-of-day vs first-quartile-of-day flatness ratios —
+    the exact finalize's O(prefix) batch graph grows through the day
+    (ratio >> 1), the fast path's sufficient-statistic
+    materialization must stay flat (the acceptance pin is p50 ratio
+    <= 1.25 on the CPU instrument).
+
+    The metric name embeds the RESOLVED finalize impl, so exact and
+    fast profiles bank as separate series under the one DECLARED
+    methodology; ``snapshot.available`` is true only for a warm run
+    (zero compiles while profiling) with enough bars to quartile —
+    regress's ``<metric>.snapshot_p99_flat_ratio`` sub-series gates on
+    it (telemetry/regress.py).
+    """
+    from replication_of_minute_frequency_factor_tpu.models.registry import (
+        factor_names as _fnames)
+    from replication_of_minute_frequency_factor_tpu.stream.engine import (
+        StreamEngine)
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        Telemetry, set_telemetry)
+
+    tickers = tickers or SNAPSHOT_TICKERS
+    if names is None:
+        factors_env = os.environ.get("BENCH_FACTORS")
+        names = (tuple(s.strip() for s in factors_env.split(",")
+                       if s.strip()) if factors_env else _fnames())
+    names = tuple(names)
+    tel = telemetry if telemetry is not None else set_telemetry(Telemetry())
+    reg = tel.registry
+    stages = {}
+
+    rng = np.random.default_rng(9)
+    bars4, mask4 = make_batch(rng, n_days=1, n_tickers=tickers)
+    day_bars, day_mask = bars4[0], mask4[0]     # [T, S, 5], [T, S]
+    n_slots = day_mask.shape[1]
+
+    engine = StreamEngine(tickers, names=names, telemetry=tel,
+                          finalize_impl=finalize_impl)
+    impl = engine.finalize_impl_resolved
+    t0 = time.perf_counter()
+    engine.warmup(micro_batches=(1,))
+    stages["warm_s"] = round(time.perf_counter() - t0, 3)
+
+    compiles_before = reg.counter_total("xla.compiles")
+    lat = np.empty(n_slots)
+    t0 = time.perf_counter()
+    for t in range(n_slots):
+        engine.ingest_minutes(
+            np.ascontiguousarray(day_bars[:, t:t + 1].swapaxes(0, 1)),
+            np.ascontiguousarray(day_mask[:, t:t + 1].T))
+        t_s = time.perf_counter()
+        exp, _ready = engine.snapshot()
+        np.asarray(exp)             # block: the latency IS the finalize
+        lat[t] = time.perf_counter() - t_s
+    stages["profile_s"] = round(time.perf_counter() - t0, 3)
+    compiles_during = int(reg.counter_total("xla.compiles")
+                          - compiles_before)
+
+    q = n_slots // 4
+    first, last = lat[:q], lat[n_slots - q:]
+
+    def _ms(a, p):
+        return round(float(np.percentile(a, p)) * 1e3, 4)
+
+    def _ratio(p):
+        lo = float(np.percentile(first, p))
+        return round(float(np.percentile(last, p)) / lo, 4) if lo > 0 \
+            else None
+
+    snapshot_block = {
+        "bars": n_slots,
+        "p50_ms": _ms(lat, 50), "p99_ms": _ms(lat, 99),
+        "first_quartile_p50_ms": _ms(first, 50),
+        "last_quartile_p50_ms": _ms(last, 50),
+        # flat-finalize evidence: last-quartile-of-day latency over
+        # first-quartile-of-day, per percentile. ~1.0 = per-snapshot
+        # work independent of the bar cursor; the exact impl's
+        # O(prefix) growth shows up here long before it IS the wall
+        "p50_flat_ratio": _ratio(50),
+        "p99_flat_ratio": _ratio(99),
+        "compiles_during_profile": compiles_during,
+        # gates the derived regress sub-series: only a WARM profile
+        # with enough bars to quartile measures finalize flatness (a
+        # compiling run measures XLA)
+        "available": compiles_during == 0 and q >= 4,
+    }
+    return {
+        "metric": f"stream_snapshot{len(names)}_{tickers}tickers_"
+                  f"{impl}_p50_ms" + _SUFFIX,
+        "value": snapshot_block["p50_ms"],
+        "unit": "ms",
+        "tickers": tickers,
+        "session": SESSION,
+        "factors": len(names),
+        "finalize_impl": impl,
+        # DECLARED series (telemetry/regress.py): per-bar finalize
+        # profiling is a new instrument — its records start their own
+        # baseline, split per resolved impl by the metric name
+        "methodology": "r14_stream_snapshot_v1",
+        "p50_ms": snapshot_block["p50_ms"],
+        "p99_ms": snapshot_block["p99_ms"],
+        "snapshot": snapshot_block,
+        "stream": {
+            "snapshots": int(reg.counter_total(
+                "stream.finalize_snapshots")),
+            "fold_factors": int(reg.gauge_value(
+                "stream.finalize_fold_factors")),
+            "residual_factors": int(reg.gauge_value(
+                "stream.finalize_residual_factors")),
+        },
+        "hbm": tel.hbm.summary(),
+        "stages": stages,
+    }
+
+
+def _fast_fold_mix_bit_identity(tickers=16, minutes=24, k=8):
+    """The fast path's statistic fold must be ingest-shape-blind
+    (ISSUE 18): the same minutes fed (a) wholesale through the scan
+    path and (b) as a cohort-scatter/advance + single-minute-scan MIX
+    must land bit-identical statistic leaves AND a bit-identical fast
+    snapshot — cohort and scan route through the one shared
+    ``ops.incremental._fold_stats`` arithmetic, so any divergence is
+    a real fold bug, not float noise."""
+    from replication_of_minute_frequency_factor_tpu.stream.engine import (
+        StreamEngine)
+
+    names = ("vol_return1min", "mmt_am", "liq_openvol")
+    rng = np.random.default_rng(5)
+    bars4, mask4 = make_batch(rng, n_days=1, n_tickers=tickers)
+    day_bars, day_mask = bars4[0], mask4[0]
+    eng_scan = StreamEngine(tickers, names=names, finalize_impl="fast")
+    eng_mix = StreamEngine(tickers, names=names, finalize_impl="fast")
+    eng_scan.ingest_minutes(
+        np.ascontiguousarray(day_bars[:, :minutes].swapaxes(0, 1)),
+        np.ascontiguousarray(day_mask[:, :minutes].T))
+    for t in range(minutes):
+        if t % 2 == 0:      # cohort scatter in K-row slices + advance
+            for c0 in range(0, tickers, k):
+                sel = np.arange(c0, min(c0 + k, tickers))
+                idx = np.where(day_mask[sel, t], sel,
+                               tickers).astype(np.int32)
+                eng_mix.ingest_cohort(
+                    np.ascontiguousarray(day_bars[sel, t]), idx)
+            eng_mix.advance()
+        else:               # whole minute through the scan path
+            eng_mix.ingest_minutes(
+                np.ascontiguousarray(
+                    day_bars[:, t:t + 1].swapaxes(0, 1)),
+                np.ascontiguousarray(day_mask[:, t:t + 1].T))
+    leaves_differ = sorted(
+        key for key in eng_scan.carry["inc"]
+        if not np.array_equal(np.asarray(eng_scan.carry["inc"][key]),
+                              np.asarray(eng_mix.carry["inc"][key]),
+                              equal_nan=True))
+    snap_a = np.asarray(eng_scan.snapshot()[0])
+    snap_b = np.asarray(eng_mix.snapshot()[0])
+    return {
+        "leaves_differ": leaves_differ,
+        "snapshot_bitwise": bool(
+            np.array_equal(snap_a, snap_b, equal_nan=True)),
+    }
+
+
 def stream_smoke():
     """run_tests.sh --quick smoke (and the CPU acceptance demo): a tiny
-    stream_bench on CPU. ``ok`` iff the acceptance signals hold — zero
-    compiles after warmup (warm executables across every ingest shape)
-    and streamed-vs-full-day parity on the seeded day (the full-58
-    sweep lives in tier-1 tests/test_stream.py; this drives the same
-    restricted family set as the serve smoke)."""
-    record = stream_bench(cohorts=(1, 8), tickers=32, updates=96,
-                          names=("vol_return1min", "mmt_am",
-                                 "liq_openvol"))
-    s = record["stream"]
+    stream_bench on CPU, run under BOTH finalize impls (ISSUE 18).
+    ``ok`` iff the acceptance signals hold for each — zero compiles
+    after warmup (warm executables across every ingest shape) and
+    streamed-vs-full-day parity on the seeded day (bitwise for exact;
+    the three-class verdict for fast) — and the fast path's statistic
+    fold survives a cohort<->scan ingest mix bit-identically (the
+    full-58 sweep lives in tier-1 tests/test_stream.py; this drives
+    the same restricted family set as the serve smoke)."""
+    impls = {}
+    for impl in ("exact", "fast"):
+        record = stream_bench(cohorts=(1, 8), tickers=32, updates=96,
+                              names=("vol_return1min", "mmt_am",
+                                     "liq_openvol"),
+                              finalize_impl=impl)
+        s = record["stream"]
+        impls[impl] = {
+            "finalize_impl_resolved": record["finalize_impl"],
+            "compiles_during_load": s["compiles_during_load"],
+            "parity_mismatched": s["parity_mismatched"],
+            "updates": s["updates"],
+            "bars": s["bars"],
+            "p50_ms": record["p50_ms"], "p99_ms": record["p99_ms"],
+            "bars_per_s": record["value"],
+        }
+    mix = _fast_fold_mix_bit_identity()
+    record_ok = all(v["compiles_during_load"] == 0
+                    and v["parity_mismatched"] == []
+                    and v["updates"] > 0 and v["bars"] > 0
+                    for v in impls.values())
     return {
         "smoke": "stream",
-        "compiles_during_load": s["compiles_during_load"],
-        "parity_mismatched": s["parity_mismatched"],
-        "updates": s["updates"],
-        "bars": s["bars"],
-        "p50_ms": record["p50_ms"], "p99_ms": record["p99_ms"],
-        "bars_per_s": record["value"],
-        "methodology": record["methodology"],
-        "ok": (s["compiles_during_load"] == 0
-               and s["parity_mismatched"] == []
-               and s["updates"] > 0 and s["bars"] > 0),
+        "impls": impls,
+        "fast_fold_mix": mix,
+        # back-compat top-level fields read the exact run (the
+        # pre-ISSUE-18 smoke shape)
+        "compiles_during_load": impls["exact"]["compiles_during_load"],
+        "parity_mismatched": impls["exact"]["parity_mismatched"],
+        "updates": impls["exact"]["updates"],
+        "bars": impls["exact"]["bars"],
+        "p50_ms": impls["exact"]["p50_ms"],
+        "p99_ms": impls["exact"]["p99_ms"],
+        "bars_per_s": impls["exact"]["bars_per_s"],
+        "methodology": "r9_stream_intraday_v1",
+        "ok": (record_ok
+               and impls["fast"]["finalize_impl_resolved"] == "fast"
+               and mix["leaves_differ"] == []
+               and mix["snapshot_bitwise"]),
     }
 
 
@@ -2175,7 +2401,18 @@ def stream_main():
     from replication_of_minute_frequency_factor_tpu.telemetry import (
         Telemetry, set_telemetry, get_telemetry)
     set_telemetry(Telemetry())
-    record = stream_bench(telemetry=get_telemetry())
+    if STREAM_SNAPSHOT_PER_BAR and STREAM_SNAPSHOT_PER_BAR != "0":
+        # snapshot-per-bar finalize profile (ISSUE 18): the run's ONE
+        # banked record becomes the r14 flatness profile; a literal
+        # "exact"/"fast" env value forces that impl, anything else
+        # adopts Config.finalize_impl
+        impl = (STREAM_SNAPSHOT_PER_BAR
+                if STREAM_SNAPSHOT_PER_BAR in ("exact", "fast")
+                else None)
+        record = stream_snapshot_bench(finalize_impl=impl,
+                                       telemetry=get_telemetry())
+    else:
+        record = stream_bench(telemetry=get_telemetry())
     print(json.dumps(record))
     tdir = os.environ.get("BENCH_TELEMETRY_DIR")
     if tdir:

@@ -159,7 +159,13 @@ def drop_conv_only_rolling(steps):
     * 'stream_intraday' entries must be r9 records that actually
       streamed warm and faithfully: ``r9_stream_intraday_v1`` with
       ``stream.updates > 0``, zero compiles during load and an empty
-      parity-mismatch list (ISSUE 7);
+      parity-mismatch list (ISSUE 7); since ISSUE 18 the window must
+      ALSO carry the fast-finalize A/B leg — an r9 record genuinely
+      RESOLVED to ``finalize_impl='fast'`` with a green three-class
+      parity verdict plus the r14 snapshot-per-bar profile whose
+      histogram is present and available (a fast number without its
+      flatness evidence, or a fast request that silently degraded to
+      exact, cannot bank);
     * since ISSUE 8 both serve and stream records must embed the HBM
       watermark block (``hbm`` with the explicit ``available``
       marker) — carried records feed the ``<metric>.hbm_peak_bytes``
@@ -229,12 +235,18 @@ def drop_conv_only_rolling(steps):
             # serving; it re-runs
             return any(_serve_record_banks(r) for r in recs)
         if name == "stream_intraday":
-            # ISSUE 7: zero streamed updates means the ingest loop
+            # ISSUE 7 + 18: zero streamed updates means the ingest loop
             # never dispatched (measured nothing), a load-phase compile
             # means the executables were not warm, and a non-empty
             # parity list means the streamed fold diverged on hardware
-            # — none of those may bank
-            return any(_stream_record_banks(r) for r in recs)
+            # — none of those may bank. Since the step became an
+            # exact/fast A/B (ISSUE 18), the window must ALSO carry a
+            # bankable fast leg: an r9 record genuinely RESOLVED to
+            # 'fast' with a green verdict plus the available r14
+            # per-bar histogram — a step that silently lost its fast
+            # leg (degraded impl, cold profile) re-runs
+            return (any(_stream_record_banks(r) for r in recs)
+                    and _stream_fast_record_banks(recs))
         if name == "fleet":
             # ISSUE 11: fewer than 2 live replicas means the pod never
             # multiplied (one replica IS the serve step), and a record
@@ -491,25 +503,49 @@ def step_stream_intraday():
     stream`` ingest-loads the streaming carry at the declared cohort
     shapes (1/8/64 tickers per update) and banks bars/sec + per-update
     p50/p99 under ``r9_stream_intraday_v1``, with the on-hardware
-    streamed-vs-full-day parity verdict riding the record. The carry
-    rule (:func:`_stream_record_banks`) rejects records with zero
-    streamed updates, any load-phase compile, or a parity mismatch."""
-    r = _run_json_lines(
-        [sys.executable, "bench.py", "stream"], timeout=1800,
-        env=dict(os.environ, BENCH_REQUIRE_TPU="1"))
-    if r.get("ok"):
-        recs = [rec for rec in r.get("results") or []
-                if isinstance(rec, dict)]
-        if any("_cpu_fallback" in str(rec.get("metric", ""))
-               for rec in recs):
-            r["ok"] = False
-            r["error"] = "stream bench printed a CPU-fallback metric"
-        elif not any(_stream_record_banks(rec) for rec in recs):
-            r["ok"] = False
-            r["error"] = ("no r9_stream_intraday_v1 record with "
-                          "updates > 0, zero load compiles and clean "
-                          "parity — cannot bank")
-    return r
+    streamed-vs-full-day parity verdict riding the record. Since ISSUE
+    18 the step is an exact/fast A/B at the SAME hardware window: the
+    r9 load runs once under each ``finalize_impl`` (MFF_FINALIZE_IMPL)
+    plus the r14 snapshot-per-bar profile under the fast impl — the
+    per-bar histogram whose flatness IS the O(1)-finalize evidence on
+    the chip. The carry rule (:func:`_stream_record_banks` +
+    :func:`_stream_fast_record_banks`) rejects windows with zero
+    streamed updates, any load-phase compile, a parity mismatch, a
+    fast leg that silently resolved to exact, or a missing/cold
+    per-bar histogram."""
+    merged = {"ok": True, "rc": 0, "seconds": 0.0, "results": []}
+    for leg, env_extra in (
+            ("exact", {"MFF_FINALIZE_IMPL": "exact"}),
+            ("fast", {"MFF_FINALIZE_IMPL": "fast"}),
+            ("fast_profile", {"BENCH_STREAM_SNAPSHOT_PER_BAR": "fast"})):
+        r = _run_json_lines(
+            [sys.executable, "bench.py", "stream"], timeout=1800,
+            env=dict(os.environ, BENCH_REQUIRE_TPU="1", **env_extra))
+        merged["rc"] = r.get("rc", merged["rc"])
+        merged["seconds"] = round(
+            merged["seconds"] + (r.get("seconds") or 0.0), 1)
+        merged["results"].extend(r.get("results") or [])
+        if not r.get("ok"):
+            merged["ok"] = False
+            merged["error"] = f"stream {leg} leg failed"
+            return merged
+    recs = [rec for rec in merged["results"] if isinstance(rec, dict)]
+    if any("_cpu_fallback" in str(rec.get("metric", ""))
+           for rec in recs):
+        merged["ok"] = False
+        merged["error"] = "stream bench printed a CPU-fallback metric"
+    elif not any(_stream_record_banks(rec) for rec in recs):
+        merged["ok"] = False
+        merged["error"] = ("no r9_stream_intraday_v1 record with "
+                           "updates > 0, zero load compiles and clean "
+                           "parity — cannot bank")
+    elif not _stream_fast_record_banks(recs):
+        merged["ok"] = False
+        merged["error"] = ("fast-finalize A/B leg unbankable: need an "
+                           "r9 record RESOLVED to finalize_impl='fast' "
+                           "with a green parity verdict AND an "
+                           "available r14 per-bar snapshot histogram")
+    return merged
 
 
 def _stream_record_banks(rec) -> bool:
@@ -535,6 +571,29 @@ def _stream_record_banks(rec) -> bool:
             and isinstance(rec.get("mesh"), dict)
             and isinstance(fh, dict)
             and fh.get("available") is True)
+
+
+def _stream_fast_record_banks(recs) -> bool:
+    """ISSUE 18: the fast-finalize A/B leg banks only when the SAME
+    window produced (a) an r9 record that actually RESOLVED to the
+    fast impl (``finalize_impl == 'fast'`` — a requested-fast engine
+    silently degrading to exact must re-run, not bank as a fast
+    number) with a green three-class parity verdict and the full warm
+    contract of :func:`_stream_record_banks`, and (b) the r14
+    snapshot-per-bar profile whose histogram is PRESENT and available
+    (warm, enough bars) — a fast throughput number without its
+    per-bar flatness evidence proves nothing about the O(1) finalize
+    claim."""
+    fast_r9 = any(_stream_record_banks(rec)
+                  and rec.get("finalize_impl") == "fast"
+                  for rec in recs if isinstance(rec, dict))
+    hist = any(
+        rec.get("methodology") == "r14_stream_snapshot_v1"
+        and rec.get("finalize_impl") == "fast"
+        and isinstance(rec.get("snapshot"), dict)
+        and rec["snapshot"].get("available") is True
+        for rec in recs if isinstance(rec, dict))
+    return fast_r9 and hist
 
 
 def step_fleet():

@@ -1,4 +1,4 @@
-"""Tier A: the AST rule engine (rules GL-A1..GL-A5).
+"""Tier A: the AST rule engine (rules GL-A1..GL-A6).
 
 One parse per file, one ancestor-tracking walk, every rule dispatched
 per node. Rules never import the scanned files — only their AST — so
@@ -25,6 +25,13 @@ GL-A4  unpaired resource acquisition (``start_trace`` without a
        ``__enter__``/``__exit__`` pair) — anywhere in the package.
 GL-A5  raw ``jnp.mean``/``std``/``var``/``nan*`` reductions in
        ``models/`` where the ``ops.masked`` equivalents are mandated.
+GL-A6  a ``@register("x")`` kernel in ``models/`` with no matching
+       module-level ``finalize_class("x", <literal>)`` declaration
+       (ISSUE 18), or a declaration whose class is not one of the
+       three literal exactness classes. The static mirror of
+       ``registry.finalize_classes()``'s loud runtime failure — the
+       linter catches the gap at review time, the registry at load
+       time.
 """
 
 from __future__ import annotations
@@ -126,6 +133,14 @@ SERIAL_LOOP_CALLS = {"fori_loop", "while_loop", "scan"}
 #: raw reductions with mandated ops.masked equivalents (GL-A5)
 RAW_REDUCTIONS = {"mean", "std", "var", "average", "median",
                   "nanmean", "nanstd", "nanvar", "nanmedian"}
+
+#: layer whose registered kernels must declare a finalize class (GL-A6)
+FINALIZE_SCOPE = ("models",)
+#: the three exactness classes (GL-A6) — the static mirror of
+#: ``models.registry.FINALIZE_CLASS_VALUES`` (the rule never imports
+#: the scanned package, so the literal set is pinned here; the
+#: registry's own ValueError guards runtime drift between the two)
+FINALIZE_CLASS_LITERALS = ("exact_fold", "stat_fold", "batch_only")
 
 
 # --------------------------------------------------------------------------
@@ -442,6 +457,95 @@ def _rule_a5(scan: _ModuleScan, node: ast.AST,
                  "polars null semantics")
 
 
+def _call_name(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _str_names(node: ast.AST, env: Dict[str, Tuple[str, ...]]
+               ) -> Optional[Tuple[str, ...]]:
+    """Statically resolve a kernel-name argument: a str constant
+    directly, or a ``for``-loop variable bound (in ``env``) to a
+    literal tuple/list of str constants. None = unresolvable."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, ast.Name) and node.id in env:
+        return env[node.id]
+    return None
+
+
+def _rule_a6_module(scan: _ModuleScan) -> None:
+    """GL-A6: every ``@register("x")`` kernel in models/ declares a
+    matching module-level ``finalize_class("x", <literal>)``.
+
+    Both declaration idioms in the family modules resolve statically:
+    a direct str-literal call, and the ``for _n in (<str literals>,):``
+    loop form. The walk threads a loop-variable environment so the
+    loop form counts; anything the rule cannot resolve (a computed
+    name, a non-literal class) flags rather than silently passing —
+    the registry's runtime check is the backstop, the linter is the
+    review-time gate."""
+    if not scan.in_scope(FINALIZE_SCOPE):
+        return
+    registered: Dict[str, ast.AST] = {}
+    declared: set = set()
+
+    def visit(node: ast.AST, env: Dict[str, Tuple[str, ...]]) -> None:
+        if isinstance(node, ast.For) and isinstance(node.target,
+                                                    ast.Name):
+            try:
+                vals = ast.literal_eval(node.iter)
+            except (ValueError, SyntaxError):
+                vals = None
+            if isinstance(vals, (tuple, list)) and all(
+                    isinstance(v, str) for v in vals):
+                env = {**env, node.target.id: tuple(vals)}
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) \
+                        and _call_name(dec) == "register" and dec.args:
+                    names = _str_names(dec.args[0], env)
+                    if names:
+                        for n in names:
+                            registered[n] = dec
+        if isinstance(node, ast.Call) \
+                and _call_name(node) == "finalize_class":
+            names = _str_names(node.args[0], env) if node.args else None
+            if names is None:
+                scan.add("GL-A6", node, "finalize_class(<dynamic>)",
+                         "finalize_class with a statically "
+                         "unresolvable kernel name: declare with a "
+                         "str literal or a literal-tuple for-loop so "
+                         "the linter can match it to @register")
+            else:
+                declared.update(names)
+            cls = node.args[1] if len(node.args) > 1 else None
+            if not (isinstance(cls, ast.Constant)
+                    and cls.value in FINALIZE_CLASS_LITERALS):
+                scan.add("GL-A6", node, "finalize_class(..., <class>)",
+                         "finalize class must be one of the literal "
+                         f"exactness classes {FINALIZE_CLASS_LITERALS}"
+                         " (docs/streaming.md 'Exactness classes')")
+        for child in ast.iter_child_nodes(node):
+            visit(child, env)
+
+    visit(scan.tree, {})
+    for name, node in sorted(registered.items()):
+        if name not in declared:
+            scan.add("GL-A6", node, f"register({name!r})",
+                     f"registered kernel {name!r} declares no "
+                     "finalize_class: every kernel must pick "
+                     "exact_fold / stat_fold / batch_only (ISSUE 18) "
+                     "or the fast-finalize partition silently "
+                     "misroutes it — fails loudly at load via "
+                     "registry.finalize_classes(), and here at "
+                     "review time")
+
+
 _RULES = (_rule_a1, _rule_a2, _rule_a3, _rule_a4, _rule_a5)
 
 
@@ -459,6 +563,7 @@ def scan_file(file_path: str, display_path: str,
     parts = tuple(scope_rel.replace(os.sep, "/").split("/"))
     scan = _ModuleScan(file_path, display_path, parts)
     _walk(scan.tree, [], scan)
+    _rule_a6_module(scan)
     return scan.violations
 
 

@@ -189,13 +189,24 @@ def run_jaxpr_tier(names: Optional[Sequence[str]] = None, days: int = 2,
 #: end-of-generation top-k gather's collective class (all_gather +
 #: top_k — emitted on the one-device trace mesh like the 2-D scan's
 #: ppermute).
+#: ``__stream_finalize_fast__`` (ISSUE 18) is the O(1)-per-bar fast
+#: finalize (``stream/fastpath.stream_finalize_fast``): the foldable
+#: kernel subset materialized from the carry's sufficient statistics
+#: alone. It is scan-free BY CONSTRUCTION — pure elementwise math over
+#: [T]-shaped accumulator leaves, no bar-buffer read — so like
+#: ``__result_encode__`` it gets NO scan exemption: zero while, zero
+#: scan, zero f64, zero callbacks. A scan appearing in this fingerprint
+#: means a sequential fold leaked into what must stay a closed-form
+#: materialization (the cost_analysis O(1) claim would silently rot).
 RESIDENT_WRAPPERS = ("__resident_scan__", "__resident_scan_sharded__",
                      "__resident_scan_2d__",
                      "__stream_update__", "__result_encode__",
+                     "__stream_finalize_fast__",
                      "__discover_generation__")
 
 #: allowed driving-scan count per wrapper symbol (default 1)
-WRAPPER_SCAN_ALLOWANCE = {"__result_encode__": 0}
+WRAPPER_SCAN_ALLOWANCE = {"__result_encode__": 0,
+                          "__stream_finalize_fast__": 0}
 
 #: factor subset the wrapper traces drive: re-tracing all 58 kernels a
 #: third time per analyze run buys no new contract coverage (the kernel
@@ -271,6 +282,16 @@ def resident_wrapper_jaxprs(n_batches: int = 2, days: int = 2,
         jax.ShapeDtypeStruct((n_batches, tickers, N_FIELDS),
                              np.float32),
         jax.ShapeDtypeStruct((n_batches, tickers), np.bool_))
+    # the fast finalize (ISSUE 18), traced over the carry's statistic
+    # leaves at the full foldable factor set — the committed
+    # fingerprint pins the scan-free closed-form materialization
+    from ..models.registry import factor_names
+    from ..stream import fastpath
+
+    fold_names, _ = fastpath.partition_names(factor_names())
+    out["__stream_finalize_fast__"] = jax.make_jaxpr(
+        lambda i: fastpath.stream_finalize_fast(i, fold_names))(
+        carry_sds["inc"])
     # the result-wire encode (ISSUE 10), traced standalone at the
     # canonical [F, days, tickers] block shape with the default spec —
     # the SAME graph every producing path fuses as its final stage
@@ -319,7 +340,9 @@ def check_resident_wrapper(name: str, closed) -> Tuple[List[Violation],
                     "scan is exempt; a while is a serial loop leaking "
                     "through", kernel=name))
     n_scan = counts.get("scan", 0)
-    allowed = WRAPPER_SCAN_ALLOWANCE.get(name, 1)
+    # session-tier names arrive prefixed ("us_390:__stream_update__");
+    # the allowance is keyed by the bare wrapper symbol
+    allowed = WRAPPER_SCAN_ALLOWANCE.get(name.rsplit(":", 1)[-1], 1)
     if n_scan != allowed:
         out.append(Violation(
             code="GL-B1", path="", line=0, symbol="scan",
@@ -357,7 +380,14 @@ def check_resident_wrapper(name: str, closed) -> Tuple[List[Violation],
 #: 2-D/discover/result wrappers layer sharding or [F, D, T] blocks on
 #: top of (a) and add no further slot-count coupling, so re-tracing
 #: them per session buys no new contract coverage.
-SESSION_TRACE_WRAPPERS = ("__resident_scan__", "__stream_update__")
+#: ``__stream_finalize_fast__`` (ISSUE 18) is traced per session
+#: precisely because it must NOT vary: its inputs are [T]-shaped
+#: statistic leaves with no slot-count coupling, so equal per-session
+#: fingerprints ARE the committed O(1)-in-session-length evidence (a
+#: session-dependent fingerprint means the fast graph started reading
+#: the bar buffer).
+SESSION_TRACE_WRAPPERS = ("__resident_scan__", "__stream_update__",
+                          "__stream_finalize_fast__")
 
 
 def session_wrapper_jaxprs(session, n_batches: int = 2, days: int = 2,
@@ -400,6 +430,12 @@ def session_wrapper_jaxprs(session, n_batches: int = 2, days: int = 2,
         jax.ShapeDtypeStruct((n_batches, tickers, N_FIELDS),
                              np.float32),
         jax.ShapeDtypeStruct((n_batches, tickers), np.bool_))
+    from ..models.registry import factor_names
+    from ..stream import fastpath
+    fold_names, _ = fastpath.partition_names(factor_names())
+    out["__stream_finalize_fast__"] = jax.make_jaxpr(
+        lambda i: fastpath.stream_finalize_fast(i, fold_names))(
+        carry_sds["inc"])
     return out
 
 

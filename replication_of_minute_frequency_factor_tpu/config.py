@@ -50,6 +50,14 @@ class Config:
     #: 'pallas_interpret' — the same kernel on the Pallas interpreter
     #: (CPU-safe; parity tests)
     rolling_impl: str = "conv"
+    #: streaming snapshot finalize implementation (ISSUE 18): 'exact' —
+    #: the bitwise O(day) batch-prefix graph (default; the
+    #: 240/390/1440-increment parity gates pin it); 'fast' — the
+    #: foldable kernel subset materializes O(F·T) from carried
+    #: sufficient statistics (stream/fastpath.py), exact_fold factors
+    #: bitwise, stat_fold factors within docs/PIN_BOUNDS.md bounds,
+    #: batch_only factors byte-identical to 'exact'
+    finalize_impl: str = "exact"
     #: donate freshly-transferred device input buffers (packed day
     #: batches, wire arrays, the resident scan's buffer year) to their
     #: consuming executables so XLA reuses their HBM for decode
@@ -93,6 +101,7 @@ class Config:
             "MFF_FACTOR_DIR": "factor_dir",
             "MFF_BACKEND": "backend",
             "MFF_ROLLING_IMPL": "rolling_impl",
+            "MFF_FINALIZE_IMPL": "finalize_impl",
             "MFF_STOCK_POOL_PATH": "stock_pool_path",
             "MFF_PROFILE_DIR": "profile_dir",
             "MFF_COMPILATION_CACHE_DIR": "compilation_cache_dir",

@@ -13,7 +13,7 @@ from __future__ import annotations
 from ..ops import segment_stats_by_value, pdf_quantile_rank
 from ..ops.ranking import topk_sum
 from .context import DayContext
-from .registry import register, stream_requirement
+from .registry import finalize_class, register, stream_requirement
 
 
 def _seg_moments(ctx: DayContext):
@@ -104,3 +104,12 @@ for _n in ("doc_kurt", "doc_skew", "doc_std", "doc_pdf60", "doc_pdf70",
            "doc_pdf80", "doc_pdf90", "doc_pdf95", "doc_vol10_ratio",
            "doc_vol5_ratio", "doc_vol50_ratio"):
     stream_requirement(_n, "bars")
+
+# --- finalize exactness classes (ISSUE 18): end-of-day anchored
+# (eod_ret reprices EVERY past bar when a new close arrives) plus the
+# whole-frame rank / top-k selections — the canonical non-foldable
+# class; every kernel here rides the batch-prefix residual ----------------
+for _n in ("doc_kurt", "doc_skew", "doc_std", "doc_pdf60", "doc_pdf70",
+           "doc_pdf80", "doc_pdf90", "doc_pdf95", "doc_vol10_ratio",
+           "doc_vol5_ratio", "doc_vol50_ratio"):
+    finalize_class(_n, "batch_only")

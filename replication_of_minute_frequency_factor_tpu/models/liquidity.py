@@ -10,7 +10,7 @@ import jax.numpy as jnp
 
 from ..ops import masked_first, masked_sum
 from .context import DayContext
-from .registry import register, stream_requirement
+from .registry import finalize_class, register, stream_requirement
 
 _NAN = jnp.nan
 
@@ -75,3 +75,12 @@ stream_requirement("liq_closevol", "auction")
 stream_requirement("liq_firstCallR", "bars")
 stream_requirement("liq_lastCallR", "bars")
 stream_requirement("liq_openvol", "bars")
+
+# --- finalize exactness classes (ISSUE 18): liq_openvol is a pure
+# selection (first present bar's volume — bitwise from the carried
+# leaf); the rest are windowed f32 sums / the streamed amihud term sum,
+# folded per bar and bounded per factor ----------------------------------
+finalize_class("liq_openvol", "exact_fold")
+for _n in ("liq_amihud_1min", "liq_closeprevol", "liq_closevol",
+           "liq_firstCallR", "liq_lastCallR"):
+    finalize_class(_n, "stat_fold")

@@ -21,7 +21,7 @@ from ..ops import (
     topk_threshold,
 )
 from .context import DayContext
-from .registry import register, stream_requirement
+from .registry import finalize_class, register, stream_requirement
 
 _NAN = jnp.nan
 
@@ -211,3 +211,18 @@ for _n in ("mmt_ols_qrs", "mmt_ols_corr_square_mean", "mmt_ols_corr_mean",
 for _n in ("mmt_top50VolumeRet", "mmt_bottom50VolumeRet",
            "mmt_top20VolumeRet", "mmt_bottom20VolumeRet"):
     stream_requirement(_n, "bars")
+
+# --- finalize exactness classes (ISSUE 18; registry.FINALIZE_CLASSES) -----
+# the sentinel ratios and mmt_paratio are pure selections over their
+# windows (first open / last close) — the carried selection leaves
+# reproduce them BITWISE; the rolling-50 family re-prices whole trailing
+# windows per bar and the volume-conditioned compounds are top-k
+# rank-dependent — both stay on the batch-prefix residual.
+for _n in ("mmt_pm", "mmt_last30", "mmt_am", "mmt_between",
+           "mmt_paratio"):
+    finalize_class(_n, "exact_fold")
+for _n in ("mmt_ols_qrs", "mmt_ols_corr_square_mean", "mmt_ols_corr_mean",
+           "mmt_ols_beta_mean", "mmt_ols_beta_zscore_last",
+           "mmt_top50VolumeRet", "mmt_bottom50VolumeRet",
+           "mmt_top20VolumeRet", "mmt_bottom20VolumeRet"):
+    finalize_class(_n, "batch_only")

@@ -27,6 +27,29 @@ ALIASES: Dict[str, Callable] = {}
 #: declares its kernels' requirements next to the kernels themselves.
 STREAM_REQUIREMENTS: Dict[str, Tuple[str, int]] = {}
 
+#: the three finalize exactness classes (ISSUE 18) — the machine-checked
+#: form of the ops/incremental.py split:
+#:
+#: ``exact_fold``  — the kernel's fast-finalize formula reads pure
+#:                   selections / integer counters from the carry and is
+#:                   BITWISE-equal to the batch formulation;
+#: ``stat_fold``   — the formula reads f32 sufficient statistics folded
+#:                   per bar: mathematically identical, bitwise broken
+#:                   by design (sequential fold vs XLA tree reduce), and
+#:                   bounded per factor by ``stream.fastpath.
+#:                   STAT_FOLD_BOUNDS`` (docs/PIN_BOUNDS.md discipline);
+#: ``batch_only``  — anchored / rank-dependent / order-sensitive: no
+#:                   O(1)-per-bar sufficient statistic exists, the fast
+#:                   path routes these through the same O(day)
+#:                   batch-prefix finalize as ``finalize_impl='exact'``
+#:                   (byte-identical between impls by construction).
+FINALIZE_CLASS_VALUES = ("exact_fold", "stat_fold", "batch_only")
+
+#: kernel name -> finalize class; every registered kernel (built-in and
+#: discovered alias alike) must carry one — :func:`finalize_classes`
+#: fails loudly on gaps, exactly like :func:`stream_requirements`.
+FINALIZE_CLASSES: Dict[str, str] = {}
+
 
 def register(name: str):
     def deco(fn):
@@ -58,13 +81,46 @@ def stream_requirements() -> Dict[str, Tuple[str, int]]:
     return dict(STREAM_REQUIREMENTS)
 
 
+def finalize_class(name: str, cls: str) -> None:
+    """Declare the finalize exactness class of a registered kernel (see
+    :data:`FINALIZE_CLASSES`). Family modules declare it next to the
+    kernel, like :func:`stream_requirement`."""
+    if cls not in FINALIZE_CLASS_VALUES:
+        raise ValueError(f"unknown finalize class {cls!r} for kernel "
+                         f"{name!r} (valid: {FINALIZE_CLASS_VALUES})")
+    FINALIZE_CLASSES[name] = cls
+
+
+def finalize_classes() -> Dict[str, str]:
+    """The full finalize-class map over the canonical kernels AND every
+    registered alias; loading asserts each declared one (a kernel
+    without an exactness class would silently fall through the fast
+    path's partition — a bug, not a gap)."""
+    _load_all()
+    missing = [n for n in FACTORS if n not in FINALIZE_CLASSES]
+    missing += [n for n in ALIASES if n not in FINALIZE_CLASSES]
+    if missing:
+        raise RuntimeError(
+            f"kernels with no finalize class: {missing}")
+    return {n: FINALIZE_CLASSES[n]
+            for n in (*FACTORS, *(n for n in ALIASES
+                                  if n not in FACTORS))}
+
+
 def register_alias(name: str, kernel) -> None:
     """Expose a kernel (an existing name or an ad-hoc ``fn(ctx)``) under a
-    user-chosen factor name (MinFreqFactor's ``calculate_method=``)."""
+    user-chosen factor name (MinFreqFactor's ``calculate_method=``).
+
+    An alias of a canonical kernel inherits its finalize class (the
+    fast-finalize formula is keyed by the CANONICAL name, so an alias
+    of a foldable kernel still rides the batch residual — declaring it
+    ``batch_only`` keeps the partition honest); an ad-hoc ``fn(ctx)``
+    has no incremental form and is ``batch_only`` by construction."""
     if isinstance(kernel, str):
         _load_all()
         kernel = FACTORS[kernel]
     ALIASES[name] = kernel
+    FINALIZE_CLASSES.setdefault(name, "batch_only")
 
 
 def resolve(name: str) -> Callable:

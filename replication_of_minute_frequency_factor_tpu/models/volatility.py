@@ -11,7 +11,7 @@ import jax.numpy as jnp
 
 from ..ops import masked_std
 from .context import DayContext
-from .registry import register, stream_requirement
+from .registry import finalize_class, register, stream_requirement
 
 _NAN = jnp.nan
 
@@ -80,3 +80,12 @@ for _n in ("vol_volume1min", "vol_range1min", "vol_return1min",
     stream_requirement(_n, "bars", 2)
 for _n in ("vol_upVol", "vol_downVol"):
     stream_requirement(_n, "bars")
+
+# --- finalize exactness classes (ISSUE 18): every std here is a
+# second central moment of a per-bar series (volume, high/low,
+# close/open-1, the signed-return subsets) — all fold per bar as
+# streamed Welford statistics (ops/incremental.py), f32-bounded per
+# factor by stream.fastpath.STAT_FOLD_BOUNDS ----------------------------
+for _n in ("vol_volume1min", "vol_range1min", "vol_return1min",
+           "vol_upVol", "vol_upRatio", "vol_downVol", "vol_downRatio"):
+    finalize_class(_n, "stat_fold")

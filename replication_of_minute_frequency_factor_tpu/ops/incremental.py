@@ -12,13 +12,38 @@ the load-bearing design decision of the whole streaming subsystem:
   the completed mask, so the streaming finalize may inject them into
   :class:`..models.context.DayContext`'s memo and skip the batch
   recompute without perturbing parity.
-* **Order-sensitive** — f32 accumulators (``vol_sum`` here). A
-  sequential left fold does not reproduce XLA's tree reduce bitwise,
-  so these NEVER feed the finalize graph: they exist for telemetry and
-  readiness only, and every f32 reduction a kernel consumes is
-  recomputed from the carried bar buffer by the batch formulation.
-  That asymmetry is what lets the 240-increment parity gate
-  (tests/test_stream.py) demand bitwise equality.
+* **Order-sensitive** — f32 accumulators (``vol_sum`` and the ``st_*``
+  sufficient statistics below). A sequential left fold does not
+  reproduce XLA's tree reduce bitwise, so these NEVER feed the
+  *bitwise* finalize graph: under the default ``finalize_impl='exact'``
+  every f32 reduction a kernel consumes is recomputed from the carried
+  bar buffer by the batch formulation. That asymmetry is what lets the
+  240-increment parity gate (tests/test_stream.py) demand bitwise
+  equality. Since ISSUE 18 the same accumulators ARE the fast
+  finalize's inputs: ``finalize_impl='fast'`` materializes the
+  ``stat_fold`` kernels from these statistics alone
+  (``stream/fastpath.py``), trading the bitwise contract for
+  per-factor pinned rtol bounds (docs/PIN_BOUNDS.md).
+
+The sufficient statistics (ISSUE 18) extend the carry per lane:
+
+* ``st_ret_*`` / ``st_volu_*`` — streamed Welford first-four central
+  moments of per-bar close/open-1 returns and volume (count =
+  ``bars``);
+* ``st_range_*`` — Welford (mean, M2) of high/low;
+* ``st_retpos_*`` / ``st_retneg_*`` — own count + Welford (mean, M2)
+  over the signed-return subsets;
+* ``st_volsum_<window>`` — windowed f32 volume sums;
+* ``st_rv_tail20`` / ``st_rv_tail50`` — windowed sums of ret·volume;
+* ``st_amihud`` — the streamed amihud term sum (|pct-close| / volume
+  over consecutive present bars);
+* ``sel_first_open_<w>`` / ``sel_last_close_<w>`` / ``sel_first_volume``
+  — pure selections (reorder-exact, like ``first_open``): the
+  sentinel/session-half anchors of the ``exact_fold`` kernels.
+
+Every statistic folds with IDENTICAL per-lane arithmetic on the dense
+(:func:`update_inc`) and cohort (:func:`update_inc_at`) paths, so the
+PR 7 cohort-vs-scan bitwise carry equality extends to the new leaves.
 
 Window membership mirrors :meth:`..models.context.DayContext.time_mask`
 over the HHMMSSmmm grid of :mod:`..sessions` — the counters are the
@@ -33,10 +58,37 @@ from typing import Dict, Tuple
 
 import jax.numpy as jnp
 
-from ..data.minute import F_CLOSE, F_OPEN, F_VOLUME
+from ..data.minute import F_CLOSE, F_HIGH, F_LOW, F_OPEN, F_VOLUME
 from ..markets import get_session
 
 _NAN = jnp.nan
+
+#: windows whose first-open/last-close selections anchor the
+#: ``exact_fold`` kernels (sentinel ratios + mmt_paratio's halves)
+SEL_WINDOWS = ("am", "pm", "sent_pm", "sent_last30", "sent_am",
+               "sent_between")
+#: windows whose f32 volume sums feed ``stat_fold`` kernels
+VOLSUM_WINDOWS = ("pre_auction", "auction", "head", "tail20", "tail30",
+                  "tail50")
+#: windows whose ret·volume sums feed the bottom-ret-ratio pair
+RV_WINDOWS = ("tail20", "tail50")
+
+#: zero-init f32 statistic leaves (order-sensitive accumulators)
+STAT_LEAVES_F32 = (
+    "st_ret_mean", "st_ret_m2", "st_ret_m3", "st_ret_m4",
+    "st_volu_mean", "st_volu_m2", "st_volu_m3", "st_volu_m4",
+    "st_range_mean", "st_range_m2",
+    "st_retpos_mean", "st_retpos_m2",
+    "st_retneg_mean", "st_retneg_m2",
+    "st_amihud",
+) + tuple(f"st_volsum_{w}" for w in VOLSUM_WINDOWS) \
+  + tuple(f"st_rv_{w}" for w in RV_WINDOWS)
+#: zero-init int32 subset counters (reorder-exact)
+STAT_LEAVES_I32 = ("st_retpos_n", "st_retneg_n")
+#: NaN-init f32 selection leaves (reorder-exact)
+SEL_LEAVES = ("sel_first_volume",) + tuple(
+    f"sel_{kind}_{w}" for w in SEL_WINDOWS
+    for kind in ("first_open", "last_close"))
 
 
 @functools.lru_cache(maxsize=None)
@@ -107,7 +159,129 @@ def init_inc(n_tickers: int) -> Dict[str, object]:
     out["vol_sum"] = np.zeros((n_tickers,), np.float32)
     out["first_open"] = np.full((n_tickers,), np.nan, np.float32)
     out["last_close"] = np.full((n_tickers,), np.nan, np.float32)
+    for name in STAT_LEAVES_F32:
+        out[name] = np.zeros((n_tickers,), np.float32)
+    for name in STAT_LEAVES_I32:
+        out[name] = np.zeros((n_tickers,), np.int32)
+    for name in SEL_LEAVES:
+        out[name] = np.full((n_tickers,), np.nan, np.float32)
     return out
+
+
+def _welford_step(n_old_f, mean, m2, x):
+    """Per-lane Welford fold of (mean, M2) for one observation ``x``.
+
+    ``n_old_f`` is the PRE-update observation count as f32. The same
+    function body serves the dense and cohort ingest paths — identical
+    per-lane arithmetic is what extends the PR 7 cohort<->scan bitwise
+    carry equality to the statistic leaves. Every increment to M2 is
+    ``delta * (delta/n) * n_old`` — a same-sign product, so M2 stays
+    non-negative in f32 too.
+    """
+    n = n_old_f + 1.0
+    delta = x - mean
+    delta_n = delta / n
+    return mean + delta_n, m2 + delta * delta_n * n_old_f
+
+
+def _welford4_step(n_old_f, mean, m2, m3, m4, x):
+    """Per-lane fold of the first four central moments (Pébay's
+    one-observation update). The M2 line is the :func:`_welford_step`
+    arithmetic verbatim."""
+    n = n_old_f + 1.0
+    delta = x - mean
+    delta_n = delta / n
+    delta_n2 = delta_n * delta_n
+    term1 = delta * delta_n * n_old_f
+    new_m4 = m4 + (term1 * delta_n2 * (n * n - 3.0 * n + 3.0)
+                   + 6.0 * delta_n2 * m2 - 4.0 * delta_n * m3)
+    new_m3 = m3 + term1 * delta_n * (n - 2.0) - 3.0 * delta_n * m2
+    return mean + delta_n, m2 + term1, new_m3, new_m4
+
+
+def _fold_stats(get, open_, high, low, close, volume, present, inw):
+    """Post-bar values of every sufficient-statistic leaf (ISSUE 18).
+
+    ``get(name)`` returns the PRE-update per-lane value of a carry leaf:
+    the dense path passes ``inc.__getitem__`` (full ``[T]`` arrays), the
+    cohort path a clip-mode gather at the cohort's indices (``[K]``
+    rows). ``inw[window]`` is the trace-time scalar bool of slot
+    membership; ``present`` gates lanes (the cohort passes ``True`` —
+    its rows are present by construction). Both ingest paths route
+    through THIS function, so the per-lane arithmetic is shared by
+    construction. Per-bar inputs reuse the batch formulations exactly
+    (``ret = (close-open)/open`` as ``DayContext.ret_co``, amihud's
+    ``(close-prev)/prev`` as ``pct_change_valid``), so each bar's
+    contribution is the bitwise-same f32 value the batch kernel sees —
+    only the accumulation order differs (the pinned-bound residual).
+    """
+    out = {}
+    bars_old = get("bars")
+    nf = bars_old.astype(jnp.float32)
+    ret = (close - open_) / open_
+    rng = high / low
+
+    # first-four-moment Welford series over all present bars
+    for leaf, x in (("ret", ret), ("volu", volume)):
+        ks = tuple(f"st_{leaf}_{p}" for p in ("mean", "m2", "m3", "m4"))
+        new = _welford4_step(nf, *(get(k) for k in ks), x)
+        for k, v in zip(ks, new):
+            out[k] = jnp.where(present, v, get(k))
+    n_mean, n_m2 = _welford_step(nf, get("st_range_mean"),
+                                 get("st_range_m2"), rng)
+    out["st_range_mean"] = jnp.where(present, n_mean, get("st_range_mean"))
+    out["st_range_m2"] = jnp.where(present, n_m2, get("st_range_m2"))
+
+    # signed-return subsets carry their own counts
+    for leaf, cond in (("retpos", ret > 0), ("retneg", ret < 0)):
+        sel = present & cond
+        n_old = get(f"st_{leaf}_n")
+        mean, m2 = get(f"st_{leaf}_mean"), get(f"st_{leaf}_m2")
+        n_mean, n_m2 = _welford_step(n_old.astype(jnp.float32), mean, m2,
+                                     ret)
+        out[f"st_{leaf}_n"] = n_old + jnp.where(sel, jnp.int32(1),
+                                                jnp.int32(0))
+        out[f"st_{leaf}_mean"] = jnp.where(sel, n_mean, mean)
+        out[f"st_{leaf}_m2"] = jnp.where(sel, n_m2, m2)
+
+    # windowed f32 sums
+    for w in VOLSUM_WINDOWS:
+        sel = present & inw[w]
+        out[f"st_volsum_{w}"] = get(f"st_volsum_{w}") + jnp.where(
+            sel, volume, 0.0)
+    for w in RV_WINDOWS:
+        sel = present & inw[w]
+        out[f"st_rv_{w}"] = get(f"st_rv_{w}") + jnp.where(
+            sel, ret * volume, 0.0)
+
+    # amihud term sum: |pct change over consecutive present closes| /
+    # volume; the first present bar contributes 0 exactly as the batch
+    # kernel's null-filled first pct (0/volume == 0.0 when volume > 0)
+    prev = get("last_close")
+    has_prev = bars_old > 0
+    pct_abs = jnp.where(has_prev, jnp.abs((close - prev) / prev), 0.0)
+    term = jnp.where(volume > 0.0, pct_abs / volume, 0.0)
+    out["st_amihud"] = get("st_amihud") + jnp.where(present, term, 0.0)
+
+    # pure selections (reorder-exact anchors of the exact_fold kernels);
+    # in-order ingestion makes first-arrival == first-slot
+    never = bars_old == 0
+    out["sel_first_volume"] = jnp.where(never & present, volume,
+                                        get("sel_first_volume"))
+    for w in SEL_WINDOWS:
+        sel = present & inw[w]
+        unseen = get(w) == 0
+        out[f"sel_first_open_{w}"] = jnp.where(
+            sel & unseen, open_, get(f"sel_first_open_{w}"))
+        out[f"sel_last_close_{w}"] = jnp.where(
+            sel, close, get(f"sel_last_close_{w}"))
+    return out
+
+
+def _stat_windows(wc):
+    """The window specs the statistic fold consults."""
+    need = set(SEL_WINDOWS) | set(VOLSUM_WINDOWS) | set(RV_WINDOWS)
+    return {w: wc[w] for w in need}
 
 
 def update_inc(inc, t, values, present, session=None):
@@ -122,10 +296,11 @@ def update_inc(inc, t, values, present, session=None):
     window boundaries (trace-time static; None = cn_ashare_240).
     """
     sess = get_session(session)
+    wc = window_counters_for(sess)
     time = jnp.asarray(sess.grid_times)[t]
     out = dict(inc)
     one = jnp.int32(1)
-    for name, spec in window_counters_for(sess).items():
+    for name, spec in wc.items():
         out[name] = inc[name] + jnp.where(
             present & window_contains(spec, time), one, jnp.int32(0))
     out["vol_sum"] = inc["vol_sum"] + jnp.where(
@@ -135,6 +310,12 @@ def update_inc(inc, t, values, present, session=None):
     never_seen = inc["bars"] == 0
     out["first_open"] = jnp.where(never_seen & present,
                                   values[..., F_OPEN], inc["first_open"])
+    inw = {w: window_contains(spec, time)
+           for w, spec in _stat_windows(wc).items()}
+    out.update(_fold_stats(
+        inc.__getitem__, values[..., F_OPEN], values[..., F_HIGH],
+        values[..., F_LOW], values[..., F_CLOSE], values[..., F_VOLUME],
+        present, inw))
     return out
 
 
@@ -146,9 +327,10 @@ def update_inc_at(inc, t, rows, idx, session=None):
     deliver one bar per ticker per minute); duplicates are undefined.
     """
     sess = get_session(session)
+    wc = window_counters_for(sess)
     time = jnp.asarray(sess.grid_times)[t]
     out = dict(inc)
-    for name, spec in window_counters_for(sess).items():
+    for name, spec in wc.items():
         bump = jnp.where(window_contains(spec, time), jnp.int32(1),
                          jnp.int32(0))
         bump = jnp.broadcast_to(bump, idx.shape)
@@ -163,4 +345,15 @@ def update_inc_at(inc, t, rows, idx, session=None):
     first = jnp.where(seen, inc["first_open"].at[idx].get(mode="clip"),
                       rows[..., F_OPEN])
     out["first_open"] = inc["first_open"].at[idx].set(first, mode="drop")
+    # statistic leaves: gather the cohort's pre-update rows, run the
+    # SAME per-lane fold as the dense path, scatter-set the results
+    # (non-selected rows write their old value back — a value no-op)
+    inw = {w: window_contains(spec, time)
+           for w, spec in _stat_windows(wc).items()}
+    new_rows = _fold_stats(
+        lambda k: inc[k].at[idx].get(mode="clip"),
+        rows[..., F_OPEN], rows[..., F_HIGH], rows[..., F_LOW],
+        rows[..., F_CLOSE], rows[..., F_VOLUME], True, inw)
+    for k, v in new_rows.items():
+        out[k] = inc[k].at[idx].set(v, mode="drop")
     return out

@@ -203,6 +203,15 @@ class ServeConfig:
     #: otherwise the engine stays single-device, silently — the
     #: ``stream.carry_sharded`` gauge says which one runs.
     stream_sharded: bool = False
+    #: streaming snapshot finalize implementation for this server's
+    #: StreamEngine (ISSUE 18): None adopts ``Config.finalize_impl``
+    #: (default 'exact', the bitwise batch-prefix graph); 'fast'
+    #: materializes the foldable kernel subset from carried sufficient
+    #: statistics in O(F·T) per snapshot (docs/streaming.md "Exactness
+    #: classes"). The engine's RESOLVED choice — 'fast' degrades to
+    #: 'exact' when the served name set has no foldable kernel — is
+    #: reported in ``/healthz`` as ``stream_finalize_impl``.
+    stream_finalize_impl: Optional[str] = None
 
 
 class FactorServer:
@@ -285,7 +294,8 @@ class FactorServer:
                     replicate_quirks=replicate_quirks,
                     rolling_impl=rolling_impl, telemetry=self.telemetry,
                     executables=self.executables, mesh=stream_mesh,
-                    session=self.session)
+                    session=self.session,
+                    finalize_impl=self.scfg.stream_finalize_impl)
                 self.stream_engine.warmup(micro_batches=stream_batches)
             #: ISSUE 14: the factor-discovery engine, sharing THE
             #: executable cache (a server's discovery jobs and its
@@ -674,6 +684,11 @@ class FactorServer:
             s = self.stream_engine.staleness_s()
             payload["stream_staleness_s"] = (None if s is None
                                              else round(s, 3))
+            # ISSUE 18: the RESOLVED finalize impl — 'fast' only when
+            # requested AND the served set has a foldable kernel, so
+            # an operator reads what actually runs, not what was asked
+            payload["stream_finalize_impl"] = \
+                self.stream_engine.finalize_impl_resolved
         return payload
 
     # --- request-lifecycle recording (ISSUE 8) --------------------------

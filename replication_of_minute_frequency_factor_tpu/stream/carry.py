@@ -152,12 +152,38 @@ def finalize(carry, names: Optional[Tuple[str, ...]] = None,
 def finalize_with_readiness(carry, names: Tuple[str, ...],
                             replicate_quirks: bool = True,
                             rolling_impl: Optional[str] = None,
-                            session=None):
+                            session=None, finalize_impl: str = "exact"):
     """The engine's snapshot graph: stacked exposures ``[F, T]`` plus
-    the readiness plane ``[F, T]`` in one dispatch."""
-    out = finalize(carry, names, replicate_quirks, rolling_impl,
-                   session=session)
-    exposures = jnp.stack([out[n] for n in names])
+    the readiness plane ``[F, T]`` in one dispatch.
+
+    ``finalize_impl`` picks the exactness/cost point (ISSUE 18):
+
+    * ``"exact"`` (default) — the bitwise batch-prefix graph above,
+      O(day) work per snapshot;
+    * ``"fast"`` — the foldable subset materializes from the carried
+      sufficient statistics (``stream/fastpath.py``, O(F·T)); only the
+      ``batch_only`` residual re-reads the bar prefix. Same [F, T]
+      output layout and factor order, readiness plane unchanged.
+    """
+    if finalize_impl not in ("exact", "fast"):
+        raise ValueError(f"unknown finalize_impl {finalize_impl!r} "
+                         "(valid: 'exact', 'fast')")
+    if finalize_impl == "exact":
+        out = finalize(carry, names, replicate_quirks, rolling_impl,
+                       session=session)
+        exposures = jnp.stack([out[n] for n in names])
+        return exposures, readiness(carry["inc"], names)
+    from . import fastpath
+
+    fold, residual = fastpath.partition_names(tuple(names))
+    vals = {}
+    if fold:
+        fast = fastpath.stream_finalize_fast(carry["inc"], fold)
+        vals.update({n: fast[i] for i, n in enumerate(fold)})
+    if residual:
+        vals.update(finalize(carry, residual, replicate_quirks,
+                             rolling_impl, session=session))
+    exposures = jnp.stack([vals[n] for n in names])
     return exposures, readiness(carry["inc"], names)
 
 

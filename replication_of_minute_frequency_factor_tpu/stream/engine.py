@@ -97,11 +97,13 @@ class StreamEngine:
                  rolling_impl: Optional[str] = None,
                  telemetry=None,
                  executables: Optional[ExecutableCache] = None,
-                 mesh=None, session=None):
+                 mesh=None, session=None,
+                 finalize_impl: Optional[str] = None):
         from ..config import get_config
         from ..markets import get_session
         from ..models.registry import factor_names
         from ..telemetry import get_telemetry
+        from . import fastpath
 
         self.n_tickers = int(n_tickers)
         #: the market session spec (ISSUE 15): sizes the day buffer
@@ -155,11 +157,29 @@ class StreamEngine:
         self.replicate_quirks = replicate_quirks
         self.rolling_impl = (rolling_impl if rolling_impl is not None
                              else get_config().rolling_impl)
+        #: snapshot finalize implementation (ISSUE 18). The REQUESTED
+        #: impl comes from the ctor (None -> Config.finalize_impl); the
+        #: RESOLVED impl is what the snapshot graphs actually trace:
+        #: 'fast' with an empty foldable subset degrades to 'exact'
+        #: (the residual would be the whole graph anyway). The carry
+        #: rule in benchmarks/tpu_session.py banks fast-path records
+        #: only against the resolved value.
+        self.finalize_impl = (finalize_impl if finalize_impl is not None
+                              else get_config().finalize_impl)
+        if self.finalize_impl not in ("exact", "fast"):
+            raise ValueError(
+                f"unknown finalize_impl {self.finalize_impl!r} "
+                "(valid: 'exact', 'fast')")
+        fold, _residual = fastpath.partition_names(self.names)
+        self.fold_names: Tuple[str, ...] = fold
+        self.finalize_impl_resolved = (
+            "fast" if self.finalize_impl == "fast" and fold else "exact")
         self.telemetry = (telemetry if telemetry is not None
                           else get_telemetry())
         self.executables = (executables if executables is not None
                             else ExecutableCache(telemetry=telemetry))
         sess = self.session
+        fin_impl = self.finalize_impl_resolved
         self._scan_jit = jax.jit(
             lambda c, b, p: scan_update(c, b, p, session=sess))
         self._cohort_jit = jax.jit(
@@ -169,7 +189,7 @@ class StreamEngine:
         self._snapshot_jit = jax.jit(
             lambda c: carry_mod.finalize_with_readiness(
                 c, self.names, self.replicate_quirks, self.rolling_impl,
-                session=sess))
+                session=sess, finalize_impl=fin_impl))
         #: snapshot through the result wire (ISSUE 10): finalize +
         #: on-device blocked-quantized encode of the [F, T] exposures
         #: (as an [F, 1, T] block — one day) fused in ONE executable;
@@ -180,7 +200,7 @@ class StreamEngine:
         def _snap_wire(c):
             exposures, ready = carry_mod.finalize_with_readiness(
                 c, self.names, self.replicate_quirks, self.rolling_impl,
-                session=sess)
+                session=sess, finalize_impl=fin_impl)
             payload = result_wire.encode_block(
                 exposures[:, None, :], self.result_spec)
             return payload, ready
@@ -196,7 +216,7 @@ class StreamEngine:
         def _snap_stats(c):
             exposures, ready = carry_mod.finalize_with_readiness(
                 c, self.names, self.replicate_quirks, self.rolling_impl,
-                session=sess)
+                session=sess, finalize_impl=fin_impl)
             return exposures, ready, factor_stats_block(exposures)
 
         self._snapshot_stats_jit = jax.jit(_snap_stats)
@@ -204,13 +224,20 @@ class StreamEngine:
         def _snap_wire_stats(c):
             exposures, ready = carry_mod.finalize_with_readiness(
                 c, self.names, self.replicate_quirks, self.rolling_impl,
-                session=sess)
+                session=sess, finalize_impl=fin_impl)
             stats = factor_stats_block(exposures)
             payload = result_wire.encode_block(
                 exposures[:, None, :], self.result_spec)
             return payload, ready, stats
 
         self._snapshot_wire_stats_jit = jax.jit(_snap_wire_stats)
+        # the finalize plane's static split (observability.md
+        # stream.finalize_* taxonomy): how many factors materialize
+        # from statistics vs ride the batch-prefix residual
+        n_fold = len(fold) if self.finalize_impl_resolved == "fast" else 0
+        self.telemetry.gauge("stream.finalize_fold_factors", n_fold)
+        self.telemetry.gauge("stream.finalize_residual_factors",
+                             len(self.names) - n_fold)
         self.carry = None
         #: host-side minute cursor mirror (no device read needed for
         #: gauges or over-ingest guards)
@@ -224,7 +251,8 @@ class StreamEngine:
     # --- lifecycle ------------------------------------------------------
     def _graph_key(self):
         return (self.n_tickers, self.names, self.replicate_quirks,
-                self.rolling_impl, self.session.name)
+                self.rolling_impl, self.session.name,
+                self.finalize_impl_resolved)
 
     def cursor(self) -> dict:
         """The fan-out contract's progress stamp (ISSUE 11): where this
@@ -434,6 +462,8 @@ class StreamEngine:
         self.telemetry.observe("stream.snapshot_seconds",
                                time.perf_counter() - t0)
         self.telemetry.counter("stream.snapshots")
+        self.telemetry.counter("stream.finalize_snapshots",
+                               impl=self.finalize_impl_resolved)
         self.telemetry.hbm.sample("stream.snapshot")
         return exposures, ready
 
@@ -454,6 +484,8 @@ class StreamEngine:
         self.telemetry.observe("stream.snapshot_seconds",
                                time.perf_counter() - t0)
         self.telemetry.counter("stream.snapshots", kind="wire")
+        self.telemetry.counter("stream.finalize_snapshots",
+                               impl=self.finalize_impl_resolved)
         self.telemetry.hbm.sample("stream.snapshot")
         return payload, ready
 
@@ -472,6 +504,8 @@ class StreamEngine:
         self.telemetry.observe("stream.snapshot_seconds",
                                time.perf_counter() - t0)
         self.telemetry.counter("stream.snapshots")
+        self.telemetry.counter("stream.finalize_snapshots",
+                               impl=self.finalize_impl_resolved)
         self.telemetry.hbm.sample("stream.snapshot")
         return exposures, ready, stats
 
@@ -489,5 +523,7 @@ class StreamEngine:
         self.telemetry.observe("stream.snapshot_seconds",
                                time.perf_counter() - t0)
         self.telemetry.counter("stream.snapshots", kind="wire")
+        self.telemetry.counter("stream.finalize_snapshots",
+                               impl=self.finalize_impl_resolved)
         self.telemetry.hbm.sample("stream.snapshot")
         return payload, ready, stats

@@ -1,0 +1,304 @@
+"""O(1)-per-bar fast finalize: materialize the foldable kernel subset
+from sufficient statistics alone (ISSUE 18).
+
+The exact finalize (``carry.finalize``) re-reads the whole carried bar
+prefix so every f32 reduction is the batch reduction — bitwise, but
+O(day) work per snapshot. This module is the other end of the
+exactness-class seam (``ops/incremental.py``): for every kernel whose
+``finalize_class`` is ``exact_fold`` or ``stat_fold`` there is a
+closed-form materialization from the carried per-lane statistics, so a
+snapshot of those factors costs O(F·T) regardless of the bar cursor —
+the per-bar work was already paid inside the same dispatch that wrote
+the bar column.
+
+``stream_finalize_fast`` is the reserved ``__stream_finalize_fast__``
+Tier B graph: a pure function of the ``inc`` leaves (all ``[T]``-shaped
+— nothing here reads the bar buffer or depends on the session's slot
+count), scan-free BY CONSTRUCTION (graftlint pins a zero-scan
+allowance, not just zero-while), and therefore with a cost_analysis
+FLOP count independent of both the minute cursor and the session
+length — the headline O(1) claim is counter-asserted, not inferred
+from timings.
+
+Exactness contract per class (docs/streaming.md):
+
+* ``exact_fold`` — the formula consumes reorder-exact leaves only
+  (integer counters, pure selections) and reproduces the batch kernel
+  BITWISE; tests gate on equality.
+* ``stat_fold`` — the formula consumes order-sensitive f32 accumulators
+  (Welford moments, windowed sums); each bar's contribution is the
+  bitwise-same f32 value the batch kernel sees, only the accumulation
+  order differs. Each factor's divergence is pinned by
+  :data:`STAT_FOLD_BOUNDS` (docs/PIN_BOUNDS.md) against the bitwise
+  batch finalize AND an f64 oracle, per tier-1 session.
+* ``batch_only`` — no formula exists (end-of-day anchored,
+  rank-dependent, order-sensitive-by-contract); those kernels ride the
+  batch-prefix residual and stay BYTE-identical between
+  ``finalize_impl='exact'`` and ``'fast'``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+
+from ..models.registry import finalize_classes
+
+_NAN = jnp.nan
+
+#: finalize classes a fast formula exists for
+FOLDABLE_CLASSES = ("exact_fold", "stat_fold")
+
+
+# --------------------------------------------------------------------------
+# shared sub-formulas (each mirrors its ops/masked.py batch twin's
+# guard structure exactly — only the moment SOURCE differs)
+# --------------------------------------------------------------------------
+
+
+def _std_unbiased(n, m2):
+    """``masked_std`` (ddof=1) from a Welford M2 and its count: NaN
+    below 2 observations, like the batch two-pass form."""
+    nf = n.astype(jnp.float32)
+    return jnp.sqrt(jnp.where(n > 1, m2 / jnp.maximum(nf - 1.0, 1.0),
+                              _NAN))
+
+
+def _g1(n, m2, m3):
+    """Biased Fisher-Pearson skew g1 from Welford M2/M3 (``masked_skew``
+    twin: m2 == 0 degenerates to the same NaN/inf)."""
+    nn = jnp.maximum(n, 1).astype(jnp.float32)
+    m2b = m2 / nn
+    m3b = m3 / nn
+    return jnp.where(n > 0, m3b / jnp.power(m2b, 1.5), _NAN)
+
+
+def _g2(n, m2, m4):
+    """Biased Fisher excess kurtosis from Welford M2/M4."""
+    nn = jnp.maximum(n, 1).astype(jnp.float32)
+    m2b = m2 / nn
+    m4b = m4 / nn
+    return jnp.where(n > 0, m4b / (m2b * m2b) - 3.0, _NAN)
+
+
+def _signed_vol(inc, leaf):
+    """``volatility._signed_vol`` twin: std of the same-sign return
+    subset, <2 subset bars -> 0, absent stock -> NaN."""
+    n_sel = inc[f"st_{leaf}_n"]
+    s = _std_unbiased(n_sel, inc[f"st_{leaf}_m2"])
+    out = jnp.where(n_sel < 2, 0.0, s)
+    return jnp.where(inc["bars"] > 0, out, _NAN)
+
+
+def _win_over_total(inc, window):
+    """``trade_flow._window_over_total`` twin: window volume / day
+    volume with the 0.125 zero-volume-day fallback."""
+    total = inc["vol_sum"]
+    out = jnp.where(total > 0.0, inc[f"st_volsum_{window}"] / total,
+                    0.125)
+    return jnp.where(inc["bars"] > 0, out, _NAN)
+
+
+def _sentinel_ratio(inc, window):
+    """``momentum._sentinel_ratio`` twin from the carried selections:
+    last in-window close / first in-window open (NaN/NaN -> NaN when
+    the window never fired, quirk Q6's degradation included — a single
+    present sentinel bar makes first == last == that bar)."""
+    return inc[f"sel_last_close_{window}"] / inc[f"sel_first_open_{window}"]
+
+
+def _paratio(inc):
+    """``mmt_paratio`` twin: PM minus AM session momentum from the
+    per-half selection leaves, 0 when only one half exists, NaN when
+    neither does — the same where() ladder as the batch kernel over
+    bitwise-equal first/last values."""
+    has_am = inc["am"] > 0
+    has_pm = inc["pm"] > 0
+    am_v = inc["sel_last_close_am"] / inc["sel_first_open_am"] - 1.0
+    pm_v = inc["sel_last_close_pm"] / inc["sel_first_open_pm"] - 1.0
+    out = jnp.where(has_am & has_pm, pm_v - am_v, 0.0)
+    return jnp.where(has_am | has_pm, out, _NAN)
+
+
+def _bottom20(inc):
+    """``trade_bottom20retRatio`` twin: the +1 denominator guard, sum
+    of ret·volume folded per bar, one division at finalize (the batch
+    form divides every term — algebraically equal, rtol-bounded)."""
+    out = inc["st_rv_tail20"] / (inc["st_volsum_tail20"] + 1.0)
+    return jnp.where(inc["tail20"] > 0, out, _NAN)
+
+
+def _bottom50(inc):
+    """``trade_bottom50retRatio`` twin (the ``== 0 -> 1`` guard)."""
+    s = inc["st_volsum_tail50"]
+    out = inc["st_rv_tail50"] / jnp.where(s == 0.0, 1.0, s)
+    return jnp.where(inc["tail50"] > 0, out, _NAN)
+
+
+#: kernel name -> materialization from the ``inc`` statistic leaves.
+#: The ``shape_*Vol`` rows exploit scale invariance: g1/g2 of
+#: ``vol_share = volume / vol_sum`` equal g1/g2 of raw volume (a
+#: zero-volume day degenerates to the same 0/0 NaN via M2 == 0).
+FAST_FORMULAS = {
+    # volatility (std family)
+    "vol_volume1min": lambda inc: _std_unbiased(inc["bars"],
+                                                inc["st_volu_m2"]),
+    "vol_range1min": lambda inc: _std_unbiased(inc["bars"],
+                                               inc["st_range_m2"]),
+    "vol_return1min": lambda inc: _std_unbiased(inc["bars"],
+                                                inc["st_ret_m2"]),
+    "vol_upVol": lambda inc: _signed_vol(inc, "retpos"),
+    "vol_downVol": lambda inc: _signed_vol(inc, "retneg"),
+    "vol_upRatio": lambda inc: _signed_vol(inc, "retpos") / _std_unbiased(
+        inc["bars"], inc["st_ret_m2"]),
+    "vol_downRatio": lambda inc: _signed_vol(inc, "retneg") / _std_unbiased(
+        inc["bars"], inc["st_ret_m2"]),
+    # shape (moment-ratio family)
+    "shape_skew": lambda inc: _g1(inc["bars"], inc["st_ret_m2"],
+                                  inc["st_ret_m3"]),
+    "shape_kurt": lambda inc: _g2(inc["bars"], inc["st_ret_m2"],
+                                  inc["st_ret_m4"]),
+    "shape_skratio": lambda inc: _g1(inc["bars"], inc["st_ret_m2"],
+                                     inc["st_ret_m3"]) / _g2(
+        inc["bars"], inc["st_ret_m2"], inc["st_ret_m4"]),
+    "shape_skewVol": lambda inc: _g1(inc["bars"], inc["st_volu_m2"],
+                                     inc["st_volu_m3"]),
+    "shape_kurtVol": lambda inc: _g2(inc["bars"], inc["st_volu_m2"],
+                                     inc["st_volu_m4"]),
+    "shape_skratioVol": lambda inc: _g1(inc["bars"], inc["st_volu_m2"],
+                                        inc["st_volu_m3"]) / _g2(
+        inc["bars"], inc["st_volu_m2"], inc["st_volu_m4"]),
+    # liquidity
+    "liq_amihud_1min": lambda inc: jnp.where(inc["bars"] > 0,
+                                             inc["st_amihud"], _NAN),
+    "liq_closeprevol": lambda inc: jnp.where(
+        inc["pre_auction"] > 0, inc["st_volsum_pre_auction"], _NAN),
+    "liq_closevol": lambda inc: jnp.where(
+        inc["auction"] > 0, inc["st_volsum_auction"], _NAN),
+    "liq_firstCallR": lambda inc: inc["sel_first_volume"] / inc["vol_sum"],
+    "liq_lastCallR": lambda inc: jnp.where(
+        inc["bars"] > 0, inc["st_volsum_auction"] / inc["vol_sum"], _NAN),
+    "liq_openvol": lambda inc: inc["sel_first_volume"],
+    # trade flow
+    "trade_headRatio": lambda inc: _win_over_total(inc, "head"),
+    "trade_tailRatio": lambda inc: _win_over_total(inc, "tail30"),
+    "trade_bottom20retRatio": _bottom20,
+    "trade_bottom50retRatio": _bottom50,
+    # momentum (pure selections)
+    "mmt_pm": lambda inc: _sentinel_ratio(inc, "sent_pm"),
+    "mmt_last30": lambda inc: _sentinel_ratio(inc, "sent_last30"),
+    "mmt_am": lambda inc: _sentinel_ratio(inc, "sent_am"),
+    "mmt_between": lambda inc: _sentinel_ratio(inc, "sent_between"),
+    "mmt_paratio": _paratio,
+}
+
+
+#: per-factor pinned divergence bounds for the ``stat_fold`` class:
+#: ``|fast - batch| <= rtol * |batch| + atol_rel * scale`` per finite
+#: lane, where ``scale`` is the max finite |batch| of the compared
+#: frame (the result-wire RESULT_BOUNDS convention); non-finite lanes
+#: must match by class (NaN/+inf/-inf). ``exact_fold`` factors carry an
+#: implicit (0, 0) — bitwise. The committed copies live in
+#: docs/PIN_BOUNDS.md; changing a bound is a DECLARED methodology
+#: event. Rationale per family: windowed non-negative sums differ only
+#: by reduction-tree order (~sqrt(n)·eps); Welford std is
+#: backward-stable; the moment RATIOS (g1, g2) divide two noisy
+#: moments and the skew/kurt ratio compounds two of those.
+STAT_FOLD_BOUNDS: Dict[str, Tuple[float, float]] = {
+    "vol_volume1min": (1e-4, 1e-5),
+    "vol_range1min": (1e-4, 1e-5),
+    "vol_return1min": (1e-4, 1e-5),
+    "vol_upVol": (1e-4, 1e-5),
+    "vol_downVol": (1e-4, 1e-5),
+    "vol_upRatio": (3e-4, 3e-5),
+    "vol_downRatio": (3e-4, 3e-5),
+    "shape_skew": (3e-3, 3e-3),
+    "shape_kurt": (3e-3, 3e-3),
+    "shape_skratio": (1e-2, 1e-2),
+    "shape_skewVol": (3e-3, 3e-3),
+    "shape_kurtVol": (3e-3, 3e-3),
+    "shape_skratioVol": (1e-2, 1e-2),
+    "liq_amihud_1min": (1e-4, 1e-6),
+    "liq_closeprevol": (1e-4, 1e-6),
+    "liq_closevol": (1e-4, 1e-6),
+    "liq_firstCallR": (1e-4, 1e-6),
+    "liq_lastCallR": (1e-4, 1e-6),
+    "trade_headRatio": (1e-4, 1e-6),
+    "trade_tailRatio": (1e-4, 1e-6),
+    "trade_bottom20retRatio": (3e-4, 3e-5),
+    "trade_bottom50retRatio": (3e-4, 3e-5),
+}
+
+
+def check_fast_coverage() -> None:
+    """Machine check of the class/formula seam: every kernel declared
+    ``exact_fold``/``stat_fold`` must have a fast formula, every fast
+    formula must belong to a foldable kernel, and every ``stat_fold``
+    kernel must carry a pinned bound. Fails loudly at engine/analyze
+    time, like ``stream_requirements()``."""
+    cls = finalize_classes()
+    foldable = {n for n, c in cls.items() if c in FOLDABLE_CLASSES}
+    missing = sorted(foldable - set(FAST_FORMULAS))
+    orphans = sorted(set(FAST_FORMULAS) - foldable)
+    unbounded = sorted(n for n, c in cls.items()
+                       if c == "stat_fold" and n not in STAT_FOLD_BOUNDS)
+    if missing or orphans or unbounded:
+        raise RuntimeError(
+            "fast-finalize coverage broken: "
+            f"foldable kernels with no FAST_FORMULAS entry: {missing}; "
+            f"formulas for non-foldable kernels: {orphans}; "
+            f"stat_fold kernels with no STAT_FOLD_BOUNDS pin: "
+            f"{unbounded}")
+
+
+def partition_names(names) -> Tuple[Tuple[str, ...], Tuple[str, ...]]:
+    """Split a snapshot's factor list into (fold, residual) by declared
+    finalize class, preserving order within each part. Coverage is
+    machine-checked on every call (trace-time only — this never runs
+    per dispatch)."""
+    check_fast_coverage()
+    cls = finalize_classes()
+    fold = tuple(n for n in names if cls[n] in FOLDABLE_CLASSES)
+    residual = tuple(n for n in names if cls[n] not in FOLDABLE_CLASSES)
+    return fold, residual
+
+
+def stream_finalize_fast(inc, names: Tuple[str, ...]):
+    """The reserved ``__stream_finalize_fast__`` graph body: stacked
+    ``[F_fold, T]`` exposures of the foldable factors, a pure function
+    of the ``inc`` statistic leaves. No bar-buffer read, no scan, no
+    slot-count dependence — per-snapshot FLOPs are O(F·T) whatever the
+    cursor or session (the counter-asserted headline)."""
+    return jnp.stack([FAST_FORMULAS[n](inc) for n in names])
+
+
+def parity_report(name: str, batch, fast) -> Dict[str, object]:
+    """Host-side pinned-bound comparison of one factor's fast vs batch
+    exposures (tests + the bench parity phase). Non-finite lanes must
+    match by class; finite lanes obey the factor's bound (implicit
+    (0, 0) == bitwise for ``exact_fold``)."""
+    import numpy as np
+
+    b = np.asarray(batch, np.float32)
+    f = np.asarray(fast, np.float32)
+    cls = finalize_classes()[name]
+    # only stat_fold carries a nonzero bound; exact_fold AND batch_only
+    # (byte-identical between impls by construction) compare bitwise
+    rtol, atol_rel = (STAT_FOLD_BOUNDS[name] if cls == "stat_fold"
+                      else (0.0, 0.0))
+    class_mismatch = int(np.sum(
+        (np.isnan(b) != np.isnan(f))
+        | (np.isposinf(b) != np.isposinf(f))
+        | (np.isneginf(b) != np.isneginf(f))))
+    finite = np.isfinite(b) & np.isfinite(f)
+    scale = float(np.max(np.abs(b[finite]), initial=0.0))
+    err = np.abs(f[finite] - b[finite])
+    allow = rtol * np.abs(b[finite]) + atol_rel * scale
+    max_excess = float(np.max(err - allow, initial=0.0))
+    ok = class_mismatch == 0 and max_excess <= 0.0
+    return {"name": name, "class": cls, "ok": bool(ok),
+            "rtol": rtol, "atol_rel": atol_rel,
+            "nonfinite_class_mismatch": class_mismatch,
+            "max_abs_err": float(np.max(err, initial=0.0)),
+            "max_excess": max_excess}

@@ -72,6 +72,18 @@ smeared):
   per-generation p50/p99 under ``levels`` and the loop's measured
   contract — syncs-per-generation, compiles-during-loop — under
   ``discover``; a new workload, so its records start their own
+  baseline),
+  ``r14_stream_snapshot_v1`` (ISSUE 18: the snapshot-PER-BAR finalize
+  profile, ``BENCH_STREAM_SNAPSHOT_PER_BAR=1 python bench.py stream``
+  — one warm ``snapshot()`` timed after every ingested minute of a
+  seeded day; the ``value`` is per-bar finalize p50 ms, the
+  ``snapshot`` block carries p99 and the last-quartile-of-day vs
+  first-quartile-of-day flatness ratios. The metric name embeds the
+  RESOLVED ``finalize_impl`` (``..._exact_p50_ms`` vs
+  ``..._fast_p50_ms``), so the O(day) batch-prefix finalize and the
+  O(1)-per-bar sufficient-statistic fast path bank as SEPARATE
+  series and the fast-vs-exact claim always has a banked
+  before/after; a new instrument, so its records start their own
   baseline).
 
 Session sub-series (ISSUE 15): every bench record stamps the market
@@ -146,6 +158,18 @@ never spent before (sheds, tail latency, stale ingest) even when the
 QPS headline held; a silent DROP to ~0 on a series that used to burn
 usually means the objective's signal went dark, not that the service
 got perfect.
+
+Snapshot-flatness sub-series (ISSUE 18, same availability contract): a
+record whose ``snapshot`` block is available (the per-bar profile ran
+WARM — zero compiles while profiling — with enough bars to quartile)
+contributes ``<metric>.snapshot_p99_flat_ratio`` — the per-bar finalize
+p99 of the last quartile of the day over the first. Both directions
+flag: a ratio JUMP on the fast series means per-snapshot work picked
+up a bar-cursor dependence again (the exact regression the
+sufficient-statistic path exists to kill), and a silent DROP toward 0
+usually means the profile stopped measuring the finalize at all (e.g.
+the snapshot lost its materializing read). Cold profiles never seed
+the baseline — a compiling run measures XLA, not the finalize.
 
 Baseline = median of every record in the group EXCEPT the latest; the
 latest is the record under test. ``--check FILE`` instead gates a fresh
@@ -483,6 +507,23 @@ def derive_records(record: dict) -> List[dict]:
                         "value": float(wbr), "unit": "ratio",
                         "methodology": meth,
                         "derived_from": "slo.worst_burn_rate"})
+    # snapshot-flatness sub-series (ISSUE 18): gated on the per-bar
+    # profile's own evidence — only a WARM profile (zero compiles
+    # while profiling, enough bars to quartile) measures finalize
+    # flatness; a cold one measures XLA and must not seed the
+    # baseline. Both directions flag: a ratio JUMP on the fast series
+    # means per-snapshot work regrew a bar-cursor dependence, a
+    # silent DROP toward 0 means the profile stopped measuring the
+    # finalize at all.
+    snap = record.get("snapshot")
+    if isinstance(snap, dict) and snap.get("available"):
+        flat = snap.get("p99_flat_ratio")
+        if isinstance(flat, (int, float)) and not isinstance(flat, bool) \
+                and flat > 0:
+            out.append({"metric": f"{metric}.snapshot_p99_flat_ratio",
+                        "value": float(flat), "unit": "ratio",
+                        "methodology": meth,
+                        "derived_from": "snapshot.p99_flat_ratio"})
     return out
 
 

@@ -1,0 +1,34 @@
+"""GL-A6 fixture: registered kernels in a models/ module missing (or
+mis-declaring) their finalize exactness class. Parsed, never run."""
+
+
+def register(name):            # stand-in decorators; the rule matches
+    def deco(fn):              # by call name, never by import
+        return fn
+    return deco
+
+
+def finalize_class(name, cls):
+    pass
+
+
+@register("fx_declared_direct")
+def fx_declared_direct(ctx):
+    return ctx.close
+
+
+@register("fx_declared_loop")
+def fx_declared_loop(ctx):
+    return ctx.volume
+
+
+@register("fx_missing")        # GL-A6: no finalize_class anywhere
+def fx_missing(ctx):
+    return ctx.open
+
+
+finalize_class("fx_declared_direct", "exact_fold")      # fine
+for _n in ("fx_declared_loop",):
+    finalize_class(_n, "stat_fold")                     # fine (loop form)
+finalize_class("fx_declared_direct", "warm_fold")       # GL-A6: bad class
+finalize_class("fx" + "_computed", "batch_only")        # GL-A6: dynamic name

@@ -2,7 +2,7 @@
 
 Three layers of coverage:
 
-* every AST rule (GL-A1..A5) fires on its injected-violation fixture
+* every AST rule (GL-A1..A6) fires on its injected-violation fixture
   under ``tests/fixtures/graftlint/`` with the exact code AND location,
   and the paired-resource negative fixture stays silent;
 * every jaxpr contract (GL-B0..B3) fires on a deliberately-bad kernel —
@@ -41,7 +41,7 @@ def _codes_by_file(violations):
 @pytest.fixture(scope="module")
 def fixture_violations():
     violations, n_files = run_ast_tier(FIXTURES, display_base=REPO)
-    assert n_files == 18
+    assert n_files == 19
     return violations
 
 
@@ -91,6 +91,35 @@ def test_a5_fires_on_raw_reductions_in_models(fixture_violations):
     assert [(c, s) for c, _, s in hits] == [
         ("GL-A5", "jnp.mean"), ("GL-A5", "jnp.std"),
         ("GL-A5", "jnp.nanmean")]
+
+
+def test_a6_fires_on_missing_or_bad_finalize_class(fixture_violations):
+    """ISSUE 18: a registered kernel with no finalize_class flags, a
+    non-literal exactness class flags, a computed kernel name flags —
+    and both declaration idioms (direct literal, literal-tuple loop)
+    count as coverage (the two declared kernels stay silent)."""
+    hits = _codes_by_file(fixture_violations)["bad_nofinalize.py"]
+    assert [c for c, _, _ in hits] == ["GL-A6"] * 3
+    symbols = {s for _, _, s in hits}
+    assert symbols == {"register('fx_missing')",
+                       "finalize_class(..., <class>)",
+                       "finalize_class(<dynamic>)"}
+    assert not any("fx_declared" in s for s in symbols)
+
+
+def test_a6_is_clean_on_the_real_family_modules():
+    """All 58 kernels declare their class in their family module — the
+    static rule agrees with the runtime registry's loud check."""
+    from replication_of_minute_frequency_factor_tpu.analysis import (
+        ast_tier)
+    from replication_of_minute_frequency_factor_tpu.models.registry \
+        import FINALIZE_CLASS_VALUES, finalize_classes
+
+    violations, _ = ast_tier.run_ast_tier()
+    assert not [v for v in violations if v.code == "GL-A6"]
+    # the linter's pinned literal set mirrors the registry's
+    assert ast_tier.FINALIZE_CLASS_LITERALS == FINALIZE_CLASS_VALUES
+    assert len(finalize_classes()) >= 58
 
 
 def test_a3_boundary_policy_allows_listed_symbol_only(
@@ -421,7 +450,7 @@ def test_cli_flags_fixtures_then_baseline_clears_them(tmp_path):
             "--report", report)
     out = _run_cli(*args)
     assert out.returncode == 1
-    assert json.loads(out.stdout.strip().splitlines()[-1])["new"] == 31
+    assert json.loads(out.stdout.strip().splitlines()[-1])["new"] == 34
     # refuse to baseline without a why
     out = _run_cli(*args, "--update-baseline")
     assert out.returncode == 2
@@ -434,7 +463,7 @@ def test_cli_flags_fixtures_then_baseline_clears_them(tmp_path):
     out = _run_cli(*args)
     assert out.returncode == 0
     assert json.loads(
-        out.stdout.strip().splitlines()[-1])["baselined"] == 31
+        out.stdout.strip().splitlines()[-1])["baselined"] == 34
 
 
 def test_manifest_carries_the_analysis_block(tmp_path):
@@ -525,9 +554,11 @@ def test_report_carries_resident_wrapper_fingerprints():
                              "__resident_scan_2d__",
                              "__stream_update__",
                              "__result_encode__",
-                             "__discover_generation__"}
+                             "__discover_generation__",
+                             "__stream_finalize_fast__"}
     for name, fp in wrappers.items():
-        want = 0 if name == "__result_encode__" else 1
+        want = 0 if name in ("__result_encode__",
+                             "__stream_finalize_fast__") else 1
         assert fp["primitives"].get("scan", 0) == want, name
     # the 2-D wrapper's committed fingerprint pins the cross-day carry
     # handoff in the collective class (ISSUE 13)

@@ -836,3 +836,99 @@ def test_burn_rate_series_gates_like_any_other(tmp_path):
     assert not v["ok"]
     flagged = [g for g in v["groups"] if g["flagged"]]
     assert ["serveN_qps.burn_rate_max"] == [g["metric"] for g in flagged]
+
+# --------------------------------------------------------------------------
+# Snapshot-flatness sub-series (ISSUE 18)
+# --------------------------------------------------------------------------
+
+
+def _snapshot_rec(value=0.8, flat=1.02, compiles=0, bars=240,
+                  methodology="r14_stream_snapshot_v1"):
+    """A bankable r14 snapshot-per-bar profile record (bench.py
+    ``stream_snapshot_bench``), override-able per test. ``available``
+    follows the instrument's own rule: warm (zero compiles while
+    profiling) with enough bars to quartile."""
+    avail = compiles == 0 and bars // 4 >= 4
+    return {"metric": "stream_snapshot58_64tickers_fast_p50_ms",
+            "value": value, "unit": "ms",
+            "finalize_impl": "fast",
+            "methodology": methodology,
+            "snapshot": {"bars": bars, "p50_ms": value,
+                         "p99_ms": value * 2,
+                         "p50_flat_ratio": round(flat * 0.98, 4),
+                         "p99_flat_ratio": flat,
+                         "compiles_during_profile": compiles,
+                         "available": avail}}
+
+
+def test_derive_records_lifts_available_snapshot_flatness():
+    """ISSUE 18 satellite: a warm per-bar profile derives the
+    <metric>.snapshot_p99_flat_ratio sub-series under r14 — the
+    fast-vs-exact flatness claim always has a banked before/after."""
+    recs = regress.derive_records(_snapshot_rec(flat=1.05))
+    by = {r["metric"]: r for r in recs}
+    key = ("stream_snapshot58_64tickers_fast_p50_ms"
+           ".snapshot_p99_flat_ratio")
+    assert key in by
+    assert by[key]["value"] == 1.05
+    assert by[key]["unit"] == "ratio"
+    assert by[key]["methodology"] == "r14_stream_snapshot_v1"
+    assert by[key]["derived_from"] == "snapshot.p99_flat_ratio"
+
+
+def test_cold_or_short_snapshot_profile_never_seeds():
+    """The other direction: a profile that compiled mid-run measured
+    XLA, one too short to quartile measured noise, and malformed
+    blocks measured nothing — none may seed (or gate) the flatness
+    baseline. A record with no snapshot block derives no flatness
+    series at all."""
+    for bad in (_snapshot_rec(compiles=2),
+                _snapshot_rec(bars=8)):
+        assert not bad["snapshot"]["available"]
+        assert not any(".snapshot_p99_flat_ratio" in r["metric"]
+                       for r in regress.derive_records(bad))
+    rec = _snapshot_rec()
+    rec["snapshot"]["p99_flat_ratio"] = None      # ratio unmeasurable
+    assert not any(".snapshot_p99_flat_ratio" in r["metric"]
+                   for r in regress.derive_records(rec))
+    rec = _snapshot_rec()
+    rec["snapshot"] = "broken"
+    assert not any(".snapshot_p99_flat_ratio" in r["metric"]
+                   for r in regress.derive_records(rec))
+    plain = {"metric": "cicc58_wall", "value": 60.0,
+             "methodology": "r6_resident_v2"}
+    assert not any(".snapshot_p99_flat_ratio" in r["metric"]
+                   for r in regress.derive_records(plain))
+
+
+def test_snapshot_flatness_gates_both_directions(tmp_path):
+    """The satellite's acceptance: both deviation directions flag on
+    the derived flatness group — a ratio JUMP means per-snapshot work
+    regrew a bar-cursor dependence, a silent collapse toward 0 means
+    the profile stopped measuring the finalize; an in-band candidate
+    stays quiet and a declared break opens fresh."""
+    for i, flat in enumerate((1.02, 1.04)):
+        with open(tmp_path / f"BENCH_r{i + 1:02d}.json", "w") as fh:
+            json.dump({"n": i + 1, "parsed": _snapshot_rec(flat=flat)},
+                      fh)
+    entries = regress.load_bench_series(str(tmp_path))
+    key = ("stream_snapshot58_64tickers_fast_p50_ms"
+           ".snapshot_p99_flat_ratio")
+    assert key in {e["record"]["metric"] for e in entries}
+    assert regress.evaluate(entries,
+                            candidate=_snapshot_rec(flat=1.03))["ok"]
+    v = regress.evaluate(entries, candidate=_snapshot_rec(flat=3.0))
+    assert not v["ok"]
+    assert any(f["metric"].endswith(".snapshot_p99_flat_ratio")
+               for f in v["flagged"])
+    v = regress.evaluate(entries, candidate=_snapshot_rec(flat=0.1))
+    assert not v["ok"]
+    # a cold candidate cannot trip (or ride) the derived gate — it
+    # never derives, and its own headline still gates
+    cold = _snapshot_rec(flat=1.03, compiles=3)
+    assert regress.evaluate(entries, candidate=cold)["ok"]
+    # a declared methodology break opens fresh series, never flagged
+    assert regress.evaluate(
+        entries,
+        candidate=_snapshot_rec(flat=0.2,
+                                methodology="r15_snapshot_v2"))["ok"]

@@ -757,3 +757,27 @@ def test_healthz_reports_flight_and_staleness():
         assert h["stream_staleness_s"] >= 0.0
     finally:
         srv.close()
+
+
+def test_healthz_reports_resolved_stream_finalize_impl():
+    """ISSUE 18 satellite: a streaming server reports the RESOLVED
+    snapshot finalize impl in healthz — 'exact' by default, 'fast'
+    when requested via ServeConfig AND a foldable kernel is served
+    (the degrade-to-exact case is what an operator needs to see)."""
+    srv, _ = _server(stream=True)
+    try:
+        assert srv.health()["stream_finalize_impl"] == "exact"
+    finally:
+        srv.close()
+    srv, _ = _server(stream=True, stream_finalize_impl="fast")
+    try:
+        assert srv.stream_engine.finalize_impl_resolved == "fast"
+        assert srv.health()["stream_finalize_impl"] == "fast"
+    finally:
+        srv.close()
+    # a batch-served (non-streaming) server reports nothing here
+    srv, _ = _server()
+    try:
+        assert "stream_finalize_impl" not in srv.health()
+    finally:
+        srv.close()

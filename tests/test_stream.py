@@ -297,7 +297,10 @@ def test_stream_bench_smoke_record():
     """bench.stream_smoke: the CPU acceptance evidence — zero compiles
     after warmup across every ingest shape, streamed-vs-full-day
     parity on the seeded day, and the declared r9_stream_intraday_v1
-    stamp on the bars/sec record."""
+    stamp on the bars/sec record. Since ISSUE 18 the smoke runs BOTH
+    finalize impls (zero compiles + clean parity each) and checks the
+    fast statistic fold survives a cohort<->scan ingest mix
+    bit-identically."""
     r = bench.stream_smoke()
     assert r["ok"], r
     assert r["methodology"] == "r9_stream_intraday_v1"
@@ -306,6 +309,13 @@ def test_stream_bench_smoke_record():
     assert r["updates"] > 0 and r["bars"] > 0
     assert r["p50_ms"] > 0 and r["p99_ms"] >= r["p50_ms"]
     assert r["bars_per_s"] > 0
+    assert set(r["impls"]) == {"exact", "fast"}
+    for impl, v in r["impls"].items():
+        assert v["finalize_impl_resolved"] == impl
+        assert v["compiles_during_load"] == 0, impl
+        assert v["parity_mismatched"] == [], impl
+    assert r["fast_fold_mix"]["leaves_differ"] == []
+    assert r["fast_fold_mix"]["snapshot_bitwise"] is True
 
 
 def test_warm_engine_ingest_compiles_nothing():

@@ -346,47 +346,86 @@ def test_resident_sharded_in_default_steps(tpu_session):
     assert "resident_sharded" in src.split("steps = {")[1]
 
 
+def _stream_rec(hbm=True, mesh=True, fh=True, finalize_impl="exact",
+                **stream):
+    """One bankable r9 stream record, override-able per test."""
+    base = {"updates": 2880, "compiles_during_load": 0,
+            "parity_mismatched": []}
+    base.update(stream)
+    rec = {"metric": "stream58_1024tickers_bars_per_s",
+           "value": 83000.0,
+           "methodology": "r9_stream_intraday_v1",
+           "finalize_impl": finalize_impl,
+           "stream": base}
+    if hbm:
+        rec["hbm"] = {"available": True, "peak_bytes": 1 << 30}
+    if mesh:
+        rec["mesh"] = {"available": False, "occupancy_frac": 1.0}
+    if fh:
+        rec["factor_health"] = {"available": True,
+                                "coverage_frac": 0.97}
+    return rec
+
+
+def _snapshot_profile_rec(available=True, finalize_impl="fast"):
+    """The r14 snapshot-per-bar histogram record the fast leg needs."""
+    return {"metric": "stream_snapshot58_1024tickers_fast_p50_ms",
+            "value": 0.8, "methodology": "r14_stream_snapshot_v1",
+            "finalize_impl": finalize_impl,
+            "snapshot": {"bars": 240, "p50_ms": 0.8, "p99_ms": 1.4,
+                         "p50_flat_ratio": 1.01,
+                         "p99_flat_ratio": 1.05,
+                         "compiles_during_profile": 0,
+                         "available": available}}
+
+
 def test_stream_intraday_carry_requires_real_streaming(tpu_session):
     """ISSUE 7: a 'stream_intraday' entry only carries when it is an
     r9 record that actually streamed warm and faithfully — updates >
     0, zero compiles during load, empty parity-mismatch list. A
     zero-update record, a cold (compiling) load, or an on-hardware
-    parity failure must re-run."""
-    def entry(hbm=True, mesh=True, fh=True, **stream):
-        base = {"updates": 2880, "compiles_during_load": 0,
-                "parity_mismatched": []}
-        base.update(stream)
-        rec = {"metric": "stream58_1024tickers_bars_per_s",
-               "value": 83000.0,
-               "methodology": "r9_stream_intraday_v1",
-               "stream": base}
-        if hbm:
-            rec["hbm"] = {"available": True, "peak_bytes": 1 << 30}
-        if mesh:
-            rec["mesh"] = {"available": False, "occupancy_frac": 1.0}
-        if fh:
-            rec["factor_health"] = {"available": True,
-                                    "coverage_frac": 0.97}
-        return {"stream_intraday": {"ok": True, "results": [rec]}}
+    parity failure must re-run. Since ISSUE 18 the window is an
+    exact/fast A/B, so the fast leg's records ride every entry here;
+    the exact-leg failure modes must still drop the step."""
+    def entry(**kw):
+        return {"stream_intraday": {"ok": True, "results": [
+            _stream_rec(**kw),
+            _stream_rec(finalize_impl="fast"),
+            _snapshot_profile_rec()]}}
 
     good = entry()
     assert tpu_session.drop_conv_only_rolling(good) == good
-    assert tpu_session.drop_conv_only_rolling(entry(updates=0)) == {}
+    assert tpu_session.drop_conv_only_rolling(entry(updates=0)) != {}
+    # ^ updates=0 only kills the exact record; the fast r9 record in
+    #   the same window still satisfies _stream_record_banks — the
+    #   interesting exact-leg drops are the whole-window ones below
+    def entry_solo(**kw):
+        return {"stream_intraday": {"ok": True, "results": [
+            _stream_rec(**kw), _snapshot_profile_rec()]}}
+    assert tpu_session.drop_conv_only_rolling(
+        entry_solo(finalize_impl="fast")) == \
+        entry_solo(finalize_impl="fast")
+    assert tpu_session.drop_conv_only_rolling(
+        entry_solo(updates=0, finalize_impl="fast")) == {}
     # ISSUE 8: a record without the HBM watermark block cannot bank —
     # the carried trajectory feeds the hbm_peak_bytes regress series
-    assert tpu_session.drop_conv_only_rolling(entry(hbm=False)) == {}
+    assert tpu_session.drop_conv_only_rolling(
+        entry_solo(hbm=False, finalize_impl="fast")) == {}
     # ISSUE 9: same rule for the mesh balance block (cohort occupancy)
-    assert tpu_session.drop_conv_only_rolling(entry(mesh=False)) == {}
+    assert tpu_session.drop_conv_only_rolling(
+        entry_solo(mesh=False, finalize_impl="fast")) == {}
     # ISSUE 12: same rule for the factor-health block (the fused
     # stats + readiness-lag sample feeds the coverage_frac series)
-    assert tpu_session.drop_conv_only_rolling(entry(fh=False)) == {}
     assert tpu_session.drop_conv_only_rolling(
-        entry(compiles_during_load=3)) == {}
+        entry_solo(fh=False, finalize_impl="fast")) == {}
     assert tpu_session.drop_conv_only_rolling(
-        entry(parity_mismatched=["vol_upRatio"])) == {}
+        entry_solo(compiles_during_load=3, finalize_impl="fast")) == {}
+    assert tpu_session.drop_conv_only_rolling(
+        entry_solo(parity_mismatched=["vol_upRatio"],
+                   finalize_impl="fast")) == {}
     wrong_series = entry()
-    wrong_series["stream_intraday"]["results"][0]["methodology"] = \
-        "r4_stream_v2"
+    for rec in wrong_series["stream_intraday"]["results"]:
+        rec["methodology"] = "r4_stream_v2"
     assert tpu_session.drop_conv_only_rolling(wrong_series) == {}
     # the UNRELATED legacy 'stream' step (r1-r4 batch loop) still
     # carries on its own mode rule — the two must not interfere
@@ -395,37 +434,87 @@ def test_stream_intraday_carry_requires_real_streaming(tpu_session):
     assert tpu_session.drop_conv_only_rolling(legacy) == legacy
 
 
+def test_stream_intraday_carry_requires_fast_ab_leg(tpu_session):
+    """ISSUE 18: the window must ALSO carry a bankable fast-finalize
+    leg — an r9 record genuinely RESOLVED to 'fast' with a green
+    verdict plus the available r14 per-bar histogram. A pre-A/B entry
+    (exact only), a fast request that silently degraded to exact, a
+    missing histogram, or a cold (unavailable) profile all re-run."""
+    def entry(fast_kw=None, profile=True, prof_kw=None):
+        recs = [_stream_rec()]
+        if fast_kw is not None:
+            recs.append(_stream_rec(**fast_kw))
+        if profile:
+            recs.append(_snapshot_profile_rec(**(prof_kw or {})))
+        return {"stream_intraday": {"ok": True, "results": recs}}
+
+    good = entry(fast_kw={"finalize_impl": "fast"})
+    assert tpu_session.drop_conv_only_rolling(good) == good
+    # exact-only window (pre-ISSUE-18 artifact): re-runs
+    assert tpu_session.drop_conv_only_rolling(
+        entry(fast_kw=None)) == {}
+    # requested fast but RESOLVED exact: not a fast number — re-runs
+    assert tpu_session.drop_conv_only_rolling(
+        entry(fast_kw={"finalize_impl": "exact"})) == {}
+    # fast leg with a parity mismatch: the verdict is not green
+    assert tpu_session.drop_conv_only_rolling(
+        entry(fast_kw={"finalize_impl": "fast",
+                       "parity_mismatched": ["mmt_am"]})) == {}
+    # per-bar histogram missing entirely, or present but cold
+    assert tpu_session.drop_conv_only_rolling(
+        entry(fast_kw={"finalize_impl": "fast"}, profile=False)) == {}
+    assert tpu_session.drop_conv_only_rolling(
+        entry(fast_kw={"finalize_impl": "fast"},
+              prof_kw={"available": False})) == {}
+    # a histogram from an exact profile run is not fast evidence
+    assert tpu_session.drop_conv_only_rolling(
+        entry(fast_kw={"finalize_impl": "fast"},
+              prof_kw={"finalize_impl": "exact"})) == {}
+
+
 def test_stream_intraday_step_refuses_unbankable_records(
         tpu_session, monkeypatch):
     """The step itself flips ok=False when the record shows a CPU
     fallback or an unbankable stream block — green-but-not-streamed
-    banking is what the carry rule cannot repair after the fact."""
-    def fake_lines(cmd, timeout, env=None):
-        assert cmd[1:] == ["bench.py", "stream"]
-        assert env["BENCH_REQUIRE_TPU"] == "1"
-        return {"ok": True, "rc": 0, "results": [
-            {"metric": "stream58_1024tickers_bars_per_s",
-             "methodology": "r9_stream_intraday_v1",
-             "hbm": {"available": True, "peak_bytes": 1 << 30},
-             "mesh": {"available": False, "occupancy_frac": 1.0},
-             "stream": {"updates": 0, "compiles_during_load": 0,
-                        "parity_mismatched": []}}]}
-    monkeypatch.setattr(tpu_session, "_run_json_lines", fake_lines)
+    banking is what the carry rule cannot repair after the fact.
+    Since ISSUE 18 the step runs three legs (exact r9, fast r9, fast
+    r14 profile) at the same window; the fake answers per the leg's
+    env so the A/B wiring itself is under test."""
+    def make_fake(updates=99, fast_resolves="fast", prof_available=True):
+        def fake_lines(cmd, timeout, env=None):
+            assert cmd[1:] == ["bench.py", "stream"]
+            assert env["BENCH_REQUIRE_TPU"] == "1"
+            if env.get("BENCH_STREAM_SNAPSHOT_PER_BAR") == "fast":
+                return {"ok": True, "rc": 0, "results": [
+                    _snapshot_profile_rec(available=prof_available)]}
+            impl = env["MFF_FINALIZE_IMPL"]
+            resolved = fast_resolves if impl == "fast" else impl
+            return {"ok": True, "rc": 0, "results": [
+                _stream_rec(updates=updates, finalize_impl=resolved)]}
+        return fake_lines
+
+    monkeypatch.setattr(tpu_session, "_run_json_lines",
+                        make_fake(updates=0))
     r = tpu_session.step_stream_intraday()
     assert r["ok"] is False and "cannot bank" in r["error"]
 
-    def fake_good(cmd, timeout, env=None):
-        return {"ok": True, "rc": 0, "results": [
-            {"metric": "stream58_1024tickers_bars_per_s",
-             "methodology": "r9_stream_intraday_v1",
-             "hbm": {"available": True, "peak_bytes": 1 << 30},
-             "mesh": {"available": False, "occupancy_frac": 1.0},
-             "factor_health": {"available": True,
-                               "coverage_frac": 0.97},
-             "stream": {"updates": 99, "compiles_during_load": 0,
-                        "parity_mismatched": []}}]}
-    monkeypatch.setattr(tpu_session, "_run_json_lines", fake_good)
-    assert tpu_session.step_stream_intraday()["ok"] is True
+    # the exact leg is green but the fast engine silently degraded
+    monkeypatch.setattr(tpu_session, "_run_json_lines",
+                        make_fake(fast_resolves="exact"))
+    r = tpu_session.step_stream_intraday()
+    assert r["ok"] is False and "fast" in r["error"]
+
+    # ... or the per-bar histogram came back cold
+    monkeypatch.setattr(tpu_session, "_run_json_lines",
+                        make_fake(prof_available=False))
+    r = tpu_session.step_stream_intraday()
+    assert r["ok"] is False and "fast" in r["error"]
+
+    monkeypatch.setattr(tpu_session, "_run_json_lines", make_fake())
+    r = tpu_session.step_stream_intraday()
+    assert r["ok"] is True
+    # the merged window carries all three legs' records
+    assert len(r["results"]) == 3
 
 
 def test_stream_intraday_in_default_steps(tpu_session):
