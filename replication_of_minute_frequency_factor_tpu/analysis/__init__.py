@@ -1,6 +1,6 @@
 """graftlint — static contract analysis for the 58-kernel factor engine.
 
-Two tiers (docs/static-analysis.md):
+Three tiers (docs/static-analysis.md):
 
 * **Tier A** (:mod:`.ast_tier`) — a rule engine over the package's
   Python AST. Rules GL-A1..GL-A5 encode the bug classes earlier PRs
@@ -16,6 +16,13 @@ Two tiers (docs/static-analysis.md):
   ``convert_element_type``, zero host callbacks, plus a per-kernel
   primitive-count fingerprint written to ``analysis_report.json`` so
   graph drift is diffable in review.
+* **Tier C** (:mod:`.concurrency_tier`) — lock-discipline and
+  thread-lifecycle rules GL-C1..GL-C4 over the threaded layers
+  (``serve/``, ``fleet/``, ``stream/``, ``research/``,
+  ``telemetry/``), driven by the ``GLC_CONTRACT`` declarations that
+  live next to each thread-shared class. Its runtime twin
+  (:mod:`..telemetry.lockcheck`, ``MFF_LOCK_ASSERT=1``) asserts the
+  same contracts at mutation time.
 
 Accepted violations live in the committed :data:`BASELINE_PATH`
 (:mod:`.violations`), each with a mandatory written justification.
@@ -26,10 +33,12 @@ from __future__ import annotations
 
 from .violations import BASELINE_PATH, Baseline, Violation
 from .ast_tier import run_ast_tier
+from .concurrency_tier import run_concurrency_tier
 from .jaxpr_tier import run_jaxpr_tier
 from .report import build_report, manifest_block, write_report
 
 __all__ = [
     "BASELINE_PATH", "Baseline", "Violation", "build_report",
-    "manifest_block", "run_ast_tier", "run_jaxpr_tier", "write_report",
+    "manifest_block", "run_ast_tier", "run_concurrency_tier",
+    "run_jaxpr_tier", "write_report",
 ]

@@ -18,14 +18,116 @@ from typing import List, Optional
 from .violations import BASELINE_PATH, Baseline
 from .report import build_report, repo_root, write_report
 
+#: ``--explain <CODE>``: rationale + fix pattern per rule, printable
+#: without importing jax or tracing anything. Every code across all
+#: three tiers appears here (docs/static-analysis.md is the long form).
+EXPLAIN = {
+    "GL-A1": ("jax attribute chain that does not exist on the pinned "
+              "jax (the jnp.maximum.accumulate incident): the call "
+              "fails only at runtime, on the accelerator host.",
+              "Use an attribute that exists on the pinned jax, or gate "
+              "behind hasattr with a tested fallback."),
+    "GL-A2": ("serial Python/lax loop constructs in the kernel layers "
+              "trace one program per iteration (the PR 3 rolling "
+              "pathology) — compile times and HBM explode.",
+              "Vectorise: windowed ops via ops.rolling / conv, batch "
+              "via vmap; the one driving scan lives only in the "
+              "resident wrappers."),
+    "GL-A3": ("host-sync calls (block_until_ready, device_get, float()"
+              " on a tracer) in device-hot modules serialize the "
+              "dispatch pipeline.",
+              "Keep results on device; sync only at the declared "
+              "boundary modules listed in GLA3_BOUNDARY_SYNCS."),
+    "GL-A4": ("resource acquisition (start_trace-style) without a "
+              "guaranteed release leaks the resource on any exception "
+              "path (the PR 2 bug).",
+              "Pair acquire/release in try/finally or a context "
+              "manager."),
+    "GL-A5": ("raw jnp.mean/std/var/nan* in models/ silently disagree "
+              "with the NaN-mask discipline the kernels mandate.",
+              "Use the ops.masked reductions — same math, explicit "
+              "mask semantics."),
+    "GL-B0": ("a registered kernel failed to abstract-trace at the "
+              "canonical shape — it cannot run at all.",
+              "Fix the trace error; the jaxpr tier's error message "
+              "carries the originating exception."),
+    "GL-B1": ("while/scan primitives in a kernel jaxpr mean a serial "
+              "loop survived into the compiled graph.",
+              "Vectorise the computation; only the resident wrappers' "
+              "ONE driving scan is exempt (by symbol, never by "
+              "baseline)."),
+    "GL-B2": ("an f64 convert_element_type in a kernel graph doubles "
+              "memory and silently de-aligns from the f32 contract "
+              "(the f64 oracle lives in tests only).",
+              "Keep kernel dtypes f32/int32; cast explicitly in the "
+              "test oracle, not the kernel."),
+    "GL-B3": ("host callbacks (pure_callback/io_callback/debug."
+              "callback) in a kernel graph stall the device on the "
+              "host every step.",
+              "Move host work outside the jitted graph."),
+    "GL-A6": ("a @register kernel in models/ with no finalize-class "
+              "declaration cannot state its exactness class, so the "
+              "fast-finalize path must guess.",
+              "Declare finalize_class(...) next to the kernel with "
+              "one of the three exactness classes."),
+    "GL-C1": ("a write/RMW of a declared guarded attribute outside "
+              "'with self.<lock>:', or a cross-object reach into "
+              "another class's guarded internals — exactly the race "
+              "that works under CPython coincidence until it "
+              "corrupts a scrape mid-flight.",
+              "Take the owning lock around the mutation; for "
+              "cross-object reads add a locked accessor on the owner "
+              "(FleetRouter.inflight() is the pattern). Methods that "
+              "genuinely run pre-thread go in the contract's 'init' "
+              "tuple; caller-holds-lock helpers go in 'locked' — both "
+              "with a docstring saying why."),
+    "GL-C2": ("a thread that is not daemon=True blocks interpreter "
+              "shutdown; one with no join path leaks; a target that "
+              "mutates a foreign class's guarded state races its "
+              "owner's lock.",
+              "Construct threads daemon=True (literal), register them "
+              "on the owner and join in stop()/close()/drain(), or "
+              "return the thread to the caller who owns its "
+              "lifecycle; route foreign-state writes through a locked "
+              "method on the owner."),
+    "GL-C3": ("a plain open('w') from a threaded context lets a "
+              "scraper/reader see a torn half-written file.",
+              "Write '<path>.tmp' then os.replace(tmp, path) — "
+              "atomic on POSIX; FlightRecorder.dump is the exemplar."),
+    "GL-C4": ("a bare except:pass in a thread run loop turns a real "
+              "bug into a silently stalled sampler — nothing in any "
+              "scrape says it died.",
+              "Count a telemetry counter in the handler "
+              "(tel.counter('<plane>.sample_errors', "
+              "error=type(e).__name__)) so the failure is "
+              "observable, then continue."),
+}
+
+
+def explain(code: str) -> int:
+    spec = EXPLAIN.get(code.strip().upper())
+    if spec is None:
+        print(f"unknown rule code {code!r}; known: "
+              + ", ".join(sorted(EXPLAIN)), file=sys.stderr)
+        return 2
+    why, fix = spec
+    print(f"{code.strip().upper()}")
+    print(f"  why: {why}")
+    print(f"  fix: {fix}")
+    return 0
+
 
 def add_args(p: argparse.ArgumentParser) -> None:
-    p.add_argument("--tier", choices=("ast", "jaxpr", "all"),
+    p.add_argument("--tier", choices=("ast", "jaxpr", "c", "all"),
                    default="all",
                    help="which tier(s) to run (default: all; the jaxpr "
                         "tier abstractly traces every registered "
                         "kernel — run it under JAX_PLATFORMS=cpu "
-                        "locally, no accelerator needed)")
+                        "locally, no accelerator needed; tier c is the "
+                        "concurrency lint over the threaded layers)")
+    p.add_argument("--explain", default=None, metavar="CODE",
+                   help="print the rationale and fix pattern for one "
+                        "rule code (e.g. GL-C1) and exit")
     p.add_argument("--baseline", default=BASELINE_PATH,
                    help="accepted-violations file (default: the "
                         "committed package baseline)")
@@ -52,6 +154,9 @@ def add_args(p: argparse.ArgumentParser) -> None:
 
 
 def run(args: argparse.Namespace) -> int:
+    if getattr(args, "explain", None):
+        return explain(args.explain)
+
     from .ast_tier import run_ast_tier
     from .jaxpr_tier import SLOTS, run_jaxpr_tier
 
@@ -63,6 +168,30 @@ def run(args: argparse.Namespace) -> int:
             vs, nf = run_ast_tier(root)
             violations += vs
             n_files += nf
+    concurrency = None
+    if args.tier in ("c", "all"):
+        from .concurrency_tier import contract_index, run_concurrency_tier
+
+        c_violations = []
+        c_files = 0
+        contracts = {}
+        roots = args.paths if args.paths else [None]
+        for root in roots:
+            vs, nf = run_concurrency_tier(root)
+            c_violations += vs
+            c_files += nf
+            contracts.update(contract_index(root))
+        violations += c_violations
+        concurrency = {
+            "files_scanned": c_files,
+            "contracts": contracts,
+            "by_rule": {},
+        }
+        for v in c_violations:
+            concurrency["by_rule"][v.code] = \
+                concurrency["by_rule"].get(v.code, 0) + 1
+        concurrency["by_rule"] = dict(
+            sorted(concurrency["by_rule"].items()))
     fingerprints = None
     resident_fps = None
     session_fps = None
@@ -110,7 +239,8 @@ def run(args: argparse.Namespace) -> int:
                           fingerprints=fingerprints,
                           files_scanned=n_files, shape=shape,
                           resident_fingerprints=resident_fps,
-                          session_fingerprints=session_fps)
+                          session_fingerprints=session_fps,
+                          concurrency=concurrency)
     report_path = args.report
     if report_path is None:
         import os
@@ -131,6 +261,8 @@ def run(args: argparse.Namespace) -> int:
         verdict["resident_wrappers"] = len(resident_fps)
     if session_fps is not None:
         verdict["sessions"] = len(session_fps)
+    if concurrency is not None:
+        verdict["contracts"] = len(concurrency["contracts"])
     if report_path != "-":
         verdict["report"] = report_path
     print(json.dumps(verdict))

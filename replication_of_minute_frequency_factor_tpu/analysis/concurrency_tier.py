@@ -1,0 +1,622 @@
+"""Tier C: the concurrency rule engine (rules GL-C1..GL-C4).
+
+The threaded layers (``serve/``, ``fleet/``, ``stream/``,
+``research/``, ``telemetry/``) declare their lock discipline next to
+the classes that own it — a module-level ``GLC_CONTRACT`` literal,
+mirroring ``GLA3_BOUNDARY_SYNCS``: per class, which lock guards which
+thread-shared attributes. This tier machine-checks the declarations on
+the AST; ``telemetry/lockcheck.py`` is the runtime twin that asserts
+the same contract at mutation time under ``MFF_LOCK_ASSERT=1``.
+
+Contract shape (parsed with ``ast.literal_eval`` — literals only)::
+
+    GLC_CONTRACT = {
+        "MetricsRegistry": {
+            "lock": "_lock",
+            "guards": ("_counters", "_gauges", "_hists"),
+            "init": (),        # extra single-threaded methods
+            "locked": (),      # caller-holds-lock helpers
+        },
+    }
+
+``__init__`` is always construction-time single-threaded; ``init``
+lists further methods documented as running before any thread starts.
+``locked`` lists private helpers whose documented contract is "caller
+holds the lock" (e.g. ``ShedPolicy._demote``) — they skip the GL-C1
+same-class check but stay covered by the runtime twin, which checks
+the lock is actually held whenever they run.
+
+Rule catalog (docs/static-analysis.md):
+
+GL-C1  a write / read-modify-write of a declared guarded attribute
+       outside a ``with self.<lock>:`` scope. Lock-scope inference is
+       lexical containment in the ``with`` body, which is exactly
+       right for early returns and try/finally: the ``with`` statement
+       guarantees the lock is held for every statement of its suite
+       and released on every exit path. A nested ``def``/``lambda``
+       resets the inference — closures run later, when the lock is no
+       longer held. Second arm: reaching through an object attribute
+       into ANOTHER object's guarded internals
+       (``self.router._inflight``) flags read or write — cross-object
+       access must go through a locked accessor on the owner.
+GL-C2  every ``threading.Thread`` started in the scanned layers must
+       be ``daemon=True``, must have a stop/join path (a ``.join``
+       somewhere in the owning class/module, or the thread object is
+       returned to the caller, who owns its lifecycle), and its target
+       must not mutate guarded state of a foreign class through a bare
+       reference.
+GL-C3  file outputs from methods of a contract-declaring class (the
+       threaded contexts: flight dumps, timeline/bench records) must
+       use the write-then-``os.replace`` atomic idiom so a reader
+       never sees a half-written file. ``__init__``/``init`` methods
+       are exempt (opening an append-mode sink once at construction is
+       not a threaded write).
+GL-C4  no bare ``except: pass`` swallowing inside a thread target —
+       a daemon loop that eats exceptions silently turns a real bug
+       into a stalled sampler; count a telemetry counter instead (the
+       ``MeshPlane.measure_ready`` / FlightRecorder discipline).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .violations import Violation
+
+#: layers the tier scans. ``concurrency`` is the fixture pseudo-layer:
+#: tests/fixtures/graftlint/concurrency/ scans under that directory
+#: name so Tier A's layer-scoped rules stay silent on the fixtures.
+CONCURRENCY_SCOPE = ("serve", "fleet", "stream", "research",
+                     "telemetry", "concurrency")
+
+#: the module-level declaration name the tier looks for
+CONTRACT_NAME = "GLC_CONTRACT"
+
+#: method names that mutate their receiver in place (GL-C1/GL-C2)
+MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "remove", "discard", "pop", "popleft", "popitem", "clear",
+    "update", "setdefault", "rotate", "sort", "reverse",
+})
+
+
+# --------------------------------------------------------------------------
+# contract collection (pass 1)
+# --------------------------------------------------------------------------
+
+
+def _load_contract(node: ast.Assign) -> Optional[dict]:
+    """The ``GLC_CONTRACT = {...}`` literal, or None if not one."""
+    if len(node.targets) != 1:
+        return None
+    t = node.targets[0]
+    if not (isinstance(t, ast.Name) and t.id == CONTRACT_NAME):
+        return None
+    try:
+        value = ast.literal_eval(node.value)
+    except (ValueError, SyntaxError):
+        return {}
+    return value if isinstance(value, dict) else {}
+
+
+def _contract_errors(contract: dict) -> List[str]:
+    errs = []
+    for cls, spec in contract.items():
+        if not isinstance(spec, dict) or not isinstance(
+                spec.get("lock"), str):
+            errs.append(f"{cls}: spec must be a dict with a str 'lock'")
+            continue
+        for key in ("guards", "init", "locked"):
+            val = spec.get(key, ())
+            if not (isinstance(val, (tuple, list))
+                    and all(isinstance(a, str) for a in val)):
+                errs.append(f"{cls}: {key!r} must be a tuple of str")
+    return errs
+
+
+class _FileScan:
+    """One parsed module: tree, declared contracts, violations."""
+
+    def __init__(self, file_path: str, display_path: str,
+                 scope_parts: Tuple[str, ...]):
+        self.file_path = file_path
+        self.path = display_path
+        self.scope_parts = scope_parts
+        with open(file_path, "rb") as fh:
+            self.tree = ast.parse(fh.read(), filename=file_path)
+        self.violations: List[Violation] = []
+        self.contracts: Dict[str, dict] = {}
+        self.threading_names: Dict[str, str] = {}
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign):
+                c = _load_contract(node)
+                if c is not None:
+                    for err in _contract_errors(c):
+                        self.add("GL-C1", node, CONTRACT_NAME,
+                                 f"malformed concurrency contract — {err}")
+                    self.contracts.update(
+                        {k: v for k, v in c.items()
+                         if isinstance(v, dict)
+                         and isinstance(v.get("lock"), str)})
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "threading":
+                        self.threading_names[a.asname or "threading"] \
+                            = "threading"
+            elif isinstance(node, ast.ImportFrom) \
+                    and node.module == "threading" and node.level == 0:
+                for a in node.names:
+                    self.threading_names[a.asname or a.name] = a.name
+
+    def in_scope(self) -> bool:
+        return bool(set(self.scope_parts[:-1]) & set(CONCURRENCY_SCOPE))
+
+    def add(self, code: str, node: ast.AST, symbol: str,
+            message: str) -> None:
+        self.violations.append(Violation(
+            code=code, path=self.path,
+            line=getattr(node, "lineno", 0), symbol=symbol,
+            message=message))
+
+
+# --------------------------------------------------------------------------
+# small AST helpers
+# --------------------------------------------------------------------------
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.x`` -> 'x'; None otherwise."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _is_lock_with(node: ast.With, lock: str) -> bool:
+    """Does any withitem acquire ``self.<lock>``?"""
+    for item in node.items:
+        if _self_attr(item.context_expr) == lock:
+            return True
+    return False
+
+
+def _class_methods(cls: ast.ClassDef) -> Dict[str, ast.FunctionDef]:
+    return {m.name: m for m in cls.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _mutation_receivers(node: ast.AST):
+    """Yield (receiver_expr, attr, kind) for every in-place mutation
+    expressed by ``node``: attribute rebinds, subscript stores/deletes,
+    augmented assigns, and mutator-method calls. The receiver is the
+    expression owning the attribute (``self`` in ``self._ring.append``).
+    """
+    def targets_of(t: ast.AST):
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                yield from targets_of(e)
+        elif isinstance(t, ast.Starred):
+            yield from targets_of(t.value)
+        elif isinstance(t, ast.Attribute):
+            yield (t.value, t.attr, "rebind")
+        elif isinstance(t, ast.Subscript) \
+                and isinstance(t.value, ast.Attribute):
+            yield (t.value.value, t.value.attr, "store")
+
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            yield from targets_of(t)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        if not (isinstance(node, ast.AnnAssign) and node.value is None):
+            yield from targets_of(node.target)
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            yield from targets_of(t)
+    elif isinstance(node, ast.Call) \
+            and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in MUTATORS \
+            and isinstance(node.func.value, ast.Attribute):
+        yield (node.func.value.value, node.func.value.attr, "mutate")
+
+
+# --------------------------------------------------------------------------
+# GL-C1: lock discipline
+# --------------------------------------------------------------------------
+
+
+def _check_c1_class(scan: _FileScan, cls: ast.ClassDef,
+                    contract: dict) -> None:
+    lock = contract["lock"]
+    guards = set(contract.get("guards", ()))
+    exempt = ({"__init__"} | set(contract.get("init", ()))
+              | set(contract.get("locked", ())))
+    methods = _class_methods(cls)
+    for name in sorted(set(contract.get("init", ()))
+                       | set(contract.get("locked", ()))):
+        if name not in methods:
+            scan.add("GL-C1", cls, f"{cls.name}.{name}",
+                     f"contract declares unknown method {name!r} — "
+                     "init/locked entries must name real methods so "
+                     "the exemption cannot outlive a rename")
+
+    def visit(node: ast.AST, locked: bool) -> None:
+        if isinstance(node, ast.With) and _is_lock_with(node, lock):
+            for item in node.items:
+                visit(item.context_expr, locked)
+            for child in node.body:
+                visit(child, True)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # a closure runs later, when the lock is no longer held
+            locked = False
+        if not locked:
+            for recv, attr, kind in _mutation_receivers(node):
+                if attr in guards and isinstance(recv, ast.Name) \
+                        and recv.id == "self":
+                    scan.add(
+                        "GL-C1", node, f"{cls.name}.{attr}",
+                        f"write to guarded attribute {attr!r} outside "
+                        f"'with self.{lock}:' — the contract declares "
+                        f"{cls.name}.{lock} as its guard; take the "
+                        "lock, or declare the method init/locked with "
+                        "a docstring saying why that is safe")
+        for child in ast.iter_child_nodes(node):
+            visit(child, locked)
+
+    for name, meth in methods.items():
+        if name in exempt:
+            continue
+        for child in meth.body:
+            visit(child, False)
+
+
+def _check_c1_foreign(scan: _FileScan,
+                      guarded_owners: Dict[str, List[Tuple[str, str]]]
+                      ) -> None:
+    """Cross-object reaches into guarded internals: ``a.b._guarded``.
+
+    Bare-name receivers (``other._counters`` in ``registry.merge``)
+    are deliberately exempt — a same-class parameter may be accessed
+    under its own lock, which the AST cannot prove either way; the
+    runtime twin covers that path. An *attribute* receiver is a
+    different object's internals by construction."""
+    for node in ast.walk(scan.tree):
+        if not isinstance(node, ast.Attribute):
+            continue
+        owners = guarded_owners.get(node.attr)
+        if not owners or not isinstance(node.value, ast.Attribute):
+            continue
+        owner_cls, lock = owners[0]
+        recv = node.value.attr
+        scan.add(
+            "GL-C1", node, f"{recv}.{node.attr}",
+            f"reach into {owner_cls}.{node.attr} (guarded by "
+            f"{owner_cls}.{lock}) from outside the owning class; add "
+            f"a locked accessor on {owner_cls} instead")
+
+
+# --------------------------------------------------------------------------
+# GL-C2: thread lifecycle
+# --------------------------------------------------------------------------
+
+
+def _is_thread_call(scan: _FileScan, call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "Thread" \
+            and isinstance(f.value, ast.Name):
+        return scan.threading_names.get(f.value.id) == "threading"
+    if isinstance(f, ast.Name):
+        return scan.threading_names.get(f.id) == "Thread"
+    return False
+
+
+def _contains_join(nodes) -> bool:
+    for n in nodes if isinstance(nodes, list) else [nodes]:
+        for sub in ast.walk(n):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr == "join":
+                return True
+    return False
+
+
+def _thread_target(call: ast.Call) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == "target":
+            return kw.value
+    return call.args[0] if call.args else None
+
+
+def _resolve_target(scan: _FileScan, target: Optional[ast.AST],
+                    encl_class: Optional[ast.ClassDef]
+                    ) -> Tuple[Optional[ast.FunctionDef],
+                               Optional[ast.ClassDef]]:
+    """(target function node, owning class) — (None, None) when the
+    target is not statically resolvable (``httpd.serve_forever``)."""
+    if target is None:
+        return None, None
+    name = _self_attr(target)
+    if name is not None and encl_class is not None:
+        meth = _class_methods(encl_class).get(name)
+        return meth, encl_class if meth is not None else None
+    if isinstance(target, ast.Name):
+        for node in scan.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == target.id:
+                return node, None
+    return None, None
+
+
+def _check_c2(scan: _FileScan,
+              guarded_owners: Dict[str, List[Tuple[str, str]]]
+              ) -> List[Tuple[ast.AST, Optional[ast.ClassDef]]]:
+    """Check every Thread construction; return the resolved targets
+    (for GL-C4)."""
+    targets: List[Tuple[ast.AST, Optional[ast.ClassDef]]] = []
+
+    def visit(node: ast.AST, stack: List[ast.AST]) -> None:
+        if isinstance(node, ast.Call) and _is_thread_call(scan, node):
+            encl_class = next((n for n in reversed(stack)
+                               if isinstance(n, ast.ClassDef)), None)
+            encl_func = next(
+                (n for n in reversed(stack)
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))), None)
+            daemon = next((kw.value for kw in node.keywords
+                           if kw.arg == "daemon"), None)
+            if not (isinstance(daemon, ast.Constant)
+                    and daemon.value is True):
+                scan.add(
+                    "GL-C2", node, "Thread(daemon=...)",
+                    "every thread in the package must be daemon=True "
+                    "(a literal, so the linter can see it) — a "
+                    "non-daemon sampler blocks interpreter shutdown")
+            search = encl_class if encl_class is not None else scan.tree
+            ok = _contains_join(search)
+            if not ok and encl_func is not None:
+                # returned to the caller, who owns the join
+                # (the serve_http pattern: `return httpd, thread`)
+                assigned = None
+                for sub in ast.walk(encl_func):
+                    if isinstance(sub, ast.Assign) and sub.value is node:
+                        t = sub.targets[0]
+                        if isinstance(t, ast.Name):
+                            assigned = t.id
+                for sub in ast.walk(encl_func):
+                    if isinstance(sub, ast.Return) \
+                            and sub.value is not None:
+                        for leaf in ast.walk(sub.value):
+                            if isinstance(leaf, ast.Name) \
+                                    and leaf.id == assigned \
+                                    and assigned is not None:
+                                ok = True
+                            if isinstance(leaf, ast.Call) \
+                                    and leaf is node:
+                                ok = True
+            if not ok:
+                scan.add(
+                    "GL-C2", node, "Thread(no stop/join path)",
+                    "thread started with no reachable join: register "
+                    "it on the owner and join in a stop()/close()/"
+                    "drain() method, or return it to the caller")
+            tnode, towner = _resolve_target(scan, _thread_target(node),
+                                            encl_class)
+            if tnode is not None:
+                targets.append((tnode, towner))
+                own_guards = set()
+                if towner is not None:
+                    own_guards = set(
+                        scan.contracts.get(towner.name, {})
+                        .get("guards", ()))
+                for sub in ast.walk(tnode):
+                    for recv, attr, kind in _mutation_receivers(sub):
+                        owners = guarded_owners.get(attr)
+                        if not owners or attr in own_guards:
+                            continue
+                        if isinstance(recv, ast.Name) \
+                                and recv.id != "self":
+                            owner_cls, lock = owners[0]
+                            scan.add(
+                                "GL-C2", sub,
+                                f"target mutates {recv.id}.{attr}",
+                                "thread target mutates guarded state "
+                                f"of a foreign class ({owner_cls}."
+                                f"{attr}, guarded by {owner_cls}."
+                                f"{lock}); route it through a locked "
+                                "method on the owner")
+        stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            visit(child, stack)
+        stack.pop()
+
+    visit(scan.tree, [])
+    return targets
+
+
+# --------------------------------------------------------------------------
+# GL-C3: atomic file outputs from threaded contexts
+# --------------------------------------------------------------------------
+
+
+def _write_mode(call: ast.Call) -> Optional[str]:
+    """The literal mode of an ``open()``/``write_text`` style call
+    that writes, else None."""
+    f = call.func
+    if isinstance(f, ast.Name) and f.id == "open":
+        mode = None
+        if len(call.args) >= 2 and isinstance(call.args[1],
+                                              ast.Constant):
+            mode = call.args[1].value
+        for kw in call.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                mode = kw.value.value
+        if isinstance(mode, str) and any(c in mode for c in "wax"):
+            return mode
+        return None
+    if isinstance(f, ast.Attribute) and f.attr in ("write_text",
+                                                   "write_bytes"):
+        return f.attr
+    return None
+
+
+def _contains_os_replace(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) \
+                and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr in ("replace", "rename") \
+                and isinstance(sub.func.value, ast.Name) \
+                and sub.func.value.id == "os":
+            return True
+    return False
+
+
+def _check_c3_class(scan: _FileScan, cls: ast.ClassDef,
+                    contract: dict) -> None:
+    exempt = {"__init__"} | set(contract.get("init", ()))
+    for name, meth in _class_methods(cls).items():
+        if name in exempt:
+            continue
+        if _contains_os_replace(meth):
+            continue
+        for sub in ast.walk(meth):
+            if isinstance(sub, ast.Call):
+                mode = _write_mode(sub)
+                if mode is not None:
+                    scan.add(
+                        "GL-C3", sub, f"{cls.name}.{name} open({mode!r})",
+                        "file output from a threaded context without "
+                        "the atomic idiom: write to '<path>.tmp' then "
+                        "os.replace(tmp, path) so readers never see a "
+                        "torn file (the FlightRecorder.dump "
+                        "discipline)")
+
+
+# --------------------------------------------------------------------------
+# GL-C4: no silent swallowing in thread targets
+# --------------------------------------------------------------------------
+
+
+def _check_c4(scan: _FileScan,
+              targets: List[Tuple[ast.AST, Optional[ast.ClassDef]]]
+              ) -> None:
+    seen = set()
+    for tnode, towner in targets:
+        if id(tnode) in seen:
+            continue
+        seen.add(id(tnode))
+        owner = f"{towner.name}." if towner is not None else ""
+        for sub in ast.walk(tnode):
+            if not isinstance(sub, ast.ExceptHandler):
+                continue
+            if all(isinstance(s, (ast.Pass, ast.Continue))
+                   for s in sub.body):
+                scan.add(
+                    "GL-C4", sub, f"{owner}{tnode.name} except:pass",
+                    "bare swallow in a thread run loop hides real "
+                    "failures as a silently stalled sampler; count a "
+                    "telemetry counter (the MeshPlane.measure_ready "
+                    "discipline: tel.counter('<plane>.sample_errors', "
+                    "error=type(e).__name__)) before continuing")
+
+
+# --------------------------------------------------------------------------
+# driver
+# --------------------------------------------------------------------------
+
+
+def _walk_files(root: str) -> List[str]:
+    files = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__",))
+        files += [os.path.join(dirpath, f) for f in sorted(filenames)
+                  if f.endswith(".py")]
+    return files
+
+
+def contract_index(root: Optional[str] = None) -> Dict[str, dict]:
+    """Every declared contract across the in-scope modules, keyed by
+    class name: ``{"module": ..., "lock": ..., "guards": [...]}``.
+
+    This is the report's ``concurrency.contracts`` block — committing
+    it makes a contract added, widened, or dropped show up as a
+    reviewable diff in ``analysis_report.json``."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    display_base = os.path.dirname(root)
+    index: Dict[str, dict] = {}
+    for f in _walk_files(root):
+        display = os.path.relpath(f, display_base).replace(os.sep, "/")
+        scope = os.path.relpath(f, root).replace(os.sep, "/")
+        try:
+            scan = _FileScan(f, display, tuple(scope.split("/")))
+        except SyntaxError:
+            continue
+        if not scan.in_scope():
+            continue
+        for cls_name, spec in scan.contracts.items():
+            index[cls_name] = {
+                "module": display,
+                "lock": spec["lock"],
+                "guards": sorted(spec.get("guards", ())),
+                "init": sorted(spec.get("init", ())),
+                "locked": sorted(spec.get("locked", ())),
+            }
+    return dict(sorted(index.items()))
+
+
+def run_concurrency_tier(root: Optional[str] = None,
+                         display_base: Optional[str] = None
+                         ) -> Tuple[List[Violation], int]:
+    """Scan every ``.py`` under ``root`` (default: this package).
+
+    Two passes: collect every module's ``GLC_CONTRACT`` first (the
+    foreign-access arms need the package-wide guarded-attribute map),
+    then apply GL-C1..C4 to the in-scope modules. Returns
+    (violations, files_scanned) like ``run_ast_tier``.
+    """
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if display_base is None:
+        display_base = os.path.dirname(root)
+    scans: List[_FileScan] = []
+    for f in _walk_files(root):
+        display = os.path.relpath(f, display_base).replace(os.sep, "/")
+        scope = os.path.relpath(f, root).replace(os.sep, "/")
+        scans.append(_FileScan(f, display, tuple(scope.split("/"))))
+
+    guarded_owners: Dict[str, List[Tuple[str, str]]] = {}
+    for scan in scans:
+        if not scan.in_scope():
+            continue
+        for cls_name, spec in sorted(scan.contracts.items()):
+            for attr in spec.get("guards", ()):
+                guarded_owners.setdefault(attr, []).append(
+                    (cls_name, spec["lock"]))
+
+    out: List[Violation] = []
+    for scan in scans:
+        if not scan.in_scope():
+            continue
+        class_defs = {node.name: node for node in scan.tree.body
+                      if isinstance(node, ast.ClassDef)}
+        for cls_name, spec in sorted(scan.contracts.items()):
+            cls = class_defs.get(cls_name)
+            if cls is None:
+                scan.add("GL-C1", scan.tree, cls_name,
+                         f"contract declares unknown class {cls_name!r}"
+                         " — the declaration must live next to the "
+                         "class it covers")
+                continue
+            _check_c1_class(scan, cls, spec)
+            _check_c3_class(scan, cls, spec)
+        _check_c1_foreign(scan, guarded_owners)
+        targets = _check_c2(scan, guarded_owners)
+        _check_c4(scan, targets)
+        out += scan.violations
+    return out, len(scans)

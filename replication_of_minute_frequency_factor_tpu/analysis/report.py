@@ -38,8 +38,8 @@ def build_report(new: List[Violation], accepted: List[Violation],
                  files_scanned: int = 0,
                  shape: Optional[tuple] = None,
                  resident_fingerprints: Optional[Dict[str, Dict]] = None,
-                 session_fingerprints: Optional[Dict[str, Dict]] = None
-                 ) -> dict:
+                 session_fingerprints: Optional[Dict[str, Dict]] = None,
+                 concurrency: Optional[dict] = None) -> dict:
     try:
         import jax
         jax_version = jax.__version__
@@ -85,6 +85,11 @@ def build_report(new: List[Violation], accepted: List[Violation],
             report["jaxpr"]["sessions"] = {
                 k: session_fingerprints[k]
                 for k in sorted(session_fingerprints)}
+    if concurrency is not None:
+        # Tier C summary (ISSUE 19): which classes declared contracts
+        # and what the lock-discipline sweep found — committed so a
+        # contract added/dropped in review shows up as a diff here
+        report["concurrency"] = concurrency
     return report
 
 

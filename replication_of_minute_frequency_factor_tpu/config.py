@@ -91,6 +91,12 @@ class Config:
     #: (data/wire.py, ~7x fewer wire bytes on typical data; auto-falls
     #: back to f32 when unrepresentable)
     wire_transfer: bool = True
+    #: runtime twin of graftlint Tier C (telemetry/lockcheck.py): arm
+    #: the declared GLC_CONTRACTs so any mutation of a guarded
+    #: attribute without its owning lock raises LockAssertionError and
+    #: counts lockcheck.violations; MFF_LOCK_ASSERT=1 is the env
+    #: override the tier-1 hammer tests use
+    debug_lock_assert: bool = False
 
     @classmethod
     def from_env(cls) -> "Config":
@@ -120,6 +126,9 @@ class Config:
         if "MFF_COMPILE_TELEMETRY" in os.environ:
             cfg.compile_telemetry = os.environ["MFF_COMPILE_TELEMETRY"] \
                 not in ("0", "false", "False")
+        if "MFF_LOCK_ASSERT" in os.environ:
+            cfg.debug_lock_assert = os.environ["MFF_LOCK_ASSERT"] \
+                not in ("", "0", "false", "False")
         if "MFF_ATTRIBUTION_TOLERANCE" in os.environ:
             cfg.attribution_tolerance = float(
                 os.environ["MFF_ATTRIBUTION_TOLERANCE"])

@@ -35,6 +35,22 @@ CANDIDATE = "candidate"
 DEMOTED = "demoted"
 PROBING = "probing"
 
+#: graftlint Tier C concurrency contract (analysis/concurrency_tier.py;
+#: runtime twin telemetry/lockcheck.py): the candidacy ladder is read
+#: by every routed request and flipped by refresh/note_result from
+#: whichever thread routes. ``_demote`` is the documented
+#: caller-holds-lock helper — refresh() takes the lock for the state
+#: flip and runs the dump outside it — so it is declared ``locked``:
+#: exempt from the lexical GL-C1 check, still asserted at runtime.
+GLC_CONTRACT = {
+    "ShedPolicy": {
+        "lock": "_lock",
+        "guards": ("_state", "_until", "_reason"),
+        "init": (),
+        "locked": ("_demote",),
+    },
+}
+
 
 class ShedPolicy:
     """Per-replica routing-candidacy state machine over the breaker +
@@ -56,6 +72,8 @@ class ShedPolicy:
                                        for r in self.replicas}
         self._until: Dict[str, float] = {}
         self._reason: Dict[str, str] = {}
+        from ..telemetry.lockcheck import maybe_install
+        maybe_install(self)
 
     # --- signal reads ---------------------------------------------------
     def _hbm_over(self, replica) -> bool:

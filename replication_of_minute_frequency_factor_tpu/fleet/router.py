@@ -102,6 +102,19 @@ def _rendezvous_order(labels: Sequence[str], key: Tuple) -> List[str]:
     return sorted(labels, key=score, reverse=True)
 
 
+#: graftlint Tier C concurrency contract (analysis/concurrency_tier.py;
+#: runtime twin telemetry/lockcheck.py): the admission count and the
+#: affinity memo are hit by every concurrently-routed request.
+GLC_CONTRACT = {
+    "FleetRouter": {
+        "lock": "_lock",
+        "guards": ("_inflight", "_route_memo"),
+        "init": (),
+        "locked": (),
+    },
+}
+
+
 class FleetRouter:
     """Routes queries/ingests over the policy's current candidates."""
 
@@ -120,6 +133,15 @@ class FleetRouter:
         #: routing key -> last owning label (bounded): the affinity
         #: hit-rate's memory, not the routing truth (rendezvous is)
         self._route_memo: Dict[Tuple, str] = {}
+        from ..telemetry.lockcheck import maybe_install
+        maybe_install(self)
+
+    def inflight(self) -> int:
+        """Locked read of the admission count — the health rollup's
+        accessor (GL-C1: cross-object reads of guarded state go
+        through the owner's lock)."""
+        with self._lock:
+            return self._inflight
 
     # --- routing --------------------------------------------------------
     def routing_key(self, q: Query) -> Tuple:
@@ -151,7 +173,8 @@ class FleetRouter:
     def _release(self) -> None:
         with self._lock:
             self._inflight = max(0, self._inflight - 1)
-        self.telemetry.gauge("fleet.inflight", self._inflight)
+            inflight = self._inflight
+        self.telemetry.gauge("fleet.inflight", inflight)
 
     def _note_affinity(self, key: Tuple, label: str) -> None:
         with self._lock:
@@ -412,7 +435,7 @@ class FactorFleet:
                 "demoted": pod_state["demoted"],
                 "states": pod_state["states"],
                 "reasons": pod_state["reasons"],
-                "inflight": self.router._inflight,
+                "inflight": self.router.inflight(),
                 "uptime_s": round(time.monotonic() - self._t_start, 3),
             },
         }

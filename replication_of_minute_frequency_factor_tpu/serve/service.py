@@ -214,6 +214,22 @@ class ServeConfig:
     stream_finalize_impl: Optional[str] = None
 
 
+#: graftlint Tier C concurrency contract (analysis/concurrency_tier.py;
+#: runtime twin telemetry/lockcheck.py): the breaker state and the
+#: drain flag are shared between caller threads (submit/ingest/
+#: discover) and the worker; ``_state_lock`` guards all of them.
+#: ``_dispatch_seq`` (worker-thread-only) and ``names`` (documented
+#: atomic-tuple-swap, worker-writes/callers-read) stay out by design.
+GLC_CONTRACT = {
+    "FactorServer": {
+        "lock": "_state_lock",
+        "guards": ("_consecutive", "_open_until", "_closed"),
+        "init": (),
+        "locked": (),
+    },
+}
+
+
 class FactorServer:
     """The long-lived factor service over one data source.
 
@@ -377,6 +393,8 @@ class FactorServer:
             time_scale=self.scfg.slo_time_scale)
         if self.scfg.timeline_sample_period_s > 0:
             self.timeline.start(self.scfg.timeline_sample_period_s)
+        from ..telemetry.lockcheck import maybe_install
+        maybe_install(self)
         if start:
             self.start()
 
@@ -402,7 +420,11 @@ class FactorServer:
     def close(self, timeout: float = 10.0) -> None:
         """Drain-and-stop: queued requests are still answered; new
         submits are refused."""
-        self._closed = True
+        with self._state_lock:
+            # GL-C1 bring-up finding: the flag is read by every
+            # submit/ingest/discover caller; the unlocked write
+            # worked only by CPython-coincidence
+            self._closed = True
         if self._thread is not None and self._thread.is_alive():
             self._q.put(_SENTINEL)
             self._thread.join(timeout)

@@ -58,6 +58,21 @@ MAX_FREE_EVENTS = 5000
 #: retained request-lifecycle records bound (ISSUE 8)
 MAX_REQUEST_RECORDS = 20000
 
+#: graftlint Tier C concurrency contract (analysis/concurrency_tier.py;
+#: runtime twin .lockcheck): the event/request buffers take writes
+#: from every instrumented thread, and the lazily-bound planes flip
+#: exactly once under the same lock (double-checked creation).
+GLC_CONTRACT = {
+    "Telemetry": {
+        "lock": "_lock",
+        "guards": ("_events", "_events_dropped", "_requests",
+                   "_requests_dropped", "_hbm", "_meshplane",
+                   "_factorplane", "_timeline", "_sloplane"),
+        "init": (),
+        "locked": (),
+    },
+}
+
 
 class StageTimer(Timer):
     """Drop-in :class:`..utils.tracing.Timer` whose stages ALSO land in
@@ -107,6 +122,8 @@ class Telemetry:
         self._timeline: Optional[TimelineStore] = None
         self._sloplane: Optional[SloPlane] = None
         self._lock = threading.Lock()
+        from .lockcheck import maybe_install
+        maybe_install(self)
 
     @property
     def hbm(self) -> HbmSampler:
@@ -252,8 +269,12 @@ class Telemetry:
         manifest = build_manifest(cfg, manifest_extra)
         manifest.update(identity)
         import json
-        with open(paths["manifest"], "w") as fh:
+        # GL-C3: atomic write — a scraper/aggregator reading the
+        # bundle mid-write must never see a torn manifest
+        tmp = paths["manifest"] + ".tmp"
+        with open(tmp, "w") as fh:
             json.dump(manifest, fh, indent=1)
+        os.replace(tmp, paths["manifest"])
         with EventSink(paths["metrics"], common=identity) as sink:
             sink.emit("manifest", payload=manifest)
             for rec in self.registry.records():

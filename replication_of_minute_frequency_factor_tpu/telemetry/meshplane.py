@@ -68,6 +68,23 @@ def _median(vals: List[float]) -> float:
     return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
 
 
+#: graftlint Tier C concurrency contract (analysis/concurrency_tier.py;
+#: runtime twin telemetry/lockcheck.py): every watermark/summary field
+#: is fed by watcher daemon threads and read by bench/summary callers.
+GLC_CONTRACT = {
+    "MeshPlane": {
+        "lock": "_lock",
+        "guards": ("_flight", "_threads", "_consecutive", "_samples",
+                   "_skew_bursts", "_boundaries", "_last_times",
+                   "_last_skew", "_slow_shard", "_pad_waste",
+                   "_pad_waste_axes", "_axes", "_occupancy",
+                   "_collectives"),
+        "init": (),
+        "locked": (),
+    },
+}
+
+
 class MeshPlane:
     """Per-shard balance sampler bound to one Telemetry (see module
     docstring). All entry points are never-raising and cheap enough
@@ -97,6 +114,8 @@ class MeshPlane:
         self._axes: Dict[str, dict] = {}
         self._occupancy: Optional[float] = None
         self._collectives = 0
+        from .lockcheck import maybe_install
+        maybe_install(self)
 
     def _tel(self):
         if self._telemetry is not None:

@@ -80,6 +80,30 @@ def canonical_trace_id(raw) -> str:
 # device-memory watermarks
 # --------------------------------------------------------------------------
 
+#: graftlint Tier C concurrency contract (analysis/concurrency_tier.py;
+#: runtime twin telemetry/lockcheck.py). The sampler's watermark state
+#: is shared between its daemon thread and any caller of ``sample``;
+#: the recorder's ring is fed from request threads and drained by the
+#: anomaly dump path. The recorder's public ``dump_count`` /
+#: ``suppressed_count`` / ``dumps`` are written under the lock but read
+#: lock-free by ``/healthz`` (monotonic ints and an append-only list —
+#: a torn read is impossible), so they stay out of the guarded set.
+GLC_CONTRACT = {
+    "HbmSampler": {
+        "lock": "_lock",
+        "guards": ("_last_t", "_peaks", "_summary", "_thread"),
+        "init": (),
+        "locked": (),
+    },
+    "FlightRecorder": {
+        "lock": "_lock",
+        "guards": ("_ring", "_last_dispatch", "_sheds", "_last_dump_t",
+                   "_last_counters", "_seq"),
+        "init": (),
+        "locked": (),
+    },
+}
+
 
 class HbmSampler:
     """Per-device memory watermark sampler over ``jax.devices()``.
@@ -118,6 +142,8 @@ class HbmSampler:
                                "bytes_in_use": 0, "peak_bytes": 0}
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        from .lockcheck import maybe_install
+        maybe_install(self)
 
     def _tel(self):
         if self._telemetry is not None:
@@ -252,8 +278,11 @@ class HbmSampler:
         while not self._stop.wait(period_s):
             try:
                 self.sample(boundary="background")
-            except Exception:  # noqa: BLE001 — sampling must never kill
-                pass
+            except Exception as e:  # noqa: BLE001 — sampling must never kill
+                # GL-C4: count the swallow so a dying sampler is
+                # observable instead of silently stalled
+                self._tel().counter("hbm.sample_errors",
+                                    error=type(e).__name__)
 
     def stop(self, timeout: float = 2.0) -> None:
         with self._lock:
@@ -308,6 +337,8 @@ class FlightRecorder:
         #: satellite: the 1/s limit used to drop them SILENTLY —
         #: now counted, surfaced in /healthz's flight block)
         self.suppressed_count = 0
+        from .lockcheck import maybe_install
+        maybe_install(self)
 
     def _tel(self):
         if self._telemetry is not None:

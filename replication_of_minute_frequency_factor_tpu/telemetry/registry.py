@@ -18,6 +18,21 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from .lockcheck import maybe_install
+
+#: graftlint Tier C concurrency contract (analysis/concurrency_tier.py;
+#: runtime twin telemetry/lockcheck.py): every metric map is mutated by
+#: the pipeline producer thread, the serve worker, and the sampler
+#: daemons concurrently, and ``_lock`` guards all three.
+GLC_CONTRACT = {
+    "MetricsRegistry": {
+        "lock": "_lock",
+        "guards": ("_counters", "_gauges", "_hists"),
+        "init": (),
+        "locked": (),
+    },
+}
+
 #: retained-sample bound per histogram; count/sum/min/max stay exact
 #: past it, percentiles come from the decimated reservoir
 HIST_BOUND = 2048
@@ -159,6 +174,7 @@ class MetricsRegistry:
         self._counters: Dict[tuple, float] = {}
         self._gauges: Dict[tuple, float] = {}
         self._hists: Dict[tuple, Histogram] = {}
+        maybe_install(self)
 
     # --- write ----------------------------------------------------------
     def counter(self, name: str, value: float = 1.0, **labels) -> None:

@@ -70,6 +70,22 @@ MAX_SLO_EVENTS = 1000
 #: spans the scaled windows the tests/smokes use with headroom)
 SLO_HISTORY = 4096
 
+#: graftlint Tier C concurrency contract (analysis/concurrency_tier.py;
+#: runtime twin ..lockcheck): evaluate() runs on the sampler thread
+#: while configure()/summary() run on callers' threads. ``_timeline``
+#: and ``clock`` stay out — both settle before the sampler thread
+#: exists in every wiring path, and ``_timeline`` is read lock-free on
+#: the hot path by design.
+GLC_CONTRACT = {
+    "SloPlane": {
+        "lock": "_lock",
+        "guards": ("objectives", "time_scale", "_flight", "_history",
+                   "_alerting", "_worst", "_alert_counts", "_events"),
+        "init": (),
+        "locked": (),
+    },
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class Objective:
@@ -175,6 +191,8 @@ class SloPlane:
         self._worst: Dict[str, float] = {}
         self._alert_counts: Dict[str, int] = {}
         self._events: List[dict] = []
+        from .lockcheck import maybe_install
+        maybe_install(self)
 
     def _tel(self):
         if self._telemetry is not None:

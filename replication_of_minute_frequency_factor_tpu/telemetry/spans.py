@@ -25,6 +25,20 @@ from .registry import MetricsRegistry
 #: the trace export (`dropped_spans` counts them)
 MAX_EVENTS = 20000
 
+#: graftlint Tier C concurrency contract (analysis/concurrency_tier.py;
+#: runtime twin .lockcheck): totals/counts/events take writes from
+#: every instrumented thread. ``dropped_spans`` is a public monotonic
+#: counter read lock-free by summaries and stays out of the guarded
+#: set (the FlightRecorder.dump_count convention).
+GLC_CONTRACT = {
+    "SpanTracer": {
+        "lock": "_lock",
+        "guards": ("_totals", "_counts", "_events"),
+        "init": (),
+        "locked": (),
+    },
+}
+
 
 class SpanTracer:
     """``with tracer("name"): ...`` — nested, thread-safe span timing.
@@ -48,6 +62,8 @@ class SpanTracer:
         self._events: List[dict] = []
         self.dropped_spans = 0
         self._tls = threading.local()
+        from .lockcheck import maybe_install
+        maybe_install(self)
 
     def _depth(self) -> int:
         return getattr(self._tls, "depth", 0)
@@ -162,6 +178,10 @@ class SpanTracer:
         }
 
     def write_chrome_trace(self, path: str) -> str:
-        with open(path, "w") as fh:
+        # GL-C3: atomic write — trace files are read by external
+        # viewers while a live tracer may still be exporting
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
             json.dump(self.to_chrome_trace(), fh)
+        os.replace(tmp, path)
         return path

@@ -70,6 +70,22 @@ TIMELINE_RING = 720
 #: default sampler-thread period
 SAMPLE_PERIOD_S = 0.5
 
+#: graftlint Tier C concurrency contract (analysis/concurrency_tier.py;
+#: runtime twin telemetry/lockcheck.py): the frame ring and its delta
+#: state are written by the sampler daemon and read by HTTP/query
+#: threads; the wiring lists and the thread handle flip under the same
+#: lock. ``_stop`` (threading.Event) is self-synchronizing and stays
+#: out of the contract.
+GLC_CONTRACT = {
+    "TimelineStore": {
+        "lock": "_lock",
+        "guards": ("_frames", "_last_counters", "_last_t", "_seq",
+                   "_sources", "_callbacks", "_thread"),
+        "init": (),
+        "locked": (),
+    },
+}
+
 
 class TimelineStore:
     """Bounded ring of registry-delta frames on one clock.
@@ -93,6 +109,8 @@ class TimelineStore:
         self._callbacks: List[Callable[[dict], None]] = []
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        from .lockcheck import maybe_install
+        maybe_install(self)
 
     def _tel(self):
         if self._telemetry is not None:
@@ -261,8 +279,11 @@ class TimelineStore:
         while not self._stop.wait(period_s):
             try:
                 self.sample()
-            except Exception:  # noqa: BLE001 — sampling must never kill
-                pass
+            except Exception as e:  # noqa: BLE001 — sampling must never kill
+                # GL-C4: a silent swallow here turns a real bug into a
+                # stalled timeline; the counter makes it observable
+                self._tel().counter("timeline.sample_errors",
+                                    error=type(e).__name__)
 
     def stop(self, timeout: float = 2.0) -> None:
         with self._lock:

@@ -157,12 +157,13 @@ print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     # verdict line, nonzero on any missing piece
     run python -c "import json, sys, bench; r = bench.slo_smoke(); \
 print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
-    # graftlint (ISSUE 4): AST rules over the whole package + jaxpr
-    # contracts over all 58 registered kernels AND the resident scan
-    # wrappers (abstract trace on CPU), gated on the committed baseline
-    # — one JSON verdict line like telemetry/regress.py, nonzero on any
-    # new violation (docs/static-analysis.md); --report - keeps the
-    # tree clean here
+    # graftlint (ISSUE 4 + 19): AST rules over the whole package +
+    # jaxpr contracts over all 58 registered kernels AND the resident
+    # scan wrappers (abstract trace on CPU) + the Tier C concurrency
+    # contracts over the threaded layers (--tier all is the default),
+    # gated on the committed baseline — one JSON verdict line like
+    # telemetry/regress.py, nonzero on any new violation
+    # (docs/static-analysis.md); --report - keeps the tree clean here
     run python -m replication_of_minute_frequency_factor_tpu analyze \
         --report -
     exit $rc
@@ -195,7 +196,8 @@ run python -m replication_of_minute_frequency_factor_tpu.telemetry.validate \
 # deviations are reported, only --strict/--check runs gate on them)
 run python -m replication_of_minute_frequency_factor_tpu.telemetry.regress \
     "$REPO"
-# graftlint gate (ISSUE 4, docs/static-analysis.md): AST + jaxpr tiers
-# against the committed baseline; nonzero on any new violation
+# graftlint gate (ISSUE 4 + 19, docs/static-analysis.md): AST + jaxpr
+# + Tier C concurrency tiers against the committed baseline; nonzero
+# on any new violation
 run python -m replication_of_minute_frequency_factor_tpu analyze \
     --report -

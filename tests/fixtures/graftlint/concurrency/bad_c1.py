@@ -1,0 +1,34 @@
+"""GL-C1 violating fixture: guarded writes outside the lock, plus a
+cross-object reach into another class's guarded internals."""
+
+import threading
+
+GLC_CONTRACT = {
+    "BadCounter": {
+        "lock": "_glock",
+        "guards": ("_c1_total", "_c1_rows"),
+        "init": (),
+        "locked": (),
+    },
+}
+
+
+class BadCounter:
+    def __init__(self):
+        self._glock = threading.Lock()
+        self._c1_total = 0
+        self._c1_rows = []
+
+    def bump(self, n):
+        self._c1_total += n  # GL-C1: RMW outside the lock
+
+    def log(self, row):
+        self._c1_rows.append(row)  # GL-C1: mutator call outside the lock
+
+
+class Reader:
+    def __init__(self, counter):
+        self.counter = counter
+
+    def peek(self):
+        return self.counter._c1_total  # GL-C1: foreign reach

@@ -1,0 +1,31 @@
+"""GL-C2 violating fixture: a non-daemon thread with no join path
+whose target mutates another class's guarded state directly."""
+
+import threading
+
+GLC_CONTRACT = {
+    "Store": {
+        "lock": "_block",
+        "guards": ("_c2_bins",),
+        "init": (),
+        "locked": (),
+    },
+}
+
+
+class Store:
+    def __init__(self):
+        self._block = threading.Lock()
+        self._c2_bins = []
+
+
+STORE = Store()
+
+
+def run_loop():
+    STORE._c2_bins.append(1)  # GL-C2: foreign guarded mutation
+
+
+def spawn():
+    t = threading.Thread(target=run_loop)  # GL-C2: not daemon, no join
+    t.start()

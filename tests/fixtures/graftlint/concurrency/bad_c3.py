@@ -1,0 +1,26 @@
+"""GL-C3 violating fixture: a file output from a threaded context
+without the write-then-``os.replace`` atomic idiom."""
+
+import json
+import threading
+
+GLC_CONTRACT = {
+    "Dumper": {
+        "lock": "_dlock",
+        "guards": ("_c3_seen",),
+        "init": (),
+        "locked": (),
+    },
+}
+
+
+class Dumper:
+    def __init__(self):
+        self._dlock = threading.Lock()
+        self._c3_seen = 0
+
+    def dump(self, path, payload):
+        with self._dlock:
+            self._c3_seen += 1
+        with open(path, "w") as fh:  # GL-C3: torn-read window
+            json.dump(payload, fh)

@@ -1,0 +1,23 @@
+"""GL-C4 violating fixture: a thread run loop that swallows
+exceptions with a bare ``pass`` — failures become a silently stalled
+sampler."""
+
+import threading
+
+
+def poll():
+    raise RuntimeError
+
+
+def run_loop(stop):
+    while not stop.wait(0.01):
+        try:
+            poll()
+        except Exception:
+            pass  # GL-C4: silent swallow
+
+
+def spawn(stop):
+    t = threading.Thread(target=run_loop, args=(stop,), daemon=True)
+    t.start()
+    return t

@@ -1,0 +1,46 @@
+"""GL-C1 compliant fixture: every guarded write under the lock, a
+declared ``init`` method for pre-thread setup, a declared ``locked``
+caller-holds-lock helper, and a locked accessor for foreign readers."""
+
+import threading
+
+GLC_CONTRACT = {
+    "GoodCounter": {
+        "lock": "_glock",
+        "guards": ("_g1_total", "_g1_rows"),
+        "init": ("warm",),
+        "locked": ("_bump_locked",),
+    },
+}
+
+
+class GoodCounter:
+    def __init__(self):
+        self._glock = threading.Lock()
+        self._g1_total = 0
+        self._g1_rows = []
+
+    def warm(self, rows):
+        """Declared init: runs before any thread exists."""
+        self._g1_rows = list(rows)
+
+    def _bump_locked(self, n):
+        """Declared locked: the caller holds ``_glock``."""
+        self._g1_total += n
+
+    def bump(self, n):
+        with self._glock:
+            self._bump_locked(n)
+            self._g1_rows.append(n)
+
+    def total(self):
+        with self._glock:
+            return self._g1_total
+
+
+class Consumer:
+    def __init__(self, counter):
+        self.counter = counter
+
+    def peek(self):
+        return self.counter.total()  # locked accessor, not internals

@@ -1,0 +1,31 @@
+"""GL-C2 compliant fixture: a daemon sampler registered on its owner
+and joined in ``close()``."""
+
+import threading
+
+GLC_CONTRACT = {
+    "Sampler": {
+        "lock": "_slock",
+        "guards": ("_g2_vals",),
+        "init": (),
+        "locked": (),
+    },
+}
+
+
+class Sampler:
+    def __init__(self):
+        self._slock = threading.Lock()
+        self._g2_vals = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.wait(0.01):
+            with self._slock:
+                self._g2_vals.append(0)
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=1.0)

@@ -1,0 +1,11 @@
+"""GL-C2 compliant fixture, second direction: the thread is returned
+to the caller, who owns its lifecycle (the ``serve_http`` pattern) —
+note this module deliberately contains no ``.join`` of its own."""
+
+import threading
+
+
+def serve(fn):
+    thread = threading.Thread(target=fn, daemon=True)
+    thread.start()
+    return thread

@@ -1,0 +1,29 @@
+"""GL-C3 compliant fixture: write to a tmp name, then ``os.replace``
+(the ``FlightRecorder.dump`` discipline)."""
+
+import json
+import os
+import threading
+
+GLC_CONTRACT = {
+    "AtomicDumper": {
+        "lock": "_dlock",
+        "guards": ("_g3_seen",),
+        "init": (),
+        "locked": (),
+    },
+}
+
+
+class AtomicDumper:
+    def __init__(self):
+        self._dlock = threading.Lock()
+        self._g3_seen = 0
+
+    def dump(self, path, payload):
+        with self._dlock:
+            self._g3_seen += 1
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(payload, fh)
+        os.replace(tmp, path)

@@ -1,0 +1,27 @@
+"""GL-C4 compliant fixture: the run loop counts a telemetry counter
+before continuing (the ``MeshPlane.measure_ready`` discipline)."""
+
+import threading
+
+
+def poll():
+    raise RuntimeError
+
+
+def run_loop(stop, counter):
+    while not stop.wait(0.01):
+        try:
+            poll()
+        except Exception as e:
+            counter("fixture.sample_errors", error=type(e).__name__)
+
+
+def spawn(stop, counter):
+    t = threading.Thread(target=run_loop, args=(stop, counter),
+                         daemon=True)
+    t.start()
+    return t
+
+
+def drain(t):
+    t.join(timeout=1.0)

@@ -8,6 +8,9 @@ Three layers of coverage:
 * every jaxpr contract (GL-B0..B3) fires on a deliberately-bad kernel —
   including the PR 3 revert scenario (a ``fori_loop``-of-``roll``
   moment pass) tripping the serial-loop gate;
+* every concurrency rule (GL-C1..C4, ISSUE 19) fires on its
+  injected-violation fixture under ``tests/fixtures/graftlint/
+  concurrency/`` and stays silent on the compliant twin;
 * the baseline workflow round-trips (new violation -> nonzero; accepted
   into the baseline with a justification -> clean; justification
   mandatory; stale entries reported), and the REPO ITSELF is clean:
@@ -22,7 +25,7 @@ import sys
 import pytest
 
 from replication_of_minute_frequency_factor_tpu.analysis import (
-    Baseline, Violation, run_ast_tier)
+    Baseline, Violation, run_ast_tier, run_concurrency_tier)
 from replication_of_minute_frequency_factor_tpu.analysis.jaxpr_tier import (
     check_kernel)
 
@@ -41,7 +44,7 @@ def _codes_by_file(violations):
 @pytest.fixture(scope="module")
 def fixture_violations():
     violations, n_files = run_ast_tier(FIXTURES, display_base=REPO)
-    assert n_files == 19
+    assert n_files == 28
     return violations
 
 
@@ -369,6 +372,85 @@ def test_fingerprints_are_stable_and_loop_free():
 
 
 # --------------------------------------------------------------------------
+# Tier C: concurrency contracts on injected-violation fixtures (ISSUE 19)
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def concurrency_violations():
+    violations, n_files = run_concurrency_tier(FIXTURES,
+                                               display_base=REPO)
+    assert n_files == 28
+    return violations
+
+
+def test_c1_fires_on_unlocked_writes_and_foreign_reach(
+        concurrency_violations):
+    """Both GL-C1 arms: a RMW and a mutator call on guarded attributes
+    outside the lock flag in the owning class, and reaching through an
+    object attribute into another class's guarded internals flags at
+    the reader."""
+    hits = _codes_by_file(concurrency_violations)["bad_c1.py"]
+    assert ("GL-C1", 23, "BadCounter._c1_total") in hits
+    assert ("GL-C1", 26, "BadCounter._c1_rows") in hits
+    assert ("GL-C1", 34, "counter._c1_total") in hits
+    assert [c for c, _, _ in hits] == ["GL-C1"] * 3
+
+
+def test_c2_fires_on_undisciplined_thread(concurrency_violations):
+    """A Thread without daemon=True, with no join path, whose target
+    mutates a foreign class's guarded state: all three GL-C2 arms."""
+    hits = _codes_by_file(concurrency_violations)["bad_c2.py"]
+    symbols = {s for _, _, s in hits}
+    assert symbols == {"Thread(daemon=...)",
+                       "Thread(no stop/join path)",
+                       "target mutates STORE._c2_bins"}
+    assert all(c == "GL-C2" for c, _, _ in hits)
+
+
+def test_c3_fires_on_nonatomic_threaded_write(concurrency_violations):
+    hits = _codes_by_file(concurrency_violations)["bad_c3.py"]
+    assert hits == [("GL-C3", 25, "Dumper.dump open('w')")]
+
+
+def test_c4_fires_on_silent_swallow_in_thread_target(
+        concurrency_violations):
+    hits = _codes_by_file(concurrency_violations)["bad_c4.py"]
+    assert hits == [("GL-C4", 16, "run_loop except:pass")]
+
+
+def test_compliant_concurrency_fixtures_stay_silent(
+        concurrency_violations):
+    """Each rule's compliant twin — locked writes with declared
+    init/locked methods, a joined daemon sampler, the
+    returned-to-caller thread, the tmp+os.replace write, the counting
+    except handler — produces zero violations."""
+    by_file = _codes_by_file(concurrency_violations)
+    for f in ("good_c1.py", "good_c2.py", "good_c2_return.py",
+              "good_c3.py", "good_c4.py"):
+        assert f not in by_file, by_file.get(f)
+
+
+def test_tier_c_repo_is_clean_and_contracts_are_declared():
+    """The real package passes its own lock-discipline lint, and the
+    contract index covers the threaded planes the runtime twin arms."""
+    from replication_of_minute_frequency_factor_tpu.analysis.concurrency_tier \
+        import contract_index
+
+    violations, n_files = run_concurrency_tier()
+    assert violations == [], [f"{v.location()}: {v.symbol}"
+                              for v in violations]
+    assert n_files > 40
+    idx = contract_index()
+    for cls in ("MetricsRegistry", "SpanTracer", "Telemetry",
+                "TimelineStore", "HbmSampler", "FlightRecorder",
+                "MeshPlane", "SloPlane", "ShedPolicy", "FleetRouter",
+                "FactorServer"):
+        assert cls in idx, sorted(idx)
+        assert idx[cls]["lock"] and idx[cls]["guards"]
+
+
+# --------------------------------------------------------------------------
 # baseline workflow
 # --------------------------------------------------------------------------
 
@@ -464,6 +546,38 @@ def test_cli_flags_fixtures_then_baseline_clears_them(tmp_path):
     assert out.returncode == 0
     assert json.loads(
         out.stdout.strip().splitlines()[-1])["baselined"] == 34
+
+
+def test_cli_tier_c_flags_fixtures_and_reports_contracts(tmp_path):
+    """``analyze --tier c`` over the fixture tree: all 8 injected
+    violations flag with the right per-rule split, the report grows
+    the committed ``concurrency`` section, and the verdict carries the
+    contract count."""
+    base = str(tmp_path / "b.json")
+    report = str(tmp_path / "r.json")
+    out = _run_cli("--tier", "c", "--paths", FIXTURES,
+                   "--baseline", base, "--report", report)
+    assert out.returncode == 1
+    verdict = json.loads(out.stdout.strip().splitlines()[-1])
+    assert verdict["new"] == 8
+    rep = json.load(open(report))
+    conc = rep["concurrency"]
+    assert conc["by_rule"] == {"GL-C1": 3, "GL-C2": 3,
+                               "GL-C3": 1, "GL-C4": 1}
+    assert conc["files_scanned"] == 28
+    assert "BadCounter" in conc["contracts"]
+    assert conc["contracts"]["BadCounter"]["lock"] == "_glock"
+
+
+def test_cli_explain_prints_rationale_for_any_rule():
+    for code in ("GL-C1", "GL-C4", "GL-A3", "GL-B1"):
+        out = _run_cli("--explain", code)
+        assert out.returncode == 0, out.stderr[-500:]
+        assert code in out.stdout
+        assert "why:" in out.stdout and "fix:" in out.stdout
+    out = _run_cli("--explain", "GL-Z9")
+    assert out.returncode == 2
+    assert "unknown rule code" in out.stderr
 
 
 def test_manifest_carries_the_analysis_block(tmp_path):

@@ -81,11 +81,15 @@ def test_partition_devices_disjoint_and_uniform():
 # --------------------------------------------------------------------------
 
 
-def test_same_range_queries_coalesce_on_one_replica():
+def test_same_range_queries_coalesce_on_one_replica(monkeypatch):
     """THE affinity gate: K same-range queries through the router land
     on ONE replica and drain as ONE coalesced dispatch there — the
     other replica dispatches nothing; the block lives on the owner's
-    own submesh."""
+    own submesh. Runs with the runtime lock-assert twin armed
+    (ISSUE 19): the router's admission counters and memo mutate from
+    caller and worker threads, so a lock-discipline regression here
+    raises a named LockAssertionError instead of flaking."""
+    monkeypatch.setenv("MFF_LOCK_ASSERT", "1")
     fleet = _fleet(start=False)
     try:
         futs = [fleet.submit(Query("factors", 2, 6, names=("mmt_am",)))

@@ -1,0 +1,211 @@
+"""Runtime lock-assertion twin of graftlint Tier C (ISSUE 19).
+
+``telemetry/lockcheck.py`` arms the same ``GLC_CONTRACT`` declarations
+the static tier checks: under ``MFF_LOCK_ASSERT=1`` (or
+``Config.debug_lock_assert``) every declared guarded attribute and
+container asserts the owning lock is held by the current thread at
+mutation time, raising ``LockAssertionError`` with a named class and
+attribute instead of flaking under load.
+"""
+
+import copy
+import threading
+
+import pytest
+
+from replication_of_minute_frequency_factor_tpu.telemetry import (
+    FlightRecorder, MetricsRegistry, Telemetry)
+from replication_of_minute_frequency_factor_tpu.telemetry.lockcheck import (
+    LockAssertionError, OwnedLock, enabled, install)
+
+#: contract for the synthetic class below — ``install`` resolves it
+#: from this module, exactly as it does for the package's own classes
+GLC_CONTRACT = {
+    "Box": {
+        "lock": "_lock",
+        "guards": ("_items", "_n"),
+        "init": (),
+        "locked": (),
+    },
+}
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._n = 0
+        install(self)  # unconditional: the tests below need it armed
+
+
+# --------------------------------------------------------------------------
+# arming switch
+# --------------------------------------------------------------------------
+
+
+def test_enabled_env_parsing(monkeypatch):
+    for raw, want in (("1", True), ("true", True), ("yes", True),
+                      ("0", False), ("", False), ("false", False),
+                      ("False", False)):
+        monkeypatch.setenv("MFF_LOCK_ASSERT", raw)
+        assert enabled() is want, raw
+    monkeypatch.delenv("MFF_LOCK_ASSERT")
+    assert enabled() is False  # Config.debug_lock_assert defaults off
+
+
+def test_config_field_arms_without_env(monkeypatch):
+    monkeypatch.delenv("MFF_LOCK_ASSERT", raising=False)
+    from replication_of_minute_frequency_factor_tpu.config import (
+        get_config)
+    monkeypatch.setattr(get_config(), "debug_lock_assert", True)
+    assert enabled() is True
+    reg = MetricsRegistry()
+    assert type(reg).__name__ == "LockCheckedMetricsRegistry"
+
+
+def test_maybe_install_is_free_when_off(monkeypatch):
+    monkeypatch.setenv("MFF_LOCK_ASSERT", "0")
+    reg = MetricsRegistry()
+    assert type(reg) is MetricsRegistry
+    # unarmed: direct mutation is merely undisciplined, not fatal
+    with reg._lock:
+        reg._counters["direct"] = 1.0
+
+
+def test_owned_lock_tracks_its_owner():
+    lk = OwnedLock()
+    assert not lk.held_by_current_thread()
+    with lk:
+        assert lk.held_by_current_thread() and lk.locked()
+        held_elsewhere = []
+        t = threading.Thread(
+            target=lambda: held_elsewhere.append(
+                lk.held_by_current_thread()), daemon=True)
+        t.start()
+        t.join()
+        assert held_elsewhere == [False]  # owner is per-thread
+    assert not lk.held_by_current_thread() and not lk.locked()
+
+
+# --------------------------------------------------------------------------
+# the hammer: provoke an unguarded write, assert the EXACT diagnostic
+# --------------------------------------------------------------------------
+
+
+def test_unguarded_write_raises_exact_diagnostic(monkeypatch):
+    monkeypatch.setenv("MFF_LOCK_ASSERT", "1")
+    reg = MetricsRegistry()
+    with pytest.raises(LockAssertionError) as ei:
+        reg._counters["rogue"] = 1.0
+    assert str(ei.value) == (
+        "lockcheck: MetricsRegistry._counters mutated without holding "
+        "MetricsRegistry._lock "
+        f"(thread={threading.current_thread().name})")
+    # rebinding the guarded attribute itself is also a mutation
+    with pytest.raises(LockAssertionError,
+                       match=r"MetricsRegistry\._gauges"):
+        reg._gauges = {}
+    # the disciplined path stays green
+    reg.counter("fine.ops")
+    assert reg.snapshot()["counters"]["fine.ops"] == 1.0
+
+
+def test_violations_are_counted(monkeypatch):
+    monkeypatch.setenv("MFF_LOCK_ASSERT", "1")
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        get_telemetry)
+    tel = get_telemetry()
+    before = tel.registry.counter_value(
+        "lockcheck.violations", cls="MetricsRegistry",
+        attr="_counters")
+    reg = MetricsRegistry()
+    with pytest.raises(LockAssertionError):
+        reg._counters["rogue"] = 1.0
+    after = tel.registry.counter_value(
+        "lockcheck.violations", cls="MetricsRegistry",
+        attr="_counters")
+    assert after == before + 1
+
+
+def test_registry_hammer_stays_green_armed(monkeypatch):
+    """The registry's public API under 4 writer threads with the
+    twin armed: zero assertions, exact totals — the lock discipline
+    the static tier proved lexically holds dynamically."""
+    monkeypatch.setenv("MFF_LOCK_ASSERT", "1")
+    reg = MetricsRegistry()
+    errors = []
+
+    def writer():
+        try:
+            for _ in range(300):
+                reg.counter("l.ops")
+                reg.observe("l.seconds", 1.0)
+                reg.gauge("l.depth", 2)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=writer, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+    snap = reg.snapshot()
+    assert snap["counters"]["l.ops"] == 4 * 300
+    assert snap["histograms"]["l.seconds"]["count"] == 4 * 300
+
+
+def test_merge_and_deepcopy_survive_arming(monkeypatch):
+    """``merge`` deep-copies histogram state under the source's lock;
+    the checking container proxies must reduce to their plain base
+    types so that copy neither trips an assertion nor leaks a proxy
+    into the destination."""
+    monkeypatch.setenv("MFF_LOCK_ASSERT", "1")
+    src = MetricsRegistry()
+    src.observe("m", 1.0)
+    src.counter("c", 3)
+    dst = MetricsRegistry()
+    dst.merge(src)
+    assert dst.histogram_stats("m")["count"] == 1
+    assert dst.counter_value("c") == 3
+
+
+# --------------------------------------------------------------------------
+# container proxies
+# --------------------------------------------------------------------------
+
+
+def test_container_and_scalar_guards_cover_the_mutator_surface():
+    b = Box()
+    with pytest.raises(LockAssertionError, match=r"Box\._items"):
+        b._items.append(1)
+    with pytest.raises(LockAssertionError, match=r"Box\._items"):
+        b._items += [2]
+    with pytest.raises(LockAssertionError, match=r"Box\._n"):
+        b._n = 5
+    with b._lock:
+        b._items.append(1)
+        b._items.extend([2, 3])
+        b._n = 5
+    assert list(b._items) == [1, 2, 3] and b._n == 5
+    # a rebind under the lock re-wraps: the new container is checked
+    with b._lock:
+        b._items = [9]
+    with pytest.raises(LockAssertionError):
+        b._items.append(10)
+
+
+def test_flight_recorder_ring_is_armed(monkeypatch, tmp_path):
+    monkeypatch.setenv("MFF_LOCK_ASSERT", "1")
+    tel = Telemetry()
+    fr = FlightRecorder(telemetry=tel, ring=4, dump_dir=str(tmp_path))
+    for i in range(6):
+        fr.record_request({"trace_id": "t", "op": "x", "status": "ok",
+                           "data": {"i": i}})
+    assert len(fr) == 4  # checked deque preserved its maxlen
+    with pytest.raises(LockAssertionError,
+                       match=r"FlightRecorder\._ring"):
+        fr._ring.append({"rogue": True})
+    plain = copy.deepcopy(fr._ring)
+    assert type(plain).__name__ == "deque" and plain.maxlen == 4

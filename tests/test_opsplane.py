@@ -266,9 +266,11 @@ def test_hbm_background_thread_samples_and_stops():
     s.stop()
     assert tel.registry.counter_value("device.hbm_samples",
                                       boundary="background") >= 2
+    # stop() joins the sampler thread, so the counter is static the
+    # moment it returns — no grace sleep (the old fixed 0.1 s sleep
+    # was a leftover timing assumption shared by the hammer tests)
     n = tel.registry.counter_value("device.hbm_samples",
                                    boundary="background")
-    time.sleep(0.1)
     assert tel.registry.counter_value("device.hbm_samples",
                                       boundary="background") == n
 
@@ -368,12 +370,15 @@ def test_metrics_endpoint_content_negotiation(tmp_path):
 # --------------------------------------------------------------------------
 
 
-def test_registry_hammer_no_torn_snapshots():
+def test_registry_hammer_no_torn_snapshots(monkeypatch):
     """N writer threads hammer one counter/histogram/gauge while a
     scraper thread snapshots and renders Prometheus text: every
     intermediate view must be internally consistent (histogram count
     == sum for unit observations, counters monotone), and the final
-    totals exact."""
+    totals exact. Runs with the runtime lock-assert twin armed
+    (ISSUE 19): a discipline regression raises LockAssertionError
+    naming the attribute instead of flaking as a torn snapshot."""
+    monkeypatch.setenv("MFF_LOCK_ASSERT", "1")
     reg = MetricsRegistry()
     N_THREADS, N_OPS = 8, 400
     stop = threading.Event()
@@ -416,10 +421,13 @@ def test_registry_hammer_no_torn_snapshots():
         == N_THREADS * N_OPS
 
 
-def test_registry_merge_is_safe_under_concurrent_observe():
+def test_registry_merge_is_safe_under_concurrent_observe(monkeypatch):
     """The audit fix: merge() deep-copies histogram state under the
     source's lock, so a concurrent observe on the source can neither
-    tear the copy nor retroactively mutate the destination."""
+    tear the copy nor retroactively mutate the destination. Armed
+    (ISSUE 19), so the deep copy also proves it never mutates outside
+    the locks it claims to hold."""
+    monkeypatch.setenv("MFF_LOCK_ASSERT", "1")
     src = MetricsRegistry()
     stop = threading.Event()
 
@@ -444,10 +452,20 @@ def test_registry_merge_is_safe_under_concurrent_observe():
         t.join()
 
 
-def test_http_scrape_hammer_while_requests_drain(tmp_path):
+def test_http_scrape_hammer_while_requests_drain(tmp_path,
+                                                 monkeypatch):
     """The satellite's exact ask: scrape /v1/metrics (both formats)
     while a request load drains; every scrape parses and the request
-    counter is monotone across scrapes."""
+    counter is monotone across scrapes.
+
+    Deflaked (ISSUE 19): runs with the runtime lock-assert twin armed
+    (MFF_LOCK_ASSERT=1) so a lock-discipline regression fails
+    deterministically with a named class.attribute instead of
+    surfacing as a rare torn scrape; the scraper yields between
+    scrapes instead of spinning (on the 1-core CI host a busy-loop
+    starves the clients it is supposed to race); and the drain is
+    bounded by one deadline instead of unbounded joins."""
+    monkeypatch.setenv("MFF_LOCK_ASSERT", "1")
     srv, _ = _server(tmp_path, n_days=8, n_tickers=12)
     httpd = None
     errors = []
@@ -473,6 +491,7 @@ def test_http_scrape_hammer_while_requests_drain(tmp_path):
                     text.decode()
                 except Exception as e:  # noqa: BLE001 — surfaced below
                     errors.append(repr(e))
+                stop.wait(0.005)  # yield the GIL to the clients
 
         def client_loop(tid):
             c = srv.client(timeout=120)
@@ -483,16 +502,22 @@ def test_http_scrape_hammer_while_requests_drain(tmp_path):
             except Exception as e:  # noqa: BLE001 — surfaced below
                 errors.append(repr(e))
 
-        s = threading.Thread(target=scraper)
+        s = threading.Thread(target=scraper, daemon=True)
         s.start()
-        clients = [threading.Thread(target=client_loop, args=(i,))
+        clients = [threading.Thread(target=client_loop, args=(i,),
+                                    daemon=True)
                    for i in range(6)]
         for t in clients:
             t.start()
+        deadline = time.monotonic() + 120.0
         for t in clients:
-            t.join()
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        stuck = [t.name for t in clients if t.is_alive()]
         stop.set()
-        s.join()
+        s.join(timeout=10.0)
+        assert not stuck, f"clients did not drain by the deadline: " \
+                          f"{stuck}; errors={errors[:5]}"
+        assert not s.is_alive(), "scraper did not stop"
         assert not errors, errors[:5]
         assert seen[0] >= 6 * 5
     finally:

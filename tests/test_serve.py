@@ -490,10 +490,14 @@ def test_serve_bench_smoke_record():
     assert r["p50_ms"] > 0 and r["p99_ms"] >= r["p50_ms"]
 
 
-def test_concurrent_clients_under_load_all_answered():
+def test_concurrent_clients_under_load_all_answered(monkeypatch):
     """A mini load test through the live queue: N threads, every
     request answered, nothing shed, per-request latency histogram
-    populated."""
+    populated. Runs with the runtime lock-assert twin armed
+    (ISSUE 19): the breaker state and registry mutate from caller and
+    worker threads under load, so a lock-discipline regression raises
+    a named LockAssertionError instead of flaking."""
+    monkeypatch.setenv("MFF_LOCK_ASSERT", "1")
     srv, tel = _server(n_days=8, n_tickers=24)
     try:
         c = srv.client()
