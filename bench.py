@@ -1258,16 +1258,124 @@ SERVE_REQUESTS = int(os.environ.get("BENCH_SERVE_REQUESTS", "192"))
 SERVE_TICKERS = int(os.environ.get("BENCH_SERVE_TICKERS", "1024"))
 SERVE_DAYS = int(os.environ.get("BENCH_SERVE_DAYS", "32"))
 SERVE_WINDOW_DAYS = int(os.environ.get("BENCH_SERVE_WINDOW_DAYS", "8"))
+#: front-door transport for the serve load (ISSUE 20): ``inproc``
+#: keeps the r8 in-process queue loop byte-for-byte; ``edge`` drives
+#: keep-alive wire-encoded HTTP load through the evented selectors
+#: front door (methodology ``r15_serve_edge_v1``); ``legacy`` drives
+#: the SAME HTTP load through the stdlib thread-per-connection server
+#: (``r15_serve_edge_v1+transport=legacy`` — the A/B leg).
+SERVE_TRANSPORT = os.environ.get("BENCH_SERVE_TRANSPORT", "inproc")
+
+
+def _http_wire_load(host, port, ranges, levels, total_requests,
+                    stages, hbm=None, stage_tag="serve.load",
+                    stage_prefix=""):
+    """Per-level threaded keep-alive wire load against a bound front
+    door (ISSUE 20). Each thread owns ONE persistent
+    :class:`serve.WireClient` for its whole request cycle — keep-alive
+    reuse IS the measured contract (the pre-ISSUE-20 comparison paid
+    TCP connect + teardown per request) — and every request is a
+    wire-encoded full-set factors query whose framed body is decoded
+    by the first-party client, so bytes-on-wire per answer is counted
+    at the consumer, not inferred from server counters.
+
+    Returns ``(level_stats, wire_totals)`` where ``wire_totals`` is
+    ``{"wire_answers", "wire_bytes", "http_failures"}`` summed over
+    every level."""
+    import threading as _th
+
+    from replication_of_minute_frequency_factor_tpu.serve import (
+        WireClient, decode_frames)
+    from replication_of_minute_frequency_factor_tpu.serve.http import (
+        WIRE_CONTENT_TYPE)
+
+    level_stats = {}
+    totals = {"wire_answers": 0, "wire_bytes": 0, "http_failures": 0}
+    tot_lock = _th.Lock()
+    for level in levels:
+        lat_lock = _th.Lock()
+        latencies = []
+        n_threads = max(1, level)
+        per_thread = max(1, total_requests // n_threads)
+
+        def run_client(tid):
+            # one persistent connection per simulated client; a
+            # distinct tenant stripe keeps any configured quota from
+            # funneling the whole fleet through one bucket
+            cli = WireClient(host, port, timeout=600.0,
+                             tenant=f"bench-{tid % 16}")
+            mine = []
+            body_bytes = answers = failures = 0
+            try:
+                for j in range(per_thread):
+                    s, e = ranges[(tid + j) % len(ranges)]
+                    t_req = time.perf_counter()
+                    status, _hdrs, data = cli.post_json(
+                        "/v1/query",
+                        {"kind": "factors", "start": s, "end": e},
+                        headers={"Accept": WIRE_CONTENT_TYPE})
+                    if status != 200:
+                        failures += 1
+                        continue
+                    decode_frames(data)
+                    mine.append(time.perf_counter() - t_req)
+                    body_bytes += len(data)
+                    answers += 1
+            finally:
+                cli.close()
+            with lat_lock:
+                latencies.extend(mine)
+            with tot_lock:
+                totals["wire_bytes"] += body_bytes
+                totals["wire_answers"] += answers
+                totals["http_failures"] += failures
+
+        t0 = time.perf_counter()
+        threads = [_th.Thread(target=run_client, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        lat = np.sort(np.asarray(latencies))
+        level_stats[str(level)] = {
+            "requests": len(lat),
+            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+            "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+            "qps": round(len(lat) / wall, 1),
+        }
+        stages[f"{stage_prefix}load_{level}_s"] = round(wall, 3)
+        if hbm is not None:
+            hbm.sample(f"{stage_tag}_{level}", force=True)
+    return level_stats, totals
 
 
 def serve_bench(levels=None, total_requests=None, tickers=None,
-                days=None, window_days=None, names=None, telemetry=None):
-    """Load-generate against an in-process :class:`serve.FactorServer`
-    over synthetic data and return the ``r8_serve_v1`` record:
-    per-concurrency-level p50/p99 latency + QPS, plus the serving
-    counters the acceptance gate reads (exposure-cache hits, coalesced
-    dispatches, and the compile count over the loaded window — ZERO
-    compiles during load is the warm-executable contract).
+                days=None, window_days=None, names=None, telemetry=None,
+                transport=None):
+    """Load-generate against a :class:`serve.FactorServer` and return
+    the serving record: per-concurrency-level p50/p99 latency + QPS,
+    plus the serving counters the acceptance gate reads
+    (exposure-cache hits, coalesced dispatches, and the compile count
+    over the loaded window — ZERO compiles during load is the
+    warm-executable contract).
+
+    ``transport`` (default ``BENCH_SERVE_TRANSPORT``) picks the entry
+    path (ISSUE 20):
+
+      inproc — the r8 in-process queue loop, byte-for-byte
+               (methodology ``r8_serve_v1``);
+      edge   — keep-alive wire-encoded HTTP load through the evented
+               selectors front door (``r15_serve_edge_v1``); the
+               record additionally carries the ``edge`` block with
+               client-side bytes-on-wire per answer and the JSON A/B
+               ratio;
+      legacy — the SAME HTTP wire load through the stdlib
+               thread-per-connection server
+               (``r15_serve_edge_v1+transport=legacy``) — the door A/B
+               leg; per-answer bytes match edge (same payload), the
+               latency/QPS columns are what differ.
 
     Three phases, each a ``stages`` column:
 
@@ -1277,7 +1385,9 @@ def serve_bench(levels=None, total_requests=None, tickers=None,
                  live load coalescing additionally happens whenever
                  concurrent clients land in one collection window);
       warm     — every (kind, factor, range) combo the load uses, once:
-                 all compiles happen here;
+                 all compiles happen here (HTTP transports additionally
+                 warm the full-set wire encode per range THROUGH the
+                 bound door, and bank the JSON answer size for the A/B);
       load     — per level: N threads issuing the combo cycle,
                  per-request wall collected client-side.
     """
@@ -1289,6 +1399,11 @@ def serve_bench(levels=None, total_requests=None, tickers=None,
         FactorServer, Query, ServeConfig, SyntheticSource)
     from replication_of_minute_frequency_factor_tpu.telemetry import (
         Telemetry, set_telemetry)
+
+    transport = (transport or SERVE_TRANSPORT).strip() or "inproc"
+    if transport not in ("inproc", "edge", "legacy"):
+        raise ValueError(f"unknown serve transport {transport!r} "
+                         "(inproc, edge or legacy)")
 
     levels = tuple(levels if levels is not None else
                    (int(s) for s in SERVE_CLIENTS.split(",") if s.strip()))
@@ -1336,41 +1451,79 @@ def serve_bench(levels=None, total_requests=None, tickers=None,
         server.submit(q).result(600)
     stages["warm_s"] = round(time.perf_counter() - t0, 3)
 
-    compiles_before = reg.counter_total("xla.compiles")
-    level_stats = {}
-    for level in levels:
-        lat_lock = _th.Lock()
-        latencies = []
-        n_threads = max(1, level)
-        per_thread = max(1, total_requests // n_threads)
-
-        def run_client(tid):
-            mine = []
-            for j in range(per_thread):
-                q = combos[(tid + j) % len(combos)]
-                t_req = time.perf_counter()
-                server.submit(q).result(600)
-                mine.append(time.perf_counter() - t_req)
-            with lat_lock:
-                latencies.extend(mine)
-
+    door = None
+    wire_totals = None
+    json_bytes_per_answer = None
+    if transport != "inproc":
+        from replication_of_minute_frequency_factor_tpu.serve import (
+            WireClient)
+        from replication_of_minute_frequency_factor_tpu.serve.http import (
+            serve_frontdoor)
         t0 = time.perf_counter()
-        threads = [_th.Thread(target=run_client, args=(i,))
-                   for i in range(n_threads)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = time.perf_counter() - t0
-        lat = np.sort(np.asarray(latencies))
-        level_stats[str(level)] = {
-            "requests": len(lat),
-            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
-            "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
-            "qps": round(len(lat) / wall, 1),
-        }
-        stages[f"load_{level}_s"] = round(wall, 3)
-        tel.hbm.sample(f"serve.load_{level}", force=True)
+        door = serve_frontdoor(server, transport=transport)
+        d_host, d_port = door.server_address[:2]
+        # full-set wire warm THROUGH the door: the AOT pack compiles
+        # once per block geometry here, and the buffered JSON answer
+        # for the same query banks the A/B denominator
+        cli = WireClient(d_host, d_port, timeout=600.0,
+                         tenant="bench-warm")
+        json_sizes = []
+        for s, e in ranges:
+            cli.query_wire(s, e)
+            status, _hdrs, data = cli.post_json(
+                "/v1/query", {"kind": "factors", "start": s, "end": e})
+            if status == 200:
+                json_sizes.append(len(data))
+        cli.close()
+        if json_sizes:
+            json_bytes_per_answer = round(
+                sum(json_sizes) / len(json_sizes), 1)
+        stages["warm_wire_s"] = round(time.perf_counter() - t0, 3)
+
+    compiles_before = reg.counter_total("xla.compiles")
+    if transport != "inproc":
+        d_host, d_port = door.server_address[:2]
+        level_stats, wire_totals = _http_wire_load(
+            d_host, d_port, ranges, levels, total_requests, stages,
+            hbm=tel.hbm, stage_tag="serve.load")
+        door.shutdown()
+        if hasattr(door, "server_close"):
+            door.server_close()
+    else:
+        level_stats = {}
+        for level in levels:
+            lat_lock = _th.Lock()
+            latencies = []
+            n_threads = max(1, level)
+            per_thread = max(1, total_requests // n_threads)
+
+            def run_client(tid):
+                mine = []
+                for j in range(per_thread):
+                    q = combos[(tid + j) % len(combos)]
+                    t_req = time.perf_counter()
+                    server.submit(q).result(600)
+                    mine.append(time.perf_counter() - t_req)
+                with lat_lock:
+                    latencies.extend(mine)
+
+            t0 = time.perf_counter()
+            threads = [_th.Thread(target=run_client, args=(i,))
+                       for i in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            lat = np.sort(np.asarray(latencies))
+            level_stats[str(level)] = {
+                "requests": len(lat),
+                "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+                "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
+                "qps": round(len(lat) / wall, 1),
+            }
+            stages[f"load_{level}_s"] = round(wall, 3)
+            tel.hbm.sample(f"serve.load_{level}", force=True)
     # SLO block (ISSUE 16): one explicit frame before close so even the
     # shortest run banks a nonzero timeline, then the objective verdicts
     server.timeline.sample()
@@ -1395,7 +1548,45 @@ def serve_bench(levels=None, total_requests=None, tickers=None,
         "load_shed": int(reg.counter_total("serve.load_shed")),
         "failures": int(reg.counter_total("serve.failures")),
     }
-    return {
+    # DECLARED series (telemetry/regress.py): the HTTP front doors are
+    # a new entry path AND a new answer encoding, so their records
+    # start their own baselines — and the legacy thread-per-connection
+    # door keys separately from the evented edge (the A/B must never
+    # gate one against the other)
+    methodology = {"inproc": "r8_serve_v1",
+                   "edge": "r15_serve_edge_v1",
+                   "legacy": "r15_serve_edge_v1+transport=legacy",
+                   }[transport]
+    edge_block = None
+    if wire_totals is not None:
+        wa = wire_totals["wire_answers"]
+        wb = wire_totals["wire_bytes"]
+        wbpa = round(wb / wa, 1) if wa else None
+        edge_block = {
+            # gates the regress-derived `<metric>.wire_bytes_per_answer`
+            # sub-series: only a load that actually decoded wire
+            # answers seeds or gates it
+            "available": wa > 0,
+            "transport": transport,
+            "wire_answers": wa,
+            "wire_bytes": wb,
+            "wire_bytes_per_answer": wbpa,
+            "json_bytes_per_answer": json_bytes_per_answer,
+            # the ISSUE 20 acceptance ratio: JSON bytes-on-wire per
+            # answer over wire bytes-on-wire per answer (>= 1.5 at the
+            # top client level is the gate)
+            "ab_ratio": (round(json_bytes_per_answer / wbpa, 2)
+                         if wbpa and json_bytes_per_answer else None),
+            "http_failures": wire_totals["http_failures"],
+        }
+        if transport == "edge":
+            edge_block["conns_opened"] = int(
+                reg.counter_total("edge.conns_opened"))
+            edge_block["requests"] = int(
+                reg.counter_total("edge.requests"))
+            edge_block["quota_rejected"] = int(
+                reg.counter_total("edge.quota_rejected"))
+    record = {
         # metric name derives from the ACTUAL factor/ticker counts, like
         # the headline (a restricted smoke can never print under the
         # full-set name)
@@ -1410,8 +1601,14 @@ def serve_bench(levels=None, total_requests=None, tickers=None,
         "window_days": window_days,
         "factors": len(names),
         # DECLARED series (telemetry/regress.py): the serving layer is a
-        # new workload — p50/p99/QPS records start their own baseline
-        "methodology": "r8_serve_v1",
+        # new workload — p50/p99/QPS records start their own baseline;
+        # the HTTP doors declare their own r15 series (see above)
+        "methodology": methodology,
+        # entry-path stamps (ISSUE 20): which door answered and how the
+        # answers went over the wire — the record is self-describing
+        # even before the methodology is consulted
+        "transport": transport,
+        "encoding": "wire" if transport != "inproc" else "json",
         "p50_ms": level_stats[top]["p50_ms"],
         "p99_ms": level_stats[top]["p99_ms"],
         "levels": level_stats,
@@ -1435,6 +1632,11 @@ def serve_bench(levels=None, total_requests=None, tickers=None,
         "slo": slo_block,
         "stages": stages,
     }
+    if edge_block is not None:
+        # only HTTP-transport records carry the block — an r8 inproc
+        # record keeps its banked shape byte-for-byte
+        record["edge"] = edge_block
+    return record
 
 
 def serve_smoke():
@@ -1459,6 +1661,98 @@ def serve_smoke():
         "ok": (s["compiles_during_load"] == 0
                and s["coalesced_dispatches"] >= 1
                and s["cache_hits"] > 0
+               and s["failures"] == 0 and s["load_shed"] == 0),
+    }
+
+
+def edge_smoke():
+    """run_tests.sh --quick smoke for the evented binary edge (ISSUE
+    20): a tiny edge-transport serve_bench plus the edge-specific
+    gates on a dedicated quota-configured server. ``ok`` iff
+
+    * the HTTP wire answer decodes byte-identically to the in-process
+      wire answer for the same range (one payload end to end — the
+      dequantize twin gate rides tier-1 in tests/test_edge.py);
+    * a chunked range answer reassembles byte-identically to the
+      buffered one (>= 2 frames actually streamed);
+    * quota exhaustion answers 429 WITH a Retry-After hint (the shed
+      contract's mirror);
+    * zero compiles during the HTTP load (the warm-executable
+      contract survives the new front door);
+    * the wire answer beats the JSON answer on bytes-on-wire
+      (``ab_ratio`` >= 1.5 — the acceptance direction, smoke-sized).
+
+    One JSON verdict line, like every other smoke."""
+    from replication_of_minute_frequency_factor_tpu.serve import (
+        FactorServer, SyntheticSource, ServeClient, ServeConfig,
+        WireClient, WireError, serve_edge)
+    from replication_of_minute_frequency_factor_tpu.telemetry import (
+        Telemetry)
+
+    names = ("vol_return1min", "mmt_am", "liq_openvol")
+    record = serve_bench(transport="edge", levels=(1, 8),
+                         total_requests=48, tickers=32, days=16,
+                         window_days=4, names=names)
+    s = record["serve"]
+    eb = record["edge"]
+
+    # --- the edge-specific gates on a dedicated tiny server (fresh
+    # telemetry, a deliberately exhaustible token bucket)
+    tel = Telemetry()
+    server = FactorServer(
+        SyntheticSource(n_days=8, n_tickers=32, seed=7), names=names,
+        telemetry=tel,
+        serve_cfg=ServeConfig(tenant_quota_rps=1.0,
+                              tenant_quota_burst=2.0))
+    door = serve_edge(server)
+    host, port = door.server_address[:2]
+    cli = WireClient(host, port, tenant="smoke-a")
+    try:
+        http_out, http_meta = cli.query_wire(0, 8)
+        inproc_out, _ = ServeClient(server).factors_wire(0, 8)
+        byte_identical = (http_out.tobytes() == inproc_out.tobytes())
+        chunked_out, chunked_meta = cli.query_wire(0, 8, chunk_days=2)
+        chunk_ok = (chunked_meta["frames"] >= 2
+                    and chunked_out.tobytes() == http_out.tobytes())
+        # a second tenant owns a FRESH bucket: burst 2 admits two,
+        # the third refuses with the backoff hint
+        quota_cli = WireClient(host, port, tenant="smoke-quota")
+        quota_429 = False
+        retry_after = None
+        try:
+            for _ in range(4):
+                quota_cli.query_wire(0, 8)
+        except WireError as e:
+            quota_429 = (e.status == 429)
+            retry_after = e.retry_after
+        finally:
+            quota_cli.close()
+    finally:
+        cli.close()
+        door.shutdown()
+        server.close()
+    ab_ratio = eb.get("ab_ratio") or 0.0
+    return {
+        "smoke": "edge",
+        "byte_identical": byte_identical,
+        "chunk_frames": chunked_meta["frames"],
+        "chunk_ok": chunk_ok,
+        "quota_429": quota_429,
+        "quota_retry_after": retry_after,
+        "compiles_during_load": s["compiles_during_load"],
+        "wire_answers": eb["wire_answers"],
+        "wire_bytes_per_answer": eb["wire_bytes_per_answer"],
+        "json_bytes_per_answer": eb["json_bytes_per_answer"],
+        "ab_ratio": ab_ratio,
+        "http_failures": eb["http_failures"],
+        "p50_ms": record["p50_ms"], "p99_ms": record["p99_ms"],
+        "qps": record["value"], "methodology": record["methodology"],
+        "ok": (byte_identical and chunk_ok
+               and quota_429 and retry_after is not None
+               and s["compiles_during_load"] == 0
+               and eb["wire_answers"] > 0
+               and eb["http_failures"] == 0
+               and ab_ratio >= 1.5
                and s["failures"] == 0 and s["load_shed"] == 0),
     }
 
@@ -1518,10 +1812,18 @@ FLEET_REQUESTS = int(os.environ.get("BENCH_FLEET_REQUESTS", "1024"))
 FLEET_TICKERS = int(os.environ.get("BENCH_FLEET_TICKERS", "1024"))
 FLEET_DAYS = int(os.environ.get("BENCH_FLEET_DAYS", "32"))
 FLEET_WINDOW_DAYS = int(os.environ.get("BENCH_FLEET_WINDOW_DAYS", "8"))
+#: pod front-door transport (ISSUE 20): ``inproc`` keeps the r11
+#: router-queue loop byte-for-byte; ``edge`` binds the evented binary
+#: edge on the POD front door and drives keep-alive wire-encoded HTTP
+#: load through the router's replica leg (methodology
+#: ``r15_fleet_edge_v1``); ``legacy`` the stdlib thread-per-connection
+#: pod server (``r15_fleet_edge_v1+transport=legacy`` — the A/B leg).
+FLEET_TRANSPORT = os.environ.get("BENCH_FLEET_TRANSPORT", "inproc")
 
 
 def fleet_bench(replica_counts=None, levels=None, total_requests=None,
-                tickers=None, days=None, window_days=None, names=None):
+                tickers=None, days=None, window_days=None, names=None,
+                transport=None):
     """Load-generate against a :class:`fleet.FactorFleet` per replica
     count and return the ``r11_fleet_v1`` record: per-replica-count
     p50/p99/QPS at every client level, the coalesce + affinity
@@ -1561,6 +1863,10 @@ def fleet_bench(replica_counts=None, levels=None, total_requests=None,
     from replication_of_minute_frequency_factor_tpu.telemetry.validate \
         import validate_dir
 
+    transport = (transport or FLEET_TRANSPORT).strip() or "inproc"
+    if transport not in ("inproc", "edge", "legacy"):
+        raise ValueError(f"unknown fleet transport {transport!r} "
+                         "(inproc, edge or legacy)")
     levels = tuple(levels if levels is not None else
                    (int(s) for s in FLEET_CLIENTS.split(",")
                     if s.strip()))
@@ -1606,6 +1912,7 @@ def fleet_bench(replica_counts=None, levels=None, total_requests=None,
     hbm_block = None
     fh_block = None
     slo_block = None
+    edge_block = None
 
     for c in runnable:
         tel_pod = Telemetry()
@@ -1629,46 +1936,85 @@ def fleet_bench(replica_counts=None, levels=None, total_requests=None,
             fleet.submit(q).result(600)
         stages[f"r{c}_warm_s"] = round(time.perf_counter() - t0, 3)
 
+        door = None
+        wire_totals = None
+        json_sizes = []
+        if transport != "inproc":
+            from replication_of_minute_frequency_factor_tpu.fleet import (
+                serve_fleet_frontdoor)
+            from replication_of_minute_frequency_factor_tpu.serve import (
+                WireClient)
+            t0 = time.perf_counter()
+            door = serve_fleet_frontdoor(fleet, transport=transport)
+            d_host, d_port = door.server_address[:2]
+            # full-set wire warm THROUGH the pod door: affinity pins
+            # each range's AOT pack to its owner replica, and the JSON
+            # answer size banks the A/B denominator
+            cli = WireClient(d_host, d_port, timeout=600.0,
+                             tenant="bench-warm")
+            for ws, we in ranges:
+                cli.query_wire(ws, we)
+                status, _hdrs, data = cli.post_json(
+                    "/v1/query",
+                    {"kind": "factors", "start": ws, "end": we})
+                if status == 200:
+                    json_sizes.append(len(data))
+            cli.close()
+            stages[f"r{c}_warm_wire_s"] = round(
+                time.perf_counter() - t0, 3)
+
         def pod_total(name):
             return (tel_pod.registry.counter_total(name)
                     + sum(r.telemetry.registry.counter_total(name)
                           for r in fleet.replicas))
 
         compiles_before = pod_total("xla.compiles")
-        level_stats = {}
-        for level in levels:
-            lat_lock = _th.Lock()
-            latencies = []
-            n_threads = max(1, level)
-            per_thread = max(1, total_requests // n_threads)
+        if transport != "inproc":
+            level_stats, wire_totals = _http_wire_load(
+                d_host, d_port, ranges, levels, total_requests, stages,
+                hbm=tel_pod.hbm, stage_tag=f"fleet.load_r{c}",
+                stage_prefix=f"r{c}_")
+            door.shutdown()
+            if hasattr(door, "server_close"):
+                door.server_close()
+        else:
+            level_stats = {}
+            for level in levels:
+                lat_lock = _th.Lock()
+                latencies = []
+                n_threads = max(1, level)
+                per_thread = max(1, total_requests // n_threads)
 
-            def run_client(tid):
-                mine = []
-                for j in range(per_thread):
-                    q = combos[(tid + j) % len(combos)]
-                    t_req = time.perf_counter()
-                    fleet.submit(q).result(600)
-                    mine.append(time.perf_counter() - t_req)
-                with lat_lock:
-                    latencies.extend(mine)
+                def run_client(tid):
+                    mine = []
+                    for j in range(per_thread):
+                        q = combos[(tid + j) % len(combos)]
+                        t_req = time.perf_counter()
+                        fleet.submit(q).result(600)
+                        mine.append(time.perf_counter() - t_req)
+                    with lat_lock:
+                        latencies.extend(mine)
 
-            t0 = time.perf_counter()
-            threads = [_th.Thread(target=run_client, args=(i,))
-                       for i in range(n_threads)]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            wall = time.perf_counter() - t0
-            lat = np.sort(np.asarray(latencies))
-            level_stats[str(level)] = {
-                "requests": len(lat),
-                "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
-                "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 2),
-                "qps": round(len(lat) / wall, 1),
-            }
-            stages[f"r{c}_load_{level}_s"] = round(wall, 3)
-            tel_pod.hbm.sample(f"fleet.load_r{c}_{level}", force=True)
+                t0 = time.perf_counter()
+                threads = [_th.Thread(target=run_client, args=(i,))
+                           for i in range(n_threads)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                wall = time.perf_counter() - t0
+                lat = np.sort(np.asarray(latencies))
+                level_stats[str(level)] = {
+                    "requests": len(lat),
+                    "p50_ms": round(
+                        float(np.percentile(lat, 50)) * 1e3, 2),
+                    "p99_ms": round(
+                        float(np.percentile(lat, 99)) * 1e3, 2),
+                    "qps": round(len(lat) / wall, 1),
+                }
+                stages[f"r{c}_load_{level}_s"] = round(wall, 3)
+                tel_pod.hbm.sample(f"fleet.load_r{c}_{level}",
+                                   force=True)
 
         # --- the pod fold, RE-VERIFIED: every merged counter equals
         # the control-plane + per-replica sum (the PR 9 contract)
@@ -1758,11 +2104,38 @@ def fleet_bench(replica_counts=None, levels=None, total_requests=None,
             # the pod objectives' verdicts
             fleet.timeline.sample()
             slo_block = fleet.sloplane.summary()
+            # binary-edge block (ISSUE 20): client-side bytes-on-wire
+            # at the top replica count, plus the router's evidence the
+            # encoding rode the replica leg end to end
+            if wire_totals is not None:
+                wa = wire_totals["wire_answers"]
+                wb = wire_totals["wire_bytes"]
+                wbpa = round(wb / wa, 1) if wa else None
+                jbpa = (round(sum(json_sizes) / len(json_sizes), 1)
+                        if json_sizes else None)
+                edge_block = {
+                    "available": wa > 0,
+                    "transport": transport,
+                    "wire_answers": wa,
+                    "wire_bytes": wb,
+                    "wire_bytes_per_answer": wbpa,
+                    "json_bytes_per_answer": jbpa,
+                    "ab_ratio": (round(jbpa / wbpa, 2)
+                                 if wbpa and jbpa else None),
+                    "http_failures": wire_totals["http_failures"],
+                    "routed_wire": int(
+                        preg.counter_total("fleet.routed_wire")),
+                }
+                if transport == "edge":
+                    edge_block["conns_opened"] = int(
+                        preg.counter_total("edge.conns_opened"))
+                    edge_block["quota_rejected"] = int(
+                        preg.counter_total("edge.quota_rejected"))
         fleet.close()
 
     top = str(runnable[-1])
     top_level = str(levels[-1])
-    return {
+    record = {
         # metric name derives from the ACTUAL factor/ticker counts,
         # like every other mode (a restricted smoke can never print
         # under the full-set name)
@@ -1782,8 +2155,16 @@ def fleet_bench(replica_counts=None, levels=None, total_requests=None,
         #: serve, not the fleet)
         "live_replicas": per_count[top]["live"],
         # DECLARED series (telemetry/regress.py): a new workload AND a
-        # new topology — fleet records start their own baseline
-        "methodology": "r11_fleet_v1",
+        # new topology — fleet records start their own baseline; the
+        # pod HTTP doors declare their own r15 series, keyed apart so
+        # the door A/B can never gate one leg against the other
+        "methodology": {"inproc": "r11_fleet_v1",
+                        "edge": "r15_fleet_edge_v1",
+                        "legacy": "r15_fleet_edge_v1+transport=legacy",
+                        }[transport],
+        # entry-path stamps (ISSUE 20), same contract as serve records
+        "transport": transport,
+        "encoding": "wire" if transport != "inproc" else "json",
         "session": SESSION,
         "p50_ms": per_count[top]["levels"][top_level]["p50_ms"],
         "p99_ms": per_count[top]["levels"][top_level]["p99_ms"],
@@ -1799,6 +2180,11 @@ def fleet_bench(replica_counts=None, levels=None, total_requests=None,
         "slo": slo_block,
         "stages": stages,
     }
+    if edge_block is not None:
+        # only HTTP-transport records carry the block — an r11 inproc
+        # record keeps its banked shape byte-for-byte
+        record["edge"] = edge_block
+    return record
 
 
 def fleet_smoke():

@@ -198,7 +198,17 @@ def drop_conv_only_rolling(steps):
       feeds the ``<metric>.burn_rate_max`` regress series, so a
       record with no burn-rate evidence cannot bank — pre-ISSUE-16
       green entries have no ``slo`` block and re-run under the new
-      contract.
+      contract;
+    * since ISSUE 20 'serve' and 'fleet' windows must ALSO carry the
+      evented-edge leg: an ``r15_serve_edge_v1`` /
+      ``r15_fleet_edge_v1`` record with ``transport == 'edge'`` and
+      an available ``edge`` block whose ``wire_answers`` is a nonzero
+      int (the load generators genuinely decoded binary frames), zero
+      HTTP failures, and — for the fleet — a nonzero ``routed_wire``
+      (the router's replica hop carried the wire, not re-encoded
+      JSON). Pre-ISSUE-20 green entries have no edge leg and re-run
+      as two-leg windows (:func:`_serve_edge_record_banks` /
+      :func:`_fleet_edge_record_banks`).
     """
     def keep(name, v):
         recs = [r for r in v.get("results") or [] if isinstance(r, dict)]
@@ -232,8 +242,11 @@ def drop_conv_only_rolling(steps):
         if name == "serve":
             # ISSUE 6: zero exposure-cache hits means the service never
             # answered warm — the record measured cold dispatch, not
-            # serving; it re-runs
-            return any(_serve_record_banks(r) for r in recs)
+            # serving; it re-runs. ISSUE 20: the window must ALSO
+            # carry a bankable evented-edge leg (binary answers
+            # through the real front door)
+            return (any(_serve_record_banks(r) for r in recs)
+                    and any(_serve_edge_record_banks(r) for r in recs))
         if name == "stream_intraday":
             # ISSUE 7 + 18: zero streamed updates means the ingest loop
             # never dispatched (measured nothing), a load-phase compile
@@ -251,8 +264,11 @@ def drop_conv_only_rolling(steps):
             # ISSUE 11: fewer than 2 live replicas means the pod never
             # multiplied (one replica IS the serve step), and a record
             # without the pod hbm/counter blocks has no degrade-policy
-            # or fold evidence — neither may bank
-            return any(_fleet_record_banks(r) for r in recs)
+            # or fold evidence — neither may bank. ISSUE 20: the
+            # window must ALSO carry a bankable pod-edge leg (wire
+            # through the door AND the routed replica hop)
+            return (any(_fleet_record_banks(r) for r in recs)
+                    and any(_fleet_edge_record_banks(r) for r in recs))
         if name == "resident_2d":
             # ISSUE 13: a record whose mesh fell back to 1-D (or whose
             # balance/wire/data-quality evidence is missing) is not
@@ -454,24 +470,47 @@ def step_serve():
     windows (BENCH_SERVE_CLIENTS); the carry rule below rejects any
     record whose exposure cache never hit — a serve number that
     recomputed every request measures the batch engine, not the
-    service."""
-    r = _run_json_lines(
-        [sys.executable, "bench.py", "serve"], timeout=1800,
-        env=dict(os.environ, BENCH_REQUIRE_TPU="1",
-                 BENCH_SERVE_CLIENTS="1,32"))
-    if r.get("ok"):
-        recs = [rec for rec in r.get("results") or []
-                if isinstance(rec, dict)]
-        if any("_cpu_fallback" in str(rec.get("metric", ""))
-               for rec in recs):
-            r["ok"] = False
-            r["error"] = "serve bench printed a CPU-fallback metric"
-        elif not any(_serve_record_banks(rec) for rec in recs):
-            r["ok"] = False
-            r["error"] = ("no r8_serve_v1 record with cache hits > 0 "
-                          "and a sampled slo block — a zero-hit or "
-                          "unsampled serve run cannot bank")
-    return r
+    service. Since ISSUE 20 the step is a two-leg window at the same
+    hardware: the in-process leg above plus the evented binary front
+    door (BENCH_SERVE_TRANSPORT=edge, ``r15_serve_edge_v1``) — keep-
+    alive HTTP load through the real edge, answers on the result wire.
+    The window banks only when BOTH legs bank
+    (:func:`_serve_record_banks` + :func:`_serve_edge_record_banks`):
+    an edge leg with zero binary answers, HTTP failures, or a silent
+    fallback off the edge transport measured the wrong door."""
+    merged = {"ok": True, "rc": 0, "seconds": 0.0, "results": []}
+    for leg, env_extra in (
+            ("inproc", {"BENCH_SERVE_TRANSPORT": "inproc"}),
+            ("edge", {"BENCH_SERVE_TRANSPORT": "edge"})):
+        r = _run_json_lines(
+            [sys.executable, "bench.py", "serve"], timeout=1800,
+            env=dict(os.environ, BENCH_REQUIRE_TPU="1",
+                     BENCH_SERVE_CLIENTS="1,32", **env_extra))
+        merged["rc"] = r.get("rc", merged["rc"])
+        merged["seconds"] = round(
+            merged["seconds"] + (r.get("seconds") or 0.0), 1)
+        merged["results"].extend(r.get("results") or [])
+        if not r.get("ok"):
+            merged["ok"] = False
+            merged["error"] = f"serve {leg} leg failed"
+            return merged
+    recs = [rec for rec in merged["results"] if isinstance(rec, dict)]
+    if any("_cpu_fallback" in str(rec.get("metric", ""))
+           for rec in recs):
+        merged["ok"] = False
+        merged["error"] = "serve bench printed a CPU-fallback metric"
+    elif not any(_serve_record_banks(rec) for rec in recs):
+        merged["ok"] = False
+        merged["error"] = ("no r8_serve_v1 record with cache hits > 0 "
+                           "and a sampled slo block — a zero-hit or "
+                           "unsampled serve run cannot bank")
+    elif not any(_serve_edge_record_banks(rec) for rec in recs):
+        merged["ok"] = False
+        merged["error"] = ("edge leg unbankable: need an "
+                           "r15_serve_edge_v1 record with "
+                           "transport=edge and nonzero binary wire "
+                           "answers, zero HTTP failures — cannot bank")
+    return merged
 
 
 def _serve_record_banks(rec) -> bool:
@@ -496,6 +535,29 @@ def _serve_record_banks(rec) -> bool:
             and isinstance(slo, dict)
             and isinstance(slo.get("frames"), int)
             and slo["frames"] > 0)
+
+
+def _serve_edge_record_banks(rec) -> bool:
+    """ISSUE 20: the edge leg banks only when the evented front door
+    genuinely answered on the binary wire: declared
+    ``r15_serve_edge_v1`` methodology with ``transport == 'edge'``
+    (the stdlib A/B leg stamps ``+transport=legacy`` and may never
+    bank as the edge), an AVAILABLE ``edge`` block whose
+    ``wire_answers`` is a nonzero int (zero binary answers means the
+    load generators never decoded a frame — the number measured
+    request plumbing, not the wire), and zero HTTP failures (a leg
+    that errored requests into its p99 is not a serving measurement).
+    The banked edge trajectory is the series the
+    ``<metric>.wire_bytes_per_answer`` regress gate reads."""
+    edge = rec.get("edge")
+    return (rec.get("methodology") == "r15_serve_edge_v1"
+            and rec.get("transport") == "edge"
+            and isinstance(edge, dict)
+            and edge.get("available") is True
+            and isinstance(edge.get("wire_answers"), int)
+            and not isinstance(edge.get("wire_answers"), bool)
+            and edge["wire_answers"] > 0
+            and edge.get("http_failures") == 0)
 
 
 def step_stream_intraday():
@@ -606,25 +668,48 @@ def step_fleet():
     carry rule (:func:`_fleet_record_banks`) rejects records with
     fewer than 2 live replicas (a single-chip window cannot validate
     the fleet — it fails loudly and re-runs, like resident_sharded) or
-    a missing pod ``hbm`` block."""
-    r = _run_json_lines(
-        [sys.executable, "bench.py", "fleet"], timeout=1800,
-        env=dict(os.environ, BENCH_REQUIRE_TPU="1",
-                 BENCH_FLEET_CLIENTS="64,512"))
-    if r.get("ok"):
-        recs = [rec for rec in r.get("results") or []
-                if isinstance(rec, dict)]
-        if any("_cpu_fallback" in str(rec.get("metric", ""))
-               for rec in recs):
-            r["ok"] = False
-            r["error"] = "fleet bench printed a CPU-fallback metric"
-        elif not any(_fleet_record_banks(rec) for rec in recs):
-            r["ok"] = False
-            r["error"] = ("no r11_fleet_v1 record with >= 2 live "
-                          "replicas, a pod hbm block, the pod "
-                          "counter fold and a sampled slo block — "
-                          "cannot bank")
-    return r
+    a missing pod ``hbm`` block. Since ISSUE 20 the step is a two-leg
+    window like serve: the in-process leg plus the pod's evented
+    binary front door (BENCH_FLEET_TRANSPORT=edge,
+    ``r15_fleet_edge_v1``) — the router's replica hop carries the
+    result wire, counted by ``fleet.routed_wire``. The window banks
+    only when BOTH legs bank (:func:`_fleet_record_banks` +
+    :func:`_fleet_edge_record_banks`)."""
+    merged = {"ok": True, "rc": 0, "seconds": 0.0, "results": []}
+    for leg, env_extra in (
+            ("inproc", {"BENCH_FLEET_TRANSPORT": "inproc"}),
+            ("edge", {"BENCH_FLEET_TRANSPORT": "edge"})):
+        r = _run_json_lines(
+            [sys.executable, "bench.py", "fleet"], timeout=1800,
+            env=dict(os.environ, BENCH_REQUIRE_TPU="1",
+                     BENCH_FLEET_CLIENTS="64,512", **env_extra))
+        merged["rc"] = r.get("rc", merged["rc"])
+        merged["seconds"] = round(
+            merged["seconds"] + (r.get("seconds") or 0.0), 1)
+        merged["results"].extend(r.get("results") or [])
+        if not r.get("ok"):
+            merged["ok"] = False
+            merged["error"] = f"fleet {leg} leg failed"
+            return merged
+    recs = [rec for rec in merged["results"] if isinstance(rec, dict)]
+    if any("_cpu_fallback" in str(rec.get("metric", ""))
+           for rec in recs):
+        merged["ok"] = False
+        merged["error"] = "fleet bench printed a CPU-fallback metric"
+    elif not any(_fleet_record_banks(rec) for rec in recs):
+        merged["ok"] = False
+        merged["error"] = ("no r11_fleet_v1 record with >= 2 live "
+                           "replicas, a pod hbm block, the pod "
+                           "counter fold and a sampled slo block — "
+                           "cannot bank")
+    elif not any(_fleet_edge_record_banks(rec) for rec in recs):
+        merged["ok"] = False
+        merged["error"] = ("edge leg unbankable: need an "
+                           "r15_fleet_edge_v1 record with "
+                           "transport=edge, nonzero binary wire "
+                           "answers and a wire-carrying routed "
+                           "replica hop — cannot bank")
+    return merged
 
 
 def _fleet_record_banks(rec) -> bool:
@@ -652,6 +737,28 @@ def _fleet_record_banks(rec) -> bool:
             and isinstance(slo, dict)
             and isinstance(slo.get("frames"), int)
             and slo["frames"] > 0)
+
+
+def _fleet_edge_record_banks(rec) -> bool:
+    """ISSUE 20, the fleet twin of :func:`_serve_edge_record_banks`:
+    declared ``r15_fleet_edge_v1`` with ``transport == 'edge'``, an
+    AVAILABLE ``edge`` block with nonzero int ``wire_answers`` and
+    zero HTTP failures, AND a nonzero ``routed_wire`` — the router's
+    replica hop must have carried the binary wire (a pod that answered
+    binary at the door but double-encoded JSON internally is exactly
+    the regression this leg exists to catch)."""
+    edge = rec.get("edge")
+    return (rec.get("methodology") == "r15_fleet_edge_v1"
+            and rec.get("transport") == "edge"
+            and isinstance(edge, dict)
+            and edge.get("available") is True
+            and isinstance(edge.get("wire_answers"), int)
+            and not isinstance(edge.get("wire_answers"), bool)
+            and edge["wire_answers"] > 0
+            and edge.get("http_failures") == 0
+            and isinstance(edge.get("routed_wire"), int)
+            and not isinstance(edge.get("routed_wire"), bool)
+            and edge["routed_wire"] > 0)
 
 
 def step_discover():

@@ -164,6 +164,13 @@ def _add_serve(sub: "argparse._SubParsersAction") -> None:
     p.add_argument("--demo", type=int, default=None, metavar="N",
                    help="answer N in-process queries (factors/IC/decile "
                         "cycle), print a JSON summary, exit — no HTTP")
+    p.add_argument("--transport", choices=("edge", "legacy"),
+                   default="edge",
+                   help="front-door transport (ISSUE 20): the evented "
+                        "selectors loop with keep-alive/pipelining/"
+                        "binary-wire answers (edge, default) or the "
+                        "stdlib thread-per-connection server (legacy, "
+                        "the A/B and fallback path)")
     p.add_argument("--telemetry-dir", default=argparse.SUPPRESS,
                    metavar="DIR",
                    help="write the run's telemetry bundle into DIR on "
@@ -172,10 +179,11 @@ def _add_serve(sub: "argparse._SubParsersAction") -> None:
 
 def cmd_serve(args: argparse.Namespace) -> int:
     import os
+    import time
 
     from .models.registry import factor_names
     from .serve import (FactorServer, MinuteDirSource, ServeConfig,
-                        SyntheticSource, serve_http)
+                        SyntheticSource, serve_frontdoor)
     from .telemetry import Telemetry, set_telemetry
 
     all_names = factor_names()
@@ -196,7 +204,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
                                  session=args.session)
     scfg = ServeConfig(batch_window_s=args.batch_window_ms / 1e3,
                        cache_bytes=args.cache_mb * 1024 * 1024,
-                       research_dir=args.research_dir)
+                       research_dir=args.research_dir,
+                       edge=args.transport)
     telemetry_dir = getattr(args, "telemetry_dir", None)
 
     def _write_bundle():
@@ -246,19 +255,21 @@ def cmd_serve(args: argparse.Namespace) -> int:
                 "ic_p50_s": lat.get("p50"),
             }))
             return 0
-        httpd, _thread = serve_http(server, host=args.host,
-                                    port=args.port)
+        door = serve_frontdoor(server, host=args.host,
+                               port=args.port)
         print(json.dumps({"serving": True, "host": args.host,
-                          "port": httpd.server_address[1],
+                          "port": door.server_address[1],
+                          "transport": args.transport,
                           "factors": len(names),
                           "days": source.n_days,
                           "pid": os.getpid()}), flush=True)
         try:
-            _thread.join()
+            while True:
+                time.sleep(3600)
         except KeyboardInterrupt:
             pass
         finally:
-            httpd.shutdown()
+            door.shutdown()
             _write_bundle()
     return 0
 
@@ -272,7 +283,9 @@ def _cmd_serve_fleet(args, source, names, scfg, stream_batches, tel,
     serves until interrupted."""
     import os
 
-    from .fleet import FactorFleet, serve_fleet_http
+    import time
+
+    from .fleet import FactorFleet, serve_fleet_frontdoor
     from .serve import Query
 
     with FactorFleet(source, args.fleet, names=names, serve_cfg=scfg,
@@ -314,20 +327,23 @@ def _cmd_serve_fleet(args, source, names, scfg, stream_batches, tel,
                         "serve.dispatches")) for r in fleet.replicas},
             }))
             return 0
-        httpd, _thread = serve_fleet_http(fleet, host=args.host,
-                                          port=args.port)
+        door = serve_fleet_frontdoor(fleet, host=args.host,
+                                     port=args.port,
+                                     transport=args.transport)
         print(json.dumps({
             "serving": True, "fleet": args.fleet,
-            "host": args.host, "port": httpd.server_address[1],
+            "host": args.host, "port": door.server_address[1],
+            "transport": args.transport,
             "factors": len(names), "days": source.n_days,
             "replicas": [r.label for r in fleet.replicas],
             "pid": os.getpid()}), flush=True)
         try:
-            _thread.join()
+            while True:
+                time.sleep(3600)
         except KeyboardInterrupt:
             pass
         finally:
-            httpd.shutdown()
+            door.shutdown()
             write_bundle()
     return 0
 

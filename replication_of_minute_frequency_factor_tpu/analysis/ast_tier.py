@@ -68,8 +68,17 @@ HOST_SYNC_SCOPE = ("ops", "models", "parallel", "serve", "stream",
 #: into every producing graph, and its host decode must operate on an
 #: ALREADY-FETCHED buffer, never trigger the fetch itself. Scoping the
 #: module keeps any ``np.asarray``/``.item()`` sync from creeping into
-#: it; the fetch stays the caller's declared boundary.
-HOST_SYNC_MODULES = frozenset({"data/result_wire.py"})
+#: it; the fetch stays the caller's declared boundary. ISSUE 20 pins
+#: the evented front door the same way: ``serve/edge.py`` is a
+#: single-threaded event loop — ONE stray sync stalls every
+#: multiplexed connection at once — and ``serve/wireclient.py``
+#: decodes host bytes a socket read already fetched. Both ride the
+#: serve layer scope today, but the module pins keep them in scope
+#: regardless of layer-tuple edits, and NEITHER gets a
+#: GLA3_BOUNDARY_SYNCS allowance: the serve layer's one declared sync
+#: stays in serve/service.py, on a worker thread.
+HOST_SYNC_MODULES = frozenset({"data/result_wire.py", "serve/edge.py",
+                               "serve/wireclient.py"})
 #: layer where raw jnp reductions are banned in favour of ops.masked (GL-A5)
 MASKED_SCOPE = ("models",)
 

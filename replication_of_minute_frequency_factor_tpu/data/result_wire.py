@@ -61,6 +61,7 @@ f32 rather than shipping silently-degraded lanes.
 from __future__ import annotations
 
 import dataclasses
+import struct
 from typing import Dict, Optional, Sequence, Tuple
 
 import jax
@@ -436,3 +437,91 @@ def check_bounds(raw: np.ndarray, decoded: np.ndarray,
                           / scale_ref)
     return {"ok": not bad, "bad_factors": sorted(set(bad)),
             "max_rel_err": max_rel}
+
+
+# --------------------------------------------------------------------------
+# wire framing (ISSUE 20): the HTTP leg of the result wire
+# --------------------------------------------------------------------------
+
+#: frame magic: "Minute Factor Wire", layout version 1
+FRAME_MAGIC = b"MFW1"
+FRAME_VERSION = 1
+
+#: fixed-size frame header preceding each packed payload on the HTTP
+#: leg: magic, version, flags (reserved 0), n_factors, days, tickers,
+#: spill_rows, start, end (the day-range the payload answers; signed so
+#: a rangeless intraday frame can carry -1), payload_len
+_FRAME_HEADER = struct.Struct("<4sHHIIIIiiI")
+FRAME_HEADER_BYTES = _FRAME_HEADER.size
+
+
+def pack_frame(payload, *, n_factors: int, days: int, tickers: int,
+               spill_rows: int, start: int = 0, end: int = 0) -> bytes:
+    """One self-describing wire frame: header + the packed payload
+    VERBATIM (the buffer :func:`encode_block` produced, already fetched
+    to host — framing is pure host-side byte shuffling, never a device
+    sync). A buffered ``/v1/query`` wire answer is one frame; a chunked
+    range answer is one frame per (block, day-range) chunk, each
+    independently decodable because the header carries the full
+    geometry and quantization is per-(factor, day) slice."""
+    body = payload.tobytes() if hasattr(payload, "tobytes") \
+        else bytes(payload)
+    expect = payload_nbytes(n_factors, days, tickers, spill_rows)
+    if len(body) != expect:
+        raise ValueError(
+            f"payload is {len(body)} bytes; the "
+            f"[{n_factors}, {days}, {tickers}] + {spill_rows}-row "
+            f"spill geometry packs to {expect}")
+    head = _FRAME_HEADER.pack(FRAME_MAGIC, FRAME_VERSION, 0,
+                              n_factors, days, tickers, spill_rows,
+                              start, end, len(body))
+    return head + body
+
+
+def unpack_frame(buf, offset: int = 0) -> Tuple[dict, np.ndarray, int]:
+    """Parse ONE frame at ``offset`` -> ``(meta, payload, next_offset)``
+    where ``meta`` has the header fields and ``payload`` is the packed
+    uint8 buffer ready for :func:`decode_block`. Raises ``ValueError``
+    on a bad magic, an unknown version, or a truncated buffer — the
+    malformed-wire contract the edge robustness tests exercise."""
+    view = memoryview(buf)
+    if len(view) - offset < FRAME_HEADER_BYTES:
+        raise ValueError(
+            f"truncated result-wire frame: {len(view) - offset} bytes "
+            f"at offset {offset}; the header alone is "
+            f"{FRAME_HEADER_BYTES}")
+    (magic, version, _flags, n_factors, days, tickers, spill_rows,
+     start, end, payload_len) = _FRAME_HEADER.unpack_from(view, offset)
+    if magic != FRAME_MAGIC:
+        raise ValueError(f"bad result-wire frame magic {bytes(magic)!r}"
+                         f" (want {FRAME_MAGIC!r})")
+    if version != FRAME_VERSION:
+        raise ValueError(f"unknown result-wire frame version {version}")
+    expect = payload_nbytes(n_factors, days, tickers, spill_rows)
+    if payload_len != expect:
+        raise ValueError(
+            f"frame header claims {payload_len} payload bytes; the "
+            f"[{n_factors}, {days}, {tickers}] + {spill_rows}-row "
+            f"geometry packs to {expect}")
+    body_off = offset + FRAME_HEADER_BYTES
+    if len(view) - body_off < payload_len:
+        raise ValueError(
+            f"truncated result-wire frame: payload wants {payload_len} "
+            f"bytes, buffer holds {len(view) - body_off}")
+    payload = np.frombuffer(view, np.uint8, count=payload_len,
+                            offset=body_off)
+    meta = {"version": version, "n_factors": n_factors, "days": days,
+            "tickers": tickers, "spill_rows": spill_rows,
+            "start": start, "end": end, "payload_bytes": payload_len}
+    return meta, payload, body_off + payload_len
+
+
+def iter_frames(buf):
+    """Yield every ``(meta, payload)`` frame in ``buf`` in order.
+    Trailing garbage (a partial frame) raises like :func:`unpack_frame`
+    — a reassembled chunked response must be EXACTLY a frame
+    sequence."""
+    offset, n = 0, len(memoryview(buf))
+    while offset < n:
+        meta, payload, offset = unpack_frame(buf, offset)
+        yield meta, payload

@@ -22,7 +22,10 @@ bundle aggregation (PR 9) — the fleet composes them:
   ``Retry-After``) only when every candidate is out;
 * :mod:`.http` — the one front door (``/v1/query``, ``/v1/ingest``,
   ``/healthz`` per-replica + rollup, ``/v1/metrics`` as the
-  registry-merge pod fold), HTTP-compatible with a single server.
+  registry-merge pod fold), HTTP-compatible with a single server; the
+  evented edge binding rides the same shared payload builders
+  (``serve_fleet_frontdoor`` picks edge vs legacy by
+  ``FleetConfig.edge``; ISSUE 20).
 
 Run it: ``python -m replication_of_minute_frequency_factor_tpu serve
 --fleet N`` (docs/fleet.md); load-bench it: ``python bench.py fleet``
@@ -31,7 +34,9 @@ Run it: ``python -m replication_of_minute_frequency_factor_tpu serve
 
 from __future__ import annotations
 
-from .http import pod_registry, serve_fleet_http
+from .http import (FleetEdgeBackend, fleet_get_payload, pod_registry,
+                   serve_fleet_edge, serve_fleet_frontdoor,
+                   serve_fleet_http)
 from .policy import ShedPolicy
 from .replica import Replica, build_replicas, partition_devices
 from .router import FactorFleet, FleetConfig, FleetRouter, FleetShedError
@@ -39,5 +44,6 @@ from .router import FactorFleet, FleetConfig, FleetRouter, FleetShedError
 __all__ = [
     "FactorFleet", "FleetConfig", "FleetRouter", "FleetShedError",
     "Replica", "ShedPolicy", "build_replicas", "partition_devices",
-    "pod_registry", "serve_fleet_http",
+    "FleetEdgeBackend", "fleet_get_payload", "pod_registry",
+    "serve_fleet_edge", "serve_fleet_frontdoor", "serve_fleet_http",
 ]

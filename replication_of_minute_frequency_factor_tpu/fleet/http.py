@@ -47,7 +47,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
 
 from ..serve.http import (MAX_BODY_BYTES, MAX_INGEST_BODY_BYTES,
-                          retry_after_seconds)
+                          query_from_doc, render_answer,
+                          retry_after_seconds, wants_prometheus)
 from ..serve.service import LoadShedError, Query
 from ..telemetry.opsplane import canonical_trace_id, to_prometheus
 from .router import FactorFleet
@@ -64,6 +65,66 @@ def pod_registry(fleet: FactorFleet):
     return merge_registries(
         [fleet.telemetry.registry]
         + [r.telemetry.registry for r in fleet.replicas])
+
+
+def fleet_get_payload(fleet: FactorFleet, path: str, query: dict,
+                      accept: str = ""
+                      ) -> Optional[Tuple[int, str, bytes]]:
+    """The pod GET surface -> ``(status, content_type, body)`` or None
+    for an unknown route — ONE implementation for the legacy binding
+    and the evented edge (ISSUE 20), the fleet twin of
+    :func:`..serve.http.get_payload`."""
+    if path == "/healthz":
+        return 200, "application/json", \
+            json.dumps(fleet.health()).encode()
+    if path == "/v1/metrics":
+        reg = pod_registry(fleet)
+        if wants_prometheus(accept, query):
+            return 200, "text/plain; version=0.0.4; charset=utf-8", \
+                to_prometheus(reg).encode()
+        return 200, "application/json", \
+            json.dumps(reg.snapshot()).encode()
+    if path == "/v1/slo":
+        if wants_prometheus(accept, query):
+            from ..telemetry.slo import slo_prometheus
+            return 200, "text/plain; version=0.0.4; charset=utf-8", \
+                slo_prometheus(fleet.telemetry.registry).encode()
+        return 200, "application/json", json.dumps({
+            "slo": fleet.sloplane.summary(),
+            "evaluation": fleet.sloplane.evaluate(),
+        }).encode()
+    if path == "/v1/timeline":
+        try:
+            name = query.get("name", [None])[0]
+            since_raw = query.get("since", [None])[0]
+            since = (float(since_raw) if since_raw is not None
+                     else None)
+            limit_raw = query.get("limit", [None])[0]
+            limit = (int(limit_raw) if limit_raw is not None
+                     else None)
+        except (TypeError, ValueError) as e:
+            return 400, "application/json", json.dumps(
+                {"error": f"malformed timeline query: {e}"}).encode()
+        frames = fleet.timeline.query(name=name, since=since,
+                                      limit=limit)
+        return 200, "application/json", json.dumps(
+            {"frames": frames, "count": len(frames)}).encode()
+    return None
+
+
+def _dump_doc(fleet: FactorFleet) -> Tuple[int, dict]:
+    """The fan-out flight capture shared by both front doors."""
+    paths = {}
+    for r in fleet.replicas:
+        try:
+            paths[r.label] = r.server.debug_dump()
+        except Exception as e:  # noqa: BLE001 — best-effort
+            paths[r.label] = f"error: {type(e).__name__}: {e}"
+    if all(p is None for p in paths.values()):
+        return 409, {"error": "no flight dump directory configured "
+                              "on any replica "
+                              "(ServeConfig.flight_dir)"}
+    return 200, {"paths": paths}
 
 
 def _make_handler(fleet: FactorFleet, timeout: Optional[float]):
@@ -99,66 +160,18 @@ def _make_handler(fleet: FactorFleet, timeout: Optional[float]):
             return canonical_trace_id(self.headers.get("X-Trace-Id"))
 
         def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            # ISSUE 20: the whole GET surface is the shared
+            # fleet_get_payload builder — the edge serves the same
+            # bytes by construction
             parsed = urllib.parse.urlparse(self.path)
-            if parsed.path == "/healthz":
-                self._reply(200, fleet.health())
+            res = fleet_get_payload(fleet, parsed.path,
+                                    urllib.parse.parse_qs(parsed.query),
+                                    self.headers.get("Accept", ""))
+            if res is None:
+                self._reply(404, {"error": f"no route {self.path}"})
                 return
-            if parsed.path == "/v1/metrics":
-                accept = self.headers.get("Accept", "")
-                query = urllib.parse.parse_qs(parsed.query)
-                want_text = ("text/plain" in accept
-                             or "openmetrics" in accept
-                             or query.get("format", [""])[0]
-                             == "prometheus")
-                reg = pod_registry(fleet)
-                if want_text:
-                    self._reply_bytes(
-                        200, to_prometheus(reg).encode(),
-                        "text/plain; version=0.0.4; charset=utf-8")
-                else:
-                    self._reply(200, reg.snapshot())
-                return
-            if parsed.path == "/v1/slo":
-                accept = self.headers.get("Accept", "")
-                query = urllib.parse.parse_qs(parsed.query)
-                want_text = ("text/plain" in accept
-                             or "openmetrics" in accept
-                             or query.get("format", [""])[0]
-                             == "prometheus")
-                if want_text:
-                    from ..telemetry.slo import slo_prometheus
-                    body = slo_prometheus(
-                        fleet.telemetry.registry).encode()
-                    self._reply_bytes(
-                        200, body,
-                        "text/plain; version=0.0.4; charset=utf-8")
-                else:
-                    self._reply(200, {
-                        "slo": fleet.sloplane.summary(),
-                        "evaluation": fleet.sloplane.evaluate(),
-                    })
-                return
-            if parsed.path == "/v1/timeline":
-                query = urllib.parse.parse_qs(parsed.query)
-                try:
-                    name = query.get("name", [None])[0]
-                    since_raw = query.get("since", [None])[0]
-                    since = (float(since_raw)
-                             if since_raw is not None else None)
-                    limit_raw = query.get("limit", [None])[0]
-                    limit = (int(limit_raw)
-                             if limit_raw is not None else None)
-                except (TypeError, ValueError) as e:
-                    self._reply(400,
-                                {"error": f"malformed timeline "
-                                          f"query: {e}"})
-                    return
-                frames = fleet.timeline.query(name=name, since=since,
-                                              limit=limit)
-                self._reply(200, {"frames": frames,
-                                  "count": len(frames)})
-                return
-            self._reply(404, {"error": f"no route {self.path}"})
+            status, ctype, body = res
+            self._reply_bytes(status, body, ctype)
 
         def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
             if self.path == "/v1/ingest":
@@ -177,16 +190,11 @@ def _make_handler(fleet: FactorFleet, timeout: Optional[float]):
                     self._reply(413, {"error": "body too large"}, tid)
                     return
                 doc = json.loads(self.rfile.read(length) or b"{}")
-                q = Query(
-                    kind=doc.get("kind", ""),
-                    start=int(doc.get("start", 0)),
-                    end=int(doc.get("end", 0)),
-                    names=(tuple(doc["names"]) if doc.get("names")
-                           else None),
-                    factor=doc.get("factor"),
-                    horizon=int(doc.get("horizon", 1)),
-                    group_num=int(doc.get("group_num", 5)))
-            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                # ISSUE 20: the ONE parser both serve front doors use
+                # (wire encoding negotiated from Accept / the body)
+                q = query_from_doc(doc, self.headers.get("Accept", ""))
+            except (KeyError, ValueError, TypeError,
+                    json.JSONDecodeError) as e:
                 self._reply(400, {"error": f"malformed request: {e}"},
                             tid)
                 return
@@ -200,7 +208,8 @@ def _make_handler(fleet: FactorFleet, timeout: Optional[float]):
                 self._reply(400, {"error": str(e)}, tid)
                 return
             try:
-                self._reply(200, fut.result(timeout), tid)
+                ctype, body = render_answer(fut.result(timeout), q)
+                self._reply_bytes(200, body, ctype, tid)
             except Exception as e:  # noqa: BLE001 — dispatch failure
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"},
                             tid)
@@ -232,18 +241,8 @@ def _make_handler(fleet: FactorFleet, timeout: Optional[float]):
             self._reply(200, res, tid)
 
         def _post_dump(self):
-            paths = {}
-            for r in fleet.replicas:
-                try:
-                    paths[r.label] = r.server.debug_dump()
-                except Exception as e:  # noqa: BLE001 — best-effort
-                    paths[r.label] = f"error: {type(e).__name__}: {e}"
-            if all(p is None for p in paths.values()):
-                self._reply(409, {"error": "no flight dump directory "
-                                           "configured on any replica "
-                                           "(ServeConfig.flight_dir)"})
-                return
-            self._reply(200, {"paths": paths})
+            status, doc = _dump_doc(fleet)
+            self._reply(status, doc)
 
     return Handler
 
@@ -261,3 +260,86 @@ def serve_fleet_http(fleet: FactorFleet, host: str = "127.0.0.1",
                               name="factor-fleet-http")
     thread.start()
     return httpd, thread
+
+
+class FleetEdgeBackend:
+    """Adapts one :class:`FactorFleet` to the evented edge's backend
+    protocol (ISSUE 20; see ``..serve.edge``). The pod's ingest
+    fan-out is SYNCHRONOUS by contract (it waits every leg's future to
+    build the per-leg map), so it runs as an aux-thread call — the
+    loop thread never blocks on a replica."""
+
+    label = "fleet"
+
+    def __init__(self, fleet: FactorFleet,
+                 timeout: Optional[float] = 60.0):
+        self.fleet = fleet
+        self.timeout = timeout
+
+    @property
+    def telemetry(self):
+        return self.fleet.telemetry
+
+    def get(self, path: str, query: dict, accept: str
+            ) -> Optional[Tuple[int, str, bytes]]:
+        return fleet_get_payload(self.fleet, path, query, accept)
+
+    def submit_query(self, q: Query, tid):
+        return self.fleet.submit(q, trace_id=tid)
+
+    def post(self, path: str, doc: dict, tid):
+        if path == "/v1/ingest":
+            bars, present = doc["bars"], doc["present"]
+            fleet, timeout = self.fleet, self.timeout
+
+            def ingest():
+                return 200, fleet.ingest(bars, present, trace_id=tid,
+                                         timeout=timeout)
+
+            return "call", ingest
+        if path == "/v1/debug/dump":
+            fleet = self.fleet
+
+            def dump():
+                return _dump_doc(fleet)
+
+            return "call", dump
+        return None
+
+    def max_body(self, path: str) -> int:
+        return (MAX_INGEST_BODY_BYTES if path == "/v1/ingest"
+                else MAX_BODY_BYTES)
+
+
+def serve_fleet_edge(fleet: FactorFleet, host: str = "127.0.0.1",
+                     port: int = 0,
+                     timeout: Optional[float] = 60.0):
+    """Bind the evented front door over one pod — the fleet twin of
+    :func:`..serve.edge.serve_edge`; quota/idle knobs come from
+    ``FleetConfig``. Returns the running ``EdgeServer``."""
+    from ..serve.edge import EdgeServer
+    cfg = fleet.cfg
+    backend = FleetEdgeBackend(fleet, timeout)
+    return EdgeServer(backend, host=host, port=port,
+                      quota_rps=cfg.tenant_quota_rps,
+                      quota_burst=cfg.tenant_quota_burst,
+                      idle_timeout_s=cfg.edge_idle_timeout_s)
+
+
+def serve_fleet_frontdoor(fleet: FactorFleet, host: str = "127.0.0.1",
+                          port: int = 0,
+                          timeout: Optional[float] = 60.0,
+                          transport: Optional[str] = None):
+    """Bind the CONFIGURED pod front door (``FleetConfig.edge``; the
+    fleet twin of :func:`..serve.http.serve_frontdoor`). Returns an
+    object with ``.server_address`` and ``.shutdown()`` either way."""
+    transport = transport or fleet.cfg.edge
+    if transport == "legacy":
+        httpd, _thread = serve_fleet_http(fleet, host=host, port=port,
+                                          timeout=timeout)
+        return httpd
+    if transport != "edge":
+        raise ValueError(f"unknown front-door transport {transport!r} "
+                         "(edge or legacy)")
+    return serve_fleet_edge(fleet, host=host, port=port,
+                            timeout=timeout)

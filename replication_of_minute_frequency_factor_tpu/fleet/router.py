@@ -28,6 +28,12 @@ admission, records its own ``route`` request record (replica + key),
 and hands the SAME ID to the replica — one request is reconstructable
 router→replica across the two telemetry streams.
 
+Answer encoding propagates the same way (ISSUE 20): the router hands
+the :class:`Query` to the owning replica VERBATIM, so a wire-encoded
+query answers with the replica's packed result-wire payload and the
+router hop never re-inflates it to JSON (``fleet.routed_wire`` counts
+those; docs/fleet.md "Router-leg encoding").
+
 graftlint note (docs/static-analysis.md): this module is a declared
 GL-A3 boundary module of the ``fleet/`` layer — its one allowed host
 sync is the ``np.asarray`` that normalizes an ingest body ONCE before
@@ -86,6 +92,18 @@ class FleetConfig:
     #: pod freshness objective threshold (s) on the worst live
     #: replica's ingest staleness
     slo_staleness_s: float = 120.0
+    #: pod front-door transport (ISSUE 20): ``'edge'`` = the evented
+    #: selectors loop (:func:`.http.serve_fleet_edge`), ``'legacy'`` =
+    #: stdlib thread-per-connection (the A/B and fallback path)
+    edge: str = "edge"
+    #: per-tenant token-bucket rate on the edge (requests/s; 0 = off),
+    #: layered ABOVE pod admission — same contract as
+    #: ``ServeConfig.tenant_quota_rps``
+    tenant_quota_rps: float = 0.0
+    #: bucket depth (0 -> ``max(1, tenant_quota_rps)``)
+    tenant_quota_burst: float = 0.0
+    #: edge idle-connection reap bound (s; the slow-loris bound)
+    edge_idle_timeout_s: float = 30.0
 
 
 def _rendezvous_order(labels: Sequence[str], key: Tuple) -> List[str]:
@@ -222,6 +240,13 @@ class FleetRouter:
                     continue
                 self._note_affinity(key, label)
                 self.telemetry.counter("fleet.routed", replica=label)
+                if q.encoding == "wire":
+                    # ISSUE 20: the replica leg carries the query's
+                    # encoding verbatim — a wire query routed here
+                    # answers with the packed payload, never a JSON
+                    # re-inflation at the router hop
+                    self.telemetry.counter("fleet.routed_wire",
+                                           replica=label)
                 self.telemetry.request({
                     "trace_id": tid, "op": "route", "status": "ok",
                     "data": {"replica": label, "kind": q.kind,

@@ -25,7 +25,15 @@ designed for exactly this request loop:
   micro-batches concurrent queries and COALESCES same-day-range ones
   into one device dispatch, with per-request latency histograms,
   queue-depth/in-flight gauges and a load-shedding circuit breaker;
-* :mod:`.http` — a stdlib-only HTTP/JSON binding (``serve_http``).
+* :mod:`.http` — a stdlib-only HTTP/JSON binding (``serve_http``),
+  plus the shared endpoint library both front doors answer through;
+* :mod:`.edge` — the evented binary front door (ISSUE 20): one
+  selectors loop, persistent keep-alive connections, pipelined
+  multiplexing, the result wire end to end, chunked range streaming,
+  per-tenant quotas (``serve_frontdoor`` picks edge vs legacy by
+  ``ServeConfig.edge``);
+* :mod:`.wireclient` — the first-party result-wire decoder +
+  keep-alive :class:`WireClient`.
 
 Streaming (ISSUE 7): ``FactorServer(stream=True)`` additionally owns a
 :class:`..stream.engine.StreamEngine` — minute bars ingest through the
@@ -52,11 +60,16 @@ from .expcache import DeviceExposureCache
 from .source import MinuteDirSource, SyntheticSource
 from .service import (Discover, FactorServer, Ingest, LoadShedError,
                       Query, ServeConfig, ServeClient)
-from .http import serve_http
+from .http import WIRE_CONTENT_TYPE, serve_frontdoor, serve_http
+from .edge import EdgeServer, serve_edge
+from .wireclient import WireClient, WireError, decode_answer, \
+    decode_frames
 
 __all__ = [
-    "DeviceExposureCache", "Discover", "ExecutableCache",
-    "FactorServer", "Ingest", "LoadShedError", "MinuteDirSource",
-    "Query", "ServeClient", "ServeConfig", "SyntheticSource",
+    "DeviceExposureCache", "Discover", "EdgeServer",
+    "ExecutableCache", "FactorServer", "Ingest", "LoadShedError",
+    "MinuteDirSource", "Query", "ServeClient", "ServeConfig",
+    "SyntheticSource", "WIRE_CONTENT_TYPE", "WireClient", "WireError",
+    "decode_answer", "decode_frames", "serve_edge", "serve_frontdoor",
     "serve_http",
 ]

@@ -65,6 +65,15 @@ accept an ``X-Trace-Id`` header (``[A-Za-z0-9._-]{1,64}``; anything
 else is replaced at admission) and every response — success or error —
 echoes the request's effective trace ID back in the same header, so a
 client can join its own logs to the server's span/request records.
+
+ISSUE 20: this module is now also the serve layer's shared endpoint
+LIBRARY — :func:`query_from_doc`, :func:`render_answer` and
+:func:`get_payload` are one implementation used by this legacy binding
+AND the evented edge (:mod:`.edge`), so the two front doors cannot
+drift; ``POST /v1/query`` honors ``Accept: application/x-mff-wire``
+(the packed result-wire payload back verbatim, framed) on both.
+:func:`serve_frontdoor` binds whichever transport ``ServeConfig.edge``
+names.
 """
 
 from __future__ import annotations
@@ -87,6 +96,14 @@ MAX_BODY_BYTES = 1 << 20
 MAX_INGEST_BODY_BYTES = 64 << 20
 
 
+#: the result-wire media type (ISSUE 20): a ``POST /v1/query`` carrying
+#: ``Accept: application/x-mff-wire`` gets the packed result-wire
+#: payload back VERBATIM, framed by ``data/result_wire.pack_frame`` —
+#: both front doors (this module and :mod:`.edge`) honor it through the
+#: same :func:`query_from_doc` / :func:`render_answer` pair.
+WIRE_CONTENT_TYPE = "application/x-mff-wire"
+
+
 def retry_after_seconds(retry_after_s: Optional[float]) -> int:
     """``Retry-After`` header value from a shed's backoff hint: whole
     seconds, rounded UP, floor 1 (a zero/None hint must still tell the
@@ -97,6 +114,98 @@ def retry_after_seconds(retry_after_s: Optional[float]) -> int:
     if retry_after_s is None or retry_after_s <= 0:
         return 1
     return max(1, math.ceil(retry_after_s))
+
+
+def wants_prometheus(accept: str, query: dict) -> bool:
+    """The ``/v1/metrics`` & ``/v1/slo`` content negotiation, shared by
+    every front door (legacy serve, legacy fleet, edge)."""
+    return ("text/plain" in accept or "openmetrics" in accept
+            or query.get("format", [""])[0] == "prometheus")
+
+
+def query_from_doc(doc: dict, accept: str = "") -> Query:
+    """One JSON request body -> :class:`Query`, shared by both serve
+    front doors and the fleet's (drift between the bindings was the
+    pre-ISSUE-20 hazard; now there is one parser). Raises
+    ``ValueError``/``TypeError``/``KeyError`` on malformed fields — the
+    caller maps those to 400. Wire encoding is negotiated from the
+    ``Accept`` header (``application/x-mff-wire``) or an explicit
+    ``"encoding": "wire"`` in the body."""
+    encoding = ("wire" if (WIRE_CONTENT_TYPE in (accept or "")
+                           or doc.get("encoding") == "wire")
+                else "json")
+    return Query(
+        kind=doc.get("kind", ""),
+        start=int(doc.get("start", 0)),
+        end=int(doc.get("end", 0)),
+        names=tuple(doc["names"]) if doc.get("names") else None,
+        factor=doc.get("factor"),
+        horizon=int(doc.get("horizon", 1)),
+        group_num=int(doc.get("group_num", 5)),
+        encoding=encoding)
+
+
+def render_answer(result: dict, q: Query) -> Tuple[str, bytes]:
+    """One resolved answer dict -> ``(content_type, body)``. A wire
+    answer (``result["wire"]``) frames the packed payload verbatim
+    (:func:`..data.result_wire.pack_frame`); everything else is the
+    JSON rendering both front doors always produced."""
+    if q.encoding == "wire" and result.get("wire"):
+        from ..data import result_wire as _rw
+        body = _rw.pack_frame(
+            result["payload"], n_factors=result["n_factors"],
+            days=result["days"], tickers=result["tickers"],
+            spill_rows=result["spill_rows"],
+            start=result.get("start", 0), end=result.get("end", 0))
+        return WIRE_CONTENT_TYPE, body
+    return "application/json", json.dumps(result).encode()
+
+
+def get_payload(server: FactorServer, path: str, query: dict,
+                accept: str = "") -> Optional[Tuple[int, str, bytes]]:
+    """The GET endpoint surface -> ``(status, content_type, body)``,
+    or None for an unknown route. ONE implementation serves both the
+    legacy thread-per-connection binding and the evented edge
+    (:mod:`.edge`), so the two front doors answer identically by
+    construction — the legacy-vs-edge parity tests then verify it."""
+    if path == "/healthz":
+        return 200, "application/json", \
+            json.dumps(server.health()).encode()
+    if path == "/v1/factors":
+        return 200, "application/json", \
+            json.dumps(server.factor_list()).encode()
+    if path == "/v1/metrics":
+        if wants_prometheus(accept, query):
+            return 200, "text/plain; version=0.0.4; charset=utf-8", \
+                to_prometheus(server.telemetry.registry).encode()
+        return 200, "application/json", \
+            json.dumps(server.telemetry.registry.snapshot()).encode()
+    if path == "/v1/slo":
+        if wants_prometheus(accept, query):
+            from ..telemetry.slo import slo_prometheus
+            return 200, "text/plain; version=0.0.4; charset=utf-8", \
+                slo_prometheus(server.telemetry.registry).encode()
+        return 200, "application/json", json.dumps({
+            "slo": server.sloplane.summary(),
+            "evaluation": server.sloplane.evaluate(),
+        }).encode()
+    if path == "/v1/timeline":
+        try:
+            name = query.get("name", [None])[0]
+            since_raw = query.get("since", [None])[0]
+            since = (float(since_raw) if since_raw is not None
+                     else None)
+            limit_raw = query.get("limit", [None])[0]
+            limit = (int(limit_raw) if limit_raw is not None
+                     else None)
+        except (TypeError, ValueError) as e:
+            return 400, "application/json", json.dumps(
+                {"error": f"malformed timeline query: {e}"}).encode()
+        frames = server.timeline.query(name=name, since=since,
+                                       limit=limit)
+        return 200, "application/json", json.dumps(
+            {"frames": frames, "count": len(frames)}).encode()
+    return None
 
 
 def _make_handler(server: FactorServer, timeout: Optional[float]):
@@ -136,77 +245,17 @@ def _make_handler(server: FactorServer, timeout: Optional[float]):
             return canonical_trace_id(self.headers.get("X-Trace-Id"))
 
         def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            # ISSUE 20: the whole GET surface is the shared
+            # get_payload builder — the edge serves the same bytes
             parsed = urllib.parse.urlparse(self.path)
-            if parsed.path == "/healthz":
-                self._reply(200, self._health_payload())
+            res = get_payload(server, parsed.path,
+                              urllib.parse.parse_qs(parsed.query),
+                              self.headers.get("Accept", ""))
+            if res is None:
+                self._reply(404, {"error": f"no route {self.path}"})
                 return
-            if parsed.path == "/v1/factors":
-                self._reply(200, server.factor_list())
-                return
-            if parsed.path == "/v1/metrics":
-                accept = self.headers.get("Accept", "")
-                query = urllib.parse.parse_qs(parsed.query)
-                want_text = ("text/plain" in accept
-                             or "openmetrics" in accept
-                             or query.get("format", [""])[0]
-                             == "prometheus")
-                if want_text:
-                    body = to_prometheus(
-                        server.telemetry.registry).encode()
-                    self._reply_bytes(
-                        200, body,
-                        "text/plain; version=0.0.4; charset=utf-8")
-                else:
-                    self._reply(200,
-                                server.telemetry.registry.snapshot())
-                return
-            if parsed.path == "/v1/slo":
-                accept = self.headers.get("Accept", "")
-                query = urllib.parse.parse_qs(parsed.query)
-                want_text = ("text/plain" in accept
-                             or "openmetrics" in accept
-                             or query.get("format", [""])[0]
-                             == "prometheus")
-                if want_text:
-                    from ..telemetry.slo import slo_prometheus
-                    body = slo_prometheus(
-                        server.telemetry.registry).encode()
-                    self._reply_bytes(
-                        200, body,
-                        "text/plain; version=0.0.4; charset=utf-8")
-                else:
-                    self._reply(200, {
-                        "slo": server.sloplane.summary(),
-                        "evaluation": server.sloplane.evaluate(),
-                    })
-                return
-            if parsed.path == "/v1/timeline":
-                query = urllib.parse.parse_qs(parsed.query)
-                try:
-                    name = query.get("name", [None])[0]
-                    since_raw = query.get("since", [None])[0]
-                    since = (float(since_raw) if since_raw is not None
-                             else None)
-                    limit_raw = query.get("limit", [None])[0]
-                    limit = (int(limit_raw) if limit_raw is not None
-                             else None)
-                except (TypeError, ValueError) as e:
-                    self._reply(400,
-                                {"error": f"malformed timeline "
-                                          f"query: {e}"})
-                    return
-                frames = server.timeline.query(name=name, since=since,
-                                               limit=limit)
-                self._reply(200, {"frames": frames,
-                                  "count": len(frames)})
-                return
-            self._reply(404, {"error": f"no route {self.path}"})
-
-        def _health_payload(self) -> dict:
-            # ISSUE 11: the payload (replica identity block included)
-            # is built by the server so the standalone endpoint and the
-            # fleet rollup report the same shape from the same code
-            return server.health()
+            status, ctype, body = res
+            self._reply_bytes(status, body, ctype)
 
         def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
             if self.path == "/v1/ingest":
@@ -228,16 +277,10 @@ def _make_handler(server: FactorServer, timeout: Optional[float]):
                     self._reply(413, {"error": "body too large"}, tid)
                     return
                 doc = json.loads(self.rfile.read(length) or b"{}")
-                q = Query(
-                    kind=doc.get("kind", ""),
-                    start=int(doc.get("start", 0)),
-                    end=int(doc.get("end", 0)),
-                    names=(tuple(doc["names"]) if doc.get("names")
-                           else None),
-                    factor=doc.get("factor"),
-                    horizon=int(doc.get("horizon", 1)),
-                    group_num=int(doc.get("group_num", 5)))
-            except (ValueError, TypeError, json.JSONDecodeError) as e:
+                q = query_from_doc(doc,
+                                   self.headers.get("Accept", ""))
+            except (KeyError, ValueError, TypeError,
+                    json.JSONDecodeError) as e:
                 self._reply(400, {"error": f"malformed request: {e}"},
                             tid)
                 return
@@ -251,7 +294,8 @@ def _make_handler(server: FactorServer, timeout: Optional[float]):
                 self._reply(400, {"error": str(e)}, tid)
                 return
             try:
-                self._reply(200, fut.result(timeout), tid)
+                ctype, body = render_answer(fut.result(timeout), q)
+                self._reply_bytes(200, body, ctype, tid)
             except Exception as e:  # noqa: BLE001 — dispatch failure
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"},
                             tid)
@@ -353,3 +397,25 @@ def serve_http(server: FactorServer, host: str = "127.0.0.1",
                               name="factor-serve-http")
     thread.start()
     return httpd, thread
+
+
+def serve_frontdoor(server: FactorServer, host: str = "127.0.0.1",
+                    port: int = 0, timeout: Optional[float] = 60.0,
+                    transport: Optional[str] = None):
+    """Bind the CONFIGURED front door (ISSUE 20): ``transport`` (or
+    ``ServeConfig.edge`` when None) picks the evented selectors loop
+    (``'edge'``, :mod:`.edge`) or this module's stdlib
+    thread-per-connection server (``'legacy'`` — the A/B and fallback
+    path). Returns an object with ``.server_address`` and
+    ``.shutdown()`` either way, so callers stop caring which one
+    runs."""
+    transport = transport or server.scfg.edge
+    if transport == "legacy":
+        httpd, _thread = serve_http(server, host=host, port=port,
+                                    timeout=timeout)
+        return httpd
+    if transport != "edge":
+        raise ValueError(f"unknown front-door transport {transport!r} "
+                         "(edge or legacy)")
+    from .edge import serve_edge
+    return serve_edge(server, host=host, port=port, timeout=timeout)

@@ -84,6 +84,13 @@ class Query:
     factor: Optional[str] = None               # ic / decile
     horizon: int = 1                           # forward-return horizon
     group_num: int = 5                         # decile buckets
+    #: answer encoding (ISSUE 20): ``json`` answers are host dicts;
+    #: ``wire`` ships the block's packed result-wire payload verbatim
+    #: (``factors`` kind over the FULL factor set only — the payload IS
+    #: the whole [F, D, T] block; see docs/serving.md "The binary
+    #: edge"). Not part of the coalescing key: a wire and a json query
+    #: over the same range share one dispatch group.
+    encoding: str = "json"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -203,6 +210,23 @@ class ServeConfig:
     #: otherwise the engine stays single-device, silently — the
     #: ``stream.carry_sharded`` gauge says which one runs.
     stream_sharded: bool = False
+    #: front-door transport the CLI binds (ISSUE 20): ``edge`` is the
+    #: evented selectors loop (:mod:`.edge` — keep-alive, pipelining,
+    #: binary wire answers, per-tenant quotas); ``legacy`` keeps the
+    #: stdlib thread-per-connection server for A/B and fallback. Code
+    #: that calls :func:`.http.serve_http` / :func:`.edge.serve_edge`
+    #: directly picks its own transport regardless of this knob.
+    edge: str = "edge"
+    #: per-tenant admission quota at the EDGE (ISSUE 20): sustained
+    #: requests/second each ``X-Tenant`` (or API key) may submit,
+    #: token-bucket enforced ABOVE pod admission; 0 disables. Refused
+    #: requests get 429 + ``Retry-After``, mirroring the shed contract.
+    tenant_quota_rps: float = 0.0
+    #: token-bucket burst depth (0 -> max(1, tenant_quota_rps))
+    tenant_quota_burst: float = 0.0
+    #: seconds an edge connection may sit idle (including mid-request —
+    #: the slow-loris bound) before the loop reaps it
+    edge_idle_timeout_s: float = 30.0
     #: streaming snapshot finalize implementation for this server's
     #: StreamEngine (ISSUE 18): None adopts ``Config.finalize_impl``
     #: (default 'exact', the bitwise batch-prefix graph); 'fast'
@@ -453,6 +477,15 @@ class FactorServer:
         if q.kind not in QUERY_KINDS:
             raise ValueError(f"unknown query kind {q.kind!r} "
                              f"(one of {QUERY_KINDS})")
+        if q.encoding not in ("json", "wire"):
+            raise ValueError(f"unknown answer encoding {q.encoding!r} "
+                             f"(json or wire)")
+        if q.encoding == "wire" and (q.kind != "factors" or q.names):
+            # the wire payload IS the whole [F, D, T] block — a subset
+            # or a scalar-shaped answer has no packed representation
+            raise ValueError(
+                "wire encoding answers kind='factors' over the full "
+                "factor set only (names=None); ask for json otherwise")
         if q.kind == "intraday":
             if self.stream_engine is None:
                 raise ValueError("intraday queries need a server "
@@ -1221,8 +1254,38 @@ class FactorServer:
                 fetched["exposures"] = np.asarray(block["exposures"])
         return fetched["exposures"]
 
+    def _wire_payload(self, block, fetched: dict):
+        """The group's ONE host fetch of the PACKED result-wire payload
+        (memoised beside the decoded-exposures memo — a mixed group of
+        wire and json factors-queries pays at most one fetch of each).
+        Encodes from the cached RAW f32 block (never from a decode; no
+        double quantization) on a warm executable, so steady-state wire
+        traffic compiles nothing."""
+        if "wire" not in fetched:
+            payload_dev, spec = self.engine.encode_exposures(block)
+            payload = np.asarray(payload_dev)  # the boundary sync
+            self.telemetry.counter("serve.result_wire_answers")
+            self.telemetry.counter("serve.result_wire_bytes",
+                                   int(payload.nbytes))
+            fetched["wire"] = (payload, spec)
+        return fetched["wire"]
+
     def _answer(self, block, q: Query, fetched: dict) -> dict:
         out = self._days_codes(q)
+        if q.kind == "factors" and q.encoding == "wire":
+            payload, spec = self._wire_payload(block, fetched)
+            f, d, t = block["exposures"].shape
+            # the payload travels VERBATIM: the HTTP edge frames these
+            # bytes (data/result_wire.pack_frame) and the client-side
+            # dequantize (serve/wireclient.py) is byte-identical to
+            # decoding the same payload here
+            out.pop("days", None)
+            out.update({
+                "wire": True, "payload": payload,
+                "n_factors": f, "days": d, "tickers": t,
+                "spill_rows": spec.spill_rows,
+                "names": list(self.names)})
+            return out
         if q.kind == "factors":
             exp = self._host_exposures(block, fetched)
             names = q.names or self.names
@@ -1274,6 +1337,18 @@ class ServeClient:
         q = Query("factors", start, end,
                   names=tuple(names) if names else None)
         return self._server.submit(q).result(self._timeout)
+
+    def factors_wire(self, start: int, end: int):
+        """The full factor block over ``[start, end)`` through the
+        result wire (ISSUE 20): submits ``encoding='wire'`` and decodes
+        the packed payload with the first-party decoder
+        (:mod:`.wireclient`) — the same dequantize an HTTP wire client
+        runs, so in-process and edge answers are byte-identical by
+        construction. Returns ``(exposures [F, D, T], meta)``."""
+        from .wireclient import decode_answer
+        q = Query("factors", start, end, encoding="wire")
+        ans = self._server.submit(q).result(self._timeout)
+        return decode_answer(ans, telemetry=self._server.telemetry)
 
     def ic(self, factor: str, start: int, end: int,
            horizon: int = 1) -> dict:

@@ -84,7 +84,17 @@ smeared):
   O(1)-per-bar sufficient-statistic fast path bank as SEPARATE
   series and the fast-vs-exact claim always has a banked
   before/after; a new instrument, so its records start their own
-  baseline).
+  baseline),
+  ``r15_serve_edge_v1`` / ``r15_fleet_edge_v1`` (ISSUE 20: the
+  evented binary front door — ``BENCH_SERVE_TRANSPORT=edge`` /
+  ``BENCH_FLEET_TRANSPORT=edge`` drive keep-alive wire-encoded HTTP
+  load through the selectors edge instead of the in-process queue
+  loop; a new entry path AND a new answer encoding, so the records
+  start their own baselines. The stdlib thread-per-connection A/B leg
+  stamps ``...+transport=legacy`` and keys apart — the door
+  comparison must never gate one leg against the other. Records whose
+  ``edge.available`` is true (the load actually decoded wire answers)
+  additionally derive ``<metric>.wire_bytes_per_answer``).
 
 Session sub-series (ISSUE 15): every bench record stamps the market
 ``session`` it ran (``bench.py``'s BENCH_SESSION; records predating
@@ -507,6 +517,26 @@ def derive_records(record: dict) -> List[dict]:
                         "value": float(wbr), "unit": "ratio",
                         "methodology": meth,
                         "derived_from": "slo.worst_burn_rate"})
+    # binary-edge sub-series (ISSUE 20): gated on edge.available with
+    # answers actually decoded — only an HTTP wire load that counted
+    # its bytes at the CLIENT seeds or gates the per-answer baseline.
+    # Both directions flag: byte GROWTH per answer is a wire
+    # regression (framing bloat, a lost quantization tier), a silent
+    # DROP usually means the answers lost content (a shrunken factor
+    # set shipping under the same metric name) — neither may pass
+    # quietly.
+    edge = record.get("edge")
+    if isinstance(edge, dict) and edge.get("available") \
+            and isinstance(edge.get("wire_answers"), int) \
+            and edge["wire_answers"] > 0:
+        wbpa = edge.get("wire_bytes_per_answer")
+        if isinstance(wbpa, (int, float)) and not isinstance(wbpa, bool) \
+                and wbpa > 0:
+            out.append({"metric": f"{metric}.wire_bytes_per_answer",
+                        "value": float(wbpa), "unit": "bytes/answer",
+                        "methodology": meth,
+                        "derived_from":
+                            "edge.wire_bytes_per_answer"})
     # snapshot-flatness sub-series (ISSUE 18): gated on the per-bar
     # profile's own evidence — only a WARM profile (zero compiles
     # while profiling, enough bars to quartile) measures finalize

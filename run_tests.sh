@@ -157,6 +157,16 @@ print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     # verdict line, nonzero on any missing piece
     run python -c "import json, sys, bench; r = bench.slo_smoke(); \
 print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
+    # edge smoke (ISSUE 20): the evented binary front door on CPU — a
+    # tiny edge-transport serve_bench driving keep-alive wire-encoded
+    # HTTP load, then the edge-specific gates: the HTTP wire answer
+    # byte-identical to the in-process one (one payload end to end), a
+    # chunked range answer reassembling byte-identically to the
+    # buffered one, quota exhaustion answering 429 + Retry-After, zero
+    # compiles during the HTTP load, and the wire answer >=1.5x
+    # smaller on the wire than JSON; one JSON verdict line
+    run python -c "import json, sys, bench; r = bench.edge_smoke(); \
+print(json.dumps(r)); sys.exit(0 if r['ok'] else 1)"
     # graftlint (ISSUE 4 + 19): AST rules over the whole package +
     # jaxpr contracts over all 58 registered kernels AND the resident
     # scan wrappers (abstract trace on CPU) + the Tier C concurrency

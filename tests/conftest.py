@@ -61,9 +61,14 @@ def rng():
 #: by explicit put (genomes, the day slab) and its one per-generation
 #: fetch is the explicit ``np.asarray`` boundary sync — the whole
 #: 1-sync/generation budget is exercised under the guard.
+#: test_edge joins (ISSUE 20): the evented front door hands HOST bytes
+#: only — the device fetch happens on the server's worker threads at
+#: the declared serve/service.py boundary, never on the loop, aux or
+#: client thread.
 TRANSFER_GUARDED_MODULES = {"test_kernel_purity", "test_serve",
                             "test_stream", "test_opsplane",
-                            "test_fleet", "test_research"}
+                            "test_fleet", "test_research",
+                            "test_edge"}
 
 
 @pytest.fixture(autouse=True)

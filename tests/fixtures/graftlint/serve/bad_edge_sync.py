@@ -1,0 +1,14 @@
+"""GL-A3 module-granular scope fixture (ISSUE 20): the evented edge
+and its wire client are pinned device-hot by MODULE
+(ast_tier.HOST_SYNC_MODULES) with NO boundary allowance — a sync
+creeping into the event loop stalls every multiplexed connection at
+once. Both injected sync symbols must flag."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def finish_answer(block):
+    host = np.asarray(block)   # flags: the edge hands host bytes only
+    x = jnp.sum(block)
+    x.block_until_ready()      # flags: never block the loop thread
+    return host, x

@@ -1,0 +1,11 @@
+"""GL-A3 negative fixture (ISSUE 20): an edge-loop-styled module that
+operates on ALREADY-FETCHED host bytes only — ``np.frombuffer`` over a
+socket read and host-side concatenation are not syncs, so the pinned
+module-granular rule (ast_tier.HOST_SYNC_MODULES) stays silent. This
+is the compliant twin of ``bad_edge_sync.py``."""
+import numpy as np
+
+
+def reassemble(frames):
+    blocks = [np.frombuffer(p, dtype=np.uint8) for p in frames]
+    return np.concatenate(blocks) if len(blocks) > 1 else blocks[0]

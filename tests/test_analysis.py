@@ -44,7 +44,7 @@ def _codes_by_file(violations):
 @pytest.fixture(scope="module")
 def fixture_violations():
     violations, n_files = run_ast_tier(FIXTURES, display_base=REPO)
-    assert n_files == 28
+    assert n_files == 30
     return violations
 
 
@@ -174,6 +174,26 @@ def test_a3_policy_matches_the_real_request_loop():
     assert not [v for v in violations if "/telemetry/" in v.path]
     assert not [v for v in violations if "/fleet/" in v.path]
     assert not [v for v in violations if "/research/" in v.path]
+
+
+def test_a3_edge_modules_are_pinned_with_no_allowance(
+        fixture_violations):
+    """ISSUE 20: the evented edge and its wire client are pinned
+    device-hot by MODULE (HOST_SYNC_MODULES) with NO boundary
+    allowance — both injected sync symbols flag in the bad twin, and
+    the host-bytes-only twin (np.frombuffer + concatenate) stays
+    silent."""
+    from replication_of_minute_frequency_factor_tpu.analysis import (
+        ast_tier)
+    assert ast_tier.HOST_SYNC_MODULES == frozenset(
+        {"data/result_wire.py", "serve/edge.py",
+         "serve/wireclient.py"})
+    by_file = _codes_by_file(fixture_violations)
+    hits = by_file["bad_edge_sync.py"]
+    assert {s for _, _, s in hits} == {"np.asarray",
+                                      ".block_until_ready()"}
+    assert all(c == "GL-A3" for c, _, _ in hits)
+    assert "edge_like.py" not in by_file, by_file.get("edge_like.py")
 
 
 def test_a3_research_evolve_boundary_allows_asarray_only(
@@ -380,7 +400,7 @@ def test_fingerprints_are_stable_and_loop_free():
 def concurrency_violations():
     violations, n_files = run_concurrency_tier(FIXTURES,
                                                display_base=REPO)
-    assert n_files == 28
+    assert n_files == 30
     return violations
 
 
@@ -445,7 +465,7 @@ def test_tier_c_repo_is_clean_and_contracts_are_declared():
     for cls in ("MetricsRegistry", "SpanTracer", "Telemetry",
                 "TimelineStore", "HbmSampler", "FlightRecorder",
                 "MeshPlane", "SloPlane", "ShedPolicy", "FleetRouter",
-                "FactorServer"):
+                "FactorServer", "EdgeServer"):
         assert cls in idx, sorted(idx)
         assert idx[cls]["lock"] and idx[cls]["guards"]
 
@@ -532,7 +552,7 @@ def test_cli_flags_fixtures_then_baseline_clears_them(tmp_path):
             "--report", report)
     out = _run_cli(*args)
     assert out.returncode == 1
-    assert json.loads(out.stdout.strip().splitlines()[-1])["new"] == 34
+    assert json.loads(out.stdout.strip().splitlines()[-1])["new"] == 36
     # refuse to baseline without a why
     out = _run_cli(*args, "--update-baseline")
     assert out.returncode == 2
@@ -545,7 +565,7 @@ def test_cli_flags_fixtures_then_baseline_clears_them(tmp_path):
     out = _run_cli(*args)
     assert out.returncode == 0
     assert json.loads(
-        out.stdout.strip().splitlines()[-1])["baselined"] == 34
+        out.stdout.strip().splitlines()[-1])["baselined"] == 36
 
 
 def test_cli_tier_c_flags_fixtures_and_reports_contracts(tmp_path):
@@ -564,7 +584,7 @@ def test_cli_tier_c_flags_fixtures_and_reports_contracts(tmp_path):
     conc = rep["concurrency"]
     assert conc["by_rule"] == {"GL-C1": 3, "GL-C2": 3,
                                "GL-C3": 1, "GL-C4": 1}
-    assert conc["files_scanned"] == 28
+    assert conc["files_scanned"] == 30
     assert "BadCounter" in conc["contracts"]
     assert conc["contracts"]["BadCounter"]["lock"] == "_glock"
 

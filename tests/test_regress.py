@@ -932,3 +932,100 @@ def test_snapshot_flatness_gates_both_directions(tmp_path):
         entries,
         candidate=_snapshot_rec(flat=0.2,
                                 methodology="r15_snapshot_v2"))["ok"]
+
+# --------------------------------------------------------------------------
+# Binary-edge sub-series (ISSUE 20)
+# --------------------------------------------------------------------------
+
+
+def _edge_rec(value=700.0, wbpa=1436.0, answers=96, available=True,
+              methodology="r15_serve_edge_v1"):
+    """A bankable r15 edge-transport serve record (bench.py
+    ``serve_bench(transport='edge')``), override-able per test."""
+    rec = _serve_rec(value=value, peak=None, methodology=methodology)
+    rec["transport"] = "edge"
+    rec["encoding"] = "wire"
+    rec["edge"] = {"available": available, "transport": "edge",
+                   "wire_answers": answers,
+                   "wire_bytes": int(wbpa * answers),
+                   "wire_bytes_per_answer": wbpa,
+                   "json_bytes_per_answer": wbpa * 5,
+                   "ab_ratio": 5.0, "http_failures": 0}
+    return rec
+
+
+def test_derive_records_lifts_wire_bytes_per_answer():
+    """ISSUE 20 satellite: an edge record whose load actually decoded
+    wire answers derives the <metric>.wire_bytes_per_answer sub-series
+    under the r15 methodology."""
+    recs = regress.derive_records(_edge_rec())
+    by = {r["metric"]: r for r in recs}
+    key = "serveN_qps.wire_bytes_per_answer"
+    assert key in by
+    assert by[key]["value"] == 1436.0
+    assert by[key]["unit"] == "bytes/answer"
+    assert by[key]["methodology"] == "r15_serve_edge_v1"
+    assert by[key]["derived_from"] == "edge.wire_bytes_per_answer"
+
+
+def test_answerless_or_unavailable_edge_never_seeds():
+    """The other direction: unavailable/answerless/malformed edge
+    blocks grow NO byte series — a load that decoded nothing measured
+    nothing. An inproc record has no edge block at all."""
+    bad_blocks = [
+        _edge_rec(available=False),
+        _edge_rec(answers=0),
+    ]
+    rec = _edge_rec()
+    rec["edge"]["wire_answers"] = "96"            # int required
+    bad_blocks.append(rec)
+    for wbpa in (None, True, "1436", 0, -5.0):    # not a byte count
+        rec = _edge_rec()
+        rec["edge"]["wire_bytes_per_answer"] = wbpa
+        bad_blocks.append(rec)
+    rec = _edge_rec()
+    rec["edge"] = "broken"
+    bad_blocks.append(rec)
+    for rec in bad_blocks:
+        metrics = [r["metric"] for r in regress.derive_records(rec)]
+        assert "serveN_qps.wire_bytes_per_answer" not in metrics, rec
+    plain = _serve_rec(peak=None)                 # inproc: no block
+    assert not any(".wire_bytes_per_answer" in r["metric"]
+                   for r in regress.derive_records(plain))
+
+
+def test_wire_bytes_series_gate_both_directions(tmp_path):
+    """The satellite's acceptance: both deviation directions flag on
+    the derived byte group — per-answer GROWTH is a wire regression,
+    a silent SHRINK means the answers lost content — while the legacy
+    A/B leg keys apart and can never gate against the edge series."""
+    for i, wbpa in enumerate((1430.0, 1440.0)):
+        with open(tmp_path / f"BENCH_r{i + 1:02d}.json", "w") as fh:
+            json.dump({"n": i + 1, "parsed": _edge_rec(wbpa=wbpa)}, fh)
+    entries = regress.load_bench_series(str(tmp_path))
+    assert "serveN_qps.wire_bytes_per_answer" in {
+        e["record"]["metric"] for e in entries}
+    assert regress.evaluate(entries,
+                            candidate=_edge_rec(wbpa=1436.0))["ok"]
+    grow = regress.evaluate(entries, candidate=_edge_rec(wbpa=7000.0))
+    assert not grow["ok"]
+    assert any(f["metric"].endswith(".wire_bytes_per_answer")
+               for f in grow["flagged"])
+    shrink = regress.evaluate(entries, candidate=_edge_rec(wbpa=200.0))
+    assert not shrink["ok"]
+    assert any(f["metric"].endswith(".wire_bytes_per_answer")
+               for f in shrink["flagged"])
+    # an answerless candidate cannot trip the derived gate — it never
+    # derives, and its own headline still gates
+    assert regress.evaluate(entries,
+                            candidate=_edge_rec(wbpa=7000.0,
+                                                answers=0))["ok"]
+    # the thread-per-connection A/B leg is a DECLARED separate series:
+    # its records suffix the methodology and open fresh, never gated
+    # against the edge baseline in either direction
+    legacy = _edge_rec(
+        wbpa=7000.0,
+        methodology="r15_serve_edge_v1+transport=legacy")
+    legacy["transport"] = "legacy"
+    legacy["edge"]["transport"] = "legacy"
+    assert regress.evaluate(entries, candidate=legacy)["ok"]

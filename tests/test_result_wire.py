@@ -320,3 +320,98 @@ def test_result_wire_smoke_components():
     assert r["overflow"] == 0 and r["parity_bad"] == []
     assert r["quantized"] + r["widened"] == len(NAMES) * r["days"]
     assert r["byte_ratio"] > 1.0
+
+
+# --------------------------------------------------------------------------
+# frame layer (ISSUE 20): the HTTP-leg envelope around the packed payload
+# --------------------------------------------------------------------------
+
+
+def test_frame_round_trip_carries_payload_verbatim(rng):
+    """pack_frame -> unpack_frame is lossless: the header reproduces
+    the full geometry + day-range and the payload bytes are the encode
+    buffer VERBATIM (framing is byte shuffling, never a re-encode) —
+    the decoded frame dequantizes identically to the unframed buffer."""
+    x = _block(rng)
+    spec = rw.ResultWireSpec.for_names(NAMES, days=3)
+    buf = _encode(x, spec)
+    frame = rw.pack_frame(buf, n_factors=x.shape[0], days=x.shape[1],
+                          tickers=x.shape[2],
+                          spill_rows=spec.spill_rows, start=5, end=8)
+    assert len(frame) == rw.FRAME_HEADER_BYTES + buf.nbytes
+    meta, payload, nxt = rw.unpack_frame(frame)
+    assert nxt == len(frame)
+    assert meta["version"] == rw.FRAME_VERSION
+    assert (meta["n_factors"], meta["days"], meta["tickers"]) == x.shape
+    assert meta["spill_rows"] == spec.spill_rows
+    assert (meta["start"], meta["end"]) == (5, 8)
+    assert meta["payload_bytes"] == buf.nbytes
+    assert payload.tobytes() == buf.tobytes()
+    out, _ = rw.decode_block(payload, *x.shape, spec.spill_rows)
+    ref, _ = rw.decode_block(buf, *x.shape, spec.spill_rows)
+    assert out.tobytes() == ref.tobytes()
+
+
+def test_iter_frames_yields_a_chunk_sequence_in_order():
+    """A reassembled chunked answer is EXACTLY a frame sequence: each
+    chunk's header carries its own day-range, iter_frames yields them
+    in wire order, and a rangeless intraday frame's -1 survives the
+    signed start/end fields."""
+    f, t, s = 2, 8, 4
+    frames, ranges = b"", [(0, 2), (2, 4), (-1, -1)]
+    for start, end in ranges:
+        d = 2 if start >= 0 else 1
+        payload = np.arange(rw.payload_nbytes(f, d, t, s),
+                            dtype=np.uint8) % 251
+        frames += rw.pack_frame(payload, n_factors=f, days=d,
+                                tickers=t, spill_rows=s, start=start,
+                                end=end)
+    got = list(rw.iter_frames(frames))
+    assert [(m["start"], m["end"]) for m, _ in got] == ranges
+    assert [m["days"] for m, _ in got] == [2, 2, 1]
+    for (m, payload) in got:
+        assert payload.nbytes == rw.payload_nbytes(
+            m["n_factors"], m["days"], m["tickers"], m["spill_rows"])
+
+
+def test_pack_frame_refuses_geometry_payload_mismatch():
+    """The header's geometry IS the length contract: a payload that
+    does not pack to exactly payload_nbytes(geometry) never leaves the
+    server."""
+    f, d, t, s = 2, 2, 8, 4
+    good = np.zeros(rw.payload_nbytes(f, d, t, s), np.uint8)
+    for bad in (good[:-1], np.concatenate([good, good[:4]])):
+        with pytest.raises(ValueError, match="packs to"):
+            rw.pack_frame(bad, n_factors=f, days=d, tickers=t,
+                          spill_rows=s)
+
+
+def test_unpack_frame_rejects_malformed_wire():
+    """The malformed-wire contract the edge robustness tests lean on:
+    bad magic, unknown version, lying payload_len, and truncation (of
+    the header AND of the payload) all raise ValueError rather than
+    yielding a short/garbage buffer to decode_block."""
+    f, d, t, s = 2, 2, 8, 4
+    payload = np.zeros(rw.payload_nbytes(f, d, t, s), np.uint8)
+    frame = rw.pack_frame(payload, n_factors=f, days=d, tickers=t,
+                          spill_rows=s)
+
+    with pytest.raises(ValueError, match="bad result-wire frame magic"):
+        rw.unpack_frame(b"NOPE" + frame[4:])
+    with pytest.raises(ValueError, match="unknown result-wire frame "
+                                         "version"):
+        rw.unpack_frame(frame[:4] + b"\x63\x00" + frame[6:])
+    # header claims a payload_len the geometry cannot pack to
+    lying = bytearray(frame)
+    lying[rw.FRAME_HEADER_BYTES - 4:rw.FRAME_HEADER_BYTES] = \
+        (payload.nbytes + 4).to_bytes(4, "little")
+    with pytest.raises(ValueError, match="frame header claims"):
+        rw.unpack_frame(bytes(lying))
+    # truncated header, then truncated payload
+    with pytest.raises(ValueError, match="truncated result-wire frame"):
+        rw.unpack_frame(frame[:rw.FRAME_HEADER_BYTES - 1])
+    with pytest.raises(ValueError, match="payload wants"):
+        rw.unpack_frame(frame[:-1])
+    # a valid frame followed by trailing garbage is NOT a sequence
+    with pytest.raises(ValueError, match="truncated result-wire frame"):
+        list(rw.iter_frames(frame + b"junk"))

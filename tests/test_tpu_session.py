@@ -525,12 +525,127 @@ def test_stream_intraday_in_default_steps(tpu_session):
     assert "stream_intraday" in src.split("steps = {")[1]
 
 
+def _serve_edge_rec(**over):
+    """A bankable r15 serve edge-leg record (ISSUE 20)."""
+    rec = {"metric": "serve58_1024tickers_qps", "value": 700.0,
+           "methodology": "r15_serve_edge_v1", "transport": "edge",
+           "encoding": "wire",
+           "edge": {"available": True, "transport": "edge",
+                    "wire_answers": 96, "wire_bytes": 137856,
+                    "wire_bytes_per_answer": 1436.0,
+                    "json_bytes_per_answer": 7080.0, "ab_ratio": 4.9,
+                    "http_failures": 0}}
+    edge_over = over.pop("edge", None)
+    rec.update(over)
+    if edge_over is not None:
+        rec["edge"] = (dict(rec["edge"], **edge_over)
+                       if isinstance(edge_over, dict) else edge_over)
+    return rec
+
+
+def test_serve_carry_requires_edge_leg(tpu_session):
+    """ISSUE 20 keep/refuse both ways for the serve window: the
+    two-leg artifact carries; a pre-ISSUE-20 window without the edge
+    leg, an edge leg with zero (or non-int) binary answers, an
+    unavailable edge block, HTTP failures, or a silent legacy
+    fallback re-runs."""
+    inproc = {"methodology": "r8_serve_v1",
+              "hbm": {"available": True},
+              "serve": {"cache_hits": 5},
+              "slo": {"available": True, "frames": 3,
+                      "worst_burn_rate": 0.0}}
+
+    def entry(edge_rec):
+        recs = [dict(inproc)] + ([edge_rec] if edge_rec else [])
+        return {"serve": {"ok": True, "results": recs}}
+
+    good = entry(_serve_edge_rec())
+    assert tpu_session.drop_conv_only_rolling(good) == good
+    assert tpu_session.drop_conv_only_rolling(entry(None)) == {}
+    for bad in (
+            _serve_edge_rec(edge={"wire_answers": 0}),
+            _serve_edge_rec(edge={"wire_answers": "96"}),
+            _serve_edge_rec(edge={"wire_answers": True}),
+            _serve_edge_rec(edge={"available": False}),
+            _serve_edge_rec(edge={"http_failures": 2}),
+            _serve_edge_rec(edge="broken"),
+            _serve_edge_rec(transport="legacy",
+                            methodology="r15_serve_edge_v1"
+                                        "+transport=legacy"),
+            _serve_edge_rec(methodology="r8_serve_v1")):
+        assert tpu_session.drop_conv_only_rolling(entry(bad)) == {}
+
+
+def test_serve_step_runs_both_legs_and_gates_the_edge(
+        tpu_session, monkeypatch):
+    """The serve step is a two-leg window since ISSUE 20: the fake
+    answers per BENCH_SERVE_TRANSPORT so the A/B wiring itself is
+    under test — both legs bank together, an edge leg with zero
+    binary answers flips ok=False, and a failed edge leg is loud."""
+    serve_rec = {"metric": "serve58_1024tickers_qps",
+                 "methodology": "r8_serve_v1",
+                 "hbm": {"available": True},
+                 "serve": {"cache_hits": 5},
+                 "slo": {"available": True, "frames": 3}}
+
+    def make_fake(wire_answers=96, edge_ok=True):
+        def fake_lines(cmd, timeout, env=None):
+            assert cmd[1:] == ["bench.py", "serve"]
+            assert env["BENCH_REQUIRE_TPU"] == "1"
+            assert env["BENCH_SERVE_CLIENTS"] == "1,32"
+            if env["BENCH_SERVE_TRANSPORT"] == "edge":
+                if not edge_ok:
+                    return {"ok": False, "rc": 1, "results": []}
+                return {"ok": True, "rc": 0, "results": [
+                    _serve_edge_rec(
+                        edge={"wire_answers": wire_answers})]}
+            assert env["BENCH_SERVE_TRANSPORT"] == "inproc"
+            return {"ok": True, "rc": 0,
+                    "results": [dict(serve_rec)]}
+        return fake_lines
+
+    monkeypatch.setattr(tpu_session, "_run_json_lines", make_fake())
+    r = tpu_session.step_serve()
+    assert r["ok"] is True
+    assert len(r["results"]) == 2  # the window carries both legs
+
+    monkeypatch.setattr(tpu_session, "_run_json_lines",
+                        make_fake(wire_answers=0))
+    r = tpu_session.step_serve()
+    assert r["ok"] is False and "edge leg" in r["error"]
+
+    monkeypatch.setattr(tpu_session, "_run_json_lines",
+                        make_fake(edge_ok=False))
+    r = tpu_session.step_serve()
+    assert r["ok"] is False and "edge leg failed" in r["error"]
+
+
+def _fleet_edge_rec(**over):
+    """A bankable r15 fleet edge-leg record (ISSUE 20)."""
+    rec = {"metric": "fleet58_1024tickers_qps", "value": 880.0,
+           "methodology": "r15_fleet_edge_v1", "transport": "edge",
+           "encoding": "wire", "live_replicas": 2,
+           "edge": {"available": True, "transport": "edge",
+                    "wire_answers": 96, "wire_bytes": 137856,
+                    "wire_bytes_per_answer": 1436.0,
+                    "json_bytes_per_answer": 7080.0, "ab_ratio": 4.9,
+                    "http_failures": 0, "routed_wire": 98}}
+    edge_over = over.pop("edge", None)
+    rec.update(over)
+    if edge_over is not None:
+        rec["edge"] = (dict(rec["edge"], **edge_over)
+                       if isinstance(edge_over, dict) else edge_over)
+    return rec
+
+
 def test_fleet_carry_requires_multiplied_pod(tpu_session):
     """ISSUE 11: a 'fleet' entry only carries when it is an r11 record
     that actually multiplied the service — >= 2 live replicas, the pod
     hbm block, and the zero-mismatch pod counter fold. A one-replica
     record (single-chip window), a watermark-less record, or a fold
-    mismatch must re-run."""
+    mismatch must re-run. Since ISSUE 20 the window must ALSO carry
+    the pod-edge leg (tested both ways below and in
+    test_fleet_carry_requires_edge_leg)."""
     def entry(hbm=True, pod=True, mismatched=0, slo=True, frames=12,
               **top):
         rec = {"metric": "fleet58_1024tickers_qps", "value": 900.0,
@@ -545,7 +660,8 @@ def test_fleet_carry_requires_multiplied_pod(tpu_session):
         if slo:
             rec["slo"] = {"available": True, "frames": frames,
                           "worst_burn_rate": 0.2, "alerts": 0}
-        return {"fleet": {"ok": True, "results": [rec]}}
+        return {"fleet": {"ok": True,
+                          "results": [rec, _fleet_edge_rec()]}}
 
     good = entry()
     assert tpu_session.drop_conv_only_rolling(good) == good
@@ -562,26 +678,70 @@ def test_fleet_carry_requires_multiplied_pod(tpu_session):
     wrong_series["fleet"]["results"][0]["methodology"] = "r8_serve_v1"
     assert tpu_session.drop_conv_only_rolling(wrong_series) == {}
     # the serve carry rule shares the slo requirement (and is otherwise
-    # untouched by the fleet rule)
+    # untouched by the fleet rule); since ISSUE 20 the serve window
+    # carries its own edge leg
     serve_rec = {"methodology": "r8_serve_v1",
                  "hbm": {"available": True}, "serve": {"cache_hits": 5},
                  "slo": {"available": True, "frames": 3,
                          "worst_burn_rate": 0.0}}
-    serve = {"serve": {"ok": True, "results": [dict(serve_rec)]}}
+    serve = {"serve": {"ok": True,
+                       "results": [dict(serve_rec),
+                                   _serve_edge_rec()]}}
     assert tpu_session.drop_conv_only_rolling(serve) == serve
     unsampled = dict(serve_rec)
     del unsampled["slo"]
     assert tpu_session.drop_conv_only_rolling(
-        {"serve": {"ok": True, "results": [unsampled]}}) == {}
+        {"serve": {"ok": True,
+                   "results": [unsampled, _serve_edge_rec()]}}) == {}
+
+
+def test_fleet_carry_requires_edge_leg(tpu_session):
+    """ISSUE 20 keep/refuse both ways for the fleet window: the good
+    two-leg artifact carries; a window without the edge leg
+    (pre-ISSUE-20), with zero binary answers, with a non-int count,
+    with HTTP failures, with a silent legacy fallback, or whose
+    routed replica hop never carried the wire re-runs."""
+    inproc = {"metric": "fleet58_1024tickers_qps", "value": 900.0,
+              "methodology": "r11_fleet_v1", "live_replicas": 2,
+              "hbm": {"available": True},
+              "pod": {"counter_totals": {"checked": 40,
+                                         "mismatched": 0}},
+              "slo": {"available": True, "frames": 12}}
+
+    def entry(edge_rec):
+        recs = [dict(inproc)] + ([edge_rec] if edge_rec else [])
+        return {"fleet": {"ok": True, "results": recs}}
+
+    good = entry(_fleet_edge_rec())
+    assert tpu_session.drop_conv_only_rolling(good) == good
+    assert tpu_session.drop_conv_only_rolling(entry(None)) == {}
+    for bad in (
+            _fleet_edge_rec(edge={"wire_answers": 0}),
+            _fleet_edge_rec(edge={"wire_answers": "96"}),
+            _fleet_edge_rec(edge={"wire_answers": True}),
+            _fleet_edge_rec(edge={"available": False}),
+            _fleet_edge_rec(edge={"http_failures": 3}),
+            _fleet_edge_rec(edge={"routed_wire": 0}),
+            _fleet_edge_rec(edge="broken"),
+            _fleet_edge_rec(transport="legacy",
+                            methodology="r15_fleet_edge_v1"
+                                        "+transport=legacy"),
+            _fleet_edge_rec(methodology="r15_serve_edge_v1")):
+        assert tpu_session.drop_conv_only_rolling(entry(bad)) == {}
 
 
 def test_fleet_step_refuses_single_replica(tpu_session, monkeypatch):
     """The step flips ok=False when the record never multiplied (one
     live replica — the single-attached-chip case) so the next
-    multi-device window re-runs it; a bankable record passes."""
+    multi-device window re-runs it; a bankable two-leg window passes
+    (since ISSUE 20 the fake answers per BENCH_FLEET_TRANSPORT); a
+    wire-less edge leg cannot bank."""
     def fake_solo(cmd, timeout, env=None):
         assert cmd[1:] == ["bench.py", "fleet"]
         assert env["BENCH_REQUIRE_TPU"] == "1"
+        if env["BENCH_FLEET_TRANSPORT"] == "edge":
+            return {"ok": True, "rc": 0,
+                    "results": [_fleet_edge_rec()]}
         return {"ok": True, "rc": 0, "results": [
             {"metric": "fleet58_1024tickers_qps",
              "methodology": "r11_fleet_v1", "live_replicas": 1,
@@ -593,6 +753,9 @@ def test_fleet_step_refuses_single_replica(tpu_session, monkeypatch):
     assert r["ok"] is False and "cannot bank" in r["error"]
 
     def fake_good(cmd, timeout, env=None):
+        if env["BENCH_FLEET_TRANSPORT"] == "edge":
+            return {"ok": True, "rc": 0,
+                    "results": [_fleet_edge_rec()]}
         return {"ok": True, "rc": 0, "results": [
             {"metric": "fleet58_1024tickers_qps",
              "methodology": "r11_fleet_v1", "live_replicas": 2,
@@ -602,18 +765,35 @@ def test_fleet_step_refuses_single_replica(tpu_session, monkeypatch):
              "slo": {"available": True, "frames": 7,
                      "worst_burn_rate": 0.1, "alerts": 0}}]}
     monkeypatch.setattr(tpu_session, "_run_json_lines", fake_good)
-    assert tpu_session.step_fleet()["ok"] is True
+    r = tpu_session.step_fleet()
+    assert r["ok"] is True
+    assert len(r["results"]) == 2  # the window carries both legs
 
     # ISSUE 16: a record whose pod SLO plane never sampled cannot bank
     def fake_unsampled(cmd, timeout, env=None):
-        rec = fake_good(cmd, timeout, env)["results"][0]
-        rec = dict(rec, slo={"available": True, "frames": 0})
+        r = fake_good(cmd, timeout, env)
+        if env["BENCH_FLEET_TRANSPORT"] == "edge":
+            return r
+        rec = dict(r["results"][0],
+                   slo={"available": True, "frames": 0})
         return {"ok": True, "rc": 0, "results": [rec]}
     monkeypatch.setattr(tpu_session, "_run_json_lines", fake_unsampled)
     r = tpu_session.step_fleet()
     assert r["ok"] is False and "slo" in r["error"]
 
+    # ISSUE 20: a router hop that never carried the wire cannot bank
+    def fake_unrouted(cmd, timeout, env=None):
+        if env["BENCH_FLEET_TRANSPORT"] == "edge":
+            return {"ok": True, "rc": 0, "results": [
+                _fleet_edge_rec(edge={"routed_wire": 0})]}
+        return fake_good(cmd, timeout, env)
+    monkeypatch.setattr(tpu_session, "_run_json_lines", fake_unrouted)
+    r = tpu_session.step_fleet()
+    assert r["ok"] is False and "edge leg" in r["error"]
+
     def fake_cpu(cmd, timeout, env=None):
+        if env["BENCH_FLEET_TRANSPORT"] == "edge":
+            return fake_good(cmd, timeout, env)
         return {"ok": True, "rc": 0, "results": [
             {"metric": "fleet58_1024tickers_qps_cpu_fallback_tunnel_down",
              "methodology": "r11_fleet_v1", "live_replicas": 2,
